@@ -1,0 +1,49 @@
+"""harp-tpu: a TPU-native collective-ML framework with the capabilities of Harp.
+
+Harp (Indiana University's "Map-Collective" framework, reference fork
+imingtsou/Harp) turns Hadoop mappers into long-running iterating workers that
+synchronize through in-memory collectives — allreduce, allgather, broadcast,
+reduce, regroup, rotate, push/pull, barrier — with Harp-DAAL providing native
+C++ compute kernels underneath.  See SURVEY.md for the full layer map.
+
+harp-tpu is NOT a port.  It is the same capability surface re-designed for TPU:
+
+- the worker membership list (``edu.iu.harp.worker.Workers``) becomes a
+  :class:`harp_tpu.parallel.mesh.WorkerMesh` over a ``jax.sharding.Mesh``;
+- the Table/Partition data model (``edu.iu.harp.partition``) becomes sharded
+  arrays/pytrees with combiners mapped to XLA reduction ops
+  (:mod:`harp_tpu.table`);
+- the Netty-socket collectives (``edu.iu.harp.collective`` over
+  ``edu.iu.harp.client``/``.server``) become XLA collectives over ICI/DCN
+  inside ``shard_map`` (:mod:`harp_tpu.parallel.collective`);
+- the dymoro model-rotation pipeline becomes a double-buffered ``ppermute``
+  ring (:mod:`harp_tpu.parallel.rotate`);
+- Intel-DAAL JNI kernels become ``jax.jit`` / Pallas compute in HBM
+  (:mod:`harp_tpu.ops`, :mod:`harp_tpu.models`);
+- the YARN ``CollectiveMapper`` driver becomes a plain host-side Python
+  driver (:mod:`harp_tpu.mapper`).
+"""
+
+from harp_tpu.parallel.mesh import (
+    WorkerMesh,
+    current_mesh,
+    set_mesh,
+    init_distributed,
+)
+from harp_tpu.parallel import collective
+from harp_tpu.parallel.collective import Combiner
+from harp_tpu.table import Table, Partition
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "WorkerMesh",
+    "current_mesh",
+    "set_mesh",
+    "init_distributed",
+    "collective",
+    "Combiner",
+    "Table",
+    "Partition",
+    "__version__",
+]

@@ -1,0 +1,43 @@
+"""Parallel substrate: device mesh, collective verbs, rotation pipeline.
+
+This package is the TPU-native replacement for Harp's L0–L3 communication
+stack (SURVEY.md §2): ``edu.iu.harp.worker`` (membership),
+``edu.iu.harp.io``/``.client``/``.server`` (Netty-socket transport + event
+queue), and ``edu.iu.harp.collective`` (the collective algorithms).  On TPU
+the transport is the ICI/DCN fabric driven by XLA, so all of L1 collapses
+into compiled collective ops and only the *semantics* (the verbs and their
+combiner behavior) survive as API.
+"""
+
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, set_mesh, init_distributed
+from harp_tpu.parallel.collective import (
+    Combiner,
+    allreduce,
+    allgather,
+    broadcast,
+    reduce,
+    regroup,
+    rotate,
+    push,
+    pull,
+    barrier,
+)
+from harp_tpu.parallel.rotate import rotate_pipeline
+
+__all__ = [
+    "WorkerMesh",
+    "current_mesh",
+    "set_mesh",
+    "init_distributed",
+    "Combiner",
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "reduce",
+    "regroup",
+    "rotate",
+    "push",
+    "pull",
+    "barrier",
+    "rotate_pipeline",
+]

@@ -1,0 +1,249 @@
+"""The Harp collective verbs, TPU-native.
+
+Reference parity (SURVEY.md §3.1, §3.6): ``edu.iu.harp.collective`` implements
+allreduce (regroup-allgather and bidirectional-exchange algorithms), bucket
+allgather, chain + MST broadcast, reduce, regroup (all-to-all by partitioner),
+rotate (ring shift), push/pull (``LocalGlobalSyncCollective``), and barrier —
+all as synchronous phases exchanging serialized Table partitions over Netty
+TCP sockets, with a ``PartitionCombiner`` giving each op its reduction
+semantics.
+
+Here every verb lowers to a single XLA collective over ICI/DCN:
+
+==============  =======================================================
+Harp verb       XLA lowering (inside ``shard_map``)
+==============  =======================================================
+allreduce       ``psum`` / ``pmax`` / ``pmin`` / mean  (combiner picks)
+allgather       ``all_gather``
+broadcast       masked ``psum`` from root (chain/MST fan-out is XLA's
+                problem, not user space's)
+reduce          ``psum`` then keep-on-root mask
+regroup         ``all_to_all`` (repartition by partitioner)
+rotate          ``ppermute`` ring shift
+push            ``psum_scatter`` (local deltas → owner shard)
+pull            ``all_gather`` (owner shards → local replica)
+barrier         trivial ``psum``; host-level: ``block_until_ready``
+==============  =======================================================
+
+All verbs are **pytree-polymorphic**: they accept any pytree of arrays, the
+way Harp verbs accept any ``Table``.  They must be called from inside a
+``shard_map`` region (device view) — see ``WorkerMesh.shard_map``.  There is
+no algorithm selection surface (chain vs MST, regroup-allgather vs
+bidirectional exchange): choosing the wire algorithm is XLA's job, informed
+by the physical topology, which is precisely the layer Harp had to hand-roll
+in user space.
+"""
+
+from __future__ import annotations
+
+import enum
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from harp_tpu.parallel.mesh import WORKER_AXIS
+
+
+class Combiner(enum.Enum):
+    """Reduction semantics — Harp's ``PartitionCombiner`` / ``ValCombiner``.
+
+    In Harp a combiner is a class resolving what happens when two partitions
+    with the same ID meet during a collective.  Here it selects the XLA
+    reduction op.
+    """
+
+    ADD = "add"
+    MAX = "max"
+    MIN = "min"
+    AVG = "avg"
+    MULTIPLY = "multiply"
+
+    def reduce_over_axis(self, x, axis: str):
+        if self is Combiner.ADD:
+            return lax.psum(x, axis)
+        if self is Combiner.MAX:
+            return lax.pmax(x, axis)
+        if self is Combiner.MIN:
+            return lax.pmin(x, axis)
+        if self is Combiner.AVG:
+            return lax.pmean(x, axis)
+        if self is Combiner.MULTIPLY:
+            # No pprod primitive: log-space would lose sign; use all_gather+prod.
+            return jnp.prod(lax.all_gather(x, axis), axis=0)
+        raise AssertionError(self)
+
+
+def _as_combiner(op: "Combiner | str") -> Combiner:
+    return op if isinstance(op, Combiner) else Combiner(str(op).lower())
+
+
+# ---------------------------------------------------------------------------
+# The nine verbs (device view — call inside shard_map).
+# ---------------------------------------------------------------------------
+
+def allreduce(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WORKER_AXIS):
+    """All workers end with the combined value — Harp ``allreduce(table)``.
+
+    Harp implements this as regroup+allgather or bidirectional exchange over
+    sockets; on TPU it is one fused ``psum`` riding ICI.
+    """
+    comb = _as_combiner(op)
+    return jax.tree.map(lambda x: comb.reduce_over_axis(x, axis), tree)
+
+
+def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
+    """Concatenate every worker's partitions on all workers — Harp allgather.
+
+    With ``tiled=True`` (default) shards concatenate along their leading dim,
+    matching Harp's "table ends up holding all partitions" semantics; with
+    ``tiled=False`` a new leading worker axis is added.
+    """
+    return jax.tree.map(lambda x: lax.all_gather(x, axis, tiled=tiled), tree)
+
+
+def broadcast(tree: Any, root: int = 0, *, axis: str = WORKER_AXIS):
+    """Every worker receives root's value — Harp chain/MST ``broadcast``."""
+
+    def bcast(x):
+        keep = lax.axis_index(axis) == root
+        y = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        # where (not multiply-by-mask): non-root buffers may hold NaN/inf
+        # garbage that must be discarded, not zero-multiplied into NaN.
+        out = lax.psum(jnp.where(keep, y, jnp.zeros_like(y)), axis)
+        return out.astype(x.dtype)
+
+    return jax.tree.map(bcast, tree)
+
+
+def reduce(tree: Any, op: "Combiner | str" = Combiner.ADD, root: int = 0,
+           *, axis: str = WORKER_AXIS):
+    """Combine onto root; non-root workers get zeros — Harp ``reduce``.
+
+    (Harp leaves non-root tables empty; zeros are the dense analogue.)
+    """
+    comb = _as_combiner(op)
+
+    def red(x):
+        y = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        total = comb.reduce_over_axis(y, axis)
+        keep = lax.axis_index(axis) == root
+        return jnp.where(keep, total, jnp.zeros_like(total)).astype(x.dtype)
+
+    return jax.tree.map(red, tree)
+
+
+def regroup(tree: Any, *, axis: str = WORKER_AXIS, split_dim: int = 0,
+            concat_dim: int | None = None):
+    """Repartition by owner — Harp ``regroup`` (the shuffle equivalent).
+
+    Each worker's leading (``split_dim``) axis must be laid out in
+    destination order: block *j* of the local array is sent to worker *j*
+    (Harp's default ``Partitioner``: ``partition_id % num_workers``).  Lowers
+    to one ``all_to_all``.
+    """
+    cd = split_dim if concat_dim is None else concat_dim
+    return jax.tree.map(
+        lambda x: lax.all_to_all(x, axis, split_axis=split_dim,
+                                 concat_axis=cd, tiled=True),
+        tree,
+    )
+
+
+def rotate(tree: Any, shift: int = 1, *, axis: str = WORKER_AXIS):
+    """Ring-shift partitions to the next worker — Harp ``rotate``.
+
+    The signature Harp primitive (dymoro model rotation, SURVEY.md §3.5):
+    worker *i*'s data goes to worker *(i + shift) % N*.  Lowers to
+    ``ppermute``, the same primitive ring attention is built on.
+    """
+
+    def rot(x):
+        n = lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis, perm)
+
+    return jax.tree.map(rot, tree)
+
+
+def push(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WORKER_AXIS,
+         scatter_dim: int = 0):
+    """Local contributions → combined owner shards — Harp ``push``.
+
+    In Harp, ``LocalGlobalSyncCollective.push`` sends each locally-cached
+    partition of a *global* (distributed) table back to its owner, combining
+    with the owner's copy.  Dense analogue: every worker holds a full-size
+    local contribution; the owner of each row-block receives the combined
+    block.  ``psum_scatter`` does exactly this in one op.
+    """
+    comb = _as_combiner(op)
+
+    def do_push(x):
+        if comb is Combiner.ADD:
+            return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+        if comb is Combiner.AVG:
+            s = lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+            return s / lax.axis_size(axis)
+        # MAX/MIN have no fused reduce-scatter primitive: reduce, then slice
+        # out our own block.
+        total = comb.reduce_over_axis(x, axis)
+        n = lax.axis_size(axis)
+        if total.shape[scatter_dim] % n != 0:
+            raise ValueError(
+                f"push: scatter dimension size {total.shape[scatter_dim]} must "
+                f"be divisible by the worker count {n}"
+            )
+        block = total.shape[scatter_dim] // n
+        idx = lax.axis_index(axis) * block
+        return lax.dynamic_slice_in_dim(total, idx, block, axis=scatter_dim)
+
+    return jax.tree.map(do_push, tree)
+
+
+def pull(tree: Any, *, axis: str = WORKER_AXIS, concat_dim: int = 0):
+    """Owner shards → full local replica — Harp ``pull``.
+
+    ``LocalGlobalSyncCollective.pull`` fetches the rows of the global table a
+    worker needs into its local cache; the dense analogue materializes the
+    whole global table locally via ``all_gather``.  For sparse row-subset
+    pulls, gather rows *after* pulling (XLA keeps it fused) or use
+    :func:`harp_tpu.table.pull_rows`.
+    """
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis, axis=concat_dim, tiled=True), tree
+    )
+
+
+def barrier(*, axis: str = WORKER_AXIS):
+    """Synchronize all workers — Harp ``barrier``.
+
+    Inside a compiled SPMD program workers are already in lockstep, so this
+    is a semantic no-op implemented as a tiny psum (it forces a collective
+    boundary, which is occasionally useful for profiling phase separation).
+    Host-level synchronization is ``jax.block_until_ready`` on any output.
+    """
+    return lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+# ---------------------------------------------------------------------------
+# Host-view wrappers: run ONE verb as a standalone pjit'd program on sharded
+# host arrays.  Apps normally call the device-view verbs inside a larger
+# jitted step (that is the whole point — zero host round-trips in the hot
+# loop); these wrappers exist for interactive use, tests, and the benchmark
+# app (edu.iu.benchmark parity).
+# ---------------------------------------------------------------------------
+
+def host_op(mesh, verb, *, in_dim: int | None = 0, out_dim: int | None = 0,
+            **verb_kwargs):
+    """Compile ``verb`` into a standalone shard_mapped callable.
+
+    ``in_dim`` / ``out_dim`` give the worker-sharded dimension of the
+    input/output (``None`` = replicated), e.g. allreduce is ``(0, None)``
+    per-shard-in, replicated-out.
+    """
+    fn = partial(verb, axis=mesh.axis, **verb_kwargs)
+    in_spec = mesh.spec(in_dim) if in_dim is not None else jax.sharding.PartitionSpec()
+    out_spec = mesh.spec(out_dim) if out_dim is not None else jax.sharding.PartitionSpec()
+    return jax.jit(mesh.shard_map(fn, in_specs=(in_spec,), out_specs=out_spec))

@@ -1,0 +1,92 @@
+"""Model-rotation pipeline: Harp's dymoro, TPU-native.
+
+Reference parity (SURVEY.md §3.1, §4.3): ``edu.iu.dymoro.Rotator`` +
+``Scheduler`` implement Harp's signature optimization — while worker threads
+update the model slice currently resident, the *next* slice is already in
+flight from the ring neighbor, so communication hides behind compute.  A
+timer bounds each compute phase so all workers rotate in lockstep.
+
+TPU-native version: a ``lax.scan`` whose body (a) issues the ``ppermute``
+for the next slice and (b) runs the compute step on the current slice.  The
+two have no data dependency, so XLA overlaps the ICI transfer with compute —
+the same double-buffering dymoro does with threads, now done by the
+compiler's async scheduler.  Lockstep comes free: SPMD programs advance
+together, so the timer-bounded dynamic scheduling is replaced by fixed work
+per step (SURVEY.md §8 "hard parts" — convergence must be validated per
+app, which the app tests do).
+
+This is structurally the ring-attention ppermute pattern; long-context
+sequence parallelism falls out of the same primitive (see
+``harp_tpu.ops.ring_attention`` for the demonstration).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from harp_tpu.parallel.mesh import WORKER_AXIS
+from harp_tpu.parallel.collective import rotate
+
+
+def rotate_pipeline(
+    step_fn: Callable[[Any, Any, Any], Any],
+    carry: Any,
+    model_slice: Any,
+    *,
+    n_steps: int | None = None,
+    shift: int = 1,
+    axis: str = WORKER_AXIS,
+):
+    """Run ``n_steps`` rotation steps of ``carry = step_fn(carry, slice, t)``.
+
+    Each step computes on the resident model slice while the next slice is
+    rotated in from the ring neighbor.  After ``n_steps == num_workers``
+    steps every worker has visited every slice exactly once and each slice
+    is back home — one full Harp "epoch" of model rotation.
+
+    Args:
+      step_fn: ``(carry, model_slice, step_index) -> (carry, model_slice)``;
+        may update the slice (MF-SGD does) — the updated slice is what
+        rotates onward, exactly like Harp rotating the mutated partition.
+      carry: loop state local to the worker (e.g. W factor, rng key, loss).
+      model_slice: this worker's resident slice of the global model (pytree).
+      n_steps: defaults to the ring size (one full revolution).
+      shift: ring direction/stride, as in Harp's rotate.
+
+    Returns:
+      ``(carry, model_slice)`` after the final step's rotation.
+
+    Must be called inside ``shard_map`` (device view).
+    """
+    if n_steps is None:
+        n_steps = lax.axis_size(axis)
+
+    def body(state, t):
+        c, cur = state
+        c, cur = step_fn(c, cur, t)
+        # Rotation of the (possibly updated) slice. With an update-free
+        # step_fn XLA overlaps this transfer with the next iteration's
+        # compute; with updates it is the serialized handoff Harp also has.
+        nxt = rotate(cur, shift=shift, axis=axis)
+        return (c, nxt), None
+
+    (carry, model_slice), _ = lax.scan(
+        body, (carry, model_slice), jnp.arange(n_steps)
+    )
+    return carry, model_slice
+
+
+def resident_slice_index(t, *, shift: int = 1, axis: str = WORKER_AXIS):
+    """Global index of the slice resident on this worker at rotation step t.
+
+    Slices start at their owners (slice *i* on worker *i*) and move ``shift``
+    workers per step, so at step ``t`` worker ``w`` holds slice
+    ``(w - t*shift) mod n``.  Apps use this to select the block of local
+    data that touches the resident slice (MF-SGD: which rating columns;
+    LDA: which vocabulary block).
+    """
+    n = lax.axis_size(axis)
+    return (lax.axis_index(axis) - t * shift) % n

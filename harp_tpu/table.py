@@ -1,0 +1,190 @@
+"""Table / Partition data model, TPU-native.
+
+Reference parity (SURVEY.md §3.1): ``edu.iu.harp.partition`` defines
+``Table`` (map ``partitionID → Partition``), ``PartitionCombiner`` (what
+happens when two partitions with the same ID meet — the reduction
+semantics), and ``Partitioner`` (partition ID → owning worker, default
+``id % numWorkers``); ``edu.iu.harp.keyval`` layers typed KV tables with
+``ValCombiner`` on top.  Underneath, ``edu.iu.harp.resource`` pools
+primitive arrays to avoid GC churn.
+
+TPU-native design (SURVEY.md §8): a model "table" is an array (or pytree)
+with a sharding; the combiner is the reduction op passed to the collective;
+the partitioner is the sharding spec.  The resource pool has no equivalent —
+XLA owns buffers and donation (``jax.jit(..., donate_argnums)``) covers
+reuse.  This module keeps a thin, host-side ``Table`` for apps that want
+Harp-flavored partition bookkeeping (irregular apps: subgraph counting,
+random forest), plus device-side helpers for the KV/combine-by-key pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.collective import Combiner
+from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
+
+
+@dataclasses.dataclass
+class Partition:
+    """One partition: an ID plus its payload array — ``edu.iu.harp.partition.Partition``."""
+
+    id: int
+    data: Any  # np/jnp array (Harp: one resource array or KV struct)
+
+
+def modulo_partitioner(num_workers: int) -> Callable[[int], int]:
+    """Harp's default ``Partitioner``: partition ID → ``id % numWorkers``."""
+
+    def owner(pid: int) -> int:
+        return pid % num_workers
+
+    return owner
+
+
+class Table:
+    """Host-side table of partitions with Harp combiner semantics.
+
+    ``addPartition`` on an existing ID invokes the combiner, exactly like
+    Harp's ``Table.addPartition`` → ``PartitionCombiner.combine``.  Device
+    computation should not iterate a ``Table``; instead :meth:`to_stacked`
+    produces a dense ``[num_partitions, ...]`` array to shard over the mesh,
+    and :meth:`from_stacked` reconstitutes the table after a host sync.
+    """
+
+    def __init__(self, combiner: Combiner | str = Combiner.ADD):
+        self.combiner = combiner if isinstance(combiner, Combiner) else Combiner(combiner)
+        self._parts: dict[int, Any] = {}
+        self._counts: dict[int, int] = {}  # contributions per ID (for AVG)
+
+    # -- Harp Table API -----------------------------------------------------
+    def add_partition(self, pid: int, data: Any) -> None:
+        if pid in self._parts:
+            if self.combiner is Combiner.AVG:
+                # running mean over ALL contributions, matching allreduce(AVG)
+                # and combine_by_key(AVG) — not a pairwise (a+b)/2.
+                n = self._counts[pid]
+                old = np.asarray(self._parts[pid])
+                self._parts[pid] = old + (np.asarray(data) - old) / (n + 1)
+            else:
+                self._parts[pid] = _combine_host(self.combiner, self._parts[pid], data)
+            self._counts[pid] += 1
+        else:
+            self._parts[pid] = data
+            self._counts[pid] = 1
+
+    def get_partition(self, pid: int) -> Any:
+        return self._parts[pid]
+
+    def partition_ids(self) -> list[int]:
+        return sorted(self._parts)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self._parts)
+
+    def __iter__(self) -> Iterator[Partition]:
+        for pid in self.partition_ids():
+            yield Partition(pid, self._parts[pid])
+
+    def __contains__(self, pid: int) -> bool:
+        return pid in self._parts
+
+    # -- device bridge ------------------------------------------------------
+    def to_stacked(self) -> tuple[np.ndarray, np.ndarray]:
+        """Dense ``(ids, stack)`` view: stack[i] is partition ids[i]'s data.
+
+        Partition shapes must match (pad irregular partitions first — the
+        TPU analogue of Harp's fixed-size resource arrays).
+        """
+        if not self._parts:
+            raise ValueError(
+                "Table has no partitions; to_stacked()/shard() need at least "
+                "one (irregular apps should pad empty workers explicitly)"
+            )
+        ids = np.asarray(self.partition_ids(), dtype=np.int32)
+        stack = np.stack([np.asarray(self._parts[i]) for i in ids])
+        return ids, stack
+
+    @classmethod
+    def from_stacked(cls, ids, stack, combiner: Combiner | str = Combiner.ADD) -> "Table":
+        t = cls(combiner)
+        for pid, row in zip(np.asarray(ids).tolist(), np.asarray(stack)):
+            t.add_partition(int(pid), row)
+        return t
+
+    def shard(self, mesh: WorkerMesh):
+        """Place the stacked table on the mesh, partitions split over workers."""
+        ids, stack = self.to_stacked()
+        return mesh.shard_array(ids, 0), mesh.shard_array(stack, 0)
+
+
+def _combine_host(comb: Combiner, a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    if comb is Combiner.ADD:
+        return a + b
+    if comb is Combiner.MAX:
+        return np.maximum(a, b)
+    if comb is Combiner.MIN:
+        return np.minimum(a, b)
+    if comb is Combiner.AVG:
+        return (a + b) / 2
+    if comb is Combiner.MULTIPLY:
+        return a * b
+    raise AssertionError(comb)
+
+
+# ---------------------------------------------------------------------------
+# Device-side KV helpers — edu.iu.harp.keyval equivalent.
+# ---------------------------------------------------------------------------
+
+def combine_by_key(keys, values, num_keys: int, op: Combiner | str = Combiner.ADD):
+    """Combine values sharing a key — the ``ValCombiner`` reduction, on device.
+
+    Harp's KV tables (``Int2IntKVTable`` …) combine colliding values as
+    entries are added; on TPU the idiomatic form is a segment reduction over
+    a dense key space.  ``num_keys`` must be static (pad the key space).
+    """
+    comb = op if isinstance(op, Combiner) else Combiner(op)
+    if comb is Combiner.ADD:
+        return jax.ops.segment_sum(values, keys, num_segments=num_keys)
+    if comb is Combiner.MAX:
+        return jax.ops.segment_max(values, keys, num_segments=num_keys)
+    if comb is Combiner.MIN:
+        return jax.ops.segment_min(values, keys, num_segments=num_keys)
+    if comb is Combiner.AVG:
+        s = jax.ops.segment_sum(values, keys, num_segments=num_keys)
+        n = jax.ops.segment_sum(jnp.ones_like(values), keys, num_segments=num_keys)
+        return s / jnp.maximum(n, 1)
+    if comb is Combiner.MULTIPLY:
+        return jax.ops.segment_prod(values, keys, num_segments=num_keys)
+    raise AssertionError(comb)
+
+
+# ---------------------------------------------------------------------------
+# Sparse push/pull on a row-sharded global table (device view).
+#
+# Harp's LocalGlobalSyncCollective moves only the partitions a worker touches.
+# The dense analogues live in collective.push/pull; these row-indexed forms
+# serve LDA-style "rows I need" access. They materialize the gathered table
+# transiently — fine for model tables that fit HBM; blocked apps (LDA) should
+# prefer rotation, which never materializes the full table.
+# ---------------------------------------------------------------------------
+
+def pull_rows(global_shard, row_ids, *, axis: str = WORKER_AXIS):
+    """Fetch specific rows of a row-sharded global table into local storage."""
+    full = jax.lax.all_gather(global_shard, axis, tiled=True)
+    return jnp.take(full, row_ids, axis=0)
+
+
+def push_rows(global_shard, row_ids, deltas, *, axis: str = WORKER_AXIS):
+    """Scatter-add local row deltas back into the row-sharded global table."""
+    n_total = global_shard.shape[0] * jax.lax.axis_size(axis)
+    dense = jnp.zeros((n_total,) + global_shard.shape[1:], deltas.dtype)
+    dense = dense.at[row_ids].add(deltas)
+    return global_shard + jax.lax.psum_scatter(dense, axis, scatter_dimension=0, tiled=True)

@@ -1,0 +1,33 @@
+"""Test harness: multi-worker simulation on host CPU.
+
+Harp's test story was "pseudo-distributed Hadoop on localhost — real sockets
+over loopback" (SURVEY.md §5).  Our analogue: 8 simulated XLA CPU devices in
+one process, so every collective runs through the real shard_map/collective
+code path with no mocks.  (The axon site config pins JAX_PLATFORMS=axon, so
+the platform override must go through jax.config, before any backend use.)
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from harp_tpu.parallel.mesh import WorkerMesh, set_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh() -> WorkerMesh:
+    m = WorkerMesh()
+    assert m.num_workers == 8, f"expected 8 simulated workers, got {m.num_workers}"
+    set_mesh(m)
+    return m

@@ -1,0 +1,90 @@
+"""Tests for the Table/Partition data model and KV helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.parallel.collective import Combiner
+from harp_tpu.table import (
+    Table,
+    combine_by_key,
+    modulo_partitioner,
+    pull_rows,
+    push_rows,
+)
+
+N = 8
+
+
+def test_table_combiner_on_collision():
+    t = Table(Combiner.ADD)
+    t.add_partition(3, np.ones(4))
+    t.add_partition(3, np.full(4, 2.0))
+    np.testing.assert_allclose(t.get_partition(3), np.full(4, 3.0))
+    assert t.num_partitions == 1
+
+
+def test_table_max_combiner():
+    t = Table("max")
+    t.add_partition(0, np.array([1.0, 5.0]))
+    t.add_partition(0, np.array([3.0, 2.0]))
+    np.testing.assert_allclose(t.get_partition(0), [3.0, 5.0])
+
+
+def test_table_stacked_roundtrip():
+    t = Table()
+    for pid in [4, 1, 9]:
+        t.add_partition(pid, np.full(3, pid, np.float32))
+    ids, stack = t.to_stacked()
+    np.testing.assert_array_equal(ids, [1, 4, 9])
+    t2 = Table.from_stacked(ids, stack)
+    assert t2.partition_ids() == [1, 4, 9]
+    np.testing.assert_allclose(t2.get_partition(9), np.full(3, 9))
+
+
+def test_modulo_partitioner():
+    owner = modulo_partitioner(4)
+    assert [owner(i) for i in range(6)] == [0, 1, 2, 3, 0, 1]
+
+
+def test_combine_by_key_ops():
+    keys = jnp.array([0, 1, 0, 2, 1])
+    vals = jnp.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    np.testing.assert_allclose(combine_by_key(keys, vals, 4), [4, 7, 4, 0])
+    np.testing.assert_allclose(
+        combine_by_key(keys, vals, 4, Combiner.AVG), [2, 3.5, 4, 0]
+    )
+
+
+def test_pull_push_rows(mesh):
+    """Row-indexed pull/pull on a row-sharded global table."""
+    global_table = np.arange(N * 2 * 3, dtype=np.float32).reshape(N * 2, 3)
+
+    def prog(shard):
+        rows = jnp.array([0, 5, 15])
+        pulled = pull_rows(shard, rows)
+        new_shard = push_rows(shard, rows, jnp.ones((3, 3), jnp.float32))
+        return pulled, new_shard
+
+    fn = jax.jit(
+        mesh.shard_map(prog, in_specs=(mesh.spec(0),), out_specs=(P(), mesh.spec(0)))
+    )
+    pulled, updated = fn(global_table)
+    np.testing.assert_allclose(np.asarray(pulled), global_table[[0, 5, 15]])
+    expect = global_table.copy()
+    expect[[0, 5, 15]] += N  # every one of the N workers pushed +1
+    np.testing.assert_allclose(np.asarray(updated), expect)
+
+
+def test_avg_combiner_is_true_mean_over_three():
+    t = Table(Combiner.AVG)
+    for v in (1.0, 2.0, 6.0):
+        t.add_partition(0, np.full(2, v))
+    np.testing.assert_allclose(t.get_partition(0), np.full(2, 3.0))
+
+
+def test_empty_table_stacked_raises():
+    with pytest.raises(ValueError, match="no partitions"):
+        Table().to_stacked()
