@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Primary metric: KMeans iter/sec on the graded config #1 (k=100, 1M×300
+dense, BASELINE.json) on real TPU.  ``vs_baseline`` compares against the
+v0 number recorded in BASELINE.md (measured on this machine's single
+v5e chip, 2026-07-29, commit of first kmeans milestone).
+
+Timing notes (see harp_tpu/utils/timing.py): all iterations run inside one
+jitted fori_loop; sync is a scalar readback, because block_until_ready can
+return early on this machine's relay transport.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+# v0 regression baseline: KMeans 1M×300 k=100 f32, 1× TPU v5e, 2026-07-29.
+BASELINE_KMEANS_ITERS_PER_SEC = 400.0
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    from harp_tpu.models import kmeans as KM
+
+    if smoke:
+        res = KM.benchmark(n=8192, d=32, k=16, iters=20, warmup=2)
+    else:
+        res = KM.benchmark(n=1_000_000, d=300, k=100, iters=100, warmup=5)
+
+    value = res["iters_per_sec"]
+    print(json.dumps({
+        "metric": "kmeans_iters_per_sec_1Mx300_k100" if not smoke else "kmeans_iters_per_sec_smoke",
+        "value": round(value, 2),
+        "unit": "iter/s",
+        "vs_baseline": round(value / BASELINE_KMEANS_ITERS_PER_SEC, 4) if not smoke else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

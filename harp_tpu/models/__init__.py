@@ -1,0 +1,19 @@
+"""ML applications — the Harp L7 capability surface (SURVEY.md §3.4).
+
+Each app mirrors one Harp application family (``ml/java`` pure-Java apps and
+``ml/daal`` Harp-DAAL apps): a jitted step function built on the collective
+verbs, a ``fit``-style host driver, and a CLI launcher replacing
+``hadoop jar harp-<app>.jar edu.iu....Launcher``.
+
+Graded configs (BASELINE.json):
+  kmeans   — KMeans k=100 on 1M×300 dense     (allreduce pattern)
+  mfsgd    — MF-SGD on MovieLens-20M           (rotate pattern)
+  lda      — LDA-CGS 1k topics, enwiki-1M docs (rotate + push/pull)
+  mlp      — neural-net / MLP on MNIST         (gradient allreduce)
+  subgraph — subgraph counting                 (allgather/regroup, irregular)
+  rf       — random forest                     (allgather)
+
+Additional reference apps: ccd (CCD++ MF), svm, wdamds (WDA-MDS/SMACOF),
+and the DAAL classic-stats suite (pca, covariance, moments, naive Bayes,
+linear/ridge regression, QR, SVD, ALS) in :mod:`harp_tpu.models.stats`.
+"""

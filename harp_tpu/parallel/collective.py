@@ -62,6 +62,11 @@ class Combiner(enum.Enum):
     MULTIPLY = "multiply"
 
     def reduce_over_axis(self, x, axis: str):
+        if x.dtype == jnp.bool_:
+            # psum/pmax promote bool; reduce in int32 and restore the dtype so
+            # the verb API has one consistent contract (ADD≡any, MULTIPLY/MIN≡all).
+            out = self.reduce_over_axis(x.astype(jnp.int32), axis)
+            return out.astype(jnp.bool_)
         if self is Combiner.ADD:
             return lax.psum(x, axis)
         if self is Combiner.MAX:

@@ -6,11 +6,16 @@ update the model slice currently resident, the *next* slice is already in
 flight from the ring neighbor, so communication hides behind compute.  A
 timer bounds each compute phase so all workers rotate in lockstep.
 
-TPU-native version: a ``lax.scan`` whose body (a) issues the ``ppermute``
-for the next slice and (b) runs the compute step on the current slice.  The
-two have no data dependency, so XLA overlaps the ICI transfer with compute —
-the same double-buffering dymoro does with threads, now done by the
-compiler's async scheduler.  Lockstep comes free: SPMD programs advance
+TPU-native version: a ``lax.scan`` whose body runs the compute step on the
+resident slice and then issues the ``ppermute``.  Overlap of transfer with
+compute depends on the data flow: for **read-only** step functions XLA's
+async scheduler overlaps the rotation with the next step's compute (the
+dymoro double-buffer, done by the compiler); for **slice-updating** step
+functions (MF-SGD) the rotation consumes the step's output, so the handoff
+serializes — exactly as it does in Harp, where a mutated partition cannot
+leave before the update finishes.  Apps that want overlap with updates
+should split the slice and rotate the half not being written (see
+``harp_tpu.models.mfsgd``).  Lockstep comes free: SPMD programs advance
 together, so the timer-bounded dynamic scheduling is replaced by fixed work
 per step (SURVEY.md §8 "hard parts" — convergence must be validated per
 app, which the app tests do).
@@ -22,6 +27,7 @@ sequence parallelism falls out of the same primitive (see
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable
 
 import jax.numpy as jnp
@@ -42,10 +48,13 @@ def rotate_pipeline(
 ):
     """Run ``n_steps`` rotation steps of ``carry = step_fn(carry, slice, t)``.
 
-    Each step computes on the resident model slice while the next slice is
-    rotated in from the ring neighbor.  After ``n_steps == num_workers``
-    steps every worker has visited every slice exactly once and each slice
-    is back home — one full Harp "epoch" of model rotation.
+    Each step computes on the resident model slice, then rotates it onward.
+    When ``gcd(shift, num_workers) == 1``, ``n_steps == num_workers`` steps
+    visit every slice on every worker exactly once and leave each slice back
+    home — one full Harp "epoch" of model rotation.  A ``shift`` sharing a
+    factor with the ring size cycles through only ``num_workers/gcd`` slices;
+    the default full-revolution mode rejects it rather than silently
+    training on a subset of the model.
 
     Args:
       step_fn: ``(carry, model_slice, step_index) -> (carry, model_slice)``;
@@ -63,6 +72,12 @@ def rotate_pipeline(
     """
     if n_steps is None:
         n_steps = lax.axis_size(axis)
+        if math.gcd(shift % n_steps, n_steps) != 1:
+            raise ValueError(
+                f"shift={shift} shares a factor with the ring size {n_steps}: "
+                f"a full revolution would visit only {n_steps // math.gcd(shift % n_steps, n_steps)} "
+                f"of {n_steps} slices; pass n_steps explicitly if that is intended"
+            )
 
     def body(state, t):
         c, cur = state

@@ -133,7 +133,10 @@ def _combine_host(comb: Combiner, a, b):
     if comb is Combiner.MIN:
         return np.minimum(a, b)
     if comb is Combiner.AVG:
-        return (a + b) / 2
+        raise AssertionError(
+            "AVG is handled by Table.add_partition's running mean; a pairwise "
+            "(a+b)/2 here would disagree with allreduce/combine_by_key AVG"
+        )
     if comb is Combiner.MULTIPLY:
         return a * b
     raise AssertionError(comb)

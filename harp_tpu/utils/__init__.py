@@ -1,0 +1,10 @@
+"""Host-side utilities: timing/sync, metrics, config, checkpointing.
+
+Replaces Harp's L8/aux surface (SURVEY.md §6): log4j iteration logs →
+metrics JSONL; Hadoop Configuration → config dataclasses; app-level HDFS
+model dumps → orbax checkpoints.
+"""
+
+from harp_tpu.utils.timing import device_sync, Timer
+
+__all__ = ["device_sync", "Timer"]

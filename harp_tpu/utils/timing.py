@@ -1,0 +1,49 @@
+"""Reliable device synchronization and iteration timing.
+
+Harp apps timed iterations with wall-clock logs around collective phases
+(SURVEY.md §6 "tracing").  On TPU, timing is only honest after forcing
+device completion; on some transports (the axon relay on this machine)
+``jax.block_until_ready`` can return early, so the portable sync is a
+device→host readback of a scalar.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def device_sync(x: Any) -> float:
+    """Force completion of everything ``x`` depends on; returns a scalar.
+
+    Reduces one leaf to a scalar and reads it back to the host — a readback
+    cannot complete before the producing computation has.  Use this, not
+    ``block_until_ready``, around benchmark timing.
+    """
+    leaf = jax.tree.leaves(x)[0]
+    return float(np.asarray(jnp.ravel(leaf)[0]))
+
+
+class Timer:
+    """Per-iteration timer table, printed like Harp's per-phase logs."""
+
+    def __init__(self):
+        self.records: dict[str, list[float]] = {}
+
+    def time(self, name: str, fn, *args, sync: bool = True, **kw):
+        t0 = time.perf_counter()
+        out = fn(*args, **kw)
+        if sync:
+            device_sync(out)
+        self.records.setdefault(name, []).append(time.perf_counter() - t0)
+        return out
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            k: {"mean_s": float(np.mean(v)), "total_s": float(np.sum(v)), "n": len(v)}
+            for k, v in self.records.items()
+        }

@@ -210,3 +210,18 @@ def test_bool_dtype_preserved(mesh):
     x = np.array([False, True] + [False] * (N - 2))[:, None]
     out = run_spmd(mesh, lambda v: C.broadcast(v, root=1), x, out_dim=None)
     assert np.asarray(out).dtype == np.bool_ and bool(np.asarray(out)[0, 0])
+
+
+def test_allreduce_bool_dtype_preserved(mesh):
+    x = np.array([True] * N)[:, None]
+    out = run_spmd(mesh, lambda v: C.allreduce(v, Combiner.MIN), x, out_dim=None)
+    assert np.asarray(out).dtype == np.bool_ and bool(np.asarray(out)[0, 0])
+
+
+def test_rotate_pipeline_rejects_partial_coverage_shift(mesh):
+    def prog(s):
+        _, final = rotate_pipeline(lambda a, c, t: (a, c), jnp.zeros(()), s, shift=2)
+        return final
+
+    with pytest.raises(ValueError, match="shares a factor"):
+        run_spmd(mesh, prog, np.zeros((N, 1), np.float32))
