@@ -1,0 +1,325 @@
+"""MF-SGD (matrix factorization) — graded config #2: MovieLens-20M, rotate.
+
+Reference parity (SURVEY.md §3.4, §4.3): Harp's ``edu.iu.sgd`` (and DAAL
+variant ``edu.iu.daal_sgd``) factorizes the ratings matrix R ≈ W·Hᵀ with the
+signature model-rotation pattern: each worker owns a user-range of R and W;
+H is split into one slice per worker; slices travel the ring (``rotate``)
+while ``edu.iu.dymoro.Rotator`` prefetches and a timer-bounded
+``DynamicScheduler`` runs Hogwild-style SGD threads on the resident slice.
+
+TPU-native design:
+- Host preprocessing partitions the rating triples into an N×N grid of
+  (user-range, item-slice) blocks, padded to a common size — the TPU
+  analogue of Harp's per-worker rating store (static shapes for XLA).
+- One epoch = ``rotate_pipeline`` over the H slices; at rotation step t a
+  worker trains on the block matching its resident slice
+  (``resident_slice_index``) — every rating is visited exactly once per
+  epoch, just like Harp.
+- Hogwild async updates become deterministic *mini-batched* SGD
+  (SURVEY.md §8 hard parts): a ``lax.scan`` over fixed-size chunks;
+  within a chunk, gradients for duplicate users/items are summed via
+  segment-sum semantics of scatter-add.  Convergence is validated by loss
+  curve, not bitwise (the reference is nondeterministic anyway).
+- The timer-bound lockstep is free: SPMD workers advance together.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, num_workers, worker_id
+from harp_tpu.utils.timing import device_sync
+
+
+@dataclasses.dataclass
+class MFSGDConfig:
+    rank: int = 64
+    lr: float = 0.01
+    reg: float = 0.05  # λ, applied to touched rows only (as SGD does)
+    chunk: int = 4096  # minibatch size inside a block
+
+
+# ---------------------------------------------------------------------------
+# Host preprocessing: triples → N×N padded block grid.
+# ---------------------------------------------------------------------------
+
+def partition_ratings(users, items, vals, n_users, n_items, n_workers, chunk,
+                      n_slices: int | None = None):
+    """Partition rating triples into the (user-range × item-slice) grid.
+
+    ``n_slices`` defaults to ``2 * n_workers`` — two half-slices per worker,
+    which the pipelined epoch needs to overlap rotation with compute.
+
+    Returns per-worker arrays ``u[S, B], i[S, B], v[S, B], mask[S, B]`` with
+    user/item ids **local** to their range/slice, stacked worker-major so
+    dim 0 shards over the mesh (worker w's row is its ``[n_slices, B]``
+    grid).  B is the global max block size rounded up to ``chunk``.
+
+    (Harp stores the same thing as per-worker rating lists keyed by the H
+    partition id; padding replaces the dynamic per-block sizes because XLA
+    needs static shapes.)
+    """
+    users = np.asarray(users)
+    items = np.asarray(items)
+    vals = np.asarray(vals, dtype=np.float32)
+    n = n_workers
+    ns = n_slices if n_slices is not None else 2 * n
+    u_bound = -(-n_users // n)  # users per range (ceil)
+    i_bound = -(-n_items // ns)  # items per slice
+
+    wid = users // u_bound  # owning worker (user range)
+    sid = items // i_bound  # item slice
+
+    # bucket sort triples by (worker, slice)
+    order = np.lexsort((items, sid, wid))
+    users, items, vals, wid, sid = (
+        a[order] for a in (users, items, vals, wid, sid)
+    )
+    counts = np.zeros((n, ns), np.int64)
+    np.add.at(counts, (wid, sid), 1)
+    bmax = int(counts.max())
+    B = max(chunk, -(-bmax // chunk) * chunk)  # pad to chunk multiple
+
+    u = np.zeros((n, ns, B), np.int32)
+    i = np.zeros((n, ns, B), np.int32)
+    v = np.zeros((n, ns, B), np.float32)
+    m = np.zeros((n, ns, B), np.float32)
+    starts = np.zeros((n, ns), np.int64)
+    starts.flat[1:] = counts.cumsum()[:-1]
+    for w in range(n):
+        for s in range(ns):
+            lo, c = starts[w, s], counts[w, s]
+            sl = slice(lo, lo + c)
+            u[w, s, :c] = users[sl] - w * u_bound
+            i[w, s, :c] = items[sl] - s * i_bound
+            v[w, s, :c] = vals[sl]
+            m[w, s, :c] = 1.0
+    return (
+        u.reshape(n * ns, B), i.reshape(n * ns, B),
+        v.reshape(n * ns, B), m.reshape(n * ns, B),
+        u_bound, i_bound,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device compute.
+# ---------------------------------------------------------------------------
+
+def _chunk_update(W, H, batch, cfg: MFSGDConfig):
+    """One deterministic minibatch SGD step on (W, H-slice).
+
+    Gradients of ½Σ m(r − w·h)² + ½λΣ(‖w‖²+‖h‖²) over the chunk; duplicate
+    rows get summed gradients (scatter-add), the batched stand-in for
+    Harp's sequential Hogwild updates.
+    """
+    bu, bi, bv, bm = batch
+    wu = jnp.take(W, bu, axis=0)          # [c, r]
+    hi = jnp.take(H, bi, axis=0)          # [c, r]
+    err = bm * (bv - (wu * hi).sum(-1))   # [c]
+    gw = err[:, None] * hi - cfg.reg * bm[:, None] * wu
+    gh = err[:, None] * wu - cfg.reg * bm[:, None] * hi
+    W = W.at[bu].add(cfg.lr * gw, mode="drop")
+    H = H.at[bi].add(cfg.lr * gh, mode="drop")
+    return W, H, (err * err).sum(), bm.sum()
+
+
+def _block_update(W, H, block, cfg: MFSGDConfig):
+    """Scan minibatch chunks over one (user-range × item-slice) block."""
+    bu, bi, bv, bm = block
+    c = cfg.chunk
+    nchunk = bu.shape[0] // c
+    chunks = jax.tree.map(lambda a: a.reshape(nchunk, c), (bu, bi, bv, bm))
+
+    def body(carry, chunk):
+        W, H, se, cnt = carry
+        W, H, dse, dcnt = _chunk_update(W, H, chunk, cfg)
+        return (W, H, se + dse, cnt + dcnt), None
+
+    (W, H, se, cnt), _ = lax.scan(
+        body, (W, H, jnp.float32(0.0), jnp.float32(0.0)), chunks
+    )
+    return W, H, se, cnt
+
+
+def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
+    """Compile one full rotation epoch (every rating visited exactly once).
+
+    This is the dymoro pipeline done the XLA way (SURVEY.md §4.3): each
+    worker's H slice is **split into two halves** that alternate roles —
+    while the SGD kernel updates one half, the other (updated on the
+    previous step) is in flight to the ring neighbor.  The ``ppermute`` has
+    no data dependency on the current step's compute, so XLA's async
+    scheduler overlaps transfer with compute; a whole-slice rotation would
+    serialize, because a mutated slice cannot leave before its update
+    finishes (the constraint Harp's Rotator also has, which is why dymoro
+    prefetches *next* slices rather than sending current ones).
+
+    Schedule (n workers, 2n half-slices, 2n steps/epoch): at step t worker
+    w computes half ``2*((w - t//2) % n)`` (t even) or
+    ``2*((w - t//2 - 1) % n) + 1`` (t odd); after 2n steps both halves are
+    back home and every (worker, half) pair has met exactly once.
+    """
+    two_n = 2 * mesh.num_workers
+
+    def epoch(W, H_slice, bu, bi, bv, bm):
+        # bu… arrive as this worker's [2n_half_slices, B] block row; the
+        # resident H rows split into an even (front) and odd (back) half.
+        ib2 = H_slice.shape[0] // 2
+        computing, inflight = H_slice[:ib2], H_slice[ib2:]
+
+        def body(carry, t):
+            W, computing, inflight, se, cnt = carry
+            received = C.rotate(inflight)  # overlaps with the update below
+            half_idx = jnp.where(
+                t % 2 == 0,
+                2 * ((worker_id() - t // 2) % num_workers()),
+                2 * ((worker_id() - t // 2 - 1) % num_workers()) + 1,
+            )
+            block = jax.tree.map(
+                lambda a: a[half_idx], (bu, bi, bv, bm)
+            )
+            W, computing, dse, dcnt = _block_update(W, computing, block, cfg)
+            return (W, received, computing, se + dse, cnt + dcnt), None
+
+        (W, computing, inflight, se, cnt), _ = lax.scan(
+            body,
+            (W, computing, inflight, jnp.float32(0.0), jnp.float32(0.0)),
+            jnp.arange(two_n),
+        )
+        # After 2n steps the even half sits in `computing`, odd in `inflight`,
+        # both back on their home worker.
+        H_slice = jnp.concatenate([computing, inflight], axis=0)
+        # loss partials are per-worker; combine before leaving SPMD (the
+        # optional end-of-epoch allreduce-RMSE in Harp's MF-SGD loop)
+        se, cnt = C.allreduce((se, cnt))
+        return W, H_slice, se, cnt
+
+    return jax.jit(
+        mesh.shard_map(
+            epoch,
+            in_specs=(mesh.spec(0),) * 6,
+            out_specs=(mesh.spec(0), mesh.spec(0), P(), P()),
+        )
+    )
+
+
+class MFSGD:
+    """Host driver (the ``mapCollective`` residue for edu.iu.sgd)."""
+
+    def __init__(self, n_users, n_items, cfg: MFSGDConfig | None = None,
+                 mesh: WorkerMesh | None = None, seed=0):
+        self.mesh = mesh or current_mesh()
+        self.cfg = cfg or MFSGDConfig()
+        self.n_users, self.n_items = n_users, n_items
+        n = self.mesh.num_workers
+        self.u_bound = -(-n_users // n)
+        # two half-slices per worker (pipelined rotation) → per-worker H rows
+        self.i_bound = 2 * (-(-n_items // (2 * n)))
+        k1, k2 = jax.random.split(jax.random.key(seed))
+        scale = 1.0 / np.sqrt(self.cfg.rank)
+        self.W = self.mesh.shard_array(
+            np.asarray(jax.random.uniform(k1, (self.u_bound * n, self.cfg.rank),
+                                          jnp.float32, 0, scale)), 0)
+        self.H = self.mesh.shard_array(
+            np.asarray(jax.random.uniform(k2, (self.i_bound * n, self.cfg.rank),
+                                          jnp.float32, 0, scale)), 0)
+        self._epoch_fn = make_epoch_fn(self.mesh, self.cfg)
+        self._blocks = None
+
+    def set_ratings(self, users, items, vals):
+        n = self.mesh.num_workers
+        bu, bi, bv, bm, ub, ib2 = partition_ratings(
+            users, items, vals, self.n_users, self.n_items, n, self.cfg.chunk
+        )
+        assert (ub, 2 * ib2) == (self.u_bound, self.i_bound)
+        self._blocks = tuple(self.mesh.shard_array(a, 0) for a in (bu, bi, bv, bm))
+        self.nnz = len(np.asarray(vals))
+
+    def train_epoch(self):
+        """One rotation epoch; returns training RMSE over visited ratings."""
+        if self._blocks is None:
+            raise RuntimeError("call set_ratings() before train_epoch()")
+        self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H, *self._blocks)
+        return float(np.sqrt(max(device_sync(se), 0.0) / max(device_sync(cnt), 1.0)))
+
+    def factors(self):
+        return np.asarray(self.W)[: self.n_users], np.asarray(self.H)[: self.n_items]
+
+    def predict_rmse(self, users, items, vals):
+        W, H = self.factors()
+        pred = (W[np.asarray(users)] * H[np.asarray(items)]).sum(-1)
+        return float(np.sqrt(np.mean((pred - np.asarray(vals)) ** 2)))
+
+
+# ---------------------------------------------------------------------------
+# Synthetic MovieLens-20M-shaped data + benchmark.
+# ---------------------------------------------------------------------------
+
+def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
+    """Low-rank ground truth + noise, uniform random (u, i) pairs."""
+    rng = np.random.default_rng(seed)
+    Wt = rng.normal(size=(n_users, rank)) / np.sqrt(rank)
+    Ht = rng.normal(size=(n_items, rank)) / np.sqrt(rank)
+    u = rng.integers(0, n_users, nnz)
+    i = rng.integers(0, n_items, nnz)
+    v = (Wt[u] * Ht[i]).sum(-1) + noise * rng.normal(size=nnz)
+    return u.astype(np.int32), i.astype(np.int32), v.astype(np.float32)
+
+
+def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
+              epochs=3, mesh=None, seed=0, chunk=8192):
+    """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
+
+    One 'update' = one rating visit (one (w_u, h_i) SGD update pair),
+    matching Harp-DAAL's MF-SGD throughput accounting.
+    """
+    mesh = mesh or current_mesh()
+    cfg = MFSGDConfig(rank=rank, chunk=chunk)
+    model = MFSGD(n_users, n_items, cfg, mesh, seed)
+    u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
+    t0 = time.perf_counter()
+    model.set_ratings(u, i, v)
+    prep = time.perf_counter() - t0
+
+    rmse0 = model.train_epoch()  # warmup (includes compile)
+    t0 = time.perf_counter()
+    rmse = 0.0
+    for _ in range(epochs):
+        rmse = model.train_epoch()
+    dt = time.perf_counter() - t0
+    ups = nnz * epochs / dt / mesh.num_workers
+    return {
+        "updates_per_sec_per_chip": ups,
+        "sec_per_epoch": dt / epochs,
+        "rmse_first_epoch": rmse0,
+        "rmse_final": rmse,
+        "prep_sec": prep,
+        "nnz": nnz, "rank": rank, "num_workers": mesh.num_workers,
+    }
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="harp-tpu MF-SGD (edu.iu.sgd parity)")
+    p.add_argument("--users", type=int, default=138_493)
+    p.add_argument("--items", type=int, default=26_744)
+    p.add_argument("--nnz", type=int, default=20_000_000)
+    p.add_argument("--rank", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--chunk", type=int, default=8192)
+    args = p.parse_args(argv)
+    print(benchmark(args.users, args.items, args.nnz, args.rank, args.epochs,
+                    chunk=args.chunk))
+
+
+if __name__ == "__main__":
+    main()
