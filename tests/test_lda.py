@@ -1,0 +1,69 @@
+"""LDA-CGS tests: count invariants, likelihood ascent, topic recovery."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.models import lda as L
+
+N = 8
+
+
+@pytest.fixture
+def small_model(mesh):
+    """Fresh model per test: shared state would make assertions depend on
+    test execution order."""
+    cfg = L.LDAConfig(n_topics=8, chunk=64, alpha=0.5, beta=0.1)
+    d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                              tokens_per_doc=50, seed=0)
+    model = L.LDA(96, 64, cfg, mesh, seed=1)
+    model.set_tokens(d, w)
+    return model, d, w
+
+
+def counts_consistent(model):
+    Ndk = np.asarray(model.Ndk)
+    Nwk = np.asarray(model.Nwk)
+    Nk = np.asarray(model.Nk)
+    assert Ndk.sum() == model.n_tokens
+    assert Nwk.sum() == model.n_tokens
+    np.testing.assert_allclose(Nwk.sum(0), Nk)
+    np.testing.assert_allclose(Ndk.sum(1).max(), 50)  # tokens per doc
+    assert (Ndk >= 0).all() and (Nwk >= 0).all() and (Nk >= 0).all()
+
+
+def test_initial_counts_consistent(small_model):
+    counts_consistent(small_model[0])
+
+
+def test_counts_invariant_after_epochs(small_model):
+    model, _, _ = small_model
+    for _ in range(2):
+        model.sample_epoch()
+    counts_consistent(model)
+
+
+def test_likelihood_improves(small_model):
+    model, _, _ = small_model
+    ll0 = model.log_likelihood()
+    for _ in range(10):
+        model.sample_epoch()
+    ll1 = model.log_likelihood()
+    assert ll1 > ll0 + 0.1, (ll0, ll1)
+
+
+def test_topic_recovery(small_model):
+    """Vocab bands are disjoint per true topic: learned word-topic rows
+    should become concentrated (low entropy vs uniform init)."""
+    model, _, _ = small_model
+    for _ in range(5):
+        model.sample_epoch()
+    Nwk = np.asarray(model.Nwk)[: model.vocab_size]
+    p = (Nwk + 1e-9) / (Nwk.sum(1, keepdims=True) + 1e-6)
+    ent = -(p * np.log(p + 1e-12)).sum(1).mean()
+    assert ent < 0.7 * np.log(model.cfg.n_topics)
+
+
+def test_sample_before_set_raises(mesh):
+    model = L.LDA(16, 16, L.LDAConfig(n_topics=4, chunk=16), mesh)
+    with pytest.raises(RuntimeError, match="set_tokens"):
+        model.sample_epoch()
