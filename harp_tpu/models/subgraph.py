@@ -1,0 +1,319 @@
+"""Subgraph counting via color-coding — graded config #5a (irregular).
+
+Reference parity (SURVEY.md §3.4): Harp's ``edu.iu.subgraph`` (and
+``edu.iu.daal_subgraph``) counts tree-shaped templates (u3-1, u5-x, u7-x …)
+in a large graph with the color-coding dynamic program: randomly color
+vertices with s colors (s = template size), count *colorful* embeddings
+(all colors distinct) by DP over a rooted decomposition of the template,
+then unbias by the colorfulness probability ``s!/sˢ``.  Harp parallelizes
+by vertex partition and exchanges per-vertex count tables with
+``allgather``/``regroup`` each DP level — the "irregular" workload.
+
+TPU-native design: the per-vertex count table is a **dense [n, 2ˢ]
+array** (subset-indexed by color-set bitmask), so each DP level becomes
+
+  ``counts_t[v, S] = Σ_{S₁⊎S₂=S} counts_{t₁}[v, S₁] · (A @ counts_{t₂})[v, S₂]``
+
+— a sparse-neighbor aggregation (padded-CSR gather + mask, vectorized over
+all 2ˢ subsets at once) followed by a subset-convolution step restricted to
+the subset sizes that actually occur (template sizes are ≤ 7, so 2ˢ ≤ 128
+columns).  The distributed step is one ``allgather`` of the partner count
+table per DP level, matching Harp's communication pattern verb-for-verb.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils.timing import device_sync
+
+
+# ---------------------------------------------------------------------------
+# Templates: rooted trees given as parent lists; decomposition into
+# (root-keeps-child-subtree) partial templates, exactly the color-coding DP.
+# ---------------------------------------------------------------------------
+
+TEMPLATES = {
+    # name: parent list (parent[i] < i, parent[0] = -1 root)
+    "u3-path": [-1, 0, 1],          # path on 3 vertices
+    "u3-star": [-1, 0, 0],          # star (same graph, different rooting)
+    "u5-path": [-1, 0, 1, 2, 3],
+    "u5-star": [-1, 0, 0, 0, 0],
+    "u5-tree": [-1, 0, 0, 1, 1],    # balanced binary-ish tree
+    "u7-tree": [-1, 0, 0, 1, 1, 2, 2],
+}
+
+
+def template_size(tpl) -> int:
+    return len(tpl)
+
+
+def _children(tpl):
+    ch = [[] for _ in tpl]
+    for i, p in enumerate(tpl):
+        if p >= 0:
+            ch[p].append(i)
+    return ch
+
+
+def _subtree_sizes(tpl):
+    ch = _children(tpl)
+    size = [1] * len(tpl)
+    for i in reversed(range(len(tpl))):
+        for c in ch[i]:
+            size[i] += size[c]
+    return size
+
+
+_FN_CACHE: dict = {}
+
+
+def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
+    """Compile the color-coding DP: (nbr, msk, colors) → colorful rooted count.
+
+    Counts maps φ: template→graph with all image colors distinct (hence
+    injective), rooted at template vertex 0 — the quantity Harp's DP
+    levels accumulate before unbiasing.  Compiled fns are cached per
+    (template, colors, mesh).
+    """
+    # key on the underlying jax Mesh (hashable, identity-stable), not the
+    # WorkerMesh wrapper, whose id could be reused after collection
+    cache_key = (tuple(tpl), k, mesh.mesh)
+    if cache_key in _FN_CACHE:
+        return _FN_CACHE[cache_key]
+    s = template_size(tpl)
+    ch = _children(tpl)
+    sizes = _subtree_sizes(tpl)
+    combos = _dp_subset_tables(tpl, k)
+    n_subsets = 1 << k
+
+    def spmv_gather(full_counts, nbr, msk):
+        # Σ_{u∈N(v)} counts[u, :] with padded CSR  [n_loc, S]
+        g = jnp.take(full_counts, nbr, axis=0)      # [n_loc, deg, S]
+        return (g * msk[:, :, None]).sum(1)
+
+    def prog(nbr, msk, colors_shard):
+        base = jnp.zeros((colors_shard.shape[0], n_subsets), jnp.float32)
+        singleton = base.at[
+            jnp.arange(colors_shard.shape[0]), 1 << colors_shard
+        ].set(1.0)
+
+        # post-order DP: table[i] = counts for subtree rooted at i
+        tables = [None] * len(tpl)
+        for i in reversed(range(len(tpl))):
+            acc = singleton  # root-of-subtree alone
+            acc_size = 1
+            for c in ch[i]:
+                # partner table: child subtree aggregated over neighbors
+                child_full = C.allgather(tables[c])  # Harp allgather step
+                nbr_counts = spmv_gather(child_full, nbr, msk)
+                triples = combos(acc_size, sizes[c])
+                S = jnp.asarray([t[0] for t in triples], jnp.int32)
+                S1 = jnp.asarray([t[1] for t in triples], jnp.int32)
+                S2 = jnp.asarray([t[2] for t in triples], jnp.int32)
+                contrib = acc[:, S1] * nbr_counts[:, S2]  # [n_loc, T]
+                acc = jnp.zeros_like(acc).at[:, S].add(contrib)
+                acc_size += sizes[c]
+            tables[i] = acc
+
+        if k == s:
+            rooted = tables[0][:, (1 << k) - 1]
+        else:
+            full_cols = [m for m in range(n_subsets) if bin(m).count("1") == s]
+            rooted = tables[0][:, jnp.asarray(full_cols)].sum(-1)
+        return C.allreduce(rooted.sum())
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0),) * 3, out_specs=P()
+    ))
+    _FN_CACHE[cache_key] = fn
+    return fn
+
+
+@dataclasses.dataclass
+class SubgraphConfig:
+    template: str = "u5-tree"
+    n_colors: int = 0        # 0 → template size (standard color-coding)
+    n_trials: int = 1        # average over colorings (variance reduction)
+    max_degree: int = 64     # padded-CSR width
+    seed: int = 0
+
+
+def pad_csr(edges, n_vertices, max_degree):
+    """Edge list → padded neighbor table [n, max_degree] + mask (vectorized).
+
+    Degrees above ``max_degree`` are truncated with a dropped count returned
+    (Harp's irregular memory reuse becomes a static-shape pad on TPU).
+    """
+    e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    src = np.concatenate([e[:, 0], e[:, 1]])
+    dst = np.concatenate([e[:, 1], e[:, 0]])
+    order = np.argsort(src, kind="stable")
+    src, dst = src[order], dst[order]
+    # position of each entry within its source-vertex run
+    starts = np.searchsorted(src, np.arange(n_vertices))
+    pos = np.arange(len(src)) - starts[src]
+    keep = pos < max_degree
+    nbr = np.zeros((n_vertices, max_degree), np.int32)
+    msk = np.zeros((n_vertices, max_degree), np.float32)
+    nbr[src[keep], pos[keep]] = dst[keep]
+    msk[src[keep], pos[keep]] = 1.0
+    return nbr, msk, int((~keep).sum())
+
+
+def _dp_subset_tables(tpl, n_colors):
+    """Static DP plan: for each template vertex i (post-order), the list of
+    (S, S1, S2) bitmask triples combining the partial at i with a child
+    subtree, restricted to |S| == accumulated size.  Returns per-combine
+    dense index arrays for a one-hot 'subset convolution' on device."""
+    s = n_colors
+    masks = list(range(1 << s))
+    popcnt = [bin(m).count("1") for m in masks]
+
+    def combos(sz1, sz2):
+        out = []
+        for S1 in masks:
+            if popcnt[S1] != sz1:
+                continue
+            for S2 in masks:
+                if popcnt[S2] != sz2 or (S1 & S2):
+                    continue
+                out.append((S1 | S2, S1, S2))
+        return out
+
+    return combos
+
+
+def count_template(edges, n_vertices, cfg: SubgraphConfig,
+                   mesh: WorkerMesh | None = None):
+    """Estimate the number of (unrooted) embeddings of the template.
+
+    Returns ``(estimate, per_trial_estimates, dropped_edges)`` —
+    ``dropped_edges`` counts adjacency entries truncated by
+    ``cfg.max_degree`` (a nonzero value biases the estimate low).  The
+    estimate is the colorful rooted count divided by the colorfulness
+    probability and by |Aut(template)| (the rooted DP counts each unrooted
+    embedding once per automorphism).
+    """
+    tpl = TEMPLATES[cfg.template] if isinstance(cfg.template, str) else cfg.template
+    s = template_size(tpl)
+    k = cfg.n_colors or s
+    if k < s:
+        raise ValueError(
+            f"n_colors={k} must be >= template size {s} for color-coding")
+    mesh = mesh or current_mesh()
+    nw = mesh.num_workers
+    n_pad = -(-n_vertices // nw) * nw
+
+    nbr, msk, dropped = pad_csr(edges, n_vertices, cfg.max_degree)
+    if n_pad > n_vertices:
+        nbr = np.concatenate([nbr, np.zeros((n_pad - n_vertices, cfg.max_degree), np.int32)])
+        msk = np.concatenate([msk, np.zeros((n_pad - n_vertices, cfg.max_degree), np.float32)])
+
+    nbr_d = mesh.shard_array(nbr, 0)
+    msk_d = mesh.shard_array(msk, 0)
+    fn = make_colorful_count_fn(tpl, k, mesh)
+
+    rng = np.random.default_rng(cfg.seed)
+    estimates = []
+    p_colorful = math.factorial(s) / (s ** s) if k == s else (
+        math.factorial(k) / (math.factorial(k - s) * k ** s))
+    n_auto = _count_automorphism_roots(tpl)
+    for _ in range(cfg.n_trials):
+        colors = rng.integers(0, k, n_pad).astype(np.int32)
+        out = fn(nbr_d, msk_d, mesh.shard_array(colors, 0))
+        colorful_rooted = float(device_sync(out))
+        estimates.append(colorful_rooted / p_colorful / n_auto)
+    return float(np.mean(estimates)), estimates, dropped
+
+
+def _count_automorphism_roots(tpl):
+    """Number of automorphisms of the template tree (each unrooted colorful
+    embedding is counted once per automorphism by the rooted DP)."""
+    ch = _children(tpl)
+
+    def canon(i):
+        return "(" + "".join(sorted(canon(c) for c in ch[i])) + ")"
+
+    def autos(i):
+        subs = [canon(c) for c in ch[i]]
+        a = 1
+        for c in ch[i]:
+            a *= autos(c)
+        from collections import Counter
+
+        for cnt in Counter(subs).values():
+            a *= math.factorial(cnt)
+        return a
+
+    # rooted automorphisms of the tree as rooted at 0, times the number of
+    # vertices whose rooted canonical form equals the root's (root orbit)
+    root_form = canon(0)
+    # re-root at each vertex to find the root orbit size
+    orbit = 0
+    n = len(tpl)
+    adj = [[] for _ in range(n)]
+    for i, p in enumerate(tpl):
+        if p >= 0:
+            adj[i].append(p)
+            adj[p].append(i)
+
+    def canon_rerooted(v, parent):
+        return "(" + "".join(
+            sorted(canon_rerooted(u, v) for u in adj[v] if u != parent)
+        ) + ")"
+
+    for v in range(n):
+        if canon_rerooted(v, -1) == root_form:
+            orbit += 1
+    return autos(0) * orbit
+
+
+def benchmark(n_vertices=100_000, avg_degree=16, template="u5-tree",
+              mesh=None, seed=0, max_degree=64):
+    """Vertices/sec through one color-coding trial (graded config #5a)."""
+    rng = np.random.default_rng(seed)
+    n_edges = n_vertices * avg_degree // 2
+    edges = np.stack([
+        rng.integers(0, n_vertices, n_edges),
+        rng.integers(0, n_vertices, n_edges),
+    ], 1)
+    cfg = SubgraphConfig(template=template, seed=seed, max_degree=max_degree)
+    count_template(edges, n_vertices, cfg, mesh)  # warmup: compile + CSR
+    t0 = time.perf_counter()
+    est, trials, dropped = count_template(edges, n_vertices, cfg, mesh)
+    dt = time.perf_counter() - t0
+    return {
+        "vertices_per_sec": n_vertices / dt,
+        "estimate": est,
+        "sec_per_trial": dt,
+        "dropped_edges": dropped,
+        "template": template,
+        "n_vertices": n_vertices,
+    }
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="harp-tpu subgraph counting (edu.iu.subgraph parity)")
+    p.add_argument("--vertices", type=int, default=100_000)
+    p.add_argument("--avg-degree", type=int, default=16)
+    p.add_argument("--template", default="u5-tree", choices=sorted(TEMPLATES))
+    p.add_argument("--max-degree", type=int, default=64)
+    args = p.parse_args(argv)
+    print(benchmark(args.vertices, args.avg_degree, args.template,
+                    max_degree=args.max_degree))
+
+
+if __name__ == "__main__":
+    main()
