@@ -1,0 +1,78 @@
+"""Collective micro-benchmarks — ``edu.iu.benchmark`` parity.
+
+The reference app sweeps message sizes through bcast/reduce/allgather/
+allreduce and prints per-size timings (SURVEY.md §3.4, §5).  Here every verb
+is a standalone jitted shard_map program (``collective.host_op``); sizes
+sweep powers of two; output is one line per (verb, size) with achieved
+GB/s and latency — run it to see what the ICI/DCN fabric actually delivers,
+the way the reference app characterized its socket fan-outs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils.timing import device_sync
+
+VERBS = {
+    # name: (fn, kwargs, out_dim, bytes_on_wire_factor(nw))
+    "allreduce": (C.allreduce, {}, None, lambda nw: 2.0),
+    "allgather": (C.allgather, {}, None, lambda nw: 1.0),
+    "broadcast": (C.broadcast, {}, None, lambda nw: 1.0),
+    "reduce": (C.reduce, {}, 0, lambda nw: 1.0),
+    "regroup": (C.regroup, {}, 0, lambda nw: 1.0),
+    "rotate": (C.rotate, {}, 0, lambda nw: 1.0),
+    "push": (C.push, {}, 0, lambda nw: 1.0),
+    "pull": (C.pull, {}, None, lambda nw: 1.0),
+}
+
+
+def bench_verb(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
+    fn, kwargs, out_dim, wire = VERBS[name]
+    nw = mesh.num_workers
+    # regroup (all_to_all) and push (psum_scatter) additionally split each
+    # worker's shard by nw, so rows must be a multiple of nw²
+    mult = nw * nw if name in ("regroup", "push") else nw
+    n_rows = max(mult, size_bytes // (4 * 128) // mult * mult)
+    x = np.random.default_rng(0).normal(size=(n_rows, 128)).astype(np.float32)
+    op = C.host_op(mesh, fn, in_dim=0, out_dim=out_dim, **kwargs)
+    out = op(x)
+    device_sync(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = op(x)
+    device_sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    payload = x.nbytes * wire(nw)
+    return {"verb": name, "bytes": x.nbytes, "sec": dt,
+            "gb_per_sec": payload / dt / 1e9, "num_workers": nw}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="harp-tpu collective micro-benchmarks")
+    p.add_argument("--verbs", nargs="*", default=sorted(VERBS))
+    p.add_argument("--min-kb", type=int, default=64)
+    p.add_argument("--max-mb", type=int, default=64)
+    p.add_argument("--reps", type=int, default=20)
+    args = p.parse_args(argv)
+    mesh = current_mesh()
+    size = args.min_kb * 1024
+    sizes = []
+    while size <= args.max_mb * 1024 * 1024:
+        sizes.append(size)
+        size *= 4
+    for verb in args.verbs:
+        for s in sizes:
+            print(json.dumps(bench_verb(verb, mesh, s, args.reps)))
+
+
+if __name__ == "__main__":
+    main()

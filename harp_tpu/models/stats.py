@@ -1,0 +1,332 @@
+"""Classic analytics suite — Harp-DAAL's map + reduce algorithms.
+
+Reference parity (SURVEY.md §3.4): the ``ml/daal`` apps ``edu.iu.daal_pca``,
+``daal_cov``, ``daal_mom``, ``daal_naive``, ``daal_linreg``, ``daal_ridgereg``,
+``daal_qr``, ``daal_svd``, ``daal_als``: each worker computes a DAAL partial
+result on its HDFS shard, partials are combined to master with Harp
+``reduce``/``allreduce``/``allgather``, and the master finalizes.
+
+TPU-native design: every algorithm is "local sufficient statistics →
+``allreduce`` → closed-form finalize", jitted end-to-end.  The sufficient
+statistics are all matmul-shaped (Gram matrices, moment sums), so the MXU
+does the heavy lifting and the collective moves O(d²) — exactly why the
+map-reduce formulation scales.  Distributed QR/SVD use the TSQR trick:
+local QR, allgather the small R factors, QR again (communication-optimal
+tall-skinny factorization).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+
+
+def _spmd(mesh, fn, n_in=1, out_spec=None):
+    return jax.jit(mesh.shard_map(
+        fn, in_specs=(mesh.spec(0),) * n_in,
+        out_specs=out_spec if out_spec is not None else P(),
+    ))
+
+
+def _shard_rows(mesh, *arrays):
+    """Pad row-aligned arrays to a worker multiple and shard them.
+
+    Returns ``(*sharded_arrays, sharded_weights)`` where weights are 1 for
+    real rows, 0 for padding — the one shared pad+shard idiom every
+    row-parallel algorithm here uses (svm/naive-bayes included).
+    """
+    arrays = [np.asarray(a) for a in arrays]
+    nw = mesh.num_workers
+    n = arrays[0].shape[0]
+    n_pad = -(-n // nw) * nw
+    w = np.ones(n, np.float32)
+    out = []
+    for a in arrays:
+        a = a.astype(np.float32) if a.dtype.kind == "f" else a
+        if n_pad > n:
+            a = np.concatenate([a, np.zeros((n_pad - n,) + a.shape[1:], a.dtype)])
+        out.append(mesh.shard_array(a, 0))
+    if n_pad > n:
+        w = np.concatenate([w, np.zeros(n_pad - n, np.float32)])
+    out.append(mesh.shard_array(w, 0))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Moments & covariance (edu.iu.daal_mom, edu.iu.daal_cov)
+# ---------------------------------------------------------------------------
+
+def moments(x, mesh: WorkerMesh | None = None):
+    """Low-order moments per feature: min/max/sum/mean/variance/std."""
+    mesh = mesh or current_mesh()
+    xd, wd = _shard_rows(mesh, x)
+
+    def prog(x, w):
+        big = jnp.float32(3.4e38)
+        masked_min = jnp.where(w[:, None] > 0, x, big).min(0)
+        masked_max = jnp.where(w[:, None] > 0, x, -big).max(0)
+        stats = {
+            "n": C.allreduce(w.sum()),
+            "sum": C.allreduce((x * w[:, None]).sum(0)),
+            "min": C.allreduce(masked_min, C.Combiner.MIN),
+            "max": C.allreduce(masked_max, C.Combiner.MAX),
+        }
+        mean = stats["sum"] / stats["n"]
+        # centered second pass: E[x²]−mean² cancels catastrophically in f32
+        # when |mean| ≫ std; one extra allreduce buys exactness
+        cx = (x - mean[None, :]) * w[:, None]
+        stats["centered_sum2"] = C.allreduce((cx * cx).sum(0))
+        stats["mean"] = mean
+        stats["variance"] = jnp.maximum(stats["centered_sum2"] / stats["n"], 0)
+        stats["std"] = jnp.sqrt(stats["variance"])
+        return stats
+
+    return {k: np.asarray(v) for k, v in _spmd(mesh, prog, 2)(xd, wd).items()}
+
+
+def covariance(x, mesh: WorkerMesh | None = None):
+    """Covariance matrix (and mean) via one allreduce of (n, Σx, ΣxxT)."""
+    mesh = mesh or current_mesh()
+    xd, wd = _shard_rows(mesh, x)
+
+    def prog(x, w):
+        xw = x * w[:, None]
+        n, s = C.allreduce((w.sum(), xw.sum(0)))
+        mean = s / n
+        # centered Gram (second pass): avoids f32 cancellation at large means
+        cx = (x - mean[None, :]) * w[:, None]
+        g = C.allreduce(jax.lax.dot_general(
+            cx, x - mean[None, :], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))
+        return mean, g / n
+
+    mean, cov = _spmd(mesh, prog, 2)(xd, wd)
+    return np.asarray(mean), np.asarray(cov)
+
+
+# ---------------------------------------------------------------------------
+# PCA (edu.iu.daal_pca: correlation method)
+# ---------------------------------------------------------------------------
+
+def pca(x, n_components=None, mesh: WorkerMesh | None = None):
+    """PCA via the covariance/correlation method (DAAL's distributed mode).
+
+    Returns (components [k, d], explained_variance [k]), sorted descending.
+    The eigendecomposition of the d×d covariance runs on device after one
+    allreduce — the O(n) part never leaves the workers.
+    """
+    mean, cov = covariance(x, mesh)
+    evals, evecs = np.linalg.eigh(cov)
+    order = np.argsort(evals)[::-1]
+    k = n_components or cov.shape[0]
+    return evecs[:, order[:k]].T, evals[order[:k]]
+
+
+# ---------------------------------------------------------------------------
+# Naive Bayes (edu.iu.daal_naive: multinomial)
+# ---------------------------------------------------------------------------
+
+def naive_bayes_fit(x, y, n_classes, alpha=1.0, mesh: WorkerMesh | None = None):
+    """Multinomial naive Bayes: per-class feature sums → allreduce → log probs."""
+    mesh = mesh or current_mesh()
+    xd, yd, wd = _shard_rows(mesh, x, np.asarray(y, np.int32))
+
+    def prog(x, w, y):
+        oh = jax.nn.one_hot(y, n_classes, dtype=jnp.float32) * w[:, None]
+        feat = C.allreduce(jax.lax.dot_general(
+            oh, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+        cls = C.allreduce(oh.sum(0))
+        return feat, cls
+
+    feat, cls = _spmd(mesh, prog, 3)(xd, wd, yd)
+    feat, cls = np.asarray(feat), np.asarray(cls)
+    log_prior = np.log((cls + alpha) / (cls.sum() + alpha * n_classes))
+    log_lik = np.log((feat + alpha) / (feat.sum(1, keepdims=True) + alpha * feat.shape[1]))
+    return {"log_prior": log_prior, "log_likelihood": log_lik}
+
+
+def naive_bayes_predict(model, x):
+    scores = np.asarray(x) @ model["log_likelihood"].T + model["log_prior"]
+    return scores.argmax(1).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Linear / ridge regression (edu.iu.daal_linreg, daal_ridgereg)
+# ---------------------------------------------------------------------------
+
+def linear_regression(x, y, l2=0.0, fit_intercept=True,
+                      mesh: WorkerMesh | None = None):
+    """Normal equations: allreduce (XᵀX, Xᵀy), solve on device.
+
+    y may be [n] or [n, t] (DAAL supports multiple dependent variables).
+    """
+    mesh = mesh or current_mesh()
+    x = np.asarray(x, np.float32)
+    y2 = np.asarray(y, np.float32)
+    y2 = y2[:, None] if y2.ndim == 1 else y2
+    if fit_intercept:
+        x = np.concatenate([x, np.ones((x.shape[0], 1), np.float32)], 1)
+    xd, wd = _shard_rows(mesh, x)
+    yd, _ = _shard_rows(mesh, y2)
+
+    d = x.shape[1]
+    reg = np.zeros((d, d), np.float32)
+    reg[np.arange(d), np.arange(d)] = l2
+    if fit_intercept:
+        reg[-1, -1] = 0.0  # never regularize the intercept
+
+    def prog(x, w, y):
+        xw = x * w[:, None]
+        xtx, xty = C.allreduce((
+            jax.lax.dot_general(xw, x, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32),
+            jax.lax.dot_general(xw, y, (((0,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32),
+        ))
+        return jnp.linalg.solve(xtx + reg, xty)
+
+    beta = np.asarray(_spmd(mesh, prog, 3)(xd, wd, yd))
+    if fit_intercept:
+        return beta[:-1].squeeze(-1) if np.asarray(y).ndim == 1 else beta[:-1], \
+               beta[-1].squeeze(-1) if np.asarray(y).ndim == 1 else beta[-1]
+    return (beta.squeeze(-1) if np.asarray(y).ndim == 1 else beta), None
+
+
+def ridge_regression(x, y, l2=1.0, fit_intercept=True, mesh=None):
+    return linear_regression(x, y, l2=l2, fit_intercept=fit_intercept, mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# QR & SVD (edu.iu.daal_qr, daal_svd): communication-optimal TSQR
+# ---------------------------------------------------------------------------
+
+def tsqr(x, mesh: WorkerMesh | None = None):
+    """Tall-skinny QR: local QR → allgather R's → QR of stack → fix-up.
+
+    Returns (Q [n, d] sharded rows as input, R [d, d]).  This is the
+    distributed QR DAAL implements (step1 local / step2 master / step3
+    local), with the master step replaced by a replicated small QR.
+    """
+    mesh = mesh or current_mesh()
+    x = np.asarray(x, np.float32)
+    n, d = x.shape
+    nw = mesh.num_workers
+    n_pad = -(-n // nw) * nw
+    if n_pad // nw < d:
+        raise ValueError(
+            f"tsqr needs a tall-skinny local block: {n} rows / {nw} workers "
+            f"= {n_pad // nw} per worker < {d} columns")
+    if n_pad > n:
+        # zero rows factor exactly: [X; 0] = [Q; 0] R
+        x = np.concatenate([x, np.zeros((n_pad - n, d), np.float32)])
+    xd = mesh.shard_array(x, 0)
+
+    def prog(x):
+        q1, r1 = jnp.linalg.qr(x)                    # local [n_loc, d], [d, d]
+        rs = C.allgather(r1)                         # [nw*d, d] everywhere
+        q2, r = jnp.linalg.qr(rs)                    # combine step
+        # this worker's block of q2 lifts its local Q
+        me = jax.lax.axis_index("workers")
+        q2_block = jax.lax.dynamic_slice_in_dim(q2, me * d, d, 0)
+        return q1 @ q2_block, r
+
+    q, r = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0),), out_specs=(mesh.spec(0), P()),
+    ))(xd)
+    return np.asarray(q)[:n], np.asarray(r)
+
+
+def svd(x, mesh: WorkerMesh | None = None):
+    """Tall-skinny SVD via TSQR: X = QR, R = UΣVᵀ → X = (QU)ΣVᵀ."""
+    q, r = tsqr(x, mesh)
+    u_r, s, vt = np.linalg.svd(r)
+    return q @ u_r, s, vt
+
+
+# ---------------------------------------------------------------------------
+# ALS (edu.iu.daal_als): alternating least squares for ratings
+# ---------------------------------------------------------------------------
+
+def als(users, items, vals, n_users, n_items, rank=16, reg=0.1, iters=10,
+        mesh: WorkerMesh | None = None, seed=0):
+    """Explicit-feedback ALS: users sharded, item factors replicated.
+
+    W step: per-user normal equations over its (padded) item list, batched
+    with vmap.  H step: per-item Grams accumulated with one-hot matmuls and
+    combined with allreduce (the DAAL partial-result exchange).  Returns
+    (W [n_users, rank], H [n_items, rank], rmse_history).
+    """
+    mesh = mesh or current_mesh()
+    nw = mesh.num_workers
+    users = np.asarray(users); items = np.asarray(items)
+    vals = np.asarray(vals, np.float32)
+    u_bound = -(-n_users // nw)
+
+    # per-user padded item lists (host prep, like HarpDAALDataSource)
+    order = np.argsort(users, kind="stable")
+    su, si, sv = users[order], items[order], vals[order]
+    starts = np.searchsorted(su, np.arange(n_users))
+    counts = np.diff(np.append(starts, len(su)))
+    m = max(int(counts.max()), 1)
+    ui = np.zeros((u_bound * nw, m), np.int32)
+    uv = np.zeros((u_bound * nw, m), np.float32)
+    um = np.zeros((u_bound * nw, m), np.float32)
+    for u in range(n_users):
+        c = counts[u]
+        ui[u, :c] = si[starts[u]:starts[u] + c]
+        uv[u, :c] = sv[starts[u]:starts[u] + c]
+        um[u, :c] = 1.0
+
+    rng = np.random.default_rng(seed)
+    H = rng.normal(size=(n_items, rank)).astype(np.float32) / np.sqrt(rank)
+    uid, uvd, umd = (mesh.shard_array(a, 0) for a in (ui, uv, um))
+    eye = reg * np.eye(rank, dtype=np.float32)
+
+    def w_step(H, ui, uv, um):
+        def solve_user(idx, v, msk):
+            h = H[idx] * msk[:, None]                  # [m, r]
+            A = h.T @ h + eye
+            b = h.T @ (v * msk)
+            return jnp.linalg.solve(A, b)
+
+        return jax.vmap(solve_user)(ui, uv, um)        # [u_loc, r]
+
+    def h_step(W, ui, uv, um):
+        # per-item Gram/vec accumulated over the worker's ratings via
+        # segment sums (a dense one-hot would be [nnz, n_items] — GBs)
+        flat_i = ui.reshape(-1)
+        flat_m = um.reshape(-1)
+        flat_v = uv.reshape(-1)
+        w_rep = jnp.repeat(W, ui.shape[1], axis=0) * flat_m[:, None]  # [nnz_loc, r]
+        WW = w_rep[:, :, None] * w_rep[:, None, :]     # [nnz_loc, r, r]
+        A = jax.ops.segment_sum(WW, flat_i, num_segments=n_items)
+        b = jax.ops.segment_sum(w_rep * flat_v[:, None], flat_i,
+                                num_segments=n_items)
+        A, b = C.allreduce((A, b))
+        return jax.vmap(lambda Ai, bi: jnp.linalg.solve(Ai + eye, bi))(A, b)
+
+    def epoch(H, ui, uv, um):
+        W = w_step(H, ui, uv, um)
+        H = h_step(W, ui, uv, um)
+        pred = (W[:, None, :] * H[ui]).sum(-1)
+        se = C.allreduce((((pred - uv) * um) ** 2).sum())
+        cnt = C.allreduce(um.sum())
+        return W, H, jnp.sqrt(se / jnp.maximum(cnt, 1))
+
+    fn = jax.jit(mesh.shard_map(
+        epoch, in_specs=(P(), mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), P(), P()),
+    ))
+    Hd = jax.device_put(jnp.asarray(H), mesh.replicated())
+    hist = []
+    for _ in range(iters):
+        W, Hd, rmse = fn(Hd, uid, uvd, umd)
+        hist.append(float(np.asarray(rmse)))
+    return np.asarray(W)[:n_users], np.asarray(Hd), hist

@@ -1,0 +1,109 @@
+"""Tests for CCD++, SVM, WDA-MDS, and the collective micro-benchmark."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.models import ccd as CCD
+from harp_tpu.models import svm as SVM
+from harp_tpu.models import wdamds as MDS
+from harp_tpu.models.mfsgd import synthetic_ratings
+
+
+def test_ccd_converges(mesh):
+    u, i, v = synthetic_ratings(128, 96, 8_000, rank=4, noise=0.01, seed=0)
+    model = CCD.CCD(128, 96, CCD.CCDConfig(rank=8, reg=0.02), mesh, seed=0)
+    model.set_ratings(u, i, v)
+    first = model.train_epoch()
+    last = None
+    for _ in range(8):
+        last = model.train_epoch()
+    assert last < 0.6 * first, (first, last)
+
+
+def test_ccd_requires_ratings(mesh):
+    with pytest.raises(RuntimeError, match="set_ratings"):
+        CCD.CCD(16, 16, CCD.CCDConfig(rank=4), mesh).train_epoch()
+
+
+def test_svm_separable(mesh):
+    rng = np.random.default_rng(0)
+    d = 16
+    true_w = rng.normal(size=d).astype(np.float32)
+    x = rng.normal(size=(2048, d)).astype(np.float32)
+    y = np.sign(x @ true_w).astype(np.float32)
+    model = SVM.SVM(SVM.SVMConfig(inner_steps=150, outer_rounds=3,
+                                  sv_per_worker=64), mesh)
+    model.fit(x, y)
+    assert model.accuracy(x, y) > 0.95
+
+
+def test_svm_label_validation(mesh):
+    with pytest.raises(AssertionError, match="±1"):
+        SVM.SVM(mesh=mesh).fit(np.zeros((16, 4)), np.array([0, 1] * 8))
+
+
+def test_mds_recovers_geometry(mesh):
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(60, 2)).astype(np.float32)  # non-divisible n
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    X, stress = MDS.mds(delta, MDS.MDSConfig(dim=2, iters=200), mesh, seed=0)
+    # embedded distances match the input dissimilarities (up to rigid motion)
+    demb = np.sqrt(((X[:, None] - X[None]) ** 2).sum(-1))
+    rel = np.abs(demb - delta)[np.triu_indices(60, 1)].mean() / delta.mean()
+    assert rel < 0.05, rel
+    assert stress >= 0
+
+
+def test_mds_stress_decreases_with_iters(mesh):
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(32, 3)).astype(np.float32)
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    _, s_short = MDS.mds(delta, MDS.MDSConfig(dim=3, iters=5), mesh, seed=0)
+    _, s_long = MDS.mds(delta, MDS.MDSConfig(dim=3, iters=80), mesh, seed=0)
+    assert s_long < s_short
+
+
+def test_collective_bench_runs(mesh):
+    from harp_tpu import benchmark as B
+
+    out = B.bench_verb("allreduce", mesh, 64 * 1024, reps=2)
+    assert out["gb_per_sec"] > 0 and out["verb"] == "allreduce"
+    out = B.bench_verb("rotate", mesh, 64 * 1024, reps=2)
+    assert out["sec"] > 0
+
+
+def test_svm_default_config_small_data(mesh):
+    """sv_per_worker larger than the local shard must not crash top_k."""
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(100, 8)).astype(np.float32)  # 12 rows/worker < 256
+    y = np.sign(x[:, 0]).astype(np.float32)
+    y[y == 0] = 1.0
+    model = SVM.SVM(mesh=mesh)  # default sv_per_worker=256
+    model.fit(x, y)
+    assert model.accuracy(x, y) > 0.8
+
+
+def test_collective_bench_regroup_push(mesh):
+    from harp_tpu import benchmark as B
+
+    for verb in ("regroup", "push"):
+        out = B.bench_verb(verb, mesh, 64 * 1024, reps=1)
+        assert out["sec"] > 0
+
+
+def test_moments_large_mean_no_cancellation(mesh):
+    rng = np.random.default_rng(4)
+    from harp_tpu.models import stats as S
+    x = (1e4 + rng.normal(size=(256, 4))).astype(np.float32)
+    m = S.moments(x, mesh)
+    np.testing.assert_allclose(m["variance"], x.var(0), rtol=0.05)
+
+
+def test_tsqr_pads_and_validates(mesh):
+    from harp_tpu.models import stats as S
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(250, 8)).astype(np.float32)  # non-divisible rows
+    q, r = S.tsqr(x, mesh)
+    np.testing.assert_allclose(q @ r, x, rtol=1e-3, atol=1e-4)
+    with pytest.raises(ValueError, match="tall-skinny"):
+        S.tsqr(rng.normal(size=(64, 32)).astype(np.float32), mesh)

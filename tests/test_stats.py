@@ -1,0 +1,99 @@
+"""Classic-stats suite: every algorithm vs its numpy reference."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.models import stats as S
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(333, 12)).astype(np.float32)  # non-divisible rows
+
+
+def test_moments(mesh, data):
+    m = S.moments(data, mesh)
+    assert m["n"] == 333
+    np.testing.assert_allclose(m["mean"], data.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(m["variance"], data.var(0), rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(m["min"], data.min(0), rtol=1e-6)
+    np.testing.assert_allclose(m["max"], data.max(0), rtol=1e-6)
+
+
+def test_covariance(mesh, data):
+    mean, cov = S.covariance(data, mesh)
+    np.testing.assert_allclose(mean, data.mean(0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cov, np.cov(data.T, bias=True), rtol=1e-3, atol=1e-4)
+
+
+def test_pca(mesh, data):
+    comps, ev = S.pca(data, n_components=3, mesh=mesh)
+    cov = np.cov(data.T, bias=True)
+    evals = np.sort(np.linalg.eigvalsh(cov))[::-1]
+    np.testing.assert_allclose(ev, evals[:3], rtol=1e-3)
+    # components are eigenvectors: cov @ v ≈ λ v
+    for i in range(3):
+        np.testing.assert_allclose(cov @ comps[i], ev[i] * comps[i],
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_naive_bayes(mesh):
+    rng = np.random.default_rng(1)
+    # multinomial-ish counts with class-dependent feature rates
+    n, d, c = 400, 10, 3
+    rates = rng.uniform(0.5, 3.0, size=(c, d))
+    y = rng.integers(0, c, n).astype(np.int32)
+    x = rng.poisson(rates[y]).astype(np.float32)
+    model = S.naive_bayes_fit(x, y, c, mesh=mesh)
+    pred = S.naive_bayes_predict(model, x)
+    assert (pred == y).mean() > 0.7
+
+
+def test_linear_regression(mesh):
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(500, 8)).astype(np.float32)
+    true_beta = rng.normal(size=8).astype(np.float32)
+    y = x @ true_beta + 2.5 + 0.01 * rng.normal(size=500).astype(np.float32)
+    beta, intercept = S.linear_regression(x, y, mesh=mesh)
+    np.testing.assert_allclose(beta, true_beta, atol=5e-3)
+    assert abs(intercept - 2.5) < 1e-2
+
+
+def test_ridge_shrinks(mesh):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = x @ rng.normal(size=8).astype(np.float32)
+    b0, _ = S.linear_regression(x, y, mesh=mesh)
+    b1, _ = S.ridge_regression(x, y, l2=100.0, mesh=mesh)
+    assert np.linalg.norm(b1) < np.linalg.norm(b0)
+
+
+def test_tsqr(mesh):
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    q, r = S.tsqr(x, mesh)
+    np.testing.assert_allclose(q @ r, x, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(16), atol=1e-4)
+    assert np.allclose(r, np.triu(r))  # R upper triangular
+
+
+def test_svd(mesh):
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    u, s, vt = S.svd(x, mesh)
+    np.testing.assert_allclose(u @ np.diag(s) @ vt, x, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(s, np.linalg.svd(x, compute_uv=False), rtol=1e-4)
+
+
+def test_als_converges(mesh):
+    rng = np.random.default_rng(6)
+    n_users, n_items, rank = 96, 40, 4
+    Wt = rng.normal(size=(n_users, rank)).astype(np.float32)
+    Ht = rng.normal(size=(n_items, rank)).astype(np.float32)
+    u = rng.integers(0, n_users, 3000).astype(np.int32)
+    i = rng.integers(0, n_items, 3000).astype(np.int32)
+    v = (Wt[u] * Ht[i]).sum(-1).astype(np.float32)
+    W, H, hist = S.als(u, i, v, n_users, n_items, rank=6, reg=0.01,
+                       iters=8, mesh=mesh)
+    assert hist[-1] < 0.2 * hist[0], hist
