@@ -1,0 +1,81 @@
+"""CollectiveApp — the ``CollectiveMapper`` residue (Harp L4).
+
+Reference parity (SURVEY.md §3.1, §4.1): Harp apps subclass
+``edu.iu.harp.mapcollective.CollectiveMapper`` whose ``run()`` bootstraps
+the worker (peer discovery, socket server, membership barrier), calls the
+user's ``mapCollective(reader, context)`` exactly once with the whole
+iterative program inside, then tears down and writes outputs.  The mapper
+exposes ``allreduce/…/getSelfID/getNumWorkers/isMaster`` to app code.
+
+On TPU the bootstrap collapses to ``jax.distributed.initialize()`` + mesh
+construction, and one Python process per *host* drives all its chips, so
+the "mapper" is a thin lifecycle wrapper: config → mesh → ``map_collective``
+→ metrics/checkpoint teardown.  Apps can equally use the function-style
+drivers in :mod:`harp_tpu.models` directly; this class exists for ports of
+Harp app code that want the familiar shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+from harp_tpu.parallel import collective
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, init_distributed
+from harp_tpu.utils.metrics import MetricsLogger
+
+log = logging.getLogger("harp_tpu")
+
+
+class CollectiveApp:
+    """Base class for Harp-style applications.
+
+    Subclass and override :meth:`map_collective`.  Inside it, use
+    ``self.mesh`` to shard/compile, the collective verbs via
+    ``harp_tpu.parallel.collective`` inside your shard_mapped step
+    functions, and ``self.metrics`` for per-iteration logging (Harp's
+    per-iteration wall-clock logs, structured).
+    """
+
+    def __init__(self, config: Any = None, mesh: WorkerMesh | None = None,
+                 metrics_path: str | None = None):
+        self.config = config
+        init_distributed()  # no-op on single host (Harp's bootstrap)
+        self.mesh = mesh or current_mesh()
+        self.metrics = MetricsLogger(metrics_path)
+
+    # -- Harp mapper API ----------------------------------------------------
+    @property
+    def num_workers(self) -> int:
+        """``getNumWorkers()``."""
+        return self.mesh.num_workers
+
+    def is_master(self) -> bool:
+        """``isMaster()`` — host-process view (process 0 of the job)."""
+        import jax
+
+        return jax.process_index() == 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def map_collective(self) -> Any:
+        """The whole iterative program — override me (Harp's mapCollective)."""
+        raise NotImplementedError
+
+    def run(self) -> Any:
+        """``CollectiveMapper.run()``: setup → mapCollective → cleanup."""
+        t0 = time.perf_counter()
+        log.info("harp-tpu app starting: %d workers, config=%s",
+                 self.num_workers, self.config)
+        try:
+            result = self.map_collective()
+        finally:
+            self.metrics.close()
+        log.info("harp-tpu app finished in %.2fs", time.perf_counter() - t0)
+        return result
+
+
+def run_app(app_cls, config=None, **kw):
+    """Launcher helper: ``hadoop jar harp-app.jar Launcher`` equivalent."""
+    return app_cls(config, **kw).run()

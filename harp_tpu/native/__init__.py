@@ -1,0 +1,13 @@
+"""Native C++ components, built on demand and driven via ctypes.
+
+The reference's native layer is Intel DAAL behind JNI (SURVEY.md §3.2).
+Compute moved into XLA; what remains host-side and performance-critical is
+data ingest — implemented in ``loader.cpp`` and compiled here with g++ on
+first use (cached ``.so``).  Falls back to numpy loaders when no compiler
+is available, so the framework never hard-requires the toolchain.
+"""
+
+from harp_tpu.native.build import load_native, native_available
+from harp_tpu.native.datasource import load_csv, load_triples
+
+__all__ = ["load_native", "native_available", "load_csv", "load_triples"]

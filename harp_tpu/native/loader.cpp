@@ -1,0 +1,277 @@
+// Fast parallel text→tensor loader — HarpDAALDataSource, TPU-native.
+//
+// Reference parity (SURVEY.md §3.3): edu.iu.datasource.HarpDAALDataSource
+// loads HDFS CSV / libsvm shards into DAAL NumericTables through JNI;
+// the heavy lifting (parse + layout) is native. Here the same role is a
+// small C++ library driven through ctypes (no JNI, no pybind11 — plain C
+// ABI): it chunk-splits a file across std::thread workers, each parses
+// its byte range with a branch-light float scanner, and rows land in one
+// contiguous float32 buffer ready for jax.device_put.
+//
+// Exposed C ABI:
+//   harp_count_rows(path, n_threads, *rows, *cols)      -> 0 on success
+//   harp_load_csv_f32(path, n_threads, buf, rows, cols) -> 0 on success
+//   harp_load_triples(path, n_threads, u_buf, i_buf, v_buf, n) -> 0
+// Caller (Python) allocates the numpy buffers after harp_count_rows.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Mapped {
+  char* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+};
+
+Mapped read_file(const char* path) {
+  Mapped m;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return m;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (sz < 0) { std::fclose(f); return m; }
+  m.data = static_cast<char*>(std::malloc(sz + 1));
+  if (!m.data) { std::fclose(f); return m; }
+  m.size = std::fread(m.data, 1, sz, f);
+  m.data[m.size] = '\0';
+  std::fclose(f);
+  m.ok = true;
+  return m;
+}
+
+// Hand-rolled float scanner: [-+]?digits[.digits][eE[-+]digits].
+// ~4× strtof (no locale, no errno); falls back to strtof for anything
+// unusual (inf/nan/hex). Exact powers of ten up to |exp| 38 via table.
+static const double kPow10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10, 1e11,
+    1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22, 1e23,
+    1e24, 1e25, 1e26, 1e27, 1e28, 1e29, 1e30, 1e31, 1e32, 1e33, 1e34, 1e35,
+    1e36, 1e37, 1e38};
+
+inline float parse_float(const char*& p) {
+  const char* s = p;
+  bool neg = false;
+  if (*s == '-') { neg = true; ++s; }
+  else if (*s == '+') { ++s; }
+  if (!((*s >= '0' && *s <= '9') || *s == '.')) {
+    // inf/nan/garbage: strtof, but ALWAYS advance past the token so the
+    // caller's column loop can't spin forever on e.g. a header row
+    char* endp = nullptr;
+    float v = std::strtof(p, &endp);
+    if (endp == p) {  // no conversion: skip the non-numeric token
+      const char* q = p;
+      while (*q && *q != ',' && *q != ' ' && *q != '\t' && *q != '\r' &&
+             *q != '\n') ++q;
+      p = (q == p) ? p + 1 : q;
+      return 0.0f;
+    }
+    p = endp;
+    return v;
+  }
+  uint64_t mant = 0;
+  int frac_digits = 0;
+  int ndig = 0;
+  while (*s >= '0' && *s <= '9') {
+    if (ndig < 19) { mant = mant * 10 + (*s - '0'); ++ndig; }
+    else { --frac_digits; }  // skipped integer digit ⇒ scale up by 10
+    ++s;
+  }
+  if (*s == '.') {
+    ++s;
+    while (*s >= '0' && *s <= '9') {
+      if (ndig < 19) { mant = mant * 10 + (*s - '0'); ++ndig; ++frac_digits; }
+      ++s;
+    }
+  }
+  int exp10 = -frac_digits;
+  if (*s == 'e' || *s == 'E') {
+    ++s;
+    bool eneg = false;
+    if (*s == '-') { eneg = true; ++s; }
+    else if (*s == '+') { ++s; }
+    int e = 0;
+    while (*s >= '0' && *s <= '9') { e = e * 10 + (*s - '0'); ++s; }
+    exp10 += eneg ? -e : e;
+  }
+  double v = static_cast<double>(mant);
+  if (exp10 > 0) v *= (exp10 <= 38) ? kPow10[exp10] : 1e308;
+  else if (exp10 < 0) v /= (-exp10 <= 38) ? kPow10[-exp10] : 1e308;
+  p = s;
+  return static_cast<float>(neg ? -v : v);
+}
+
+inline void skip_seps(const char*& p, const char* end) {
+  while (p < end && (*p == ',' || *p == ' ' || *p == '\t' || *p == '\r')) ++p;
+}
+
+// Align a byte offset to the start of the next line.
+size_t align_to_line(const char* data, size_t off, size_t size) {
+  if (off == 0) return 0;
+  while (off < size && data[off - 1] != '\n') ++off;
+  return off;
+}
+
+void count_range(const char* data, size_t begin, size_t end_, int64_t* rows,
+                 int64_t* cols) {
+  int64_t r = 0, c = 0;
+  const char* p = data + begin;
+  const char* end = data + end_;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    if (line_end > p) {  // non-empty line
+      ++r;
+      if (c == 0) {
+        const char* q = p;
+        while (q < line_end) {
+          skip_seps(q, line_end);
+          if (q >= line_end) break;
+          parse_float(q);
+          ++c;
+        }
+      }
+    }
+    p = nl ? nl + 1 : end;
+  }
+  *rows = r;
+  *cols = c;
+}
+
+}  // namespace
+
+extern "C" {
+
+// First pass: rows and columns (cols from the first non-empty line).
+int harp_count_rows(const char* path, int n_threads, int64_t* rows,
+                    int64_t* cols) {
+  Mapped m = read_file(path);
+  if (!m.ok) return 1;
+  int nt = n_threads > 0 ? n_threads : 1;
+  std::vector<int64_t> r(nt, 0), c(nt, 0);
+  std::vector<std::thread> ts;
+  size_t chunk = m.size / nt + 1;
+  for (int t = 0; t < nt; ++t) {
+    size_t b = align_to_line(m.data, t * chunk, m.size);
+    size_t e = align_to_line(m.data, (t + 1) * chunk, m.size);
+    if (e > m.size) e = m.size;
+    ts.emplace_back(count_range, m.data, b, e, &r[t], &c[t]);
+  }
+  for (auto& t : ts) t.join();
+  *rows = 0;
+  *cols = 0;
+  for (int t = 0; t < nt; ++t) {
+    *rows += r[t];
+    if (*cols == 0) *cols = c[t];
+  }
+  std::free(m.data);
+  return 0;
+}
+
+// Second pass: parse into the caller-allocated [rows, cols] f32 buffer.
+int harp_load_csv_f32(const char* path, int n_threads, float* buf,
+                      int64_t rows, int64_t cols) {
+  Mapped m = read_file(path);
+  if (!m.ok) return 1;
+  int nt = n_threads > 0 ? n_threads : 1;
+
+  // per-thread row offsets need a prefix count first
+  std::vector<size_t> begins(nt), ends(nt);
+  std::vector<int64_t> r(nt, 0), c(nt, 0);
+  size_t chunk = m.size / nt + 1;
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; ++t) {
+      begins[t] = align_to_line(m.data, t * chunk, m.size);
+      ends[t] = align_to_line(m.data, (t + 1) * chunk, m.size);
+      if (ends[t] > m.size) ends[t] = m.size;
+      ts.emplace_back(count_range, m.data, begins[t], ends[t], &r[t], &c[t]);
+    }
+    for (auto& t : ts) t.join();
+  }
+  std::vector<int64_t> row0(nt, 0);
+  for (int t = 1; t < nt; ++t) row0[t] = row0[t - 1] + r[t - 1];
+  if (row0[nt - 1] + r[nt - 1] != rows) { std::free(m.data); return 2; }
+
+  auto parse_range = [&](int t) {
+    const char* p = m.data + begins[t];
+    const char* end = m.data + ends[t];
+    float* out = buf + row0[t] * cols;
+    while (p < end) {
+      const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+      const char* line_end = nl ? nl : end;
+      if (line_end > p) {
+        const char* q = p;
+        for (int64_t j = 0; j < cols; ++j) {
+          skip_seps(q, line_end);
+          *out++ = (q < line_end) ? parse_float(q) : 0.0f;
+        }
+      }
+      p = nl ? nl + 1 : end;
+    }
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nt; ++t) ts.emplace_back(parse_range, t);
+  for (auto& t : ts) t.join();
+  std::free(m.data);
+  return 0;
+}
+
+// Rating/token triples "u i v" → int32/int32/float32 columns (MF-SGD, LDA).
+int harp_load_triples(const char* path, int n_threads, int32_t* u_buf,
+                      int32_t* i_buf, float* v_buf, int64_t n) {
+  Mapped m = read_file(path);
+  if (!m.ok) return 1;
+  int nt = n_threads > 0 ? n_threads : 1;
+  std::vector<size_t> begins(nt), ends(nt);
+  std::vector<int64_t> r(nt, 0), c(nt, 0);
+  size_t chunk = m.size / nt + 1;
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; ++t) {
+      begins[t] = align_to_line(m.data, t * chunk, m.size);
+      ends[t] = align_to_line(m.data, (t + 1) * chunk, m.size);
+      if (ends[t] > m.size) ends[t] = m.size;
+      ts.emplace_back(count_range, m.data, begins[t], ends[t], &r[t], &c[t]);
+    }
+    for (auto& t : ts) t.join();
+  }
+  std::vector<int64_t> row0(nt, 0);
+  for (int t = 1; t < nt; ++t) row0[t] = row0[t - 1] + r[t - 1];
+  if (row0[nt - 1] + r[nt - 1] != n) { std::free(m.data); return 2; }
+
+  auto parse_range = [&](int t) {
+    const char* p = m.data + begins[t];
+    const char* end = m.data + ends[t];
+    int64_t row = row0[t];
+    while (p < end) {
+      const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+      const char* line_end = nl ? nl : end;
+      if (line_end > p) {
+        const char* q = p;
+        skip_seps(q, line_end);
+        u_buf[row] = static_cast<int32_t>(std::strtol(q, const_cast<char**>(&q), 10));
+        skip_seps(q, line_end);
+        i_buf[row] = static_cast<int32_t>(std::strtol(q, const_cast<char**>(&q), 10));
+        skip_seps(q, line_end);
+        v_buf[row] = (q < line_end) ? parse_float(q) : 0.0f;
+        ++row;
+      }
+      p = nl ? nl + 1 : end;
+    }
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < nt; ++t) ts.emplace_back(parse_range, t);
+  for (auto& t : ts) t.join();
+  std::free(m.data);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+"""Device kernels: ring attention (long-context) and Pallas TPU kernels.
+
+Harp's rotate collective is structurally the ring-attention primitive
+(SURVEY.md §3.5, §6 "long-context"): a ppermute ring with compute/transfer
+overlap.  :mod:`harp_tpu.ops.ring_attention` makes that concrete — exact
+blockwise attention over a sequence-sharded mesh — so long-context models
+scale across chips with the same machinery the classic apps use.
+:mod:`harp_tpu.ops.flash_attention` is the single-chip Pallas kernel
+(VMEM-blocked online softmax) the ring's local step can use.
+"""
+
+from harp_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["ring_attention"]

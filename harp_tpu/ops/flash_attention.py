@@ -1,0 +1,119 @@
+"""Flash attention — Pallas TPU kernel (single-chip blockwise softmax).
+
+The native-kernel counterpart of the XLA path in
+:mod:`harp_tpu.ops.ring_attention`: Q/K/V blocks stream HBM→VMEM, the
+online-softmax accumulators live in VMEM scratch, and the MXU consumes
+[block_q, d] × [d, block_k] tiles.  Grid = (batch·heads, q_blocks,
+k_blocks) with K innermost so accumulators carry across the K sweep.
+
+This is the playbook kernel from /opt/skills/guides/pallas_guide.md
+(Grid/BlockSpec + scratch + @pl.when init/flush); it exists both as a
+usable op and as the template for future hand-written kernels (MF-SGD
+fused gather-update, LDA sampling).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: K blocks entirely above the diagonal contribute nothing —
+    # skip their MXU work (≈2× for long sequences)
+    fully_masked = (ki * block_k > qi * block_q + block_q - 1) if causal else False
+
+    @pl.when(jnp.logical_not(fully_masked))
+    def _compute():
+        q = q_ref[0]                      # [bq, d]
+        k = k_ref[0]                      # [bk, d]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            masked = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
+        else:
+            masked = scores
+
+        m_prev = m_ref[:, 0]                              # [bq]
+        m_blk = masked.max(axis=1)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(masked),
+                              masked - m_new[:, None], -jnp.inf))
+        l_new = l_ref[:, 0] * alpha + p.sum(axis=1)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, d]
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + pv
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _flush():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, 0], 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: bool = False):
+    """Blockwise attention. q/k/v: [BH, N, D] (fold batch×heads upstream)."""
+    bh, n, d = q.shape
+    block_q = min(block_q, n)
+    block_k = min(block_k, n)
+    assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    grid = (bh, n // block_q, n // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # l
+            pltpu.VMEM((block_q, d), jnp.float32),   # acc
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal=False, scale=None):
+    """Straight-line reference for tests."""
+    bh, n, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)

@@ -1,0 +1,91 @@
+"""Ring attention: exact attention over a sequence-sharded ring.
+
+Long-context support (SURVEY.md §6): the sequence axis is sharded across
+workers (sequence/context parallelism); K/V blocks travel the ring via
+``rotate`` (the dymoro ppermute pattern) while each worker's resident Q
+block accumulates **online softmax** statistics (the flash-attention
+recurrence), so attention over the full sequence is exact without any
+worker ever materializing full K/V — memory per chip is O(seq/n), enabling
+sequences n× longer than a single chip holds.
+
+The rotation is issued before the block compute each step, so XLA overlaps
+the ICI transfer with the attention math (K/V are read-only — the easy
+case of the rotate pipeline).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
+
+
+def _block_attend(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
+    """One online-softmax update of (m, l, acc) with a K/V block.
+
+    q: [B, nq, H, D]; k, v: [B, nk, H, D]; m, l: [B, H, nq]; acc like q.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    m_blk = scores.max(-1)                               # [B, H, nq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard: fully-masked rows keep m=-inf; exp(-inf - -inf) → use where
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                          scores - m_new[..., None], -jnp.inf))
+    l_new = l * alpha + p.sum(-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
+                   scale: float | None = None):
+    """Exact multi-head attention, sequence sharded (device view).
+
+    Args (per-worker shards, call inside ``shard_map``):
+      q, k, v: [batch, seq_local, heads, head_dim]
+      causal: apply causal masking using *global* positions.
+    Returns: [batch, seq_local, heads, head_dim] attention output.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, nq, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    q_pos = me * nq + jnp.arange(nq)
+    m0 = jnp.full((b, h, nq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, nq), jnp.float32)
+    acc0 = jnp.zeros((b, nq, h, d), jnp.float32)
+
+    def body(carry, t):
+        m, l, acc, k_cur, v_cur = carry
+        # rotate first: transfer has no dependency on this step's compute,
+        # so it rides ICI while the MXU does the block attention
+        k_nxt = C.rotate(k_cur, axis=axis)
+        v_nxt = C.rotate(v_cur, axis=axis)
+        src = (me - t) % n                      # whose block is resident
+        k_pos = src * nq + jnp.arange(k_cur.shape[1])
+        m, l, acc = _block_attend(q, k_cur, v_cur, m, l, acc,
+                                  q_pos, k_pos, scale, causal)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = lax.scan(body, (m0, l0, acc0, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh: WorkerMesh, causal: bool = False):
+    """Host-view compile: full arrays in, sequence-sharded underneath."""
+    fn = functools.partial(ring_attention, causal=causal, axis=mesh.axis)
+    spec = mesh.spec(1, ndim=4)  # shard the sequence dim
+    return jax.jit(mesh.shard_map(fn, in_specs=(spec,) * 3, out_specs=spec))

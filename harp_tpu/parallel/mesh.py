@@ -50,12 +50,30 @@ def init_distributed(
     TPU pods) let JAX auto-detect the topology.
     """
     explicit = coordinator_address is not None or num_processes is not None
-    auto = any(
-        v in os.environ
-        for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES")
+    # auto-init only on genuinely multi-host topologies: a coordinator env
+    # var, or a TPU hostname list naming more than one worker (single-host
+    # TPU VMs export TPU_WORKER_HOSTNAMES=localhost)
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    auto = (
+        any(v in os.environ for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
+        or "," in hostnames
     )
     if not (explicit or auto):
         return  # single-host: nothing to do
+    import jax._src.xla_bridge as xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        coord_set = any(v in os.environ for v in
+                        ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"))
+        if explicit or coord_set:
+            # a declared multi-host topology that we can no longer join must
+            # fail fast — proceeding would run N independent single-host jobs
+            raise RuntimeError(
+                "init_distributed must run before any JAX computation "
+                "(the XLA backend is already initialized) — a coordinator "
+                "address is configured, so this process would otherwise "
+                "silently run single-host")
+        return  # hostname-list heuristic only: assume single-host was intended
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,38 @@
+"""Per-iteration metrics as JSONL — Harp's log4j iteration logs, structured.
+
+Reference parity (SURVEY.md §6): Harp apps print per-iteration wall-clock
+lines into container logs; observability is grepping YARN logs.  Here every
+iteration appends one JSON object to a file (and mirrors to the Python
+logger), so the north-star metrics (iter/sec, updates/sec/chip) are
+machine-readable.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any, IO
+
+log = logging.getLogger("harp_tpu.metrics")
+
+
+class MetricsLogger:
+    def __init__(self, path: str | None = None):
+        self._fh: IO | None = open(path, "a") if path else None
+        self._t0 = time.perf_counter()
+
+    def log(self, step: int | None = None, **metrics: Any) -> dict:
+        rec = {"t": round(time.perf_counter() - self._t0, 6), **metrics}
+        if step is not None:
+            rec["step"] = step
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        log.info("%s", rec)
+        return rec
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,29 @@
+"""Profiler hook — Harp's "tracing" subsystem, on XLA's profiler.
+
+Reference parity (SURVEY.md §6): the reference has per-iteration wall-clock
+log lines and DAAL verbose timing; no structured tracer.  Here one context
+manager captures a TensorBoard-viewable XLA trace (op timeline, HBM
+allocations, ICI traffic on real pods), plus :class:`harp_tpu.utils.timing.
+Timer` for the Harp-style per-phase table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/harp_tpu_trace"):
+    """``with trace("dir"): run_steps()`` → TensorBoard trace in dir."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up in the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,82 @@
+"""Native loader: correctness vs numpy, threads, fallback, errors."""
+
+import numpy as np
+import pytest
+
+from harp_tpu.native import load_csv, load_triples, load_native
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = load_native()
+    if lib is None:
+        pytest.skip("no g++ and no prebuilt .so")
+    return lib
+
+
+def test_load_csv_matches_numpy(native_lib, tmp_path):
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(1000, 7)).astype(np.float32)
+    p = tmp_path / "d.csv"
+    np.savetxt(p, a, delimiter=",", fmt="%.6g")
+    out = load_csv(str(p), n_threads=4)
+    ref = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_load_csv_single_thread_same(native_lib, tmp_path):
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(257, 3)).astype(np.float32)
+    p = tmp_path / "d.csv"
+    np.savetxt(p, a, delimiter=",", fmt="%.7g")
+    np.testing.assert_array_equal(load_csv(str(p), 1), load_csv(str(p), 8))
+
+
+def test_load_triples(native_lib, tmp_path):
+    rng = np.random.default_rng(2)
+    u = rng.integers(0, 1000, 5000).astype(np.int32)
+    i = rng.integers(0, 500, 5000).astype(np.int32)
+    v = rng.normal(size=5000).astype(np.float32)
+    p = tmp_path / "t.txt"
+    with open(p, "w") as f:
+        for uu, ii, vv in zip(u, i, v):
+            f.write(f"{uu} {ii} {vv:.6g}\n")
+    u2, i2, v2 = load_triples(str(p), n_threads=4)
+    np.testing.assert_array_equal(u2, u)
+    np.testing.assert_array_equal(i2, i)
+    np.testing.assert_allclose(v2, v, rtol=1e-5)
+
+
+def test_missing_file_raises(native_lib):
+    with pytest.raises(OSError, match="native loader"):
+        load_csv("/nonexistent/file.csv")
+
+
+def test_trailing_newline_and_blank_lines(native_lib, tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,2\n\n3,4\n\n\n5,6\n")
+    out = load_csv(str(p), 4)
+    np.testing.assert_array_equal(out, [[1, 2], [3, 4], [5, 6]])
+
+
+def test_header_row_does_not_hang(native_lib, tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("x,y,z\n1,2,3\n4,5,6\n")
+    out = load_csv(str(p), 2)  # header parses as zeros, must not hang
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(out[1:], [[1, 2, 3], [4, 5, 6]])
+
+
+def test_huge_integer_digits(native_lib, tmp_path):
+    p = tmp_path / "big.csv"
+    p.write_text("12345678901234567890123456,1\n")
+    out = load_csv(str(p), 1)
+    np.testing.assert_allclose(out[0, 0], 1.2345679e25, rtol=1e-6)
+
+
+def test_fallback_whitespace_equivalent(tmp_path):
+    from harp_tpu.native.datasource import _loadtxt_any_sep
+    p = tmp_path / "ws.txt"
+    p.write_text("1 2 3\n4,5,6\n")
+    np.testing.assert_array_equal(_loadtxt_any_sep(str(p)),
+                                  [[1, 2, 3], [4, 5, 6]])
