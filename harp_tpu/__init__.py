@@ -33,6 +33,7 @@ from harp_tpu.parallel.mesh import (
 from harp_tpu.parallel import collective
 from harp_tpu.parallel.collective import Combiner
 from harp_tpu.table import Table, Partition
+from harp_tpu.schedule import StaticScheduler, DynamicScheduler, Task
 
 __version__ = "0.1.0"
 
@@ -45,5 +46,8 @@ __all__ = [
     "Combiner",
     "Table",
     "Partition",
+    "StaticScheduler",
+    "DynamicScheduler",
+    "Task",
     "__version__",
 ]

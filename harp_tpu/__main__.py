@@ -1,0 +1,49 @@
+"""Unified launcher — Harp L8 (``hadoop jar harp-<app>.jar Launcher``) parity.
+
+Harp apps each ship a ``main`` Launcher class invoked through ``hadoop
+jar`` with positional args, wrapped by per-app shell scripts (SURVEY.md
+§2 L8).  Here every app already has a module-level ``main(argv)``
+(``python -m harp_tpu.models.kmeans …``); this dispatcher is the single
+front door:
+
+    python -m harp_tpu <app> [app args...]
+    python -m harp_tpu bench [--size-mb N]       # collective micro-bench
+    python -m harp_tpu --list
+"""
+
+from __future__ import annotations
+
+import sys
+from importlib import import_module
+
+APPS = {
+    "kmeans": ("harp_tpu.models.kmeans", "KMeans Lloyd iterations (allreduce)"),
+    "mfsgd": ("harp_tpu.models.mfsgd", "MF-SGD matrix factorization (rotate)"),
+    "ccd": ("harp_tpu.models.ccd", "CCD++ matrix factorization (rotate)"),
+    "lda": ("harp_tpu.models.lda", "LDA-CGS topic model (rotate + push/pull)"),
+    "mlp": ("harp_tpu.models.mlp", "MLP neural net (gradient allreduce)"),
+    "subgraph": ("harp_tpu.models.subgraph", "color-coding subgraph counting"),
+    "rf": ("harp_tpu.models.rf", "random forest (allgather of trees)"),
+    "svm": ("harp_tpu.models.svm", "distributed linear SVM (allreduce)"),
+    "wdamds": ("harp_tpu.models.wdamds", "WDA-MDS / SMACOF embedding"),
+    "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
+}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv or argv[0] in ("-h", "--help", "--list"):
+        print("usage: python -m harp_tpu <app> [args...]\n\napps:")
+        for name, (_, desc) in APPS.items():
+            print(f"  {name:10s} {desc}")
+        return 0 if argv else 2
+    app, rest = argv[0], argv[1:]
+    if app not in APPS:
+        print(f"unknown app {app!r}; run with --list", file=sys.stderr)
+        return 2
+    mod = import_module(APPS[app][0])
+    return mod.main(rest) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

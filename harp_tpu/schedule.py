@@ -1,0 +1,206 @@
+"""Intra-worker task scheduling — Harp L5 (schstatic / schdynamic) parity.
+
+Reference parity (SURVEY.md §3.1): ``edu.iu.harp.schstatic.StaticScheduler``
+and ``edu.iu.harp.schdynamic.DynamicScheduler`` run user ``Task`` objects
+over a thread pool inside one worker — Harp's answer to multicore.  The
+static scheduler pre-assigns inputs to tasks; the dynamic one feeds a shared
+input queue and drains an output queue (``ComputeUtil`` has the
+wait/accounting helpers).  The third L5 component, the ``edu.iu.dymoro``
+rotation pipeline, lives in :mod:`harp_tpu.parallel.rotate`.
+
+TPU-native design: *device* multicore is XLA's job — regular per-item
+compute should be ``jax.vmap``-ed into one kernel (:func:`device_map`), not
+threaded.  What legitimately remains host-side is irregular Python work that
+feeds or drains the device: file parsing, per-tree/per-partition host prep,
+output writing.  For that, these schedulers give Harp's exact API shape on a
+``ThreadPoolExecutor`` (threads, not processes: loaders release the GIL in
+numpy/native code, and device dispatch is async anyway).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Callable, Generic, Iterable, Sequence, TypeVar
+
+import jax
+
+I = TypeVar("I")
+O = TypeVar("O")
+
+_SENTINEL = object()
+
+
+class Task(Generic[I, O]):
+    """User compute unit — ``edu.iu.harp.schdynamic.Task`` equivalent.
+
+    Subclass and override :meth:`run`.  Pass a *list* of instances (one per
+    thread) to a scheduler for thread-private per-task state (buffers,
+    models), exactly like Harp's task objects; passing a single
+    callable/instance shares it across every thread, so it must be
+    stateless or thread-safe.
+    """
+
+    def run(self, item: I) -> O:
+        raise NotImplementedError
+
+    def __call__(self, item: I) -> O:
+        return self.run(item)
+
+
+def _n_threads(n: int | None) -> int:
+    return n if n and n > 0 else (os.cpu_count() or 1)
+
+
+class StaticScheduler(Generic[I, O]):
+    """Pre-partitioned thread-pool execution — ``schstatic.StaticScheduler``.
+
+    Inputs are split round-robin across task instances *before* execution
+    (Harp: each task owns a fixed submission list); results return in input
+    order.  Use when per-item cost is uniform; otherwise prefer
+    :class:`DynamicScheduler`.  A single callable is shared by all threads
+    (see :class:`Task`); pass one instance per thread for private state.
+    """
+
+    def __init__(self, tasks: Sequence[Callable[[I], O]] | Callable[[I], O],
+                 n_threads: int | None = None):
+        if callable(tasks):
+            n = _n_threads(n_threads)
+            self.tasks: list[Callable[[I], O]] = [tasks] * n
+        else:
+            self.tasks = list(tasks)
+        if not self.tasks:
+            raise ValueError("StaticScheduler needs at least one task")
+
+    def schedule(self, items: Sequence[I]) -> list[O]:
+        """Run every item; item *i* goes to task ``i % len(tasks)``."""
+        n = len(self.tasks)
+        results: list[Any] = [None] * len(items)
+        errors: list[BaseException] = []
+
+        def worker(t: int) -> None:
+            try:
+                for idx in range(t, len(items), n):
+                    results[idx] = self.tasks[t](items[idx])
+            except BaseException as e:  # noqa: BLE001 - re-raised on main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                   for t in range(min(n, len(items)))]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errors:
+            raise errors[0]
+        return results
+
+
+class DynamicScheduler(Generic[I, O]):
+    """Work-stealing queue execution — ``schdynamic.DynamicScheduler``.
+
+    Tasks pull from a shared input queue and push to an output queue; the
+    Harp lifecycle (``start`` → ``submit``\\* → ``waitForOutput``/``stop``)
+    is preserved for streaming use, and :meth:`schedule` wraps it for the
+    common submit-all-then-drain pattern (results in completion order,
+    tagged with input index).
+    """
+
+    def __init__(self, tasks: Sequence[Callable[[I], O]] | Callable[[I], O],
+                 n_threads: int | None = None):
+        if callable(tasks):
+            self.tasks: list[Callable[[I], O]] = [tasks] * _n_threads(n_threads)
+        else:
+            self.tasks = list(tasks)
+        if not self.tasks:
+            raise ValueError("DynamicScheduler needs at least one task")
+        self._in: queue.Queue = queue.Queue()
+        self._out: queue.Queue = queue.Queue()
+        self._threads: list[threading.Thread] = []
+        self._submitted = 0
+        self._drained = 0
+
+    # -- Harp lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        if self._threads:
+            raise RuntimeError("scheduler already started")
+
+        def worker(task: Callable[[I], O]) -> None:
+            while True:
+                got = self._in.get()
+                if got is _SENTINEL:
+                    return
+                idx, item = got
+                try:
+                    self._out.put((idx, task(item), None))
+                except BaseException as e:  # noqa: BLE001 - surfaced in wait_output
+                    self._out.put((idx, None, e))
+
+        self._threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+                         for t in self.tasks]
+        for th in self._threads:
+            th.start()
+
+    def submit(self, item: I) -> None:
+        self._in.put((self._submitted, item))
+        self._submitted += 1
+
+    def wait_output(self) -> tuple[int, O]:
+        """Block for one result — ``waitForOutput``; raises task exceptions."""
+        idx, out, err = self._out.get()
+        self._drained += 1
+        if err is not None:
+            raise err
+        return idx, out
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._in.put(_SENTINEL)
+        for th in self._threads:
+            th.join()
+        self._threads = []
+
+    # -- convenience --------------------------------------------------------
+    def schedule(self, items: Iterable[I]) -> list[O]:
+        """submit-all → drain-all → stop; results re-ordered to input order.
+
+        On an externally-started scheduler every prior submission must have
+        been drained first — otherwise a stale result would be mis-slotted
+        into this batch.
+        """
+        started = bool(self._threads)
+        if started and self._submitted != self._drained:
+            raise RuntimeError(
+                f"schedule() with {self._submitted - self._drained} undrained "
+                f"submissions outstanding; wait_output() them first")
+        if not started:
+            self.start()
+        base = self._submitted
+        n = 0
+        for item in items:
+            self.submit(item)
+            n += 1
+        out: list[Any] = [None] * n
+        try:
+            for _ in range(n):
+                idx, val = self.wait_output()
+                assert base <= idx < base + n, (idx, base, n)
+                out[idx - base] = val
+        finally:
+            if not started:
+                self.stop()
+        return out
+
+
+def device_map(fn: Callable, items, *, batched: bool = True):
+    """The TPU-native replacement for thread schedulers on *regular* work.
+
+    Harp threads exist to use a worker's cores on per-item compute; on TPU
+    the same per-item function should be ``vmap``-ed into one XLA kernel so
+    the scalar/vector units and MXU see the whole batch.  ``items`` is a
+    pytree whose leaves have a leading item axis.
+    """
+    if batched:
+        return jax.vmap(fn)(items)
+    return jax.lax.map(fn, items)

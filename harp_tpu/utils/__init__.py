@@ -8,3 +8,11 @@ model dumps → orbax checkpoints.
 from harp_tpu.utils.timing import device_sync, Timer
 
 __all__ = ["device_sync", "Timer"]
+
+# Also available (imported lazily by apps to keep startup light):
+#   harp_tpu.utils.checkpoint  — orbax CheckpointManager (resume support)
+#   harp_tpu.utils.config      — dataclass → argparse CLI configs
+#   harp_tpu.utils.metrics     — per-iteration JSONL metrics logger
+#   harp_tpu.utils.profiling   — jax.profiler trace/annotate helpers
+#   harp_tpu.utils.fault       — fault injection + restart-from-checkpoint
+#   harp_tpu.utils.check       — checkify sanitizers (NaN / OOB / asserts)

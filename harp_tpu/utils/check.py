@@ -1,0 +1,47 @@
+"""Runtime checking — SURVEY.md §6 "race detection / sanitizers".
+
+Reference behavior: Harp has no framework-level race detection — the JVM
+memory model plus a synchronized event queue, with data races possible in
+user ``Task`` threads.  On TPU the collectives and jitted steps are
+pure-functional and deterministic by construction, so the race class
+disappears; what remains worth sanitizing is numerics (NaN/inf) and
+out-of-bounds indexing in gather/scatter-heavy kernels (MF-SGD, LDA).
+``checkify`` instruments those at the XLA level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.experimental import checkify
+
+SANITIZE = checkify.float_checks | checkify.index_checks | checkify.user_checks
+
+
+def checked_jit(fn: Callable, *, errors=SANITIZE, **jit_kwargs) -> Callable:
+    """``jit`` with NaN / OOB-index / user-assert sanitizers compiled in.
+
+    Returns a callable with the same signature as ``fn`` that raises
+    ``checkify.JaxRuntimeError`` (on the host, at call time) if any check
+    trips on device.  Debug/test builds pay the instrumentation cost; hot
+    production loops should jit the raw ``fn``.
+    """
+    checked = checkify.checkify(fn, errors=errors)
+    compiled = jax.jit(checked, **jit_kwargs)
+
+    def wrapper(*args, **kw):
+        err, out = compiled(*args, **kw)
+        checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+def assert_finite(tree: Any, name: str = "value") -> None:
+    """In-kernel user check: every leaf finite (use inside checked fns)."""
+    import jax.numpy as jnp
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        checkify.check(jnp.all(jnp.isfinite(leaf)),
+                       f"{name}{jax.tree_util.keystr(path)} has non-finite values")

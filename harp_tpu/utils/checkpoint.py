@@ -53,8 +53,9 @@ class CheckpointManager:
     def save(self, step: int, state: Any) -> str:
         """Write state (any pytree of arrays) for ``step``; prunes old."""
         path = self._path(step)
-        # device arrays → host before orbax (works for sharded arrays too)
-        host_state = jax.tree.map(np.asarray, state)
+        # device arrays → host before orbax (works for sharded arrays too);
+        # wrap in a dict so bare-array / scalar states are valid orbax trees
+        host_state = {"state": jax.tree.map(np.asarray, state)}
         self._ckptr.save(path, host_state, force=True)
         for old in self.steps()[: -self.keep] if self.keep else []:
             import shutil
@@ -68,4 +69,7 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.root}")
-        return step, self._ckptr.restore(self._path(step))
+        tree = self._ckptr.restore(self._path(step))
+        if isinstance(tree, dict) and set(tree) == {"state"}:
+            return step, tree["state"]
+        return step, tree  # checkpoint from before the {"state": ...} wrapper

@@ -1,0 +1,30 @@
+"""L8 launcher tests — unified CLI dispatcher."""
+
+import harp_tpu.__main__ as cli
+
+
+def test_list(capsys):
+    assert cli.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for app in ("kmeans", "mfsgd", "lda", "mlp", "subgraph", "rf", "bench"):
+        assert app in out
+
+
+def test_unknown_app(capsys):
+    assert cli.main(["nosuchapp"]) == 2
+    assert "unknown app" in capsys.readouterr().err
+
+
+def test_dispatch_kmeans_smoke(capsys):
+    rc = cli.main(["kmeans", "--n", "512", "--d", "8", "--k", "4",
+                   "--iters", "3", "--bench"])
+    assert rc == 0
+    assert "iters_per_sec" in capsys.readouterr().out
+
+
+def test_dispatch_bench_smoke(capsys):
+    rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
+                   "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "allreduce" in out

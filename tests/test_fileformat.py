@@ -1,0 +1,80 @@
+"""L4 input-format tests — edu.iu.fileformat parity (SURVEY.md §3.1)."""
+
+import numpy as np
+import pytest
+
+from harp_tpu import fileformat as ff
+
+
+def _write(tmp_path, name, rows):
+    p = tmp_path / name
+    p.write_text("\n".join(",".join(str(v) for v in r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_multi_file_splits_balanced_by_size(tmp_path):
+    paths = []
+    for i, n in enumerate([100, 1, 1, 1]):
+        paths.append(_write(tmp_path, f"f{i}.csv", [[j, j] for j in range(n)]))
+    splits = ff.multi_file_splits(paths, 2)
+    assert len(splits) == 2
+    assert sorted(sum(splits, [])) == sorted(paths)
+    # the big file's worker should not also get all the small ones
+    sizes = [sum(len(open(p).read()) for p in s) for s in splits]
+    assert max(sizes) < sum(sizes)
+
+
+def test_multi_file_splits_more_workers_than_files(tmp_path):
+    p = _write(tmp_path, "only.csv", [[1, 2]])
+    splits = ff.multi_file_splits([p], 4)
+    assert sum(len(s) for s in splits) == 1
+    assert len(splits) == 4
+
+
+def test_single_file_splits_requires_match(tmp_path):
+    ps = [_write(tmp_path, f"f{i}.csv", [[i]]) for i in range(3)]
+    assert ff.single_file_splits(ps, 3) == [[p] for p in ps]
+    with pytest.raises(ValueError):
+        ff.single_file_splits(ps, 4)
+
+
+def test_load_sharded_csv_roundtrip(tmp_path, mesh):
+    rng = np.random.default_rng(0)
+    all_rows = []
+    paths = []
+    for i in range(5):  # 5 files, uneven rows, over 8 workers
+        rows = rng.normal(size=(3 + 2 * i, 4)).round(3)
+        all_rows.append(rows)
+        paths.append(_write(tmp_path, f"part{i}.csv", rows.tolist()))
+    stacked, counts = ff.load_sharded_csv(str(tmp_path), mesh.num_workers)
+    assert counts.sum() == sum(r.shape[0] for r in all_rows)
+    rows_pad = stacked.shape[0] // mesh.num_workers
+    assert rows_pad == counts.max()
+    # every real row present exactly once; padding is zeros
+    real = np.concatenate([
+        stacked[w * rows_pad: w * rows_pad + c] for w, c in enumerate(counts)])
+    want = np.concatenate(all_rows).astype(np.float32)
+    got = sorted(map(tuple, real.round(3).tolist()))
+    assert got == sorted(map(tuple, want.round(3).tolist()))
+    # shardable on the mesh
+    arr = mesh.shard_array(stacked)
+    assert arr.shape == stacked.shape
+
+
+def test_load_sharded_triples(tmp_path, mesh):
+    lines = [(u, u % 3, float(u) / 2) for u in range(11)]
+    for i in range(3):
+        _write(tmp_path, f"r{i}.txt", [list(t) for t in lines[i::3]])
+    (u, i_, v), counts = ff.load_sharded_triples(str(tmp_path), 4)
+    assert counts.sum() == 11
+    mask = u >= 0
+    assert mask.sum() == 11
+    got = sorted(zip(u[mask].tolist(), i_[mask].tolist(), v[mask].tolist()))
+    assert got == sorted(lines)
+    # padding convention: u = i = -1, v = 0
+    assert np.all(i_[~mask] == -1) and np.all(v[~mask] == 0)
+
+
+def test_load_sharded_csv_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ff.load_sharded_csv(str(tmp_path / "nope*.csv"), 2)
