@@ -182,14 +182,29 @@ class DynamicScheduler(Generic[I, O]):
             self.submit(item)
             n += 1
         out: list[Any] = [None] * n
+        first_err: BaseException | None = None
         try:
+            # drain the WHOLE batch even when a task failed — leaving results
+            # queued would mis-slot them into the next schedule() call
             for _ in range(n):
-                idx, val = self.wait_output()
+                idx, val, err = self._out.get()
+                self._drained += 1
+                if err is not None:
+                    first_err = first_err or err
+                    continue
                 assert base <= idx < base + n, (idx, base, n)
                 out[idx - base] = val
         finally:
             if not started:
                 self.stop()
+                while True:  # interrupted drain: discard leftovers
+                    try:
+                        self._out.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._drained += 1
+        if first_err is not None:
+            raise first_err
         return out
 
 

@@ -55,7 +55,8 @@ class CheckpointManager:
         path = self._path(step)
         # device arrays → host before orbax (works for sharded arrays too);
         # wrap in a dict so bare-array / scalar states are valid orbax trees
-        host_state = {"state": jax.tree.map(np.asarray, state)}
+        # (the dunder key cannot collide with a user pytree's own keys)
+        host_state = {"__harp_state__": jax.tree.map(np.asarray, state)}
         self._ckptr.save(path, host_state, force=True)
         for old in self.steps()[: -self.keep] if self.keep else []:
             import shutil
@@ -70,6 +71,8 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.root}")
         tree = self._ckptr.restore(self._path(step))
-        if isinstance(tree, dict) and set(tree) == {"state"}:
-            return step, tree["state"]
-        return step, tree  # checkpoint from before the {"state": ...} wrapper
+        if isinstance(tree, dict) and set(tree) == {"__harp_state__"}:
+            return step, tree["__harp_state__"]
+        raise ValueError(
+            f"{self._path(step)} is not a harp-tpu checkpoint "
+            f"(missing the __harp_state__ wrapper)")

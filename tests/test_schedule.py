@@ -81,6 +81,20 @@ def test_dynamic_scheduler_propagates_errors():
         DynamicScheduler(boom, n_threads=2).schedule(range(8))
 
 
+def test_dynamic_scheduler_reusable_after_failure():
+    # a failed batch must not leave stale results queued: the next
+    # schedule() on the same object has to see a clean output queue
+    def boom(x):
+        if x == 3:
+            raise RuntimeError("worker task failed")
+        return x * 10
+
+    sched = DynamicScheduler(boom, n_threads=2)
+    with pytest.raises(RuntimeError, match="worker task failed"):
+        sched.schedule(range(6))
+    assert sched.schedule([1, 2]) == [10, 20]
+
+
 def test_device_map_matches_loop():
     xs = jnp.arange(12.0).reshape(6, 2)
     out = device_map(lambda row: row.sum() * 2, xs)
