@@ -42,6 +42,9 @@ class KMeansConfig:
     iters: int = 10
     dtype: Any = jnp.float32  # bf16 points keep f32 accumulation (MXU-friendly)
     block_points: int = 0  # >0: process points in blocks to bound the [n,k] dist matrix
+    # opt-in single-pass Pallas kernel; the default XLA path measured faster
+    # on v5e (see harp_tpu/ops/kmeans_kernel.py for the numbers)
+    use_pallas: bool = False
 
     def __post_init__(self):
         if self.k < 1:
@@ -74,19 +77,35 @@ def _partials_block(points, centroids, c2):
     return sums, counts, inertia
 
 
+def kmeans_kernel_supported(n: int) -> bool:
+    """use_pallas falls back to the XLA path when no tile divides the shard."""
+    from harp_tpu.ops import kmeans_kernel
+
+    return kmeans_kernel.supported(n)
+
+
 def kmeans_step(points, centroids, cfg: KMeansConfig):
     """One Lloyd iteration (device view, per-worker shard).
 
     Returns (new_centroids, inertia).  The partial-sums → allreduce is
     exactly Harp's regroup+allgather phase, fused to one psum.
     """
-    c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)  # [k]
     n = points.shape[0]
     block = cfg.block_points
-    if block <= 0 or block >= n:
+    if cfg.use_pallas and kmeans_kernel_supported(n):
+        from harp_tpu.ops import kmeans_kernel
+
+        if block:
+            raise ValueError("block_points has no effect with use_pallas "
+                             "(the kernel picks its own tile size)")
+        sums, counts, partial_inertia = kmeans_kernel.kmeans_partials(
+            points, centroids, interpret=jax.default_backend() != "tpu")
+    elif block <= 0 or block >= n:
+        c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)  # [k]
         sums, counts, partial_inertia = _partials_block(points, centroids, c2)
     else:
         assert n % block == 0, "block_points must divide the local shard size"
+        c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)  # [k]
         blocks = points.reshape(n // block, block, points.shape[1])
         sums, counts, partial_inertia = lax.map(
             lambda b: _partials_block(b, centroids, c2), blocks
@@ -117,7 +136,7 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
 
 
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
-        dtype=jnp.float32, block_points=0):
+        dtype=jnp.float32, block_points=0, use_pallas=False):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
     ``points``: [n, d] host or device array; sharded over workers on dim 0.
@@ -127,7 +146,8 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     golden tests use this mode).
     """
     mesh = mesh or current_mesh()
-    cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points)
+    cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points,
+                       use_pallas=use_pallas)
     n = points.shape[0]
     if seed is None:
         init_idx = np.arange(k)
@@ -142,10 +162,10 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
-              warmup=2, seed=0):
+              warmup=2, seed=0, use_pallas=False):
     """Measure iter/sec on the graded 1M×300 k=100 config (north-star metric)."""
     mesh = mesh or current_mesh()
-    cfg = KMeansConfig(k=k, iters=1, dtype=dtype)
+    cfg = KMeansConfig(k=k, iters=1, dtype=dtype, use_pallas=use_pallas)
     nw = mesh.num_workers
     n = (n // nw) * nw  # actual points generated/processed (and reported)
 

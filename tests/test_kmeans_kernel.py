@@ -1,0 +1,70 @@
+"""Fused Pallas KMeans kernel vs the XLA partials path (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harp_tpu.models.kmeans import KMeansConfig, _partials_block, fit
+from harp_tpu.ops import kmeans_kernel
+
+
+def _blobs(n, d, k, seed=0, spread=8.0):
+    """Well-separated clusters: assignment is unambiguous under bf16 scoring
+    (the kernel computes distances in bf16 on the MXU, so boundary points of
+    overlapping blobs may legitimately flip vs an f32 reference)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, d)).astype(np.float32) * spread
+    assign = rng.integers(0, k, n)
+    assign[:k] = np.arange(k)  # first-k init (seed=None) gets one per blob
+    pts = centers[assign] + rng.normal(size=(n, d)).astype(np.float32) * 0.1
+    return pts.astype(np.float32), centers
+
+
+def test_kernel_matches_xla_partials():
+    pts, centers = _blobs(512, 40, 7)
+    c = jnp.asarray(centers)
+    s1, n1, i1 = kmeans_kernel.kmeans_partials(jnp.asarray(pts), c,
+                                               interpret=True)
+    c2 = (c ** 2).sum(-1)
+    s2, n2, i2 = _partials_block(jnp.asarray(pts), c, c2)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                               rtol=2e-2, atol=2e-2)
+    # inertia comes from the ||x||² − 2x·c + ||c||² decomposition, which
+    # cancels catastrophically when cluster spread ≫ within-cluster distance;
+    # under bf16 scoring the absolute error scales with Σ||x||², not with the
+    # inertia itself (see kernel docstring)
+    x2 = float((pts.astype(np.float64) ** 2).sum())
+    assert abs(float(i1) - float(i2)) < 4e-3 * x2
+
+
+def test_kernel_tie_breaks_to_lowest_index():
+    # two identical centroids: every point must land on index 0, like argmin
+    pts = jnp.asarray(np.random.default_rng(0).normal(size=(64, 8)),
+                      jnp.float32)
+    c = jnp.tile(pts[:1], (4, 1))
+    _, counts, _ = kmeans_kernel.kmeans_partials(pts, c, interpret=True)
+    assert counts[0] == 64 and counts[1:].sum() == 0
+
+
+def test_supported_tile_sizes():
+    assert kmeans_kernel.supported(1_000_000)
+    assert kmeans_kernel.supported(512)
+    assert not kmeans_kernel.supported(7)
+
+
+def test_fit_use_pallas_matches_default(mesh):
+    pts, _ = _blobs(mesh.num_workers * 64, 16, 4, seed=1)
+    c1, i1 = fit(pts, k=4, iters=4, mesh=mesh, seed=None, use_pallas=True)
+    c2, i2 = fit(pts, k=4, iters=4, mesh=mesh, seed=None)
+    np.testing.assert_allclose(c1, c2, rtol=2e-2, atol=2e-2)
+    x2 = float((pts.astype(np.float64) ** 2).sum())
+    assert abs(i1 - i2) < 4e-3 * x2  # bf16 cancellation bound, see above
+
+
+def test_kernel_rejects_unsupported_n():
+    pts = jnp.zeros((7, 8), jnp.float32)
+    c = jnp.zeros((2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="tile size"):
+        kmeans_kernel.kmeans_partials(pts, c, interpret=True)
