@@ -32,7 +32,19 @@ from harp_tpu.parallel.mesh import (
 )
 from harp_tpu.parallel import collective
 from harp_tpu.parallel.collective import Combiner
-from harp_tpu.table import Table, Partition
+from harp_tpu.table import (
+    Int2DoubleKVTable,
+    Int2FloatKVTable,
+    Int2IntKVTable,
+    Int2LongKVTable,
+    KVTable,
+    Long2DoubleKVTable,
+    Long2IntKVTable,
+    Partition,
+    Table,
+    combine_by_key,
+    kv_allreduce,
+)
 from harp_tpu.schedule import StaticScheduler, DynamicScheduler, Task
 
 __version__ = "0.1.0"
@@ -44,6 +56,15 @@ __all__ = [
     "init_distributed",
     "collective",
     "Combiner",
+    "KVTable",
+    "Int2IntKVTable",
+    "Int2LongKVTable",
+    "Int2FloatKVTable",
+    "Int2DoubleKVTable",
+    "Long2IntKVTable",
+    "Long2DoubleKVTable",
+    "kv_allreduce",
+    "combine_by_key",
     "Table",
     "Partition",
     "StaticScheduler",

@@ -64,19 +64,11 @@ class Table:
 
     # -- Harp Table API -----------------------------------------------------
     def add_partition(self, pid: int, data: Any) -> None:
-        if pid in self._parts:
-            if self.combiner is Combiner.AVG:
-                # running mean over ALL contributions, matching allreduce(AVG)
-                # and combine_by_key(AVG) — not a pairwise (a+b)/2.
-                n = self._counts[pid]
-                old = np.asarray(self._parts[pid])
-                self._parts[pid] = old + (np.asarray(data) - old) / (n + 1)
-            else:
-                self._parts[pid] = _combine_host(self.combiner, self._parts[pid], data)
-            self._counts[pid] += 1
-        else:
-            self._parts[pid] = data
-            self._counts[pid] = 1
+        # running mean over ALL contributions for AVG, matching
+        # allreduce(AVG) and combine_by_key(AVG) — not a pairwise (a+b)/2.
+        # data is stored verbatim on first insert (np/jnp array or any
+        # pytree); only collisions force array arithmetic.
+        _accumulate(self._parts, self._counts, pid, data, self.combiner)
 
     def get_partition(self, pid: int) -> Any:
         return self._parts[pid]
@@ -140,6 +132,280 @@ def _combine_host(comb: Combiner, a, b):
     if comb is Combiner.MULTIPLY:
         return a * b
     raise AssertionError(comb)
+
+
+# ---------------------------------------------------------------------------
+# KV tables — edu.iu.harp.keyval equivalent.
+#
+# Harp layers typed key-value tables (Int2IntKVTable, Long2DoubleKVTable, …)
+# over partitions: keys hash to partitions (key % numPartitions), and a
+# ValCombiner resolves collisions as entries are added, so collectives can
+# move whole key-partitions and merge them without app code.  Host-side
+# bookkeeping stays a dict here; device compute goes through to_arrays() /
+# combine_by_key (the segment-reduce form XLA vectorizes).
+# ---------------------------------------------------------------------------
+
+
+def _accumulate(store: dict, counts: dict, key: int, value, combiner: Combiner,
+                weight: int = 1) -> None:
+    """Fold one contribution into a keyed store — the one ValCombiner kernel.
+
+    Shared by ``Table.add_partition``, ``KVTable.add`` and ``KVTable.merge``
+    so AVG semantics (a true running mean over ALL contributions, matching
+    ``allreduce(AVG)`` / ``combine_by_key(AVG)``) live in exactly one place.
+    ``weight`` is how many raw contributions ``value`` already aggregates
+    (used when merging pre-combined tables).
+    """
+    if key in store:
+        if combiner is Combiner.AVG:
+            n = counts[key]
+            old = np.asarray(store[key])
+            store[key] = old + (np.asarray(value) - old) * (weight / (n + weight))
+        else:
+            store[key] = _combine_host(combiner, store[key], value)
+        counts[key] += weight
+    else:
+        store[key] = value
+        counts[key] = weight
+
+
+class KVTable:
+    """Typed key→value table with ValCombiner collision semantics.
+
+    ``add`` on an existing key invokes the combiner (Harp: ``ValCombiner.
+    combine``); values may be scalars or fixed-shape arrays.  ``partition``
+    buckets keys Harp-style (``key % num_partitions``) for placement; the
+    ``merge`` method is what collective exchange uses to fold one worker's
+    table into another's.
+
+    AVG caveat: a mean is not closed over integers, so AVG tables store
+    float64 values regardless of the typed ``dtype`` (an ``Int2IntKVTable``
+    with AVG yields float means — truncating back to int would silently
+    diverge from ``combine_by_key(AVG)`` and from merge round-trips).
+    """
+
+    def __init__(self, combiner: Combiner | str = Combiner.ADD,
+                 num_partitions: int = 1, dtype=None):
+        self.combiner = combiner if isinstance(combiner, Combiner) else Combiner(combiner)
+        self.num_partitions = int(num_partitions)
+        self.dtype = np.float64 if self.combiner is Combiner.AVG and dtype is not None \
+            and np.issubdtype(np.dtype(dtype), np.integer) else dtype
+        self._kv: dict[int, Any] = {}
+        self._counts: dict[int, int] = {}
+
+    # -- Harp KVTable API ---------------------------------------------------
+    def add(self, key: int, value: Any) -> None:
+        _accumulate(self._kv, self._counts, int(key),
+                    np.asarray(value, dtype=self.dtype), self.combiner)
+
+    def get(self, key: int, default: Any = None) -> Any:
+        return self._kv.get(int(key), default)
+
+    def keys(self) -> list[int]:
+        return sorted(self._kv)
+
+    def items(self) -> Iterator[tuple[int, Any]]:
+        for k in self.keys():
+            yield k, self._kv[k]
+
+    def __len__(self) -> int:
+        return len(self._kv)
+
+    def __contains__(self, key: int) -> bool:
+        return int(key) in self._kv
+
+    def partition(self, key: int) -> int:
+        """Owning partition for a key — Harp's ``key % numPartitions``."""
+        return int(key) % self.num_partitions
+
+    def merge(self, other: "KVTable") -> None:
+        """Fold another table in through the combiner (collective merge step).
+
+        Count-weighted: a key that aggregates ``m`` raw contributions in
+        ``other`` enters the AVG running mean with weight ``m``, so merging
+        pre-combined worker tables equals combining all raw contributions
+        directly (parity with ``combine_by_key(AVG)``).
+        """
+        for k in other.keys():
+            _accumulate(self._kv, self._counts, k,
+                        np.asarray(other._kv[k], dtype=self.dtype),
+                        self.combiner, weight=other._counts[k])
+
+    # -- device bridge ------------------------------------------------------
+    def to_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dense ``(keys [n] int64, values [n, ...], counts [n] int64)`` view.
+
+        Keys ascending; ``counts[i]`` is how many raw contributions
+        ``values[i]`` aggregates (needed to merge AVG tables faithfully).
+        An empty table yields values of shape ``(0,)`` — the value shape is
+        unknowable before the first ``add``.
+        """
+        ks = self.keys()
+        keys = np.asarray(ks, dtype=np.int64)
+        counts = np.asarray([self._counts[k] for k in ks], dtype=np.int64)
+        if ks:
+            vals = np.stack([np.asarray(self._kv[k]) for k in ks])
+        else:
+            vals = np.zeros((0,), dtype=self.dtype or np.float32)
+        return keys, vals, counts
+
+    @classmethod
+    def from_arrays(cls, keys, values, combiner: Combiner | str = Combiner.ADD,
+                    num_partitions: int = 1, dtype=None, counts=None) -> "KVTable":
+        # typed subclasses pin dtype in their __init__ and don't accept it
+        t = cls(combiner, num_partitions) if cls is not KVTable \
+            else cls(combiner, num_partitions, dtype)
+        keys = np.asarray(keys).tolist()
+        counts = [1] * len(keys) if counts is None else np.asarray(counts).tolist()
+        for k, v, c in zip(keys, np.asarray(values), counts):
+            _accumulate(t._kv, t._counts, int(k),
+                        np.asarray(v, dtype=t.dtype), t.combiner, weight=int(c))
+        return t
+
+
+# Harp's typed table classes (edu.iu.harp.keyval.*KVTable) — the key is
+# always a python int here; the *value* dtype is what the names pin down.
+class Int2IntKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.int32)
+
+
+class Int2LongKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.int64)
+
+
+class Int2FloatKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.float32)
+
+
+class Int2DoubleKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.float64)
+
+
+class Long2IntKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.int32)
+
+
+class Long2DoubleKVTable(KVTable):
+    def __init__(self, combiner: Combiner | str = Combiner.ADD, num_partitions: int = 1):
+        super().__init__(combiner, num_partitions, dtype=np.float64)
+
+
+def _empty_like(table: KVTable) -> KVTable:
+    """Fresh empty table of the same (sub)class, combiner and partitioning."""
+    if type(table) is KVTable:
+        return KVTable(table.combiner, table.num_partitions, table.dtype)
+    return type(table)(table.combiner, table.num_partitions)
+
+
+def kv_allreduce(table: KVTable, worker_tables: list[KVTable] | None = None):
+    """Merge KV tables across workers so every worker holds the union.
+
+    The KV analogue of Harp's table allreduce: the ValCombiner resolves key
+    collisions (count-weighted, so AVG matches combining raw contributions).
+
+    Two deployment shapes:
+    - single process (this machine, tests): the per-worker tables live in
+      one host process — pass them as ``worker_tables``;
+    - multi-host (``jax.distributed``): each host passes only its local
+      ``table`` and the union is formed over all processes via a host
+      allgather of the (keys, values, counts) arrays.
+
+    Device-side dense key spaces should use :func:`combine_by_key` +
+    ``allreduce`` instead — this host path serves the irregular apps.
+    """
+    merged = _empty_like(table)
+    merged.merge(table)
+    for t in worker_tables or []:
+        merged.merge(t)
+
+    if jax.process_count() > 1:
+        merged = _kv_process_union(merged)
+    return merged
+
+
+def _kv_process_union(local: KVTable) -> KVTable:
+    """Union a KV table across all ``jax.distributed`` processes.
+
+    ``process_allgather`` needs identical shapes/dtypes on every process, so
+    the value signature (rank + dims + dtype) and the pad length are agreed
+    globally first; a process with an empty table (value shape unknowable
+    locally) adopts the gathered signature.  Validity is carried by
+    ``counts > 0``, not a key sentinel, so negative keys survive.  All
+    payloads travel as raw bytes (uint8 views): ``process_allgather`` moves
+    data through JAX device arrays, which with x64 disabled would silently
+    downcast int64→int32 / float64→float32 — byte transport is dtype-exact
+    by construction.
+    """
+    from jax.experimental import multihost_utils
+
+    def gather_rows(arr2d: np.ndarray, n_rows_max: int) -> np.ndarray:
+        """Allgather a [n, b] byte matrix padded to [n_rows_max, b] → [P, n_rows_max, b]."""
+        padded = np.pad(arr2d, ((0, n_rows_max - arr2d.shape[0]), (0, 0)))
+        return np.asarray(multihost_utils.process_allgather(padded))
+
+    def as_bytes(arr: np.ndarray) -> np.ndarray:
+        a = np.ascontiguousarray(arr)
+        return a.view(np.uint8).reshape(a.shape[0], -1) if a.size else \
+            np.zeros((0, a.itemsize * (int(np.prod(a.shape[1:])) or 1)), np.uint8)
+
+    keys, vals, counts = local.to_arrays()
+    vshape = vals.shape[1:]
+
+    # agree on (n_max, value dtype, value rank, value dims) across processes
+    _MAXD = 8
+    sig = np.full(3 + _MAXD, -1, np.int32)
+    sig[0] = len(keys)
+    if len(keys):
+        sig[1] = np.dtype(vals.dtype).num
+        sig[2] = len(vshape)
+        sig[3:3 + len(vshape)] = vshape
+    all_sig = np.asarray(multihost_utils.process_allgather(sig))
+    n_max = int(all_sig[:, 0].max())
+    nonempty = all_sig[all_sig[:, 0] > 0]
+    if n_max == 0:
+        return local  # every process is empty
+    sigs = {tuple(r[1:]) for r in nonempty.tolist()}
+    if len(sigs) > 1:
+        raise ValueError(
+            f"kv_allreduce: value dtypes/shapes differ across processes: "
+            f"{sorted(sigs)}"
+        )
+    vdtype = _dtype_from_num(int(nonempty[0, 1]))
+    rank = int(nonempty[0, 2])
+    vshape = tuple(int(x) for x in nonempty[0, 3:3 + rank])
+
+    flat = np.asarray(vals, vdtype).reshape(len(keys), -1) if len(keys) else \
+        np.zeros((0, int(np.prod(vshape, dtype=np.int64)) if vshape else 1), vdtype)
+    all_keys = gather_rows(as_bytes(keys[:, None]), n_max).view(np.int64)[..., 0]
+    all_vals = gather_rows(as_bytes(flat), n_max).view(vdtype)
+    all_counts = gather_rows(as_bytes(counts[:, None]), n_max).view(np.int64)[..., 0]
+
+    union = _empty_like(local)
+    for p in range(all_keys.shape[0]):
+        for k, v, c in zip(all_keys[p], all_vals[p], all_counts[p]):
+            if c > 0:
+                _accumulate(union._kv, union._counts, int(k),
+                            np.asarray(v.reshape(vshape), dtype=union.dtype),
+                            union.combiner, weight=int(c))
+    return union
+
+
+_NUMPY_DTYPES_BY_NUM = {np.dtype(t).num: np.dtype(t) for t in
+                        (np.int8, np.int16, np.int32, np.int64,
+                         np.uint8, np.uint16, np.uint32, np.uint64,
+                         np.float16, np.float32, np.float64, np.bool_)}
+
+
+def _dtype_from_num(num: int) -> np.dtype:
+    try:
+        return _NUMPY_DTYPES_BY_NUM[num]
+    except KeyError:
+        raise ValueError(f"kv_allreduce: unsupported value dtype num {num}") from None
 
 
 # ---------------------------------------------------------------------------

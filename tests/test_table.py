@@ -8,8 +8,12 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel.collective import Combiner
 from harp_tpu.table import (
+    Int2DoubleKVTable,
+    Int2IntKVTable,
+    KVTable,
     Table,
     combine_by_key,
+    kv_allreduce,
     modulo_partitioner,
     pull_rows,
     push_rows,
@@ -88,3 +92,158 @@ def test_avg_combiner_is_true_mean_over_three():
 def test_empty_table_stacked_raises():
     with pytest.raises(ValueError, match="no partitions"):
         Table().to_stacked()
+
+
+def test_kvtable_valcombiner_on_collision():
+    t = Int2IntKVTable()  # ADD combiner, int32 values
+    t.add(7, 2)
+    t.add(7, 3)
+    t.add(1, 10)
+    assert int(t.get(7)) == 5
+    assert int(t.get(1)) == 10
+    assert t.get(99, default=-1) == -1
+    assert len(t) == 2 and 7 in t and t.keys() == [1, 7]
+    assert t.get(7).dtype == np.int32
+
+
+def test_kvtable_avg_is_true_mean():
+    t = Int2DoubleKVTable(Combiner.AVG)
+    for v in (1.0, 2.0, 6.0):
+        t.add(5, v)
+    np.testing.assert_allclose(t.get(5), 3.0)
+
+
+def test_kvtable_array_values_and_roundtrip():
+    t = KVTable("max", dtype=np.float32)
+    t.add(2, [1.0, 5.0])
+    t.add(2, [3.0, 2.0])
+    t.add(0, [0.0, 0.0])
+    keys, vals, counts = t.to_arrays()
+    np.testing.assert_array_equal(keys, [0, 2])
+    np.testing.assert_array_equal(counts, [1, 2])
+    np.testing.assert_allclose(vals[1], [3.0, 5.0])
+    t2 = KVTable.from_arrays(keys, vals, "max", counts=counts)
+    np.testing.assert_allclose(t2.get(2), [3.0, 5.0])
+
+
+def test_kvtable_empty_to_arrays_shapes():
+    t = KVTable(dtype=np.float32)
+    keys, vals, counts = t.to_arrays()
+    assert keys.shape == (0,) and vals.shape == (0,) and counts.shape == (0,)
+    t.add(1, [1.0, 2.0, 3.0])
+    assert t.to_arrays()[1].shape == (1, 3)
+
+
+def test_typed_kvtables_are_classes():
+    t = Int2IntKVTable()
+    assert isinstance(t, Int2IntKVTable) and isinstance(t, KVTable)
+
+
+def test_typed_kvtable_from_arrays_roundtrip():
+    t = Int2IntKVTable()
+    t.add(1, 3)
+    t.add(2, 4)
+    keys, vals, counts = t.to_arrays()
+    t2 = Int2IntKVTable.from_arrays(keys, vals, counts=counts)
+    assert isinstance(t2, Int2IntKVTable)
+    assert int(t2.get(1)) == 3 and t2.get(2).dtype == np.int32
+
+
+def test_int_kvtable_avg_promotes_to_float():
+    t = Int2IntKVTable(Combiner.AVG)
+    t.add(0, 1)
+    t.add(0, 2)
+    np.testing.assert_allclose(t.get(0), 1.5)  # not truncated to int
+
+
+def test_kv_process_union_single_process():
+    """The multi-host union path, driven with process_count==1.
+
+    Exercises the signature agreement, padding, float64 transport, and
+    counts>0 validity (negative keys must survive).
+    """
+    from harp_tpu.table import _kv_process_union
+
+    t = KVTable("add", dtype=np.float32)
+    t.add(-3, [1.0, 2.0])  # negative key
+    t.add(5, [3.0, 4.0])
+    t.add(5, [1.0, 1.0])
+    u = _kv_process_union(t)
+    assert u.keys() == [-3, 5]
+    np.testing.assert_allclose(u.get(-3), [1.0, 2.0])
+    np.testing.assert_allclose(u.get(5), [4.0, 5.0])
+    assert u.get(5).dtype == np.float32
+
+    empty = KVTable("add", dtype=np.float32)
+    assert _kv_process_union(empty).keys() == []
+
+
+def test_kv_process_union_int64_exact_and_typed():
+    """Byte transport: int64 counters above 2**53 survive exactly, and the
+    union keeps the typed subclass."""
+    from harp_tpu.table import Int2LongKVTable, _kv_process_union
+
+    big = 2**60 + 1
+    t = Int2LongKVTable()
+    t.add(1, big)
+    t.add(2**40, 7)  # key beyond int32 range survives too
+    u = _kv_process_union(t)
+    assert isinstance(u, Int2LongKVTable)
+    assert int(u.get(1)) == big
+    assert int(u.get(2**40)) == 7
+
+
+def test_kv_allreduce_preserves_typed_class():
+    from harp_tpu.table import Int2IntKVTable, kv_allreduce
+
+    t = Int2IntKVTable()
+    t.add(0, 1)
+    assert isinstance(kv_allreduce(t), Int2IntKVTable)
+
+
+def test_table_first_insert_stored_verbatim():
+    t = Table()
+    d = {"w": np.ones(2)}
+    t.add_partition(0, d)
+    assert t.get_partition(0) is d  # pytree payloads survive un-coerced
+
+
+def test_kvtable_partitioning_matches_modulo():
+    t = KVTable(num_partitions=4)
+    assert [t.partition(k) for k in (0, 1, 5, 11)] == [0, 1, 1, 3]
+
+
+def test_kv_allreduce_merges_worker_tables():
+    workers = []
+    for w in range(3):
+        t = Int2IntKVTable()
+        t.add(w, 1)       # unique key per worker
+        t.add(100, w + 1)  # shared key: combined 1+2+3
+        workers.append(t)
+    merged = kv_allreduce(workers[0], worker_tables=workers[1:])
+    assert merged.keys() == [0, 1, 2, 100]
+    assert int(merged.get(100)) == 6
+
+
+def test_kv_merge_avg_is_count_weighted():
+    """Merging pre-combined AVG tables == AVG over all raw contributions."""
+    a = KVTable(Combiner.AVG, dtype=np.float64)
+    a.add(7, 0.0)
+    a.add(7, 0.0)       # a holds mean 0.0 with count 2
+    b = KVTable(Combiner.AVG, dtype=np.float64)
+    b.add(7, 6.0)       # b holds mean 6.0 with count 1
+    merged = kv_allreduce(a, worker_tables=[b])
+    np.testing.assert_allclose(merged.get(7), 2.0)  # (0+0+6)/3, not 3.0
+
+
+def test_kvtable_matches_combine_by_key():
+    """Host KVTable and device combine_by_key agree (same ValCombiner math)."""
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 16, 200)
+    vals = rng.normal(size=200).astype(np.float32)
+    t = KVTable(Combiner.ADD, dtype=np.float32)
+    for k, v in zip(keys, vals):
+        t.add(k, v)
+    dense = np.asarray(combine_by_key(jnp.asarray(keys), jnp.asarray(vals), 16))
+    for k in t.keys():
+        np.testing.assert_allclose(t.get(k), dense[k], rtol=1e-5)
