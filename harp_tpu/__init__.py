@@ -45,6 +45,7 @@ from harp_tpu.table import (
     combine_by_key,
     kv_allreduce,
 )
+from harp_tpu.mapper import CollectiveApp, KeyValReader, run_app
 from harp_tpu.schedule import StaticScheduler, DynamicScheduler, Task
 
 __version__ = "0.1.0"
@@ -67,6 +68,9 @@ __all__ = [
     "combine_by_key",
     "Table",
     "Partition",
+    "CollectiveApp",
+    "KeyValReader",
+    "run_app",
     "StaticScheduler",
     "DynamicScheduler",
     "Task",

@@ -29,6 +29,54 @@ from harp_tpu.utils.metrics import MetricsLogger
 log = logging.getLogger("harp_tpu")
 
 
+class KeyValReader:
+    """This worker's input splits — Harp's ``KeyValReader`` handed to
+    ``mapCollective`` (key = file path, value = loader result).
+
+    Harp's map-collective jobs use ``MultiFileInputFormat`` so each mapper
+    receives whole files; the reader iterates them.  Here the splits come
+    from :mod:`harp_tpu.fileformat` and ``value`` is produced lazily by the
+    ``loader`` (default: the native C++ CSV loader, the Harp-DAAL
+    ``HarpDAALDataSource`` equivalent).
+    """
+
+    def __init__(self, paths: list[str], loader=None):
+        if loader is None:
+            from harp_tpu.native.datasource import load_csv as loader
+        self._paths = list(paths)
+        self._loader = loader
+        self._pos = 0
+        self._value = None  # loaded lazily, cached per position
+
+    def __iter__(self):
+        for p in self._paths:
+            yield p, self._loader(p)
+
+    # Harp's imperative reader API (nextKeyValue/getCurrentKey/getCurrentValue)
+    def next_key_value(self) -> bool:
+        if self._pos >= len(self._paths):
+            return False
+        self._pos += 1
+        self._value = None
+        return True
+
+    def current_key(self) -> str:
+        if self._pos == 0:
+            raise RuntimeError("call next_key_value() before current_key()")
+        return self._paths[self._pos - 1]
+
+    def current_value(self):
+        if self._pos == 0:
+            raise RuntimeError("call next_key_value() before current_value()")
+        if self._value is None:
+            self._value = self._loader(self._paths[self._pos - 1])
+        return self._value
+
+    @property
+    def paths(self) -> list[str]:
+        return list(self._paths)
+
+
 class CollectiveApp:
     """Base class for Harp-style applications.
 
@@ -40,11 +88,22 @@ class CollectiveApp:
     """
 
     def __init__(self, config: Any = None, mesh: WorkerMesh | None = None,
-                 metrics_path: str | None = None):
+                 metrics_path: str | None = None,
+                 input_paths: list[str] | None = None, loader=None):
         self.config = config
         init_distributed()  # no-op on single host (Harp's bootstrap)
         self.mesh = mesh or current_mesh()
         self.metrics = MetricsLogger(metrics_path)
+        # this host's input splits (MultiFileInputFormat semantics): split
+        # the file list over *processes* — each process drives its chips
+        self.reader = None
+        if input_paths is not None:
+            import jax
+
+            from harp_tpu.fileformat import multi_file_splits
+
+            splits = multi_file_splits(input_paths, jax.process_count())
+            self.reader = KeyValReader(splits[jax.process_index()], loader)
 
     # -- Harp mapper API ----------------------------------------------------
     @property

@@ -44,7 +44,11 @@ class MFSGDConfig:
     rank: int = 64
     lr: float = 0.01
     reg: float = 0.05  # λ, applied to touched rows only (as SGD does)
-    chunk: int = 4096  # minibatch size inside a block
+    # minibatch size inside a block; 32768 measured best on 1× v5e
+    # (26.3M vs 14.4M ups/chip at 8192, identical RMSE — see benchmark()).
+    # Small-data runs should pass a chunk ≲ their nnz: blocks pad up to a
+    # chunk multiple, so an oversized chunk wastes compute on padding.
+    chunk: int = 32768
 
 
 # ---------------------------------------------------------------------------
@@ -275,19 +279,21 @@ def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
-              epochs=3, mesh=None, seed=0, chunk=32768):
+              epochs=3, mesh=None, seed=0, chunk=None):
     """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
 
     One 'update' = one rating visit (one (w_u, h_i) SGD update pair),
     matching Harp-DAAL's MF-SGD throughput accounting.
 
-    chunk=32768 measured best on 1× v5e (2026-07-29): 26.3M ups/chip vs
-    14.4M at 8192 (scatter dispatch amortizes; RMSE identical to 4 decimal
-    places).  65536 is within noise of 32768; 131072 hit an XLA scatter
-    compile/runtime pathology (>9 min, killed) — do not default past 64k.
+    chunk=None inherits MFSGDConfig's tuned default (32768, measured on
+    1× v5e 2026-07-29: 26.3M ups/chip vs 14.4M at 8192 — scatter dispatch
+    amortizes; RMSE identical to 4 decimal places).  65536 is within noise
+    of 32768; 131072 hit an XLA scatter compile/runtime pathology (>9 min,
+    killed) — do not default past 64k.
     """
     mesh = mesh or current_mesh()
-    cfg = MFSGDConfig(rank=rank, chunk=chunk)
+    cfg = MFSGDConfig(rank=rank) if chunk is None else \
+        MFSGDConfig(rank=rank, chunk=chunk)
     model = MFSGD(n_users, n_items, cfg, mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     t0 = time.perf_counter()
@@ -320,7 +326,8 @@ def main(argv=None):
     p.add_argument("--nnz", type=int, default=20_000_000)
     p.add_argument("--rank", type=int, default=64)
     p.add_argument("--epochs", type=int, default=3)
-    p.add_argument("--chunk", type=int, default=32768)
+    p.add_argument("--chunk", type=int, default=None,
+                   help="minibatch size (default: MFSGDConfig's tuned value)")
     args = p.parse_args(argv)
     print(benchmark(args.users, args.items, args.nnz, args.rank, args.epochs,
                     chunk=args.chunk))

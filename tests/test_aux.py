@@ -32,6 +32,39 @@ def test_collective_app_lifecycle(mesh, tmp_path):
     assert recs and "inertia" in recs[0] and recs[0]["step"] == 1
 
 
+def test_keyval_reader(mesh, tmp_path):
+    """KeyValReader hands this worker its whole-file splits (L4 parity)."""
+    from harp_tpu.mapper import KeyValReader
+
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"part{i}.csv"
+        p.write_text("\n".join(f"{i}.0,{j}.0" for j in range(4)))
+        paths.append(str(p))
+
+    class App(CollectiveApp):
+        def map_collective(self):
+            return {k: v for k, v in self.reader}
+
+    app = App(mesh=mesh, input_paths=paths)
+    assert isinstance(app.reader, KeyValReader)
+    assert sorted(app.reader.paths) == sorted(paths)
+    data = app.run()
+    assert len(data) == 3
+    assert data[paths[0]].shape == (4, 2)
+
+    # imperative Harp-style API
+    r = KeyValReader(paths[:1])
+    with pytest.raises(RuntimeError, match="next_key_value"):
+        r.current_key()  # before the first advance
+    assert r.next_key_value()
+    assert r.current_key() == paths[0]
+    v = r.current_value()
+    assert v.shape == (4, 2)
+    assert r.current_value() is v  # cached per position, not re-parsed
+    assert not r.next_key_value()
+
+
 def test_metrics_logger_without_file():
     m = MetricsLogger()
     rec = m.log(step=3, loss=1.5)

@@ -1,0 +1,23 @@
+"""Guard the driver entry points: single-chip compile check + multichip dry run.
+
+The driver imports ``__graft_entry__`` and runs these out-of-process; this
+in-suite copy catches regressions earlier.  The conftest already forces an
+8-device CPU topology, which is exactly what ``dryrun_multichip`` needs.
+"""
+
+import jax
+
+import __graft_entry__ as ge
+
+
+def test_entry_compiles_and_runs():
+    fn, args = ge.entry()
+    out = jax.jit(fn).lower(*args).compile()(*args)
+    centroids, inertia = out
+    assert centroids.shape[0] == 16
+    assert inertia.shape == ()
+
+
+def test_dryrun_multichip_8(mesh):
+    # mesh fixture guarantees the 8-device CPU topology is initialized
+    ge.dryrun_multichip(8)
