@@ -104,7 +104,9 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
             d_blk, w_blk, m_blk, z_blk = jax.tree.map(
                 lambda a: a[half_idx], (bd, bw, bm, z_grid)
             )
-            c = cfg.chunk
+            # clamp to the static block width (blocks narrower than
+            # cfg.chunk arise on small corpora — see partition_ratings)
+            c = min(cfg.chunk, d_blk.shape[0])
             nchunk = d_blk.shape[0] // c
             key, sub = jax.random.split(key)
             chunk_keys = jax.random.split(sub, nchunk)

@@ -46,8 +46,8 @@ class MFSGDConfig:
     reg: float = 0.05  # λ, applied to touched rows only (as SGD does)
     # minibatch size inside a block; 32768 measured best on 1× v5e
     # (26.3M vs 14.4M ups/chip at 8192, identical RMSE — see benchmark()).
-    # Small-data runs should pass a chunk ≲ their nnz: blocks pad up to a
-    # chunk multiple, so an oversized chunk wastes compute on padding.
+    # Small datasets are safe: blocks narrower than this clamp themselves
+    # (partition_ratings pads only to the real max block size).
     chunk: int = 32768
 
 
@@ -90,7 +90,14 @@ def partition_ratings(users, items, vals, n_users, n_items, n_workers, chunk,
     counts = np.zeros((n, ns), np.int64)
     np.add.at(counts, (wid, sid), 1)
     bmax = int(counts.max())
-    B = max(chunk, -(-bmax // chunk) * chunk)  # pad to chunk multiple
+    if bmax >= chunk:
+        B = -(-bmax // chunk) * chunk  # pad to chunk multiple
+    else:
+        # small data: don't pad every block up to a full chunk (400× waste
+        # at the tuned 32768 default on 10k-rating datasets) — one
+        # sublane-aligned sub-chunk suffices; the device side clamps its
+        # scan chunk to the block width (see _block_update).
+        B = max(8, -(-bmax // 8) * 8)
 
     u = np.zeros((n, ns, B), np.int32)
     i = np.zeros((n, ns, B), np.int32)
@@ -136,9 +143,14 @@ def _chunk_update(W, H, batch, cfg: MFSGDConfig):
 
 
 def _block_update(W, H, block, cfg: MFSGDConfig):
-    """Scan minibatch chunks over one (user-range × item-slice) block."""
+    """Scan minibatch chunks over one (user-range × item-slice) block.
+
+    The effective chunk is clamped to the (static) block width — small
+    datasets produce blocks narrower than ``cfg.chunk`` (see
+    ``partition_ratings``), which then run as a single minibatch.
+    """
     bu, bi, bv, bm = block
-    c = cfg.chunk
+    c = min(cfg.chunk, bu.shape[0])
     nchunk = bu.shape[0] // c
     chunks = jax.tree.map(lambda a: a.reshape(nchunk, c), (bu, bi, bv, bm))
 

@@ -65,6 +65,21 @@ def test_keyval_reader(mesh, tmp_path):
     assert not r.next_key_value()
 
 
+def test_example_kmeans_app_runs():
+    """The MIGRATING.md example app runs end-to-end on the CPU sim."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "kmeans_app.py"),
+         "--cpu8", "--n", "512", "--d", "4", "--k", "2", "--iters", "2"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "centroid_norm" in out.stdout
+
+
 def test_metrics_logger_without_file():
     m = MetricsLogger()
     rec = m.log(step=3, loss=1.5)
