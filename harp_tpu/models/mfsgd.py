@@ -96,8 +96,10 @@ def partition_ratings(users, items, vals, n_users, n_items, n_workers, chunk,
         # small data: don't pad every block up to a full chunk (400× waste
         # at the tuned 32768 default on 10k-rating datasets) — one
         # sublane-aligned sub-chunk suffices; the device side clamps its
-        # scan chunk to the block width (see _block_update).
-        B = max(8, -(-bmax // 8) * 8)
+        # scan chunk to the block width (see _block_update).  Cap at chunk:
+        # sublane alignment may otherwise overshoot it when chunk % 8 != 0,
+        # and the device reshape needs B % min(chunk, B) == 0.
+        B = min(chunk, max(8, -(-bmax // 8) * 8))
 
     u = np.zeros((n, ns, B), np.int32)
     i = np.zeros((n, ns, B), np.int32)

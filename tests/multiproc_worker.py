@@ -1,0 +1,49 @@
+"""Worker script for the two-process jax.distributed test (not a pytest module).
+
+Launched by tests/test_multiprocess.py as ``python multiproc_worker.py
+<process_id> <port>``.  Validates the multi-host code paths without TPU
+hardware: ``init_distributed`` bootstrap, a mesh spanning processes, a
+device collective crossing the process boundary (Gloo on CPU — the DCN
+stand-in), and ``kv_allreduce``'s host-side cross-process union.
+"""
+
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from harp_tpu import Int2IntKVTable, WorkerMesh, init_distributed, kv_allreduce
+from harp_tpu.parallel import collective as C
+
+init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=proc_id)
+assert jax.process_count() == 2, jax.process_count()
+
+import numpy as np
+
+mesh = WorkerMesh()  # 2 devices, one per process
+assert mesh.num_workers == 2
+
+# device collective across the process boundary; in multi-process each
+# host reads only its addressable shard of the global result
+op = C.host_op(mesh, C.allreduce, in_dim=0, out_dim=0)
+x = np.arange(4, dtype=np.float32).reshape(2, 2)
+out = op(x)
+local = np.asarray(out.addressable_shards[0].data)
+np.testing.assert_allclose(local, x.sum(0)[None, :])
+
+# host-side KV union across processes
+t = Int2IntKVTable()
+t.add(proc_id, 1)        # unique key per process
+t.add(100, proc_id + 1)  # shared: combined 1+2
+u = kv_allreduce(t)
+assert u.keys() == [0, 1, 100], u.keys()
+assert int(u.get(100)) == 3, u.get(100)
+
+print(f"proc {proc_id}: MULTIPROC OK", flush=True)

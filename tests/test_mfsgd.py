@@ -54,6 +54,15 @@ def test_partition_ratings_small_data_does_not_pad_to_chunk(mesh):
     bu, *_ = MF.partition_ratings(u, i, v, 64, 48, N, 32768)
     assert bu.shape[1] <= max(8, -(-nnz // 8) * 8)  # not 32768
 
+    # non-multiple-of-8 chunk with bmax just below it: sublane alignment
+    # must not overshoot chunk (device reshape needs B % min(chunk, B) == 0)
+    u97 = np.zeros(97, np.int32)
+    i97 = np.arange(97, dtype=np.int32) % 3
+    b97, *_ = MF.partition_ratings(u97, i97, np.ones(97, np.float32),
+                                   64, 48, N, 100)
+    B = b97.shape[1]
+    assert B % min(100, B) == 0
+
     # and training still works at the clamped width (single sub-chunk scan)
     model = MF.MFSGD(64, 48, MF.MFSGDConfig(rank=4), mesh=mesh)
     model.set_ratings(u, i, v)
