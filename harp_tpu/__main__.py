@@ -26,6 +26,8 @@ APPS = {
     "rf": ("harp_tpu.models.rf", "random forest (allgather of trees)"),
     "svm": ("harp_tpu.models.svm", "distributed linear SVM (allreduce)"),
     "wdamds": ("harp_tpu.models.wdamds", "WDA-MDS / SMACOF embedding"),
+    "stats": ("harp_tpu.models.stats",
+              "classic analytics: pca/cov/moments/naive/linreg/ridge/qr/svd/als"),
     "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
 }
 

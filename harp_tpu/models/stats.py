@@ -330,3 +330,63 @@ def als(users, items, vals, n_users, n_items, rank=16, reg=0.1, iters=10,
         W, Hd, rmse = fn(Hd, uid, uvd, umd)
         hist.append(float(np.asarray(rmse)))
     return np.asarray(W)[:n_users], np.asarray(Hd), hist
+
+
+def main(argv=None):
+    """Launcher for the classic-stats suite — the ``daal_{pca,cov,...}``
+    per-app launchers collapsed into one (`python -m harp_tpu stats <algo>`)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="harp-tpu classic analytics (edu.iu.daal_* parity)")
+    p.add_argument("algo", choices=["pca", "cov", "moments", "naive",
+                                    "linreg", "ridge", "qr", "svd", "als"])
+    p.add_argument("--n", type=int, default=100_000)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    x = rng.normal(size=(args.n, args.d)).astype(np.float32)
+    if args.algo == "pca":
+        _, evals = pca(x)
+        print({"algo": "pca", "top5_evals": np.asarray(evals)[:5].tolist()})
+    elif args.algo == "cov":
+        _, c = covariance(x)
+        print({"algo": "cov", "trace": float(np.trace(np.asarray(c)))})
+    elif args.algo == "moments":
+        m = moments(x)
+        print({"algo": "moments",
+               "mean_norm": float(np.linalg.norm(np.asarray(m["mean"]))),
+               "var_mean": float(np.mean(np.asarray(m["variance"])))})
+    elif args.algo == "naive":
+        y = rng.integers(0, 4, args.n)
+        model = naive_bayes_fit(np.abs(x), y, n_classes=4)
+        acc = float((naive_bayes_predict(model, np.abs(x)) == y).mean())
+        print({"algo": "naive_bayes", "train_acc": acc})
+    elif args.algo in ("linreg", "ridge"):
+        w_true = rng.normal(size=args.d).astype(np.float32)
+        y = x @ w_true + 0.01 * rng.normal(size=args.n).astype(np.float32)
+        fit = linear_regression if args.algo == "linreg" else ridge_regression
+        coef, _intercept = fit(x, y)
+        err = float(np.linalg.norm(np.asarray(coef) - w_true))
+        print({"algo": args.algo, "coef_err": err})
+    elif args.algo == "qr":
+        q, r = tsqr(x)
+        resid = float(np.linalg.norm(np.asarray(q) @ np.asarray(r) - x) /
+                      np.linalg.norm(x))
+        print({"algo": "tsqr", "rel_resid": resid})
+    elif args.algo == "svd":
+        u, s, vt = svd(x)
+        print({"algo": "svd", "top5_sv": np.asarray(s)[:5].tolist()})
+    elif args.algo == "als":
+        nnz = min(args.n, 200_000)
+        users = rng.integers(0, 1000, nnz).astype(np.int32)
+        items = rng.integers(0, 500, nnz).astype(np.int32)
+        vals = rng.normal(size=nnz).astype(np.float32)
+        _, _, hist = als(users, items, vals, 1000, 500, rank=8, iters=3)
+        print({"algo": "als", "rmse_history": [round(h, 4) for h in hist]})
+
+
+if __name__ == "__main__":
+    main()

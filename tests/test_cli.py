@@ -22,6 +22,23 @@ def test_dispatch_kmeans_smoke(capsys):
     assert "iters_per_sec" in capsys.readouterr().out
 
 
+def test_dispatch_stats_smoke(capsys):
+    rc = cli.main(["stats", "pca", "--n", "512", "--d", "8"])
+    assert rc == 0
+    assert "top5_evals" in capsys.readouterr().out
+
+
+def test_stats_all_algos_run(capsys):
+    """Every daal_* launcher equivalent dispatches and prints a result."""
+    from harp_tpu.models import stats
+
+    for algo in ("cov", "moments", "naive", "linreg", "ridge",
+                 "qr", "svd", "als"):
+        stats.main([algo, "--n", "512", "--d", "8"])
+        assert algo.replace("qr", "tsqr").replace(
+            "naive", "naive_bayes") in capsys.readouterr().out
+
+
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
                    "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
