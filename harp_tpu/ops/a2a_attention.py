@@ -1,0 +1,74 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+Long-context support (SURVEY.md §6), the second canonical scheme next to
+:mod:`harp_tpu.ops.ring_attention`: instead of rotating K/V blocks around
+the ring, one ``all_to_all`` (Harp's *regroup* verb — the same collective
+``C.regroup`` lowers to) re-shards the tensors from sequence-sharded to
+head-sharded, every worker runs exact local attention over the FULL
+sequence for its subset of heads, and a second ``all_to_all`` restores
+sequence sharding.
+
+Trade-offs vs ring (why both exist):
+- a2a moves each of Q, K, V, O exactly once (4·bytes/chip) regardless of
+  worker count; ring moves K/V (n−1) times — a2a wins on fabrics where
+  latency dominates and for small n.
+- ring never materializes full-sequence K/V on a chip; a2a holds full
+  K/V for h/n heads, so memory is O(seq) — ring is the one that scales to
+  million-token contexts (its per-chip memory is O(seq/n)).
+- a2a needs ``heads % n_workers == 0``; ring has no head constraint.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
+
+
+def _local_attention(q, k, v, scale, causal):
+    """Exact softmax attention, everything resident.  [b, s, h, d] each."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
+                  scale: float | None = None):
+    """Exact multi-head attention, sequence sharded, via all-to-all (device view).
+
+    Args (per-worker shards, call inside ``shard_map``):
+      q, k, v: [batch, seq_local, heads, head_dim]; heads must be divisible
+      by the worker count.
+    Returns: [batch, seq_local, heads, head_dim].
+    """
+    n = lax.axis_size(axis)
+    b, nq, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(
+            f"a2a attention needs heads ({h}) divisible by workers ({n}); "
+            "use ring_attention for head counts that don't divide")
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    # seq-sharded → head-sharded ([b, s/n, h, d] → [b, s, h/n, d]) is one
+    # regroup (Harp's shuffle verb); the inverse restores sequence sharding
+    qh, kh, vh = C.regroup((q, k, v), axis=axis, split_dim=2, concat_dim=1)
+    out = _local_attention(qh, kh, vh, scale, causal)
+    return C.regroup(out, axis=axis, split_dim=1, concat_dim=2)
+
+
+def make_a2a_attention_fn(mesh: WorkerMesh, causal: bool = False):
+    """Host-view compile: full arrays in, sequence-sharded underneath."""
+    fn = functools.partial(a2a_attention, causal=causal, axis=mesh.axis)
+    spec = mesh.spec(1, ndim=4)  # shard the sequence dim
+    return jax.jit(mesh.shard_map(fn, in_specs=(spec,) * 3, out_specs=spec))

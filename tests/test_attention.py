@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from harp_tpu.ops.a2a_attention import make_a2a_attention_fn
 from harp_tpu.ops.flash_attention import flash_attention, reference_attention
 from harp_tpu.ops.ring_attention import make_ring_attention_fn
 
@@ -24,6 +25,31 @@ def test_ring_attention_matches_full(mesh, causal):
     ref = np.asarray(reference_attention(qf, kf, vf, causal=causal))
     ref = ref.reshape(b, h, n, d).transpose(0, 2, 1, 3)
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_a2a_attention_matches_full(mesh, causal):
+    """Ulysses all-to-all sequence parallelism == dense reference."""
+    rng = np.random.default_rng(2)
+    b, n, h, d = 2, 64, 8, 16  # 8 heads over 8 workers → 1 head each
+    q, k, v = (rng.normal(size=(b, n, h, d)).astype(np.float32) for _ in range(3))
+    fn = make_a2a_attention_fn(mesh, causal=causal)
+    out = np.asarray(fn(q, k, v))
+
+    qf = jnp.asarray(q).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    kf = jnp.asarray(k).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    vf = jnp.asarray(v).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    ref = np.asarray(reference_attention(qf, kf, vf, causal=causal))
+    ref = ref.reshape(b, h, n, d).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_a2a_attention_rejects_indivisible_heads(mesh):
+    rng = np.random.default_rng(3)
+    q = rng.normal(size=(1, 64, 6, 8)).astype(np.float32)  # 6 heads, 8 workers
+    fn = make_a2a_attention_fn(mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        fn(q, q, q)
 
 
 @pytest.mark.parametrize("causal", [False, True])
