@@ -268,6 +268,59 @@ class MFSGD:
         self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H, *self._blocks)
         return float(np.sqrt(max(device_sync(se), 0.0) / max(device_sync(cnt), 1.0)))
 
+    def fit(self, epochs: int, ckpt_dir: str | None = None, *,
+            ckpt_every: int = 5, max_restarts: int = 3, fault=None):
+        """Train with optional checkpoint/resume — the SURVEY.md §6 driver.
+
+        With ``ckpt_dir`` set, epochs checkpoint every ``ckpt_every`` and a
+        crashed run (or a rerun pointing at the same dir) resumes from the
+        latest saved epoch instead of epoch 0 — Harp's YARN whole-job retry,
+        upgraded.  Returns the per-epoch RMSE list for the epochs this call
+        actually ran.
+        """
+        rmses: list[float] = []
+        if ckpt_dir is None:
+            if fault is not None:
+                raise ValueError(
+                    "fault injection requires ckpt_dir (recovery restarts "
+                    "from checkpoints; without one the injector would be "
+                    "silently ignored)")
+            for _ in range(epochs):
+                rmses.append(self.train_epoch())
+            return rmses
+
+        from harp_tpu.utils.checkpoint import CheckpointManager
+        from harp_tpu.utils.fault import run_with_recovery
+
+        mgr = CheckpointManager(ckpt_dir)
+        # snapshot the pre-training factors: a crash before the first
+        # checkpoint must restart from THESE, not from crash-time weights
+        # (double-applying epochs trains silently wrong)
+        w0, h0 = np.asarray(self.W), np.asarray(self.H)
+
+        def _install(state):
+            if not isinstance(state["W"], jax.Array):  # numpy from restore
+                self.W = self.mesh.shard_array(np.asarray(state["W"]), 0)
+                self.H = self.mesh.shard_array(np.asarray(state["H"]), 0)
+            else:
+                self.W, self.H = state["W"], state["H"]
+
+        def make_state():
+            return {"W": w0, "H": h0}
+
+        def step(i, state):
+            _install(state)
+            rmses.append(self.train_epoch())
+            return {"W": self.W, "H": self.H}
+
+        final = run_with_recovery(make_state, step, epochs, mgr,
+                                  ckpt_every=ckpt_every,
+                                  max_restarts=max_restarts, fault=fault)
+        # a resume that had nothing left to run still must land the
+        # restored factors in the model
+        _install(final)
+        return rmses
+
     def factors(self):
         return np.asarray(self.W)[: self.n_users], np.asarray(self.H)[: self.n_items]
 
@@ -292,6 +345,12 @@ def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
     return u.astype(np.int32), i.astype(np.int32), v.astype(np.float32)
 
 
+def _make_config(rank: int, chunk: int | None) -> MFSGDConfig:
+    """chunk=None inherits MFSGDConfig's tuned default."""
+    return MFSGDConfig(rank=rank) if chunk is None else \
+        MFSGDConfig(rank=rank, chunk=chunk)
+
+
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
               epochs=3, mesh=None, seed=0, chunk=None):
     """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
@@ -306,8 +365,7 @@ def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
     killed) — do not default past 64k.
     """
     mesh = mesh or current_mesh()
-    cfg = MFSGDConfig(rank=rank) if chunk is None else \
-        MFSGDConfig(rank=rank, chunk=chunk)
+    cfg = _make_config(rank, chunk)
     model = MFSGD(n_users, n_items, cfg, mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     t0 = time.perf_counter()
@@ -342,9 +400,24 @@ def main(argv=None):
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--chunk", type=int, default=None,
                    help="minibatch size (default: MFSGDConfig's tuned value)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="train with checkpoint/resume instead of benchmarking; "
+                        "rerunning with the same dir resumes from the latest "
+                        "saved epoch")
+    p.add_argument("--ckpt-every", type=int, default=5)
     args = p.parse_args(argv)
-    print(benchmark(args.users, args.items, args.nnz, args.rank, args.epochs,
-                    chunk=args.chunk))
+    if args.ckpt_dir:
+        model = MFSGD(args.users, args.items, _make_config(args.rank, args.chunk))
+        u, i, v = synthetic_ratings(args.users, args.items, args.nnz)
+        model.set_ratings(u, i, v)
+        rmses = model.fit(args.epochs, args.ckpt_dir,
+                          ckpt_every=args.ckpt_every)
+        print({"epochs_run": len(rmses),
+               "rmse_final": rmses[-1] if rmses else None,
+               "ckpt_dir": args.ckpt_dir})
+    else:
+        print(benchmark(args.users, args.items, args.nnz, args.rank,
+                        args.epochs, chunk=args.chunk))
 
 
 if __name__ == "__main__":

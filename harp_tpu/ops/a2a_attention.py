@@ -13,8 +13,11 @@ Trade-offs vs ring (why both exist):
   worker count; ring moves K/V (n−1) times — a2a wins on fabrics where
   latency dominates and for small n.
 - ring never materializes full-sequence K/V on a chip; a2a holds full
-  K/V for h/n heads, so memory is O(seq) — ring is the one that scales to
-  million-token contexts (its per-chip memory is O(seq/n)).
+  K/V for h/n heads, so K/V memory is O(seq) — ring is the one that
+  scales to million-token contexts (its per-chip memory is O(seq/n)).
+  The local attention here is blockwise online-softmax (ring attention's
+  recurrence over resident K/V blocks), so scores stay O(seq·block_k),
+  not O(seq²); ``block_k=None`` falls back to one dense block.
 - a2a needs ``heads % n_workers == 0``; ring has no head constraint.
 """
 
@@ -28,23 +31,39 @@ from jax import lax
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
+from harp_tpu.ops.ring_attention import _block_attend
 
 
-def _local_attention(q, k, v, scale, causal):
-    """Exact softmax attention, everything resident.  [b, s, h, d] each."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = q.shape[1]
-        mask = jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+def _local_attention(q, k, v, scale, causal, block_k):
+    """Exact attention, everything resident ([b, s, h, d] each), computed
+    blockwise over K/V with the online-softmax recurrence so the score
+    tensor is [b, h, s, block_k], never [b, h, s, s]."""
+    b, s, h, d = q.shape
+    bk = s if block_k is None else block_k
+    if s % bk != 0:
+        raise ValueError(f"block_k={bk} must divide the sequence length {s}")
+    pos = jnp.arange(s)
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    acc0 = jnp.zeros((b, s, h, d), jnp.float32)
+    kb = k.reshape(b, s // bk, bk, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, s // bk, bk, h, d).transpose(1, 0, 2, 3, 4)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        kt, vt, t = inp
+        m, l, acc = _block_attend(q, kt, vt, m, l, acc,
+                                  pos, t * bk + jnp.arange(bk), scale, causal)
+        return (m, l, acc), None
+
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
+                              (kb, vb, jnp.arange(s // bk)))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
 
 
 def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
-                  scale: float | None = None):
+                  scale: float | None = None, block_k: int | None = None):
     """Exact multi-head attention, sequence sharded, via all-to-all (device view).
 
     Args (per-worker shards, call inside ``shard_map``):
@@ -63,12 +82,14 @@ def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
     # seq-sharded → head-sharded ([b, s/n, h, d] → [b, s, h/n, d]) is one
     # regroup (Harp's shuffle verb); the inverse restores sequence sharding
     qh, kh, vh = C.regroup((q, k, v), axis=axis, split_dim=2, concat_dim=1)
-    out = _local_attention(qh, kh, vh, scale, causal)
+    out = _local_attention(qh, kh, vh, scale, causal, block_k)
     return C.regroup(out, axis=axis, split_dim=1, concat_dim=2)
 
 
-def make_a2a_attention_fn(mesh: WorkerMesh, causal: bool = False):
+def make_a2a_attention_fn(mesh: WorkerMesh, causal: bool = False,
+                          block_k: int | None = None):
     """Host-view compile: full arrays in, sequence-sharded underneath."""
-    fn = functools.partial(a2a_attention, causal=causal, axis=mesh.axis)
+    fn = functools.partial(a2a_attention, causal=causal, axis=mesh.axis,
+                           block_k=block_k)
     spec = mesh.spec(1, ndim=4)  # shard the sequence dim
     return jax.jit(mesh.shard_map(fn, in_specs=(spec,) * 3, out_specs=spec))

@@ -28,12 +28,13 @@ def test_ring_attention_matches_full(mesh, causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_a2a_attention_matches_full(mesh, causal):
+@pytest.mark.parametrize("block_k", [None, 16])
+def test_a2a_attention_matches_full(mesh, causal, block_k):
     """Ulysses all-to-all sequence parallelism == dense reference."""
     rng = np.random.default_rng(2)
     b, n, h, d = 2, 64, 8, 16  # 8 heads over 8 workers → 1 head each
     q, k, v = (rng.normal(size=(b, n, h, d)).astype(np.float32) for _ in range(3))
-    fn = make_a2a_attention_fn(mesh, causal=causal)
+    fn = make_a2a_attention_fn(mesh, causal=causal, block_k=block_k)
     out = np.asarray(fn(q, k, v))
 
     qf = jnp.asarray(q).transpose(0, 2, 1, 3).reshape(b * h, n, d)

@@ -48,6 +48,56 @@ def test_recovery_gives_up(tmp_path):
         _driver(tmp_path, fail_at=(5, 6, 7, 8), max_restarts=2)
 
 
+def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path):
+    """The MF-SGD driver survives an injected crash and a process 'restart'."""
+    from harp_tpu.models import mfsgd as MF
+
+    rng = np.random.default_rng(0)
+    nnz = 400
+    u = rng.integers(0, 32, nnz).astype(np.int32)
+    i = rng.integers(0, 24, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+
+    def make_model():
+        m = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, chunk=64), mesh=mesh)
+        m.set_ratings(u, i, v)
+        return m
+
+    ckpt = str(tmp_path / "mf")
+    # crash at epoch 3 (after the epoch-2 checkpoint with ckpt_every=2):
+    # recovery restarts in-process and completes all 6 epochs
+    model = make_model()
+    rmses = model.fit(6, ckpt, ckpt_every=2, fault=FaultInjector(fail_at=(3,)))
+    assert len(rmses) >= 6  # all epochs ran (pre-crash ones included)
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 5
+
+    # a fresh driver pointing at the same dir resumes, not restarts —
+    # and must INSTALL the restored factors even though no epoch runs
+    model2 = make_model()
+    more = model2.fit(6, ckpt, ckpt_every=2)
+    assert more == []  # epochs 0..5 already done — nothing to run
+    np.testing.assert_allclose(np.asarray(model2.W), np.asarray(model.W),
+                               rtol=1e-6)
+
+    # crash BEFORE the first checkpoint: recovery must restart from the
+    # initial factors, not the crash-time ones (no double-applied epochs)
+    model3 = make_model()
+    w_init = np.asarray(model3.W).copy()
+    clean = make_model()  # same seed → same init
+    clean_rmses = clean.fit(3)
+    ckpt2 = str(tmp_path / "mf2")
+    rmses3 = model3.fit(3, ckpt2, ckpt_every=100,
+                        fault=FaultInjector(fail_at=(2,)))
+    np.testing.assert_allclose(np.asarray(model3.W), np.asarray(clean.W),
+                               rtol=1e-5)
+    assert not np.allclose(np.asarray(model3.W), w_init)  # it did train
+
+    # fault injection without a checkpoint dir must refuse, not no-op
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        make_model().fit(2, fault=FaultInjector(fail_at=(1,)))
+
+
 def test_fault_injector_fires_once():
     fi = FaultInjector(fail_at=(3,))
     with pytest.raises(WorkerFailure):
