@@ -224,6 +224,39 @@ class LDA:
         )
         device_sync(self.Nk)
 
+    def fit(self, epochs: int, ckpt_dir: str | None = None, *,
+            ckpt_every: int = 5, max_restarts: int = 3, fault=None):
+        """Sample ``epochs`` Gibbs sweeps with optional checkpoint/resume.
+
+        Same recovery contract as :meth:`harp_tpu.models.mfsgd.MFSGD.fit`
+        (restart-from-entry-state before the first checkpoint; resume
+        installs the restored counts; fault without ckpt_dir is refused).
+        The RNG keys are part of the checkpoint, so a recovered run samples
+        the same chain it would have without the crash.
+        """
+        from harp_tpu.utils.fault import fit_epochs
+
+        def get_state():
+            return {"Ndk": self.Ndk, "Nwk": self.Nwk, "Nk": self.Nk,
+                    "z": self.z_grid, "keys": np.asarray(self._keys)}
+
+        def set_state(state):
+            if not isinstance(state["Ndk"], jax.Array):  # numpy from restore
+                sh = self.mesh.shard_array
+                self.Ndk = sh(np.asarray(state["Ndk"]), 0)
+                self.Nwk = sh(np.asarray(state["Nwk"]), 0)
+                self.z_grid = sh(np.asarray(state["z"]), 0)
+                self.Nk = jax.device_put(jnp.asarray(np.asarray(state["Nk"])),
+                                         self.mesh.replicated())
+            else:
+                self.Ndk, self.Nwk = state["Ndk"], state["Nwk"]
+                self.Nk, self.z_grid = state["Nk"], state["z"]
+            self._keys = np.asarray(state["keys"])
+
+        fit_epochs(self.sample_epoch, get_state, set_state, epochs,
+                   ckpt_dir, ckpt_every=ckpt_every,
+                   max_restarts=max_restarts, fault=fault)
+
     def log_likelihood(self):
         """Mean per-token predictive log-likelihood of current assignments."""
         if self._tokens is None:

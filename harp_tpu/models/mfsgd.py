@@ -278,47 +278,24 @@ class MFSGD:
         upgraded.  Returns the per-epoch RMSE list for the epochs this call
         actually ran.
         """
+        from harp_tpu.utils.fault import fit_epochs
+
         rmses: list[float] = []
-        if ckpt_dir is None:
-            if fault is not None:
-                raise ValueError(
-                    "fault injection requires ckpt_dir (recovery restarts "
-                    "from checkpoints; without one the injector would be "
-                    "silently ignored)")
-            for _ in range(epochs):
-                rmses.append(self.train_epoch())
-            return rmses
 
-        from harp_tpu.utils.checkpoint import CheckpointManager
-        from harp_tpu.utils.fault import run_with_recovery
-
-        mgr = CheckpointManager(ckpt_dir)
-        # snapshot the pre-training factors: a crash before the first
-        # checkpoint must restart from THESE, not from crash-time weights
-        # (double-applying epochs trains silently wrong)
-        w0, h0 = np.asarray(self.W), np.asarray(self.H)
-
-        def _install(state):
+        def set_state(state):
             if not isinstance(state["W"], jax.Array):  # numpy from restore
                 self.W = self.mesh.shard_array(np.asarray(state["W"]), 0)
                 self.H = self.mesh.shard_array(np.asarray(state["H"]), 0)
             else:
                 self.W, self.H = state["W"], state["H"]
 
-        def make_state():
-            return {"W": w0, "H": h0}
-
-        def step(i, state):
-            _install(state)
-            rmses.append(self.train_epoch())
-            return {"W": self.W, "H": self.H}
-
-        final = run_with_recovery(make_state, step, epochs, mgr,
-                                  ckpt_every=ckpt_every,
-                                  max_restarts=max_restarts, fault=fault)
-        # a resume that had nothing left to run still must land the
-        # restored factors in the model
-        _install(final)
+        fit_epochs(
+            lambda: rmses.append(self.train_epoch()),
+            lambda: {"W": self.W, "H": self.H},
+            set_state,
+            epochs, ckpt_dir, ckpt_every=ckpt_every,
+            max_restarts=max_restarts, fault=fault,
+        )
         return rmses
 
     def factors(self):

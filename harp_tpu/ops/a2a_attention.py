@@ -31,7 +31,7 @@ from jax import lax
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
-from harp_tpu.ops.ring_attention import _block_attend
+from harp_tpu.ops.ring_attention import online_softmax_block
 
 
 def _local_attention(q, k, v, scale, causal, block_k):
@@ -52,8 +52,8 @@ def _local_attention(q, k, v, scale, causal, block_k):
     def body(carry, inp):
         m, l, acc = carry
         kt, vt, t = inp
-        m, l, acc = _block_attend(q, kt, vt, m, l, acc,
-                                  pos, t * bk + jnp.arange(bk), scale, causal)
+        m, l, acc = online_softmax_block(
+            q, kt, vt, m, l, acc, pos, t * bk + jnp.arange(bk), scale, causal)
         return (m, l, acc), None
 
     (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),

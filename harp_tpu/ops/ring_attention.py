@@ -25,10 +25,14 @@ from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
 
 
-def _block_attend(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
+def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
     """One online-softmax update of (m, l, acc) with a K/V block.
 
     q: [B, nq, H, D]; k, v: [B, nk, H, D]; m, l: [B, H, nq]; acc like q.
+
+    Shared API: this is the flash-attention recurrence both sequence-parallel
+    schemes build on — ring attention scans it over rotating K/V blocks,
+    a2a attention (:mod:`harp_tpu.ops.a2a_attention`) over resident ones.
     """
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -75,7 +79,7 @@ def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
         v_nxt = C.rotate(v_cur, axis=axis)
         src = (me - t) % n                      # whose block is resident
         k_pos = src * nq + jnp.arange(k_cur.shape[1])
-        m, l, acc = _block_attend(q, k_cur, v_cur, m, l, acc,
+        m, l, acc = online_softmax_block(q, k_cur, v_cur, m, l, acc,
                                   q_pos, k_pos, scale, causal)
         return (m, l, acc, k_nxt, v_nxt), None
 

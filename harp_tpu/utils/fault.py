@@ -43,6 +43,65 @@ class WorkerFailure(RuntimeError):
     """A worker died mid-job (Harp: container failure surfaced by YARN)."""
 
 
+def fit_epochs(
+    train_one: Callable[[], Any],
+    get_state: Callable[[], Any],
+    set_state: Callable[[Any], None],
+    epochs: int,
+    ckpt_dir: str | None = None,
+    *,
+    ckpt_every: int = 5,
+    max_restarts: int = 3,
+    fault: "FaultInjector | None" = None,
+) -> None:
+    """Epoch-loop driver with optional checkpoint/resume — shared by the
+    model ``fit`` methods (MF-SGD, LDA).
+
+    ``get_state`` returns the model's checkpointable pytree (live device
+    arrays are fine); ``set_state`` installs a state that may be numpy
+    (fresh restore) or live arrays (normal step-to-step flow).  Contract
+    guarantees, locked in by tests:
+    - a crash before the first checkpoint restarts from the state at THIS
+      call's entry (snapshotted host-side), never from crash-time state;
+    - a resume with no epochs left still installs the restored state;
+    - ``fault`` without ``ckpt_dir`` is refused rather than ignored.
+    """
+    if ckpt_dir is None:
+        if fault is not None:
+            raise ValueError(
+                "fault injection requires ckpt_dir (recovery restarts from "
+                "checkpoints; without one the injector would be silently "
+                "ignored)")
+        for _ in range(epochs):
+            train_one()
+        return
+
+    import numpy as np
+
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir)
+    # snapshot the entry state: a crash before the first checkpoint must
+    # restart from these values (double-applying epochs trains silently
+    # wrong).  Skipped when a checkpoint already exists — every restart
+    # then restores from disk, so the host-side copy would be dead weight.
+    import jax
+
+    init = None if mgr.latest_step() is not None \
+        else jax.tree.map(np.asarray, get_state())
+
+    def step(i, state):
+        set_state(state)
+        train_one()
+        return get_state()
+
+    final = run_with_recovery(lambda: init, step, epochs, mgr,
+                              ckpt_every=ckpt_every,
+                              max_restarts=max_restarts, fault=fault)
+    # a resume that had nothing left to run still must land in the model
+    set_state(final)
+
+
 def run_with_recovery(
     make_state: Callable[[], Any],
     step: Callable[[int, Any], Any],

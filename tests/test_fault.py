@@ -92,10 +92,36 @@ def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path):
     np.testing.assert_allclose(np.asarray(model3.W), np.asarray(clean.W),
                                rtol=1e-5)
     assert not np.allclose(np.asarray(model3.W), w_init)  # it did train
+    # crash at epoch 2 → epochs 0,1 ran, then the full clean trajectory
+    # replays from the entry snapshot: the tail must match the clean run
+    np.testing.assert_allclose(rmses3[-3:], clean_rmses, rtol=1e-5)
+    np.testing.assert_allclose(rmses3[:2], clean_rmses[:2], rtol=1e-5)
 
     # fault injection without a checkpoint dir must refuse, not no-op
     with pytest.raises(ValueError, match="ckpt_dir"):
         make_model().fit(2, fault=FaultInjector(fail_at=(1,)))
+
+
+def test_lda_fit_checkpoint_resume(mesh, tmp_path):
+    """LDA sampling recovers from a crash on the same chain as a clean run."""
+    from harp_tpu.models import lda as L
+
+    def make_model():
+        m = L.LDA(16, 24, L.LDAConfig(n_topics=4, chunk=32), mesh=mesh, seed=1)
+        d, w = L.synthetic_corpus(16, 24, 2, tokens_per_doc=8, seed=1)
+        m.set_tokens(d, w)
+        return m
+
+    clean = make_model()
+    clean.fit(4)
+
+    ckpt = str(tmp_path / "lda")
+    model = make_model()
+    model.fit(4, ckpt, ckpt_every=2, fault=FaultInjector(fail_at=(3,)))
+    # keys are checkpointed, so the recovered chain == the clean chain
+    np.testing.assert_array_equal(np.asarray(model.z_grid),
+                                  np.asarray(clean.z_grid))
+    np.testing.assert_allclose(np.asarray(model.Nwk), np.asarray(clean.Nwk))
 
 
 def test_fault_injector_fires_once():
