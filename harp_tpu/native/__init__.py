@@ -8,6 +8,12 @@ is available, so the framework never hard-requires the toolchain.
 """
 
 from harp_tpu.native.build import load_native, native_available
-from harp_tpu.native.datasource import load_csv, load_triples
+from harp_tpu.native.datasource import (
+    csr_to_ell,
+    load_csv,
+    load_libsvm,
+    load_triples,
+)
 
-__all__ = ["load_native", "native_available", "load_csv", "load_triples"]
+__all__ = ["load_native", "native_available", "load_csv", "load_libsvm",
+           "load_triples", "csr_to_ell"]

@@ -73,5 +73,13 @@ def load_native():
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64]
     lib.harp_load_triples.restype = ctypes.c_int
+    lib.harp_count_libsvm.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      i64p, i64p, i64p]
+    lib.harp_count_libsvm.restype = ctypes.c_int
+    lib.harp_load_libsvm.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        i64p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+    lib.harp_load_libsvm.restype = ctypes.c_int
     _LIB = lib
     return _LIB

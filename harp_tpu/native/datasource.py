@@ -47,6 +47,110 @@ def load_csv(path: str, n_threads: int = 0) -> np.ndarray:
     return out
 
 
+def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
+    """libsvm/CSR sparse file → (labels, indptr, indices, values, n_features).
+
+    The HarpDAALDataSource CSR input path.  Lines are
+    ``label idx:val idx:val ... [# comment]``; indices are 1-based in the
+    wild (``zero_based=False`` subtracts 1, matching sklearn's default).
+    Returns ``labels f32 [n]``, CSR ``indptr i64 [n+1]``,
+    ``indices i32 [nnz]``, ``values f32 [nnz]``, and ``n_features``.
+    """
+    n_threads = n_threads or (os.cpu_count() or 1)
+    lib = load_native()
+    n_features_native = None
+    if lib is None:
+        # tolerance mirrors the native parser: an unparseable label reads
+        # as 0.0 (header lines become zero-label rows), stray tokens that
+        # aren't idx:val pairs are skipped
+        def _tofloat(s):
+            try:
+                return float(s)
+            except ValueError:
+                return 0.0
+
+        labels, indptr, indices, values = [], [0], [], []
+        with open(path) as f:
+            for line in f:
+                toks = line.split("#", 1)[0].split()
+                if not toks:
+                    continue
+                labels.append(_tofloat(toks[0]))
+                for pair in toks[1:]:
+                    idx, colon, val = pair.partition(":")
+                    if not colon or not val:
+                        continue
+                    try:
+                        i = int(idx) if idx else 0
+                    except ValueError:
+                        continue
+                    indices.append(i)
+                    values.append(_tofloat(val))
+                indptr.append(len(indices))
+        labels = np.asarray(labels, np.float32)
+        indptr = np.asarray(indptr, np.int64)
+        indices = np.asarray(indices, np.int32)
+        values = np.asarray(values, np.float32)
+    else:
+        rows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        max_idx = ctypes.c_int64()
+        rc = lib.harp_count_libsvm(path.encode(), n_threads,
+                                   ctypes.byref(rows), ctypes.byref(nnz),
+                                   ctypes.byref(max_idx))
+        if rc != 0:
+            raise OSError(f"native loader failed to read {path!r} (rc={rc})")
+        labels = np.empty(rows.value, np.float32)
+        indptr = np.empty(rows.value + 1, np.int64)
+        indices = np.empty(nnz.value, np.int32)
+        values = np.empty(nnz.value, np.float32)
+        rc = lib.harp_load_libsvm(
+            path.encode(), n_threads,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.value, nnz.value)
+        if rc != 0:
+            raise OSError(f"native loader failed to parse {path!r} (rc={rc})")
+        n_features_native = max_idx.value  # max 1-based index == n_features
+    if not zero_based:
+        indices -= 1  # freshly allocated on both paths: in-place is safe
+    if n_features_native is not None:
+        n_features = n_features_native + (1 if zero_based else 0)
+        n_features = max(n_features, 0)
+    else:
+        n_features = int(indices.max()) + 1 if len(indices) else 0
+    return labels, indptr, indices, values, n_features
+
+
+def csr_to_ell(indptr, indices, values, width: int | None = None):
+    """CSR → padded ELL blocks ``(ids [n, w] i32, vals [n, w] f32,
+    mask [n, w] f32)`` — the static-shape layout TPU kernels consume
+    (SURVEY.md §8: CSR→ELL-style padding for sparse workloads).
+
+    ``width`` defaults to the max row length; longer rows are truncated
+    (count returned by the caller comparing ``indptr`` diffs to ``width``).
+    """
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int32)
+    values = np.asarray(values, np.float32)
+    n = len(indptr) - 1
+    lens = np.diff(indptr)
+    w = int(lens.max()) if width is None and n else (width or 0)
+    ids = np.zeros((n, w), np.int32)
+    vals = np.zeros((n, w), np.float32)
+    mask = np.zeros((n, w), np.float32)
+    # position of each nnz within its row, vectorized
+    pos = np.arange(len(indices)) - np.repeat(indptr[:-1], lens)
+    row = np.repeat(np.arange(n), lens)
+    keep = pos < w
+    ids[row[keep], pos[keep]] = indices[keep]
+    vals[row[keep], pos[keep]] = values[keep]
+    mask[row[keep], pos[keep]] = 1.0
+    return ids, vals, mask
+
+
 def load_triples(path: str, n_threads: int = 0):
     """'u i v' rating/token lines → (int32 [n], int32 [n], float32 [n])."""
     n_threads = n_threads or (os.cpu_count() or 1)

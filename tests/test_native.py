@@ -3,7 +3,13 @@
 import numpy as np
 import pytest
 
-from harp_tpu.native import load_csv, load_triples, load_native
+from harp_tpu.native import (
+    csr_to_ell,
+    load_csv,
+    load_libsvm,
+    load_native,
+    load_triples,
+)
 
 
 @pytest.fixture(scope="module")
@@ -80,3 +86,76 @@ def test_fallback_whitespace_equivalent(tmp_path):
     p.write_text("1 2 3\n4,5,6\n")
     np.testing.assert_array_equal(_loadtxt_any_sep(str(p)),
                                   [[1, 2, 3], [4, 5, 6]])
+
+
+LIBSVM_SAMPLE = """\
+1 1:0.5 3:1.25 7:-2.0
+-1 2:3.0
+# a full-line comment
+1 1:1e-3 7:4.5  # trailing comment
+-1 5:0.0
+"""
+
+
+def test_load_libsvm_native(native_lib, tmp_path):
+    p = tmp_path / "d.svm"
+    p.write_text(LIBSVM_SAMPLE)
+    labels, indptr, indices, values, nf = load_libsvm(str(p), n_threads=4)
+    np.testing.assert_array_equal(labels, [1, -1, 1, -1])
+    np.testing.assert_array_equal(indptr, [0, 3, 4, 6, 7])
+    np.testing.assert_array_equal(indices, [0, 2, 6, 1, 0, 6, 4])  # 1-based → 0
+    np.testing.assert_allclose(values, [0.5, 1.25, -2.0, 3.0, 1e-3, 4.5, 0.0])
+    assert nf == 7
+
+
+def test_load_libsvm_fallback_parity(native_lib, tmp_path, monkeypatch):
+    """Python fallback parses identically to the C++ path."""
+    import harp_tpu.native.datasource as ds
+
+    p = tmp_path / "d.svm"
+    p.write_text(LIBSVM_SAMPLE)
+    native = load_libsvm(str(p))
+    monkeypatch.setattr(ds, "load_native", lambda: None)
+    fallback = ds.load_libsvm(str(p))
+    for a, b in zip(native, fallback):
+        np.testing.assert_allclose(a, b)
+
+
+def test_load_libsvm_malformed_trailing_colon(native_lib, tmp_path, monkeypatch):
+    """'3:' with no value must not swallow the next line's label (and the
+    fallback must agree on malformed input, not crash)."""
+    import harp_tpu.native.datasource as ds
+
+    p = tmp_path / "bad.svm"
+    p.write_text("1 3:\n5 1:2.0\nheader junk:line\n-1 abc:1 2:7.0\n")
+    native = load_libsvm(str(p))
+    labels, indptr, indices, values, nf = native
+    np.testing.assert_array_equal(labels, [1, 5, 0, -1])  # header label → 0
+    np.testing.assert_array_equal(indptr, [0, 0, 1, 1, 2])  # '3:' dropped
+    np.testing.assert_allclose(values, [2.0, 7.0])
+    monkeypatch.setattr(ds, "load_native", lambda: None)
+    fallback = ds.load_libsvm(str(p))
+    for a, b in zip(native, fallback):
+        np.testing.assert_allclose(a, b)
+
+
+def test_load_libsvm_zero_based(native_lib, tmp_path):
+    p = tmp_path / "d.svm"
+    p.write_text("1 0:2.0 3:4.0\n")
+    _, _, indices, _, nf = load_libsvm(str(p), zero_based=True)
+    np.testing.assert_array_equal(indices, [0, 3])
+    assert nf == 4
+
+
+def test_csr_to_ell_roundtrip():
+    indptr = np.array([0, 2, 2, 5])
+    indices = np.array([4, 1, 0, 2, 3])
+    values = np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+    ids, vals, mask = csr_to_ell(indptr, indices, values)
+    assert ids.shape == (3, 3)
+    np.testing.assert_array_equal(mask.sum(1), [2, 0, 3])
+    np.testing.assert_allclose(vals[0, :2], [1.0, 2.0])
+    np.testing.assert_array_equal(ids[2], [0, 2, 3])
+    # truncation at fixed width
+    ids2, vals2, mask2 = csr_to_ell(indptr, indices, values, width=2)
+    assert mask2.sum() == 4  # row 2 lost one entry
