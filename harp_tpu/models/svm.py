@@ -53,37 +53,78 @@ def _pegasos(w, b, x, y, sample_w, cfg: SVMConfig):
     return w, b
 
 
-def make_train_fn(mesh: WorkerMesh, cfg: SVMConfig, d: int, n_loc: int):
-    k = min(cfg.sv_per_worker, n_loc)  # top_k needs k <= local shard size
+def _pegasos_ell(w, b, ids, vals, msk, y, sample_w, cfg: SVMConfig):
+    """Hinge subgradient descent on padded-ELL sparse rows.
 
-    def prog(x, y, sample_w):
-        n_loc = x.shape[0]
+    ids/vals/msk: [n, width] (see ``csr_to_ell``) — f(x) is a gather-dot,
+    the gradient a segment-sum scatter; memory stays O(nnz), never O(n·d).
+    """
+    d = w.shape[0]
+
+    def step(carry, t):
+        w, b = carry
+        fx = (vals * jnp.take(w, ids) * msk).sum(1) + b
+        margin = y * fx
+        viol = (margin < 1.0).astype(jnp.float32) * sample_w
+        denom = jnp.maximum(sample_w.sum(), 1.0)
+        coef = (viol * y) / denom                     # [n]
+        gw_data = jax.ops.segment_sum(
+            (coef[:, None] * vals * msk).ravel(), ids.ravel(), num_segments=d)
+        lr = cfg.lr / (1.0 + 0.01 * t)
+        return (w - lr * (cfg.l2 * w - gw_data), b + lr * coef.sum()), None
+
+    (w, b), _ = jax.lax.scan(step, (w, b), jnp.arange(cfg.inner_steps))
+    return w, b
+
+
+def _make_train_prog(cfg: SVMConfig, d: int, k: int, sparse: bool):
+    """Shared outer loop: local solve → top-k margin violators → allgather.
+
+    ``sparse`` switches the row representation: dense [n, d] x vs ELL
+    (ids, vals, msk) triples.  The SV exchange gathers rows the same way
+    in both (fixed-size top-k keeps shapes static).
+    """
+
+    def prog(rows, y, sample_w):
         w = jnp.zeros((d,), jnp.float32)
         b = jnp.float32(0.0)
-        # augmented set: local shard + gathered SVs from all workers
         nw = jax.lax.axis_size("workers")
-        sv_x = jnp.zeros((nw * k, d), jnp.float32)
+
+        def fwd(rows, w, b):
+            if sparse:
+                ids, vals, msk = rows
+                return (vals * jnp.take(w, ids) * msk).sum(1) + b
+            return rows @ w + b
+
+        def take_rows(rows, idx):
+            return jax.tree.map(lambda a: a[idx], rows)
+
+        sv_rows = jax.tree.map(
+            lambda a: jnp.zeros((nw * k,) + a.shape[1:], a.dtype), rows)
         sv_y = jnp.zeros((nw * k,), jnp.float32)
         sv_m = jnp.zeros((nw * k,), jnp.float32)
 
         def round_body(carry, _):
-            w, b, sv_x, sv_y, sv_m = carry
-            ax = jnp.concatenate([x, sv_x], 0)
+            w, b, sv_rows, sv_y, sv_m = carry
+            arows = jax.tree.map(
+                lambda a, s: jnp.concatenate([a, s], 0), rows, sv_rows)
             ay = jnp.concatenate([y, sv_y], 0)
             am = jnp.concatenate([sample_w, sv_m], 0)
-            w, b = _pegasos(w, b, ax, ay, am, cfg)
+            if sparse:
+                w, b = _pegasos_ell(w, b, *arows, ay, am, cfg)
+            else:
+                w, b = _pegasos(w, b, arows, ay, am, cfg)
             # margin violators of the LOCAL shard → top-k by closeness
-            margin = y * (x @ w + b)
-            score = jnp.where(sample_w > 0, margin, jnp.inf)
+            score = jnp.where(sample_w > 0, y * fwd(rows, w, b), jnp.inf)
             _, idx = jax.lax.top_k(-score, k)       # most-violating k
             cand_m = (score[idx] < 1.0).astype(jnp.float32)
             # Harp step: allgather the SV lists
-            sv_x, sv_y, sv_m = C.allgather(
-                (x[idx], y[idx], cand_m))
-            return (w, b, sv_x, sv_y, sv_m), None
+            sv_rows, sv_y, sv_m = C.allgather(
+                (take_rows(rows, idx), y[idx], cand_m))
+            return (w, b, sv_rows, sv_y, sv_m), None
 
         (w, b, *_), _ = jax.lax.scan(
-            round_body, (w, b, sv_x, sv_y, sv_m), None,
+            round_body, (w, b, sv_rows, sv_y, sv_m), None,
             length=cfg.outer_rounds)
         # final consensus: average the (identical-input-fed) models — with
         # gathered SVs shared, worker models already agree up to local data;
@@ -92,8 +133,24 @@ def make_train_fn(mesh: WorkerMesh, cfg: SVMConfig, d: int, n_loc: int):
         b = C.allreduce(b, C.Combiner.AVG)
         return w, b
 
+    return prog
+
+
+def make_train_fn(mesh: WorkerMesh, cfg: SVMConfig, d: int, n_loc: int):
+    k = min(cfg.sv_per_worker, n_loc)  # top_k needs k <= local shard size
+    prog = _make_train_prog(cfg, d, k, sparse=False)
     return jax.jit(mesh.shard_map(
         prog, in_specs=(mesh.spec(0),) * 3, out_specs=(P(), P()),
+    ))
+
+
+def make_train_fn_ell(mesh: WorkerMesh, cfg: SVMConfig, d: int, n_loc: int):
+    k = min(cfg.sv_per_worker, n_loc)
+    prog = _make_train_prog(cfg, d, k, sparse=True)
+    return jax.jit(mesh.shard_map(
+        prog,
+        in_specs=((mesh.spec(0),) * 3, mesh.spec(0), mesh.spec(0)),
+        out_specs=(P(), P()),
     ))
 
 
@@ -118,6 +175,20 @@ class SVM:
         n_loc = xd.shape[0] // self.mesh.num_workers
         fn = make_train_fn(self.mesh, self.cfg, x.shape[1], n_loc)
         w, b = fn(xd, yd, sample_wd)
+        self.w, self.b = np.asarray(w), float(np.asarray(b))
+        return self
+
+    def fit_sparse(self, ids, vals, mask, y, n_features: int):
+        """Train on padded-ELL sparse rows (``csr_to_ell`` output) —
+        memory stays O(nnz) end to end, never densifying [n, d]."""
+        from harp_tpu.models.stats import _shard_rows
+
+        y = np.asarray(y, np.float32)
+        assert set(np.unique(y)) <= {-1.0, 1.0}, "labels must be ±1"
+        idd, vd, md, yd, sample_wd = _shard_rows(self.mesh, ids, vals, mask, y)
+        n_loc = yd.shape[0] // self.mesh.num_workers
+        fn = make_train_fn_ell(self.mesh, self.cfg, n_features, n_loc)
+        w, b = fn((idd, vd, md), yd, sample_wd)
         self.w, self.b = np.asarray(w), float(np.asarray(b))
         return self
 
@@ -152,8 +223,34 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="harp-tpu SVM (edu.iu.svm parity)")
     p.add_argument("--n", type=int, default=500_000)
     p.add_argument("--d", type=int, default=128)
+    p.add_argument("--libsvm", default=None, metavar="FILE",
+                   help="train on a libsvm-format file (the reference's "
+                        "native input format) instead of synthetic data")
+    p.add_argument("--zero-based", action="store_true",
+                   help="file indices start at 0 (default: 1-based)")
     args = p.parse_args(argv)
-    print(benchmark(args.n, args.d))
+    if args.libsvm:
+        from harp_tpu.native.datasource import csr_to_ell, load_libsvm
+
+        try:
+            labels, indptr, indices, values, nf = load_libsvm(
+                args.libsvm, zero_based=args.zero_based)
+        except ValueError as e:  # e.g. a 0-based file without --zero-based
+            raise SystemExit(str(e))
+        classes = np.unique(labels)
+        if len(classes) != 2:
+            raise SystemExit(
+                f"{args.libsvm}: need exactly 2 label values, got "
+                f"{classes.tolist()} (binary SVM)")
+        y = np.where(labels == classes[1], 1.0, -1.0).astype(np.float32)
+        ids, vals, mask = csr_to_ell(indptr, indices, values)
+        model = SVM().fit_sparse(ids, vals, mask, y, nf)
+        fx = (vals * model.w[ids] * mask).sum(1) + model.b
+        acc = float((np.sign(fx) == y).mean())
+        print({"file": args.libsvm, "n": len(labels), "d": nf,
+               "classes": classes.tolist(), "train_acc": acc})
+    else:
+        print(benchmark(args.n, args.d))
 
 
 if __name__ == "__main__":

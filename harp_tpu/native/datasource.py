@@ -60,14 +60,22 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
     lib = load_native()
     n_features_native = None
     if lib is None:
-        # tolerance mirrors the native parser: an unparseable label reads
-        # as 0.0 (header lines become zero-label rows), stray tokens that
+        # tolerance mirrors the native parser: the label is the numeric
+        # prefix of the first token (its trailing garbage is dropped, so
+        # '3:1.5' is a label-only line), an unparseable label reads as 0.0
+        # (header lines become zero-label rows), and stray tokens that
         # aren't idx:val pairs are skipped
+        import re
+
+        _num_prefix = re.compile(
+            r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
+
         def _tofloat(s):
             try:
-                return float(s)
+                return float(s)  # also accepts inf/nan, like strtof
             except ValueError:
-                return 0.0
+                m = _num_prefix.match(s)
+                return float(m.group()) if m else 0.0
 
         labels, indptr, indices, values = [], [0], [], []
         with open(path) as f:
@@ -116,6 +124,10 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
         n_features_native = max_idx.value  # max 1-based index == n_features
     if not zero_based:
         indices -= 1  # freshly allocated on both paths: in-place is safe
+    if len(indices) and indices.min() < 0:
+        raise ValueError(
+            f"{path!r}: negative feature index after 1-based correction — "
+            "the file is 0-based; pass zero_based=True (CLI: --zero-based)")
     if n_features_native is not None:
         n_features = n_features_native + (1 if zero_based else 0)
         n_features = max(n_features, 0)

@@ -238,15 +238,23 @@ void count_libsvm_range(const char* data, size_t begin, size_t end_,
   while (p < end) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
     const char* line_end = nl ? nl : end;
+    // '#' starts a comment anywhere on the line (parity with the Python
+    // fallback's split('#', 1))
+    const char* hash =
+        static_cast<const char*>(memchr(p, '#', line_end - p));
+    if (hash) line_end = hash;
     if (line_end > p) {
       const char* q = p;
       skip_seps(q, line_end);
-      if (q < line_end && *q != '#') {
+      if (q < line_end) {
         ++r;
-        parse_float(q);  // label
+        parse_float(q);  // label: numeric prefix of the first token...
+        // ...and any trailing garbage in that token is dropped whole, so
+        // '3:1.5' is a label-only line, never a phantom (0, 1.5) pair
+        while (q < line_end && *q != ' ' && *q != '\t' && *q != ',') ++q;
         while (q < line_end) {
           skip_seps(q, line_end);
-          if (q >= line_end || *q == '#') break;
+          if (q >= line_end) break;
           long idx = std::strtol(q, const_cast<char**>(&q), 10);
           // a value exists only if something non-blank follows the ':' on
           // THIS line — "3:\n" must not let strtof's whitespace skip eat
@@ -333,15 +341,20 @@ int harp_load_libsvm(const char* path, int n_threads, float* labels,
     while (p < end) {
       const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
       const char* line_end = nl ? nl : end;
+      const char* hash =
+          static_cast<const char*>(memchr(p, '#', line_end - p));
+      if (hash) line_end = hash;
       if (line_end > p) {
         const char* q = p;
         skip_seps(q, line_end);
-        if (q < line_end && *q != '#') {
+        if (q < line_end) {
           indptr[row] = k;
           labels[row] = parse_float(q);
+          // drop the label token's trailing garbage (mirror the count pass)
+          while (q < line_end && *q != ' ' && *q != '\t' && *q != ',') ++q;
           while (q < line_end) {
             skip_seps(q, line_end);
-            if (q >= line_end || *q == '#') break;
+            if (q >= line_end) break;
             long idx = std::strtol(q, const_cast<char**>(&q), 10);
             // mirror count_libsvm_range's has-value guard exactly — the
             // prefix offsets depend on both passes agreeing

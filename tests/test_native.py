@@ -127,12 +127,16 @@ def test_load_libsvm_malformed_trailing_colon(native_lib, tmp_path, monkeypatch)
     import harp_tpu.native.datasource as ds
 
     p = tmp_path / "bad.svm"
-    p.write_text("1 3:\n5 1:2.0\nheader junk:line\n-1 abc:1 2:7.0\n")
+    p.write_text("1 3:\n5 1:2.0\nheader junk:line\n-1 abc:1 2:7.0\n"
+                 "3:1.5\n1 foo#bar 2:9.0\n1x 2:4.0\n")
     native = load_libsvm(str(p))
     labels, indptr, indices, values, nf = native
-    np.testing.assert_array_equal(labels, [1, 5, 0, -1])  # header label → 0
-    np.testing.assert_array_equal(indptr, [0, 0, 1, 1, 2])  # '3:' dropped
-    np.testing.assert_allclose(values, [2.0, 7.0])
+    # header label → 0; '3:1.5' is a label-only line (label token's
+    # trailing garbage dropped whole); '#' comments out the rest of a line
+    # even mid-token; '1x' label parses its numeric prefix
+    np.testing.assert_array_equal(labels, [1, 5, 0, -1, 3, 1, 1])
+    np.testing.assert_array_equal(indptr, [0, 0, 1, 1, 2, 2, 2, 3])
+    np.testing.assert_allclose(values, [2.0, 7.0, 4.0])
     monkeypatch.setattr(ds, "load_native", lambda: None)
     fallback = ds.load_libsvm(str(p))
     for a, b in zip(native, fallback):
