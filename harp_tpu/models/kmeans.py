@@ -42,6 +42,14 @@ class KMeansConfig:
     iters: int = 10
     dtype: Any = jnp.float32  # bf16 points keep f32 accumulation (MXU-friendly)
     block_points: int = 0  # >0: process points in blocks to bound the [n,k] dist matrix
+    # Harp's two app variants (edu.iu.kmeans.allreduce / .regroupallgather):
+    # "allreduce" = one psum; "regroupallgather" = reduce-scatter the
+    # partials so each worker owns and normalizes a centroid block, then
+    # allgather the new centroids — Harp's headline variant, kept for
+    # parity/explicitness.  Identical results AND identical wire traffic:
+    # XLA's ring psum already lowers to reduce-scatter+allgather, so this
+    # is not a performance knob.
+    variant: str = "allreduce"
     # opt-in single-pass Pallas kernel; the default XLA path measured faster
     # on v5e (see harp_tpu/ops/kmeans_kernel.py for the numbers)
     use_pallas: bool = False
@@ -49,6 +57,10 @@ class KMeansConfig:
     def __post_init__(self):
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.variant not in ("allreduce", "regroupallgather"):
+            raise ValueError(
+                f"variant must be 'allreduce' or 'regroupallgather', "
+                f"got {self.variant!r}")
 
 
 def _partials_block(points, centroids, c2):
@@ -113,11 +125,30 @@ def kmeans_step(points, centroids, cfg: KMeansConfig):
         sums, counts = sums.sum(0), counts.sum(0)
         partial_inertia = partial_inertia.sum()
 
+    def normalize(sums, counts, old):
+        # empty cluster keeps its old centroid (shared by both variants —
+        # a change here, e.g. reseeding, must apply to both identically)
+        return jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), old
+        ).astype(old.dtype)
+
+    nw = lax.axis_size(C.WORKER_AXIS)
+    if cfg.variant == "regroupallgather" and sums.shape[0] % nw == 0:
+        # Harp's regroup+allgather: reduce-scatter the partials so worker w
+        # owns centroid block w (the regroup/push phase), normalize locally,
+        # allgather the normalized blocks.  Falls back to allreduce when
+        # k isn't divisible (Harp's partitioner would round-robin uneven
+        # blocks; one fused psum is the degenerate equivalent).
+        my_sums, my_counts = C.push((sums, counts))
+        kb = sums.shape[0] // nw
+        me = lax.axis_index(C.WORKER_AXIS)
+        cent_blk = lax.dynamic_slice_in_dim(centroids, me * kb, kb, 0)
+        new_centroids = C.pull(normalize(my_sums, my_counts, cent_blk))
+        inertia = C.allreduce(partial_inertia)
+        return new_centroids, inertia
+
     sums, counts, inertia = C.allreduce((sums, counts, partial_inertia))
-    new_centroids = jnp.where(
-        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centroids
-    ).astype(centroids.dtype)
-    return new_centroids, inertia
+    return normalize(sums, counts, centroids), inertia
 
 
 def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
@@ -136,7 +167,8 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
 
 
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
-        dtype=jnp.float32, block_points=0, use_pallas=False):
+        dtype=jnp.float32, block_points=0, use_pallas=False,
+        variant="allreduce"):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
     ``points``: [n, d] host or device array; sharded over workers on dim 0.
@@ -147,7 +179,7 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     """
     mesh = mesh or current_mesh()
     cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points,
-                       use_pallas=use_pallas)
+                       use_pallas=use_pallas, variant=variant)
     n = points.shape[0]
     if seed is None:
         init_idx = np.arange(k)
@@ -162,10 +194,11 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
-              warmup=2, seed=0, use_pallas=False):
+              warmup=2, seed=0, use_pallas=False, variant="allreduce"):
     """Measure iter/sec on the graded 1M×300 k=100 config (north-star metric)."""
     mesh = mesh or current_mesh()
-    cfg = KMeansConfig(k=k, iters=1, dtype=dtype, use_pallas=use_pallas)
+    cfg = KMeansConfig(k=k, iters=1, dtype=dtype, use_pallas=use_pallas,
+                       variant=variant)
     nw = mesh.num_workers
     n = (n // nw) * nw  # actual points generated/processed (and reported)
 
@@ -226,17 +259,23 @@ def main(argv=None):
     p.add_argument("--k", type=int, default=100)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--variant", default="allreduce",
+                   choices=["allreduce", "regroupallgather"],
+                   help="Harp app variant: one fused psum, or the explicit "
+                        "regroup(reduce-scatter)+allgather two-phase form")
     p.add_argument("--bench", action="store_true", help="synthetic benchmark mode")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     if args.bench:
-        out = benchmark(args.n, args.d, args.k, args.iters, dtype=dtype)
+        out = benchmark(args.n, args.d, args.k, args.iters, dtype=dtype,
+                        variant=args.variant)
         print(out)
     else:
         rng = np.random.default_rng(0)
         pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
-        c, inertia = fit(pts, args.k, args.iters, dtype=dtype)
+        c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
+                         variant=args.variant)
         print({"k": args.k, "iters": args.iters, "inertia": inertia})
 
 

@@ -42,6 +42,31 @@ def test_kmeans_matches_numpy_lloyd(mesh):
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_kmeans_regroupallgather_matches_allreduce(mesh):
+    """Harp's two app variants compute identical centroids."""
+    pts = blobs(n_per=64, k=8)  # k=8 divisible by the 8 workers
+    a, ia = KM.fit(pts, k=8, iters=4, mesh=mesh, seed=None)
+    b, ib = KM.fit(pts, k=8, iters=4, mesh=mesh, seed=None,
+                   variant="regroupallgather")
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    assert abs(ia - ib) / max(abs(ia), 1.0) < 1e-5
+
+
+def test_kmeans_regroupallgather_falls_back_on_indivisible_k(mesh):
+    pts = blobs(n_per=32, k=3)  # 3 % 8 != 0 → allreduce fallback, same math
+    a, _ = KM.fit(pts, k=3, iters=3, mesh=mesh, seed=None)
+    b, _ = KM.fit(pts, k=3, iters=3, mesh=mesh, seed=None,
+                  variant="regroupallgather")
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_kmeans_bad_variant_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="variant"):
+        KM.KMeansConfig(k=2, variant="nope")
+
+
 def test_kmeans_blocked_assignment_matches(mesh):
     pts = blobs(n_per=64, k=4)
     ours_full, _ = KM.fit(pts, k=4, iters=3, mesh=mesh, seed=None)
@@ -77,6 +102,31 @@ def test_kmeans_empty_cluster_keeps_centroid(mesh):
     assert not np.isnan(new_c).any()
     np.testing.assert_allclose(new_c[1], far[0])  # empty cluster untouched
     np.testing.assert_allclose(new_c[0], np.ones(3))
+
+
+def test_kmeans_empty_cluster_regroupallgather(mesh):
+    """The two-phase variant's local-normalize phase also keeps empty
+    clusters' centroids (each worker owns one centroid block here)."""
+    pts = np.ones((N * 4, 3), np.float32)
+    init = np.concatenate(
+        [np.ones((1, 3), np.float32),
+         np.arange(1, 8, dtype=np.float32)[:, None] * 1e5 * np.ones((7, 3), np.float32)]
+    )
+    cfg = KM.KMeansConfig(k=8, iters=1, variant="regroupallgather")
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    step = jax.jit(
+        mesh.shard_map(
+            lambda p, c: KM.kmeans_step(p, c, cfg),
+            in_specs=(mesh.spec(0), P()),
+            out_specs=(P(), P()),
+        )
+    )
+    new_c = np.asarray(step(pts, jnp.asarray(init))[0])
+    assert not np.isnan(new_c).any()
+    np.testing.assert_allclose(new_c[0], np.ones(3))
+    np.testing.assert_allclose(new_c[1:], init[1:])  # 7 empty clusters survive
 
 
 def test_kmeans_bf16_close_to_f32(mesh):
