@@ -332,9 +332,25 @@ def main(argv=None):
     p.add_argument("--tokens-per-doc", type=int, default=100)
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--ckpt-dir", default=None,
+                   help="sample with checkpoint/resume instead of "
+                        "benchmarking; rerunning with the same dir resumes "
+                        "the chain from the latest saved epoch")
+    p.add_argument("--ckpt-every", type=int, default=5)
     args = p.parse_args(argv)
-    print(benchmark(args.docs, args.vocab, args.topics, args.tokens_per_doc,
-                    args.epochs, chunk=args.chunk))
+    if args.ckpt_dir:
+        model = LDA(args.docs, args.vocab,
+                    LDAConfig(n_topics=args.topics, chunk=args.chunk))
+        d_ids, w_ids = synthetic_corpus(args.docs, args.vocab,
+                                        max(2, args.topics // 8),
+                                        args.tokens_per_doc)
+        model.set_tokens(d_ids, w_ids)
+        model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
+        print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
+               "log_likelihood": round(model.log_likelihood(), 4)})
+    else:
+        print(benchmark(args.docs, args.vocab, args.topics,
+                        args.tokens_per_doc, args.epochs, chunk=args.chunk))
 
 
 if __name__ == "__main__":

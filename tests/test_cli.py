@@ -96,6 +96,30 @@ def test_svm_libsvm_rejects_bad_inputs(tmp_path):
         S.main(["--libsvm", str(p2)])
 
 
+def test_dispatch_lda_ckpt_resume(capsys, tmp_path, monkeypatch):
+    """LDA CLI trains with checkpoints; a rerun RESUMES (zero epochs run)."""
+    from harp_tpu.models.lda import LDA
+
+    calls = []
+    orig = LDA.sample_epoch
+    monkeypatch.setattr(LDA, "sample_epoch",
+                        lambda self: (calls.append(1), orig(self))[1])
+
+    args = ["lda", "--docs", "16", "--vocab", "16", "--topics", "2",
+            "--tokens-per-doc", "4", "--epochs", "2", "--chunk", "16",
+            "--ckpt-dir", str(tmp_path / "c")]
+    assert cli.main(args) == 0
+    first = capsys.readouterr().out
+    assert "log_likelihood" in first
+    assert len(calls) == 2  # both epochs trained
+
+    calls.clear()
+    assert cli.main(args) == 0
+    second = capsys.readouterr().out
+    assert len(calls) == 0  # resumed from the checkpoint: nothing re-ran
+    assert first == second  # and the restored chain state is identical
+
+
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
                    "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
