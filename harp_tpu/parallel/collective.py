@@ -247,6 +247,12 @@ def host_op(mesh, verb, *, in_dim: int | None = 0, out_dim: int | None = 0,
     ``in_dim`` / ``out_dim`` give the worker-sharded dimension of the
     input/output (``None`` = replicated), e.g. allreduce is ``(0, None)``
     per-shard-in, replicated-out.
+
+    Multi-process note: the returned callable produces a *global* array.
+    Under ``jax.distributed`` (multi-host) a host can only read its own
+    shards — use ``out.addressable_shards[i].data`` (or
+    ``multihost_utils.process_allgather``) instead of ``np.asarray(out)``,
+    which raises on non-addressable arrays (see tests/multiproc_worker.py).
     """
     fn = partial(verb, axis=mesh.axis, **verb_kwargs)
     in_spec = mesh.spec(in_dim) if in_dim is not None else jax.sharding.PartitionSpec()

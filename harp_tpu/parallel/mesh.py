@@ -164,6 +164,23 @@ class WorkerMesh:
         return f"WorkerMesh(num_workers={self.num_workers}, axis={self.axis!r})"
 
 
+def mesh_2d(n_data: int, n_model: int, devices: Sequence[Any] | None = None,
+            axes: tuple[str, str] = (WORKER_AXIS, "model")) -> Mesh:
+    """A 2-D (data × model) ``jax.sharding.Mesh`` — the tensor-parallel
+    extension beyond Harp's single worker axis (SURVEY.md §3.5: TP is not
+    in the reference; this exists so model-sharded layers can ride GSPMD
+    sharding annotations with no explicit collectives).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_data * n_model > len(devices):
+        raise ValueError(
+            f"mesh_2d({n_data}x{n_model}) needs {n_data * n_model} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, axes)
+
+
 def current_mesh() -> WorkerMesh:
     """The process-wide default mesh (created over all devices on first use)."""
     global _CURRENT_MESH

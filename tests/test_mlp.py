@@ -54,3 +54,46 @@ def test_bf16_trains(mesh):
 def test_bad_optimizer_raises(mesh):
     with pytest.raises(ValueError, match="unknown optimizer"):
         M.MLPTrainer(M.MLPConfig(optimizer="lion"), mesh)
+
+
+def test_tp_matches_dp(mesh):
+    """Tensor-parallel (2x4 data x model mesh) == data-parallel trainer.
+
+    Same init seed, same full batch: the TP step's global loss/grads are
+    the same math as DP's allreduce(AVG), so params must agree.
+    """
+    cfg = M.MLPConfig(sizes=(16, 32, 8), lr=0.05)
+    x, y = M.synthetic_mnist(n=64, d=16, classes=8, seed=3)
+
+    from harp_tpu.parallel.mesh import mesh_2d
+
+    dp = M.MLPTrainer(cfg, mesh, seed=0)
+    tp = M.TPMLPTrainer(cfg, mesh_2d(2, 4), seed=0)
+    for _ in range(3):
+        dp_loss, _ = dp.train_batch(x, y)
+        tp_loss, _ = tp.train_batch(x, y)
+    assert abs(dp_loss - tp_loss) < 1e-4
+    for pl_dp, pl_tp in zip(dp.params, tp.params):
+        np.testing.assert_allclose(np.asarray(pl_dp["w"]),
+                                   np.asarray(pl_tp["w"]), rtol=2e-4,
+                                   atol=2e-5)
+
+
+def test_mesh_2d_validates_device_count(mesh):
+    from harp_tpu.parallel.mesh import mesh_2d
+
+    with pytest.raises(ValueError, match="needs"):
+        mesh_2d(4, 4)  # 16 > 8 simulated devices
+
+
+def test_tp_validates_divisibility(mesh):
+    from harp_tpu.parallel.mesh import mesh_2d
+
+    # layer 0 is column-parallel: its output dim 10 must divide the model axis
+    with pytest.raises(ValueError, match="divisible by the model axis"):
+        M.TPMLPTrainer(M.MLPConfig(sizes=(16, 10, 8)), mesh_2d(1, 8))
+
+    tp = M.TPMLPTrainer(M.MLPConfig(sizes=(16, 32, 8)), mesh_2d(2, 4))
+    x, y = M.synthetic_mnist(n=63, d=16, classes=8)  # 63 % 2 != 0
+    with pytest.raises(ValueError, match="batch size"):
+        tp.train_batch(x, y)
