@@ -79,22 +79,31 @@ def make_optimizer(cfg: MLPConfig):
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
-def make_train_step(mesh: WorkerMesh, cfg: MLPConfig):
-    """Compile the data-parallel training step (the daal_nn hot loop)."""
-    tx = make_optimizer(cfg)
+def _step_body(tx, cfg: MLPConfig, combine):
+    """The one train-step body both trainers share: value_and_grad →
+    ``combine`` (the DP gradient allreduce; identity under GSPMD where XLA
+    inserts the collectives) → optax update.  A change here (e.g. grad
+    clipping) applies to DP and TP identically — the equivalence tests
+    depend on that."""
 
     def step(params, opt_state, x, y):
         (loss, logits), grads = jax.value_and_grad(
             lambda p: loss_fn(p, x, y, cfg), has_aux=True
         )(params)
-        # the graded pattern: gradient allreduce through the app-level verb
-        grads = C.allreduce(grads, C.Combiner.AVG)
-        loss = C.allreduce(loss, C.Combiner.AVG)
-        acc = C.allreduce((jnp.argmax(logits, -1) == y).mean(), C.Combiner.AVG)
+        acc = (jnp.argmax(logits, -1) == y).mean()
+        grads, loss, acc = combine((grads, loss, acc))
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss, acc
 
+    return step
+
+
+def make_train_step(mesh: WorkerMesh, cfg: MLPConfig):
+    """Compile the data-parallel training step (the daal_nn hot loop)."""
+    tx = make_optimizer(cfg)
+    # the graded pattern: gradient allreduce through the app-level verb
+    step = _step_body(tx, cfg, lambda t: C.allreduce(t, C.Combiner.AVG))
     return jax.jit(
         mesh.shard_map(
             step,
@@ -171,7 +180,21 @@ class TPMLPTrainer:
         from harp_tpu.parallel.mesh import mesh_2d
 
         self.cfg = cfg or MLPConfig()
-        self.mesh = mesh if mesh is not None else mesh_2d(1, len(jax.devices()))
+        if mesh is None:
+            # largest model axis that divides every SHARDED layer dim (the
+            # output dim of even layers, input dim of odd ones) AND the
+            # device count — so the no-arg constructor works on any host
+            import math
+
+            sizes = self.cfg.sizes
+            sharded_dims = [sizes[i + 1] if i % 2 == 0 else sizes[i]
+                            for i in range(len(sizes) - 1)]
+            g = math.gcd(*sharded_dims)
+            n_dev = len(jax.devices())
+            n_model = max(d for d in range(1, min(g, n_dev) + 1)
+                          if g % d == 0 and n_dev % d == 0)
+            mesh = mesh_2d(n_dev // n_model, n_model)
+        self.mesh = mesh
         data_ax, model_ax = self.mesh.axis_names
         n_model = self.mesh.shape[model_ax]
         self._n_data = self.mesh.shape[data_ax]
@@ -199,16 +222,10 @@ class TPMLPTrainer:
         tx = make_optimizer(self.cfg)
         self.opt_state = tx.init(self.params)
         self._batch_sharding = NamedSharding(self.mesh, P(data_ax))
-
-        def step(params, opt_state, x, y):
-            (loss, logits), grads = jax.value_and_grad(
-                lambda p: loss_fn(p, x, y, self.cfg), has_aux=True
-            )(params)
-            acc = (jnp.argmax(logits, -1) == y).mean()
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss, acc
-
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        # same body as the DP trainer; GSPMD inserts the collectives, so
+        # the combine step is the identity
+        self._step = jax.jit(_step_body(tx, self.cfg, lambda t: t),
+                             donate_argnums=(0, 1))
 
     def train_batch(self, x, y):
         """x: [b, features], y: [b]; b must be divisible by the data axis."""

@@ -86,6 +86,15 @@ def test_mesh_2d_validates_device_count(mesh):
         mesh_2d(4, 4)  # 16 > 8 simulated devices
 
 
+def test_tp_default_constructor_works(mesh):
+    """TPMLPTrainer() must be instantiable on the default topology: the
+    auto-picked model axis divides every sharded layer dim."""
+    tp = M.TPMLPTrainer()  # default MNIST sizes (784,512,256,10), 8 devices
+    x, y = M.synthetic_mnist(n=64)
+    loss, _ = tp.train_batch(x, y)
+    assert np.isfinite(loss)
+
+
 def test_tp_validates_divisibility(mesh):
     from harp_tpu.parallel.mesh import mesh_2d
 

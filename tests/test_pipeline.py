@@ -1,0 +1,87 @@
+"""Pipeline parallelism: forward equals serial composition; grads match."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.parallel.pipeline import pipeline_forward, pipeline_loss_and_grads
+
+N = 8  # workers / stages (conftest topology)
+WIDTH = 16
+MB = 4
+
+
+def stage_fn(params, h):
+    return jax.nn.tanh(h @ params["w"] + params["b"])
+
+
+def make_stage_params(rng, n_stages):
+    return {
+        "w": rng.normal(size=(n_stages, WIDTH, WIDTH)).astype(np.float32) * 0.5,
+        "b": rng.normal(size=(n_stages, WIDTH)).astype(np.float32) * 0.1,
+    }
+
+
+def serial_forward(stacked, x):
+    """Reference: apply all stages in sequence on the host."""
+    h = jnp.asarray(x)
+    for i in range(stacked["w"].shape[0]):
+        h = stage_fn({"w": jnp.asarray(stacked["w"][i]),
+                      "b": jnp.asarray(stacked["b"][i])}, h)
+    return h
+
+
+@pytest.mark.parametrize("m", [1, 3, 8])
+def test_pipeline_forward_matches_serial(mesh, m):
+    rng = np.random.default_rng(0)
+    stacked = make_stage_params(rng, N)
+    x = rng.normal(size=(m, MB, WIDTH)).astype(np.float32)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda p, xx: pipeline_forward(stage_fn, jax.tree.map(lambda a: a[0], p), xx),
+        in_specs=({"w": mesh.spec(0), "b": mesh.spec(0)}, P()),
+        out_specs=P(),
+    ))
+    out = np.asarray(fn(stacked, x))
+    for i in range(m):
+        np.testing.assert_allclose(
+            out[i], np.asarray(serial_forward(stacked, x[i])),
+            rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_grads_match_serial(mesh):
+    """Autodiff through the ring == serial chain-rule, stage by stage."""
+    rng = np.random.default_rng(1)
+    stacked = make_stage_params(rng, N)
+    m = 4
+    x = rng.normal(size=(m, MB, WIDTH)).astype(np.float32)
+    tgt = rng.normal(size=(m, MB, WIDTH)).astype(np.float32)
+
+    def loss_fn(outs, targets):
+        return ((outs - targets) ** 2).mean()
+
+    fn = jax.jit(mesh.shard_map(
+        lambda p, xx, tt: pipeline_loss_and_grads(
+            stage_fn, loss_fn, jax.tree.map(lambda a: a[0], p), xx, tt),
+        in_specs=({"w": mesh.spec(0), "b": mesh.spec(0)}, P(), P()),
+        out_specs=(P(), {"w": mesh.spec(0), "b": mesh.spec(0)}),
+    ))
+    loss, grads = fn(stacked, x, tgt)
+
+    # serial reference gradient over the STACKED params
+    def serial_loss(p):
+        outs = jnp.stack([serial_forward(p, x[i]) for i in range(m)])
+        return loss_fn(outs, tgt)
+
+    ref_loss, ref_grads = jax.value_and_grad(serial_loss)(
+        jax.tree.map(jnp.asarray, stacked))
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+    # shard_map concatenated the per-stage grads along dim 0: re-stack
+    gw = np.asarray(grads["w"]).reshape(N, WIDTH, WIDTH)
+    gb = np.asarray(grads["b"]).reshape(N, WIDTH)
+    np.testing.assert_allclose(gw, np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gb, np.asarray(ref_grads["b"]),
+                               rtol=1e-4, atol=1e-6)
