@@ -1,0 +1,105 @@
+"""Expert parallelism — Switch-style top-1 MoE FFN, one expert per worker.
+
+Completes the parallelism inventory (SURVEY.md §3.5: Harp has no EP, but
+its ``regroup``/all-to-all is exactly the EP dispatch pattern — this module
+makes that concrete): tokens are routed to experts by a gating argmax,
+packed into capacity-bounded per-expert buffers, exchanged with ONE
+``regroup`` (all-to-all) so each worker receives every token routed to ITS
+expert, run through the local expert FFN, and returned by the inverse
+``regroup``; the gate probability scales the combined output.
+
+Static shapes throughout (XLA requirement): each worker sends exactly
+``capacity`` token slots to every expert; tokens beyond capacity are
+DROPPED (standard Switch behavior) — their output is zero, and
+:func:`moe_ffn` reports how many.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import WORKER_AXIS
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, *, capacity: int,
+            axis: str = WORKER_AXIS):
+    """Top-1 MoE feed-forward (device view, inside ``shard_map``).
+
+    Args (per worker):
+      x: [n_loc, d] local tokens.
+      gate_w: [d, E] router weights, replicated (E = worker count).
+      w1 [d, h], b1 [h], w2 [h, d], b2 [d]: THIS worker's expert.
+      capacity: token slots this worker may send to EACH expert.
+    Returns ``(y [n_loc, d], dropped)`` — dropped is the GLOBAL (already
+    allreduced) count of tokens that exceeded a capacity bucket on any
+    worker; their y rows are zero.
+    """
+    e = jax.lax.axis_size(axis)
+    n_loc, d = x.shape
+    if gate_w.shape[-1] != e:
+        raise ValueError(
+            f"gate_w routes to {gate_w.shape[-1]} experts but the mesh has "
+            f"{e} workers (one expert per worker) — shapes must match or "
+            "tokens would silently clamp to wrong experts")
+
+    logits = x @ gate_w  # [n_loc, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(logits, axis=-1)         # [n_loc]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [n_loc, E]
+    # position of each token within its expert's send buffer
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(n_loc), expert_idx]
+    keep = pos < capacity
+    dropped = C.allreduce(jnp.sum(~keep))  # global drop count (all workers)
+    # capacity+1 slots: the last is the trash slot over-capacity tokens
+    # scatter into (so they can't corrupt a real slot); sliced off below
+    slot = jnp.where(keep, pos, capacity)
+    send = jnp.zeros((e, capacity + 1, d), x.dtype)
+    send = send.at[expert_idx, slot].set(x * keep[:, None])
+    send = send[:, :capacity]                                 # [E, cap, d]
+
+    # the EP exchange: block e of `send` goes to worker e; received block s
+    # holds worker s's tokens for MY expert — Harp's regroup, verbatim
+    recv = C.regroup(send, axis=axis, split_dim=0, concat_dim=0)
+
+    h = jax.nn.relu(recv @ w1 + b1)
+    out = h @ w2 + b2                                          # [E, cap, d]
+
+    # inverse exchange: block s returns to worker s
+    back = C.regroup(out, axis=axis, split_dim=0, concat_dim=0)
+
+    # un-dispatch: token t reads its expert's returned slot; dropped → 0
+    y = back[expert_idx, jnp.clip(slot, 0, capacity - 1)]
+    return y * (gate * keep)[:, None], dropped
+
+
+def reference_moe(x, gate_w, w1_all, b1_all, w2_all, b2_all, capacity, n_workers):
+    """Host reference: same routing/capacity semantics, dense numpy-style.
+
+    ``x`` is the GLOBAL [n, d] token array laid out worker-major (worker w
+    owns rows ``w*n_loc:(w+1)*n_loc``); ``*_all`` stack all experts on dim 0.
+    """
+    import numpy as np
+
+    x = np.asarray(x)
+    n, d = x.shape
+    n_loc = n // n_workers
+    logits = x @ np.asarray(gate_w)
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    probs = np.asarray(probs)
+    idx = logits.argmax(-1)
+    y = np.zeros_like(x)
+    # per (source worker, expert) capacity buckets, in token order
+    counts = np.zeros((n_workers, len(b1_all)), np.int64)
+    for t in range(n):
+        w = t // n_loc
+        ei = idx[t]
+        if counts[w, ei] >= capacity:
+            continue  # dropped
+        counts[w, ei] += 1
+        h = np.maximum(x[t] @ np.asarray(w1_all[ei]) + np.asarray(b1_all[ei]), 0)
+        y[t] = (h @ np.asarray(w2_all[ei]) + np.asarray(b2_all[ei])) * probs[t, ei]
+    return y

@@ -1,0 +1,77 @@
+"""Expert-parallel MoE: regroup dispatch == dense host reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.ops.moe import moe_ffn, reference_moe
+
+N = 8  # workers == experts
+D, H = 8, 16
+
+
+def make_weights(rng):
+    return {
+        "gate": rng.normal(size=(D, N)).astype(np.float32),
+        "w1": rng.normal(size=(N, D, H)).astype(np.float32) * 0.5,
+        "b1": rng.normal(size=(N, H)).astype(np.float32) * 0.1,
+        "w2": rng.normal(size=(N, H, D)).astype(np.float32) * 0.5,
+        "b2": rng.normal(size=(N, D)).astype(np.float32) * 0.1,
+    }
+
+
+def run_moe(mesh, weights, x, capacity):
+    fn = jax.jit(mesh.shard_map(
+        lambda xx, wt: moe_ffn(
+            xx, wt["gate"],
+            wt["w1"][0], wt["b1"][0], wt["w2"][0], wt["b2"][0],
+            capacity=capacity),
+        in_specs=(mesh.spec(0),
+                  {"gate": P(), "w1": mesh.spec(0), "b1": mesh.spec(0),
+                   "w2": mesh.spec(0), "b2": mesh.spec(0)}),
+        out_specs=(mesh.spec(0), P()),
+    ))
+    return fn(x, weights)
+
+
+@pytest.mark.parametrize("capacity", [16, 4])
+def test_moe_matches_reference(mesh, capacity):
+    """Large capacity: nothing dropped, exact match.  Small capacity: the
+    same tokens drop (deterministic order) and survivors still match."""
+    rng = np.random.default_rng(0)
+    weights = make_weights(rng)
+    x = rng.normal(size=(N * 16, D)).astype(np.float32)
+
+    y, dropped = run_moe(mesh, weights, x, capacity)
+    ref = reference_moe(x, weights["gate"], weights["w1"], weights["b1"],
+                        weights["w2"], weights["b2"], capacity, N)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+    # reference drop count from the same bucket semantics
+    logits = x @ weights["gate"]
+    idx = logits.argmax(-1)
+    ref_dropped = 0
+    for w in range(N):
+        rows = idx[w * 16:(w + 1) * 16]
+        for ei in range(N):
+            ref_dropped += max(0, int((rows == ei).sum()) - capacity)
+    assert int(dropped) == ref_dropped
+    if capacity >= 16:
+        assert ref_dropped == 0
+
+
+def test_moe_capacity_drops_are_counted(mesh):
+    """Routing everything to one expert overflows its buckets measurably."""
+    rng = np.random.default_rng(1)
+    weights = make_weights(rng)
+    # gate forces expert 0 for every token
+    weights["gate"] = np.zeros((D, N), np.float32)
+    weights["gate"][:, 0] = 1.0
+    x = np.abs(rng.normal(size=(N * 16, D))).astype(np.float32)  # positive dot
+    capacity = 4
+    y, dropped = run_moe(mesh, weights, x, capacity)
+    # each of the 8 workers keeps `capacity` of its 16 tokens
+    assert int(dropped) == N * (16 - capacity)
+    nonzero_rows = (~(np.asarray(y) == 0).all(-1)).sum()
+    assert nonzero_rows == N * capacity
