@@ -1,14 +1,20 @@
-"""Device kernels: ring attention (long-context) and Pallas TPU kernels.
+"""Device kernels: long-context attention, expert dispatch, Pallas kernels.
 
 Harp's rotate collective is structurally the ring-attention primitive
 (SURVEY.md §3.5, §6 "long-context"): a ppermute ring with compute/transfer
 overlap.  :mod:`harp_tpu.ops.ring_attention` makes that concrete — exact
-blockwise attention over a sequence-sharded mesh — so long-context models
-scale across chips with the same machinery the classic apps use.
-:mod:`harp_tpu.ops.flash_attention` is the single-chip Pallas kernel
-(VMEM-blocked online softmax) the ring's local step can use.
+blockwise attention over a sequence-sharded mesh — and
+:mod:`harp_tpu.ops.a2a_attention` is the Ulysses all-to-all alternative
+(regroup to head-sharded, full-sequence local attention, regroup back).
+:mod:`harp_tpu.ops.moe` rides the same regroup verb for expert-parallel
+MoE dispatch.  :mod:`harp_tpu.ops.flash_attention` is the single-chip
+Pallas kernel (VMEM-blocked online softmax) the local steps can use;
+:mod:`harp_tpu.ops.kmeans_kernel` is the fused single-pass KMeans kernel.
 """
 
-from harp_tpu.ops.ring_attention import ring_attention
+from harp_tpu.ops.a2a_attention import a2a_attention, make_a2a_attention_fn
+from harp_tpu.ops.moe import moe_ffn
+from harp_tpu.ops.ring_attention import make_ring_attention_fn, ring_attention
 
-__all__ = ["ring_attention"]
+__all__ = ["ring_attention", "make_ring_attention_fn", "a2a_attention",
+           "make_a2a_attention_fn", "moe_ffn"]

@@ -9,7 +9,13 @@ into compiled collective ops and only the *semantics* (the verbs and their
 combiner behavior) survive as API.
 """
 
-from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, set_mesh, init_distributed
+from harp_tpu.parallel.mesh import (
+    WorkerMesh,
+    current_mesh,
+    init_distributed,
+    mesh_2d,
+    set_mesh,
+)
 from harp_tpu.parallel.collective import (
     Combiner,
     allreduce,
@@ -22,6 +28,7 @@ from harp_tpu.parallel.collective import (
     pull,
     barrier,
 )
+from harp_tpu.parallel.pipeline import pipeline_forward, pipeline_loss_and_grads
 from harp_tpu.parallel.rotate import rotate_pipeline
 
 __all__ = [
@@ -29,6 +36,9 @@ __all__ = [
     "current_mesh",
     "set_mesh",
     "init_distributed",
+    "mesh_2d",
+    "pipeline_forward",
+    "pipeline_loss_and_grads",
     "Combiner",
     "allreduce",
     "allgather",
