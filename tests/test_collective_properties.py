@@ -1,0 +1,101 @@
+"""Property-based collective tests — hypothesis over values, ops, shifts.
+
+The deterministic tests in test_collective.py pin exact cases; these sweep
+random inputs against straight-line numpy models of each verb's contract.
+Shapes stay fixed so XLA compiles each (verb, static-arg) pair once.
+"""
+
+import jax
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+from hypothesis.extra.numpy import arrays
+
+from harp_tpu.parallel import collective as C
+
+N = 8
+SHAPE = (N, 3, 4)  # dim 0 shards over the workers
+
+finite_f32 = st.floats(-1e3, 1e3, allow_nan=False, allow_infinity=False,
+                       width=32)
+data_st = arrays(np.float32, SHAPE, elements=finite_f32)
+
+_OPS = {
+    C.Combiner.ADD: lambda a: a.sum(0),
+    C.Combiner.MAX: lambda a: a.max(0),
+    C.Combiner.MIN: lambda a: a.min(0),
+    C.Combiner.AVG: lambda a: a.mean(0),
+    C.Combiner.MULTIPLY: lambda a: a.prod(0),
+}
+
+_op_cache = {}
+
+
+def _host(verb, out_dim, **kw):
+    key = (verb.__name__, out_dim, tuple(sorted(kw.items())))
+    if key not in _op_cache:
+        mesh = _host.mesh
+        _op_cache[key] = C.host_op(mesh, verb, in_dim=0, out_dim=out_dim, **kw)
+    return _op_cache[key]
+
+
+@pytest.fixture(autouse=True)
+def _bind_mesh(mesh):
+    _host.mesh = mesh
+
+
+@settings(max_examples=20, deadline=None)
+@given(data=data_st, op=st.sampled_from(list(_OPS)))
+def test_allreduce_matches_numpy(data, op):
+    # MULTIPLY overflows easily at 8 factors of up to 1e3: tame the scale
+    if op is C.Combiner.MULTIPLY:
+        data = np.clip(data, -3.0, 3.0)
+    out = np.asarray(_host(C.allreduce, 0, op=op)(data))
+    ref = _OPS[op](data)
+    # every worker must hold the same reduced value
+    for w in range(N):
+        np.testing.assert_allclose(out[w], ref, rtol=2e-5, atol=2e-4)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=data_st, shift=st.sampled_from([-9, -2, -1, 0, 1, 2, 7, 8, 17]))
+def test_rotate_matches_roll(data, shift):
+    out = np.asarray(_host(C.rotate, 0, shift=shift)(data))
+    # shift=+1 sends to the next worker: worker w holds worker (w-shift)'s
+    np.testing.assert_array_equal(out, np.roll(data, shift, axis=0))
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=data_st)
+def test_allgather_replicates_everything(data):
+    out = np.asarray(_host(C.allgather, None)(data))
+    np.testing.assert_array_equal(out, data)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=data_st, root=st.integers(0, N - 1))
+def test_broadcast_takes_root_shard(data, root):
+    out = np.asarray(_host(C.broadcast, 0, root=root)(data))
+    for w in range(N):
+        np.testing.assert_array_equal(out[w], data[root])
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=arrays(np.float32, (N * N, 4), elements=finite_f32))
+def test_push_pull_roundtrip_is_allreduce(data):
+    """pull(push(x)) over worker blocks == allreduce(ADD) of the blocks."""
+    pushed = _host(C.push, 0)(data)          # reduce-scatter
+    out = np.asarray(_host(C.pull, None)(np.asarray(pushed)))
+    blocks = data.reshape(N, N, 4)
+    ref = blocks.sum(0)                       # [N, 4]
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-4)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=arrays(np.float32, (N * N, 4), elements=finite_f32))
+def test_regroup_is_block_transpose(data):
+    """Worker w's block j lands on worker j as block w (all_to_all)."""
+    out = np.asarray(_host(C.regroup, 0)(data))
+    blocks = data.reshape(N, N, 4)            # [src, dst, payload]
+    ref = blocks.transpose(1, 0, 2).reshape(N * N, 4)
+    np.testing.assert_array_equal(out, ref)
