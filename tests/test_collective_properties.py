@@ -7,7 +7,6 @@ Shapes stay fixed so XLA compiles each (verb, static-arg) pair once.
 
 import jax
 import numpy as np
-import pytest
 from hypothesis import given, settings, strategies as st
 from hypothesis.extra.numpy import arrays
 
@@ -31,26 +30,22 @@ _OPS = {
 _op_cache = {}
 
 
-def _host(verb, out_dim, **kw):
+def _host(mesh, verb, out_dim, **kw):
+    """Compile-once per (verb, static args): hypothesis replays many value
+    examples and must not recompile each time."""
     key = (verb.__name__, out_dim, tuple(sorted(kw.items())))
     if key not in _op_cache:
-        mesh = _host.mesh
         _op_cache[key] = C.host_op(mesh, verb, in_dim=0, out_dim=out_dim, **kw)
     return _op_cache[key]
 
 
-@pytest.fixture(autouse=True)
-def _bind_mesh(mesh):
-    _host.mesh = mesh
-
-
 @settings(max_examples=20, deadline=None)
 @given(data=data_st, op=st.sampled_from(list(_OPS)))
-def test_allreduce_matches_numpy(data, op):
+def test_allreduce_matches_numpy(mesh, data, op):
     # MULTIPLY overflows easily at 8 factors of up to 1e3: tame the scale
     if op is C.Combiner.MULTIPLY:
         data = np.clip(data, -3.0, 3.0)
-    out = np.asarray(_host(C.allreduce, 0, op=op)(data))
+    out = np.asarray(_host(mesh, C.allreduce, 0, op=op)(data))
     ref = _OPS[op](data)
     # every worker must hold the same reduced value
     for w in range(N):
@@ -59,33 +54,33 @@ def test_allreduce_matches_numpy(data, op):
 
 @settings(max_examples=15, deadline=None)
 @given(data=data_st, shift=st.sampled_from([-9, -2, -1, 0, 1, 2, 7, 8, 17]))
-def test_rotate_matches_roll(data, shift):
-    out = np.asarray(_host(C.rotate, 0, shift=shift)(data))
+def test_rotate_matches_roll(mesh, data, shift):
+    out = np.asarray(_host(mesh, C.rotate, 0, shift=shift)(data))
     # shift=+1 sends to the next worker: worker w holds worker (w-shift)'s
     np.testing.assert_array_equal(out, np.roll(data, shift, axis=0))
 
 
 @settings(max_examples=15, deadline=None)
 @given(data=data_st)
-def test_allgather_replicates_everything(data):
-    out = np.asarray(_host(C.allgather, None)(data))
+def test_allgather_replicates_everything(mesh, data):
+    out = np.asarray(_host(mesh, C.allgather, None)(data))
     np.testing.assert_array_equal(out, data)
 
 
 @settings(max_examples=15, deadline=None)
 @given(data=data_st, root=st.integers(0, N - 1))
-def test_broadcast_takes_root_shard(data, root):
-    out = np.asarray(_host(C.broadcast, 0, root=root)(data))
+def test_broadcast_takes_root_shard(mesh, data, root):
+    out = np.asarray(_host(mesh, C.broadcast, 0, root=root)(data))
     for w in range(N):
         np.testing.assert_array_equal(out[w], data[root])
 
 
 @settings(max_examples=15, deadline=None)
 @given(data=arrays(np.float32, (N * N, 4), elements=finite_f32))
-def test_push_pull_roundtrip_is_allreduce(data):
+def test_push_pull_roundtrip_is_allreduce(mesh, data):
     """pull(push(x)) over worker blocks == allreduce(ADD) of the blocks."""
-    pushed = _host(C.push, 0)(data)          # reduce-scatter
-    out = np.asarray(_host(C.pull, None)(np.asarray(pushed)))
+    pushed = _host(mesh, C.push, 0)(data)          # reduce-scatter
+    out = np.asarray(_host(mesh, C.pull, None)(np.asarray(pushed)))
     blocks = data.reshape(N, N, 4)
     ref = blocks.sum(0)                       # [N, 4]
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-4)
@@ -93,9 +88,9 @@ def test_push_pull_roundtrip_is_allreduce(data):
 
 @settings(max_examples=15, deadline=None)
 @given(data=arrays(np.float32, (N * N, 4), elements=finite_f32))
-def test_regroup_is_block_transpose(data):
+def test_regroup_is_block_transpose(mesh, data):
     """Worker w's block j lands on worker j as block w (all_to_all)."""
-    out = np.asarray(_host(C.regroup, 0)(data))
+    out = np.asarray(_host(mesh, C.regroup, 0)(data))
     blocks = data.reshape(N, N, 4)            # [src, dst, payload]
     ref = blocks.transpose(1, 0, 2).reshape(N * N, 4)
     np.testing.assert_array_equal(out, ref)

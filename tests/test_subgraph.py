@@ -45,7 +45,8 @@ TINY_EDGES = [(0, 1), (1, 2), (2, 3), (3, 0), (0, 2), (4, 0), (5, 1), (4, 5)]
 TINY_N = 8  # includes two isolated-ish vertices 6, 7
 
 
-@pytest.mark.parametrize("tname", ["u3-path", "u3-star", "u5-path", "u5-tree"])
+@pytest.mark.parametrize("tname", ["u3-path", "u3-star", "u5-path", "u5-star",
+                                   "u5-tree"])
 def test_dp_matches_brute_force_colorful(mesh, tname):
     tpl = SG.TEMPLATES[tname]
     s = len(tpl)

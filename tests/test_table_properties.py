@@ -1,0 +1,83 @@
+"""Property tests for KVTable: random op sequences vs a model dict.
+
+The interesting invariant is the count-weighted AVG merge: merging
+pre-combined tables in ANY grouping must equal combining all raw
+contributions directly (associativity Harp's ValCombiner relies on).
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from harp_tpu.parallel.collective import Combiner
+from harp_tpu.table import KVTable, kv_allreduce
+
+keys_st = st.integers(-4, 4)
+vals_st = st.floats(-100, 100, allow_nan=False, allow_infinity=False,
+                    width=32)
+pairs_st = st.lists(st.tuples(keys_st, vals_st), min_size=1, max_size=40)
+ops_st = st.sampled_from([Combiner.ADD, Combiner.MAX, Combiner.MIN,
+                          Combiner.AVG])
+
+_NUMPY_OP = {
+    Combiner.ADD: np.sum,
+    Combiner.MAX: np.max,
+    Combiner.MIN: np.min,
+    Combiner.AVG: np.mean,
+}
+
+
+@settings(max_examples=60, deadline=None)
+@given(pairs=pairs_st, op=ops_st)
+def test_kvtable_add_matches_numpy_reduction(pairs, op):
+    t = KVTable(op, dtype=np.float64)
+    model = {}
+    for k, v in pairs:
+        t.add(k, v)
+        model.setdefault(k, []).append(v)
+    assert t.keys() == sorted(model)
+    for k, contributions in model.items():
+        np.testing.assert_allclose(float(t.get(k)),
+                                   _NUMPY_OP[op](contributions),
+                                   rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=60, deadline=None)
+@given(pairs=pairs_st, op=ops_st, n_splits=st.integers(1, 5))
+def test_kv_merge_grouping_invariance(pairs, op, n_splits):
+    """Splitting the contribution stream across worker tables and merging
+    gives the same result as one table seeing every raw contribution."""
+    direct = KVTable(op, dtype=np.float64)
+    for k, v in pairs:
+        direct.add(k, v)
+
+    workers = [KVTable(op, dtype=np.float64) for _ in range(n_splits)]
+    for i, (k, v) in enumerate(pairs):
+        workers[i % n_splits].add(k, v)
+    merged = kv_allreduce(workers[0], worker_tables=workers[1:])
+
+    assert merged.keys() == direct.keys()
+    for k in direct.keys():
+        np.testing.assert_allclose(float(merged.get(k)), float(direct.get(k)),
+                                   rtol=1e-9, atol=1e-9)
+
+
+@settings(max_examples=30, deadline=None)
+@given(pairs=pairs_st, op=ops_st)
+def test_kvtable_array_roundtrip_preserves_state(pairs, op):
+    """to_arrays → from_arrays(counts=...) reproduces values AND merge
+    behavior (counts carry the AVG weights)."""
+    t = KVTable(op, dtype=np.float64)
+    for k, v in pairs:
+        t.add(k, v)
+    keys, vals, counts = t.to_arrays()
+    t2 = KVTable.from_arrays(keys, vals, op, dtype=np.float64, counts=counts)
+    for k in t.keys():
+        np.testing.assert_allclose(float(t2.get(k)), float(t.get(k)))
+    # the restored table must merge identically to the original
+    other = KVTable(op, dtype=np.float64)
+    other.add(0, 7.0)
+    a = kv_allreduce(t, worker_tables=[other])
+    b = kv_allreduce(t2, worker_tables=[other])
+    for k in a.keys():
+        np.testing.assert_allclose(float(a.get(k)), float(b.get(k)),
+                                   rtol=1e-12)
