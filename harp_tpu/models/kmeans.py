@@ -279,6 +279,9 @@ def main(argv=None):
                    choices=["allreduce", "regroupallgather"],
                    help="Harp app variant: one fused psum, or the explicit "
                         "regroup(reduce-scatter)+allgather two-phase form")
+    p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
+                   help="CSV/whitespace point files (one point per row) — "
+                        "the Harp app's HDFS input; default: synthetic")
     p.add_argument("--bench", action="store_true", help="synthetic benchmark mode")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
@@ -288,11 +291,20 @@ def main(argv=None):
                         variant=args.variant)
         print(out)
     else:
-        rng = np.random.default_rng(0)
-        pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
+        if args.input:
+            from harp_tpu.native.datasource import load_csv_glob
+
+            try:
+                pts = load_csv_glob(args.input)
+            except ValueError as e:
+                raise SystemExit(str(e))
+        else:
+            rng = np.random.default_rng(0)
+            pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
                          variant=args.variant)
-        print({"k": args.k, "iters": args.iters, "inertia": inertia})
+        print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
+               "d": pts.shape[1], "inertia": inertia})
 
 
 if __name__ == "__main__":

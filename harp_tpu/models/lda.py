@@ -326,8 +326,10 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser(description="harp-tpu LDA-CGS (edu.iu.lda parity)")
-    p.add_argument("--docs", type=int, default=100_000)
-    p.add_argument("--vocab", type=int, default=50_000)
+    p.add_argument("--docs", type=int, default=None,
+                   help="default: 100000, or max doc id + 1 with --input")
+    p.add_argument("--vocab", type=int, default=None,
+                   help="default: 50000, or max word id + 1 with --input")
     p.add_argument("--topics", type=int, default=1000)
     p.add_argument("--tokens-per-doc", type=int, default=100)
     p.add_argument("--epochs", type=int, default=2)
@@ -337,19 +339,38 @@ def main(argv=None):
                         "benchmarking; rerunning with the same dir resumes "
                         "the chain from the latest saved epoch")
     p.add_argument("--ckpt-every", type=int, default=5)
+    p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
+                   help="token files ('doc word [count]' rows) — the Harp "
+                        "app's HDFS input; implies sampling mode. --docs/"
+                        "--vocab are raised to max id + 1 as needed")
     args = p.parse_args(argv)
-    if args.ckpt_dir:
-        model = LDA(args.docs, args.vocab,
+    if args.input or args.ckpt_dir:
+        if args.input:
+            from harp_tpu.native.datasource import load_triples_glob
+
+            try:
+                d_ids, w_ids, counts = load_triples_glob(args.input)
+            except ValueError as e:
+                raise SystemExit(str(e))
+            reps = np.maximum(counts.astype(np.int64), 1)  # bare rows = 1
+            d_ids = np.repeat(d_ids, reps)
+            w_ids = np.repeat(w_ids, reps)
+            # explicit sizes are raised to fit the data (as the help says)
+            n_docs = max(args.docs or 0, int(d_ids.max()) + 1)
+            vocab = max(args.vocab or 0, int(w_ids.max()) + 1)
+        else:
+            n_docs, vocab = args.docs or 100_000, args.vocab or 50_000
+            d_ids, w_ids = synthetic_corpus(n_docs, vocab,
+                                            max(2, args.topics // 8),
+                                            args.tokens_per_doc)
+        model = LDA(n_docs, vocab,
                     LDAConfig(n_topics=args.topics, chunk=args.chunk))
-        d_ids, w_ids = synthetic_corpus(args.docs, args.vocab,
-                                        max(2, args.topics // 8),
-                                        args.tokens_per_doc)
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
                "log_likelihood": round(model.log_likelihood(), 4)})
     else:
-        print(benchmark(args.docs, args.vocab, args.topics,
+        print(benchmark(args.docs or 100_000, args.vocab or 50_000, args.topics,
                         args.tokens_per_doc, args.epochs, chunk=args.chunk))
 
 

@@ -370,8 +370,12 @@ def main(argv=None):
     import argparse
 
     p = argparse.ArgumentParser(description="harp-tpu MF-SGD (edu.iu.sgd parity)")
-    p.add_argument("--users", type=int, default=138_493)
-    p.add_argument("--items", type=int, default=26_744)
+    p.add_argument("--users", type=int, default=None,
+                   help="default: 138493 (ML-20M); with --input, raised to "
+                        "max id + 1 as needed")
+    p.add_argument("--items", type=int, default=None,
+                   help="default: 26744 (ML-20M); with --input, raised to "
+                        "max id + 1 as needed")
     p.add_argument("--nnz", type=int, default=20_000_000)
     p.add_argument("--rank", type=int, default=64)
     p.add_argument("--epochs", type=int, default=3)
@@ -382,19 +386,38 @@ def main(argv=None):
                         "rerunning with the same dir resumes from the latest "
                         "saved epoch")
     p.add_argument("--ckpt-every", type=int, default=5)
+    p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
+                   help="rating triple files ('user item rating' rows, e.g. "
+                        "MovieLens) — the Harp app's HDFS input; implies "
+                        "training mode. --users/--items default to max id + 1")
     args = p.parse_args(argv)
-    if args.ckpt_dir:
-        model = MFSGD(args.users, args.items, _make_config(args.rank, args.chunk))
-        u, i, v = synthetic_ratings(args.users, args.items, args.nnz)
+    if args.input or args.ckpt_dir:
+        if args.input:
+            from harp_tpu.native.datasource import load_triples_glob
+
+            try:
+                u, i, v = load_triples_glob(args.input)
+            except ValueError as e:
+                raise SystemExit(str(e))
+            # explicit sizes are raised to fit the data (out-of-range ids
+            # would crash the partitioner deep inside otherwise)
+            n_users = max(args.users or 0, int(u.max()) + 1)
+            n_items = max(args.items or 0, int(i.max()) + 1)
+        else:
+            n_users = args.users or 138_493
+            n_items = args.items or 26_744
+            u, i, v = synthetic_ratings(n_users, n_items, args.nnz)
+        model = MFSGD(n_users, n_items, _make_config(args.rank, args.chunk))
         model.set_ratings(u, i, v)
         rmses = model.fit(args.epochs, args.ckpt_dir,
                           ckpt_every=args.ckpt_every)
         print({"epochs_run": len(rmses),
                "rmse_final": rmses[-1] if rmses else None,
+               "nnz": len(u), "users": n_users, "items": n_items,
                "ckpt_dir": args.ckpt_dir})
     else:
-        print(benchmark(args.users, args.items, args.nnz, args.rank,
-                        args.epochs, chunk=args.chunk))
+        print(benchmark(args.users or 138_493, args.items or 26_744,
+                        args.nnz, args.rank, args.epochs, chunk=args.chunk))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,40 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
     return labels, indptr, indices, values, n_features
 
 
+def load_csv_glob(pattern_or_dir: str, n_threads: int = 0) -> np.ndarray:
+    """Concatenate every file matching a glob/dir through :func:`load_csv`
+    (the Harp app's multi-file HDFS input shape).  Raises ``ValueError``
+    on zero matches or zero total rows — callers get a clear error, not a
+    concatenate traceback."""
+    from harp_tpu.fileformat import list_files
+
+    paths = list_files(pattern_or_dir)
+    if not paths:
+        raise ValueError(f"{pattern_or_dir}: no input files matched")
+    out = np.concatenate([load_csv(f, n_threads) for f in paths])
+    if out.shape[0] == 0:
+        raise ValueError(f"{pattern_or_dir}: input files contain no rows")
+    return out
+
+
+def load_triples_glob(pattern_or_dir: str, n_threads: int = 0):
+    """Concatenate 'u i [v]' triple files matching a glob/dir — shared by
+    the MF-SGD and LDA CLIs.  Raises ``ValueError`` on zero matches or
+    zero total rows."""
+    from harp_tpu.fileformat import list_files
+
+    paths = list_files(pattern_or_dir)
+    if not paths:
+        raise ValueError(f"{pattern_or_dir}: no input files matched")
+    parts = [load_triples(f, n_threads) for f in paths]
+    u = np.concatenate([p[0] for p in parts])
+    i = np.concatenate([p[1] for p in parts])
+    v = np.concatenate([p[2] for p in parts])
+    if len(u) == 0:
+        raise ValueError(f"{pattern_or_dir}: input files contain no rows")
+    return u, i, v
+
+
 def csr_to_ell(indptr, indices, values, width: int | None = None):
     """CSR → padded ELL blocks ``(ids [n, w] i32, vals [n, w] f32,
     mask [n, w] f32)`` — the static-shape layout TPU kernels consume
@@ -164,13 +198,18 @@ def csr_to_ell(indptr, indices, values, width: int | None = None):
 
 
 def load_triples(path: str, n_threads: int = 0):
-    """'u i v' rating/token lines → (int32 [n], int32 [n], float32 [n])."""
+    """'u i [v]' rating/token lines → (int32 [n], int32 [n], float32 [n]).
+
+    A missing third column reads as v=0.0 (both paths — the native parser
+    already tolerates it).
+    """
     n_threads = n_threads or (os.cpu_count() or 1)
     lib = load_native()
     if lib is None:
         a = _loadtxt_any_sep(path)
+        v = a[:, 2] if a.shape[1] >= 3 else np.zeros(len(a))
         return (a[:, 0].astype(np.int32), a[:, 1].astype(np.int32),
-                a[:, 2].astype(np.float32))
+                v.astype(np.float32))
     rows = ctypes.c_int64()
     cols = ctypes.c_int64()
     rc = lib.harp_count_rows(path.encode(), n_threads,

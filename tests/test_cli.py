@@ -120,6 +120,63 @@ def test_dispatch_lda_ckpt_resume(capsys, tmp_path, monkeypatch):
     assert first == second  # and the restored chain state is identical
 
 
+def test_dispatch_file_inputs(capsys, tmp_path):
+    """kmeans/mfsgd/lda consume input files like the Harp apps' HDFS paths."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    # kmeans: two CSV shards via a glob
+    for j in range(2):
+        np.savetxt(tmp_path / f"pts{j}.csv",
+                   rng.normal(size=(64, 4)).astype(np.float32), delimiter=",")
+    assert cli.main(["kmeans", "--input", str(tmp_path / "pts*.csv"),
+                     "--k", "2", "--iters", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "'n': 128" in out and "inertia" in out
+
+    # mfsgd: rating triples, dims inferred from ids
+    lines = [f"{rng.integers(0, 24)} {rng.integers(0, 16)} {rng.normal():.3f}"
+             for _ in range(300)]
+    (tmp_path / "r.txt").write_text("\n".join(lines) + "\n")
+    assert cli.main(["mfsgd", "--input", str(tmp_path / "r.txt"),
+                     "--rank", "4", "--epochs", "2", "--chunk", "64"]) == 0
+    out = capsys.readouterr().out
+    assert "'nnz': 300" in out and "rmse_final" in out
+
+    # lda: doc-word tokens with a count column (expanded)
+    tok = ["0 1 2", "0 3 1", "1 2 3", "2 0 1"]
+    (tmp_path / "tok.txt").write_text("\n".join(tok) + "\n")
+    assert cli.main(["lda", "--input", str(tmp_path / "tok.txt"),
+                     "--topics", "2", "--chunk", "16", "--epochs", "2",
+                     "--ckpt-dir", str(tmp_path / "lc")]) == 0
+    out = capsys.readouterr().out
+    assert "log_likelihood" in out
+
+    # zero matches → clear SystemExit, not a concatenate traceback
+    import pytest
+
+    with pytest.raises(SystemExit, match="no input files"):
+        cli.main(["kmeans", "--input", str(tmp_path / "nope*.csv")])
+    with pytest.raises(SystemExit, match="no input files"):
+        cli.main(["mfsgd", "--input", str(tmp_path / "nope*.txt")])
+
+
+def test_triples_two_column_fallback_matches_native(tmp_path, monkeypatch):
+    """Bare 'doc word' rows (no count) load identically on both paths."""
+    import numpy as np
+
+    import harp_tpu.native.datasource as ds
+
+    p = tmp_path / "two.txt"
+    p.write_text("0 1\n2 3\n")
+    native = ds.load_triples(str(p))
+    monkeypatch.setattr(ds, "load_native", lambda: None)
+    fallback = ds.load_triples(str(p))
+    for a, b in zip(native, fallback):
+        np.testing.assert_allclose(a, b)
+    np.testing.assert_array_equal(native[2], [0.0, 0.0])
+
+
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
                    "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
