@@ -44,6 +44,7 @@ from harp_tpu.table import (
     Table,
     combine_by_key,
     kv_allreduce,
+    regroup_by_key,
 )
 from harp_tpu.mapper import CollectiveApp, KeyValReader, run_app
 from harp_tpu.schedule import StaticScheduler, DynamicScheduler, Task
@@ -66,6 +67,7 @@ __all__ = [
     "Long2DoubleKVTable",
     "kv_allreduce",
     "combine_by_key",
+    "regroup_by_key",
     "Table",
     "Partition",
     "CollectiveApp",

@@ -349,12 +349,20 @@ def main(argv=None):
             from harp_tpu.native.datasource import load_triples_glob
 
             try:
-                d_ids, w_ids, counts = load_triples_glob(args.input)
+                d_ids, w_ids, counts, has_counts = load_triples_glob(args.input)
             except ValueError as e:
                 raise SystemExit(str(e))
-            reps = np.maximum(counts.astype(np.int64), 1)  # bare rows = 1
+            if int(d_ids.min()) < 0 or int(w_ids.min()) < 0:
+                raise SystemExit(f"{args.input}: negative doc/word ids")
+            if has_counts:
+                # explicit count column: 0 means "absent" — drop, don't clamp
+                reps = np.maximum(counts.astype(np.int64), 0)
+            else:
+                reps = np.ones(len(d_ids), np.int64)  # bare pair = one token
             d_ids = np.repeat(d_ids, reps)
             w_ids = np.repeat(w_ids, reps)
+            if len(d_ids) == 0:
+                raise SystemExit(f"{args.input}: all token counts are zero")
             # explicit sizes are raised to fit the data (as the help says)
             n_docs = max(args.docs or 0, int(d_ids.max()) + 1)
             vocab = max(args.vocab or 0, int(w_ids.max()) + 1)

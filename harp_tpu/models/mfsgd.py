@@ -396,9 +396,18 @@ def main(argv=None):
             from harp_tpu.native.datasource import load_triples_glob
 
             try:
-                u, i, v = load_triples_glob(args.input)
+                u, i, v, has_rating = load_triples_glob(args.input)
             except ValueError as e:
                 raise SystemExit(str(e))
+            if not has_rating:
+                raise SystemExit(
+                    f"{args.input}: rows have no rating column — MF-SGD "
+                    "needs 'user item rating' triples (training on the "
+                    "implied zeros would silently fit nothing)")
+            if int(u.min()) < 0 or int(i.min()) < 0:
+                raise SystemExit(
+                    f"{args.input}: negative user/item ids (ids index model "
+                    "rows; JAX would silently clamp them to wrong rows)")
             # explicit sizes are raised to fit the data (out-of-range ids
             # would crash the partitioner deep inside otherwise)
             n_users = max(args.users or 0, int(u.max()) + 1)

@@ -21,8 +21,12 @@ def _loadtxt_any_sep(path: str) -> np.ndarray:
     with open(path) as f:
         text = f.read().replace(",", " ")
     import io
+    import warnings
 
-    return np.loadtxt(io.StringIO(text), dtype=np.float64, ndmin=2)
+    with warnings.catch_warnings():
+        # empty shards are legitimate input (skipped by the glob loaders)
+        warnings.filterwarnings("ignore", message=".*input contained no data.*")
+        return np.loadtxt(io.StringIO(text), dtype=np.float64, ndmin=2)
 
 
 def load_csv(path: str, n_threads: int = 0) -> np.ndarray:
@@ -138,36 +142,80 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
 
 def load_csv_glob(pattern_or_dir: str, n_threads: int = 0) -> np.ndarray:
     """Concatenate every file matching a glob/dir through :func:`load_csv`
-    (the Harp app's multi-file HDFS input shape).  Raises ``ValueError``
-    on zero matches or zero total rows — callers get a clear error, not a
+    (the Harp app's multi-file HDFS input shape).  Empty shards are
+    skipped (routine in HDFS-style directories); raises ``ValueError`` on
+    zero matches or zero total rows — callers get a clear error, not a
     concatenate traceback."""
     from harp_tpu.fileformat import list_files
 
     paths = list_files(pattern_or_dir)
     if not paths:
         raise ValueError(f"{pattern_or_dir}: no input files matched")
-    out = np.concatenate([load_csv(f, n_threads) for f in paths])
-    if out.shape[0] == 0:
+    arrays = [a for a in (load_csv(f, n_threads) for f in paths)
+              if a.shape[0] > 0]
+    if not arrays:
         raise ValueError(f"{pattern_or_dir}: input files contain no rows")
-    return out
+    return np.concatenate(arrays)
+
+
+_COLUMN_SCAN_ROWS = 10_000
+
+
+def _scan_columns(path: str) -> set[int]:
+    """Distinct column counts over the file's first data rows.
+
+    Scans up to ``_COLUMN_SCAN_ROWS`` non-comment rows (ragged files are
+    overwhelmingly ragged early — headers, truncated exports); rows beyond
+    the scan window are not validated, which keeps huge files on the fast
+    native parser.  Returns an empty set for an empty file.
+    """
+    seen: set[int] = set()
+    with open(path) as f:
+        rows = 0
+        for line in f:
+            toks = line.split("#", 1)[0].replace(",", " ").split()
+            if toks:
+                seen.add(len(toks))
+                rows += 1
+                if rows >= _COLUMN_SCAN_ROWS:
+                    break
+    return seen
 
 
 def load_triples_glob(pattern_or_dir: str, n_threads: int = 0):
     """Concatenate 'u i [v]' triple files matching a glob/dir — shared by
-    the MF-SGD and LDA CLIs.  Raises ``ValueError`` on zero matches or
-    zero total rows."""
+    the MF-SGD and LDA CLIs.
+
+    Returns ``(u, i, v, has_value_column)``: v reads as 0.0 for two-column
+    files, and ``has_value_column`` tells the caller whether a third
+    column actually existed (an explicit 0 and a missing column are
+    different facts — LDA drops explicit zero counts but treats bare
+    pairs as single tokens).  All rows (within the first
+    ``_COLUMN_SCAN_ROWS`` of each file, and across files) must agree on
+    the column count — a ragged row would otherwise read as a fabricated
+    0.0 value.  Raises ``ValueError`` on zero matches, zero total rows,
+    or disagreeing column counts.
+    """
     from harp_tpu.fileformat import list_files
 
     paths = list_files(pattern_or_dir)
     if not paths:
         raise ValueError(f"{pattern_or_dir}: no input files matched")
+    ncols: set[int] = set()
+    for f in paths:
+        ncols |= _scan_columns(f)
+    if len(ncols) > 1:
+        raise ValueError(
+            f"{pattern_or_dir}: rows disagree on column count "
+            f"({sorted(ncols)}) — a short row would read as a fabricated "
+            "0.0 value; fix the input")
     parts = [load_triples(f, n_threads) for f in paths]
     u = np.concatenate([p[0] for p in parts])
     i = np.concatenate([p[1] for p in parts])
     v = np.concatenate([p[2] for p in parts])
     if len(u) == 0:
         raise ValueError(f"{pattern_or_dir}: input files contain no rows")
-    return u, i, v
+    return u, i, v, bool(ncols) and max(ncols) >= 3
 
 
 def csr_to_ell(indptr, indices, values, width: int | None = None):
@@ -207,6 +255,9 @@ def load_triples(path: str, n_threads: int = 0):
     lib = load_native()
     if lib is None:
         a = _loadtxt_any_sep(path)
+        if a.shape[0] == 0:  # empty shard: loadtxt yields (0, 1)
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                    np.zeros(0, np.float32))
         v = a[:, 2] if a.shape[1] >= 3 else np.zeros(len(a))
         return (a[:, 0].astype(np.int32), a[:, 1].astype(np.int32),
                 v.astype(np.float32))

@@ -119,6 +119,19 @@ size_t align_to_line(const char* data, size_t off, size_t size) {
   return off;
 }
 
+// Truncate a line at '#' (numpy.loadtxt's default comment marker — the
+// Python fallback inherits it, so the native parser must agree).
+inline const char* strip_comment(const char* p, const char* line_end) {
+  const char* hash = static_cast<const char*>(memchr(p, '#', line_end - p));
+  return hash ? hash : line_end;
+}
+
+// A line is blank if it holds only separators (or was all comment).
+inline bool blank_line(const char* p, const char* line_end) {
+  skip_seps(p, line_end);
+  return p >= line_end;
+}
+
 void count_range(const char* data, size_t begin, size_t end_, int64_t* rows,
                  int64_t* cols) {
   int64_t r = 0, c = 0;
@@ -126,8 +139,8 @@ void count_range(const char* data, size_t begin, size_t end_, int64_t* rows,
   const char* end = data + end_;
   while (p < end) {
     const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-    const char* line_end = nl ? nl : end;
-    if (line_end > p) {  // non-empty line
+    const char* line_end = strip_comment(p, nl ? nl : end);
+    if (line_end > p && !blank_line(p, line_end)) {
       ++r;
       if (c == 0) {
         const char* q = p;
@@ -206,8 +219,8 @@ int harp_load_csv_f32(const char* path, int n_threads, float* buf,
     float* out = buf + row0[t] * cols;
     while (p < end) {
       const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-      const char* line_end = nl ? nl : end;
-      if (line_end > p) {
+      const char* line_end = strip_comment(p, nl ? nl : end);
+      if (line_end > p && !blank_line(p, line_end)) {
         const char* q = p;
         for (int64_t j = 0; j < cols; ++j) {
           skip_seps(q, line_end);
@@ -411,8 +424,8 @@ int harp_load_triples(const char* path, int n_threads, int32_t* u_buf,
     int64_t row = row0[t];
     while (p < end) {
       const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
-      const char* line_end = nl ? nl : end;
-      if (line_end > p) {
+      const char* line_end = strip_comment(p, nl ? nl : end);
+      if (line_end > p && !blank_line(p, line_end)) {
         const char* q = p;
         skip_seps(q, line_end);
         u_buf[row] = static_cast<int32_t>(std::strtol(q, const_cast<char**>(&q), 10));

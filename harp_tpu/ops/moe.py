@@ -49,17 +49,11 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, *, capacity: int,
     expert_idx = jnp.argmax(logits, axis=-1)         # [n_loc]
     gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
 
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [n_loc, E]
-    # position of each token within its expert's send buffer
-    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(n_loc), expert_idx]
-    keep = pos < capacity
-    dropped = C.allreduce(jnp.sum(~keep))  # global drop count (all workers)
-    # capacity+1 slots: the last is the trash slot over-capacity tokens
-    # scatter into (so they can't corrupt a real slot); sliced off below
-    slot = jnp.where(keep, pos, capacity)
-    send = jnp.zeros((e, capacity + 1, d), x.dtype)
-    send = send.at[expert_idx, slot].set(x * keep[:, None])
-    send = send[:, :capacity]                                 # [E, cap, d]
+    from harp_tpu.parallel.dispatch import bucket_by_destination
+
+    (send,), keep, slot, dropped_local = bucket_by_destination(
+        expert_idx, (x,), capacity, e)                        # [E, cap, d]
+    dropped = C.allreduce(dropped_local)  # global drop count (all workers)
 
     # the EP exchange: block e of `send` goes to worker e; received block s
     # holds worker s's tokens for MY expert — Harp's regroup, verbatim

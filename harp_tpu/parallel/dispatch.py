@@ -1,0 +1,46 @@
+"""Capacity-bounded destination bucketing — the all_to_all dispatch core.
+
+Reference parity (SURVEY.md §3.5): Harp's ``regroup`` repartitions table
+entries to their owning worker; the same all-to-all pattern underlies
+expert-parallel dispatch.  This module is the one implementation of the
+routing math shared by MoE dispatch (:mod:`harp_tpu.ops.moe`) and the
+device-side KV shuffle (:func:`harp_tpu.table.regroup_by_key`): items
+carry a destination id, each (source, destination) bucket holds a STATIC
+``capacity`` slots (XLA needs static shapes), over-capacity items are
+dropped via a trash slot that is sliced off before the exchange.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_by_destination(dest, payloads, capacity: int, n_dest: int):
+    """Pack items into per-destination capacity buckets.
+
+    Args:
+      dest: [n] int — destination id per item (0 <= dest < n_dest).
+      payloads: tuple of arrays with leading dim n (any trailing shape).
+      capacity: slots per destination bucket.
+      n_dest: number of destinations.
+    Returns ``(bufs, keep, slot, dropped_local)``:
+      bufs — tuple of [n_dest, capacity, ...] arrays, item i stored at
+      ``(dest[i], slot[i])`` when kept, zeros elsewhere;
+      keep — [n] bool, False for over-capacity items;
+      slot — [n] int, the in-bucket position (== capacity for dropped
+      items; pair with ``keep`` when gathering back);
+      dropped_local — scalar count of THIS shard's dropped items.
+    """
+    n = dest.shape[0]
+    onehot = jax.nn.one_hot(dest, n_dest, dtype=jnp.int32)     # [n, n_dest]
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(n), dest]
+    keep = pos < capacity
+    slot = jnp.where(keep, pos, capacity)  # trash slot, sliced off below
+
+    bufs = []
+    for p in payloads:
+        buf = jnp.zeros((n_dest, capacity + 1) + p.shape[1:], p.dtype)
+        masked = p * keep.reshape((n,) + (1,) * (p.ndim - 1)).astype(p.dtype)
+        bufs.append(buf.at[dest, slot].set(masked)[:, :capacity])
+    return tuple(bufs), keep, slot, jnp.sum(~keep)

@@ -435,6 +435,42 @@ def combine_by_key(keys, values, num_keys: int, op: Combiner | str = Combiner.AD
     raise AssertionError(comb)
 
 
+def regroup_by_key(keys, values, *, capacity: int, axis: str = WORKER_AXIS):
+    """Route (key, value) pairs to their owning worker — device-side KV regroup.
+
+    Harp's KV tables repartition by ``key % numWorkers`` (the keyval
+    regroup); on TPU that is one ``all_to_all`` over static capacity-bounded
+    buckets (same machinery as MoE expert dispatch).  Call inside
+    ``shard_map``.
+
+    Args (per worker): ``keys [n] int`` (non-negative), ``values [n, ...]``,
+    ``capacity`` = pair slots this worker may send to EACH destination.
+    Returns ``(keys_out [nw·capacity], values_out [nw·capacity, ...],
+    mask [nw·capacity], dropped)`` — the pairs this worker now owns, plus
+    the GLOBAL count of pairs dropped by capacity overflow.  Padding slots
+    carry key ``-1`` (and mask 0), which JAX segment ops drop as
+    out-of-range — so :func:`combine_by_key` is safe for EVERY combiner
+    (AVG/MIN/MAX included), not just value-masked ADD.
+    """
+    from harp_tpu.parallel.collective import allreduce as _allreduce
+    from harp_tpu.parallel.collective import regroup as _regroup
+    from harp_tpu.parallel.dispatch import bucket_by_destination
+
+    nw = jax.lax.axis_size(axis)
+    dest = keys % nw
+    # keys travel shifted by +1 so the dispatch's zero-filled padding
+    # becomes key -1 on receipt (a sentinel no valid key can collide with)
+    (buf_k1, buf_v, buf_m), _, _, dropped_local = bucket_by_destination(
+        dest, (keys + 1, values, jnp.ones(keys.shape[0], jnp.float32)),
+        capacity, nw)
+    dropped = _allreduce(dropped_local, axis=axis)
+
+    rk1, rv, rm = _regroup((buf_k1, buf_v, buf_m),
+                           axis=axis, split_dim=0, concat_dim=0)
+    flat = lambda a: a.reshape((nw * capacity,) + a.shape[2:])
+    return flat(rk1) - 1, flat(rv), flat(rm), dropped
+
+
 # ---------------------------------------------------------------------------
 # Sparse push/pull on a row-sharded global table (device view).
 #

@@ -160,6 +160,43 @@ def test_dispatch_file_inputs(capsys, tmp_path):
     with pytest.raises(SystemExit, match="no input files"):
         cli.main(["mfsgd", "--input", str(tmp_path / "nope*.txt")])
 
+    # an empty shard among real ones is skipped, not a concat crash
+    (tmp_path / "pts_empty.csv").write_text("")
+    assert cli.main(["kmeans", "--input", str(tmp_path / "pts*.csv"),
+                     "--k", "2", "--iters", "1"]) == 0
+    assert "'n': 128" in capsys.readouterr().out
+
+    # rating files without a rating column are refused (a silent all-zero
+    # fit would look like success)
+    (tmp_path / "pairs.txt").write_text("0 1\n2 3\n")
+    with pytest.raises(SystemExit, match="no rating column"):
+        cli.main(["mfsgd", "--input", str(tmp_path / "pairs.txt")])
+
+    # negative ids are refused
+    (tmp_path / "neg.txt").write_text("-1 2 3.0\n0 1 1.0\n")
+    with pytest.raises(SystemExit, match="negative"):
+        cli.main(["mfsgd", "--input", str(tmp_path / "neg.txt")])
+
+    # ragged rows are refused (a short row would read as a fabricated 0.0)
+    (tmp_path / "ragged.txt").write_text("0 1 4.5\n2 3\n")
+    with pytest.raises(SystemExit, match="disagree on column count"):
+        cli.main(["mfsgd", "--input", str(tmp_path / "ragged.txt")])
+
+
+def test_lda_explicit_zero_counts_dropped(capsys, tmp_path):
+    """'doc word 0' means absent (dropped); bare pairs mean one token."""
+    import pytest
+
+    (tmp_path / "z.txt").write_text("0 1 2\n0 2 0\n1 0 1\n")
+    assert cli.main(["lda", "--input", str(tmp_path / "z.txt"),
+                     "--topics", "2", "--chunk", "8", "--epochs", "1"]) == 0
+    capsys.readouterr()
+
+    (tmp_path / "allz.txt").write_text("0 1 0\n1 2 0\n")
+    with pytest.raises(SystemExit, match="all token counts are zero"):
+        cli.main(["lda", "--input", str(tmp_path / "allz.txt"),
+                  "--topics", "2", "--chunk", "8", "--epochs", "1"])
+
 
 def test_triples_two_column_fallback_matches_native(tmp_path, monkeypatch):
     """Bare 'doc word' rows (no count) load identically on both paths."""

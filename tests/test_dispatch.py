@@ -1,0 +1,40 @@
+"""Direct tests for the shared capacity-bucket dispatch helper."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.dispatch import bucket_by_destination
+
+
+def test_bucketing_places_items_in_order():
+    dest = jnp.asarray([1, 0, 1, 1, 0])
+    vals = jnp.asarray([10.0, 20.0, 30.0, 40.0, 50.0])
+    (buf,), keep, slot, dropped = bucket_by_destination(dest, (vals,), 3, 2)
+    assert int(dropped) == 0
+    assert bool(keep.all())
+    np.testing.assert_allclose(np.asarray(buf[0]), [20.0, 50.0, 0.0])
+    np.testing.assert_allclose(np.asarray(buf[1]), [10.0, 30.0, 40.0])
+
+
+def test_bucketing_drops_over_capacity_via_trash_slot():
+    dest = jnp.zeros(5, jnp.int32)
+    vals = jnp.arange(1.0, 6.0)
+    (buf,), keep, slot, dropped = bucket_by_destination(dest, (vals,), 2, 2)
+    assert int(dropped) == 3
+    np.testing.assert_array_equal(np.asarray(keep), [True, True, False, False, False])
+    # the kept items survive intact; no trash-slot bleed into real slots
+    np.testing.assert_allclose(np.asarray(buf[0]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(buf[1]), [0.0, 0.0])
+    # dropped items all point at the (sliced-off) trash slot
+    np.testing.assert_array_equal(np.asarray(slot[2:]), [2, 2, 2])
+
+
+def test_bucketing_multi_payload_and_trailing_dims():
+    dest = jnp.asarray([0, 1])
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = jnp.asarray([7, 9], dtype=jnp.int32)
+    (ba, bb), keep, _, dropped = bucket_by_destination(dest, (a, b), 1, 2)
+    assert int(dropped) == 0
+    np.testing.assert_allclose(np.asarray(ba[0, 0]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(ba[1, 0]), [3.0, 4.0])
+    np.testing.assert_array_equal(np.asarray(bb[:, 0]), [7, 9])

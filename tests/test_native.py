@@ -97,6 +97,39 @@ LIBSVM_SAMPLE = """\
 """
 
 
+def test_comment_lines_match_fallback(native_lib, tmp_path, monkeypatch):
+    """'#' comments (numpy.loadtxt's default) are honored identically by
+    the native CSV and triples parsers — a comment header must not become
+    a phantom (0, 0, 0.0) row."""
+    import harp_tpu.native.datasource as ds
+
+    p = tmp_path / "c.txt"
+    p.write_text("# user item rating\n5 3 4.0\n  # indented comment\n"
+                 "1 2 0.5  # trailing\n\n")
+    native = load_triples(str(p))
+    np.testing.assert_array_equal(native[0], [5, 1])
+    np.testing.assert_allclose(native[2], [4.0, 0.5])
+    monkeypatch.setattr(ds, "load_native", lambda: None)
+    fallback = ds.load_triples(str(p))
+    for a, b in zip(native, fallback):
+        np.testing.assert_allclose(a, b)
+
+    p2 = tmp_path / "c.csv"
+    p2.write_text("# header\n1.0,2.0\n3.0,4.0 # note\n")
+    out = load_csv(str(p2))
+    np.testing.assert_allclose(out, [[1.0, 2.0], [3.0, 4.0]])
+
+
+def test_empty_shard_fallback_returns_empty(tmp_path, monkeypatch):
+    import harp_tpu.native.datasource as ds
+
+    p = tmp_path / "empty.txt"
+    p.write_text("")
+    monkeypatch.setattr(ds, "load_native", lambda: None)
+    u, i, v = ds.load_triples(str(p))
+    assert len(u) == len(i) == len(v) == 0
+
+
 def test_load_libsvm_native(native_lib, tmp_path):
     p = tmp_path / "d.svm"
     p.write_text(LIBSVM_SAMPLE)
