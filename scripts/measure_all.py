@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Run every graded-config benchmark and record JSONL — the L8 scripts layer.
+
+The reference wraps its canonical configs in shell scripts
+(SURVEY.md §2 L8: bin/, test_scripts/); this is the harp-tpu equivalent,
+and the protocol behind BASELINE.md's measured rows.
+
+Usage:  python scripts/measure_all.py [--out results.jsonl] [--smoke]
+        [--only kmeans mfsgd ...]
+
+--smoke shrinks every config for a fast correctness pass (CPU-safe);
+without it the full graded shapes run (real TPU recommended).  Each line
+of output is one JSON record with the config, metric, and environment.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_all(smoke: bool, only):
+    import jax
+
+    from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+
+    # (name, callable) — each returns the model module's benchmark dict
+    configs = {
+        "kmeans": lambda: kmeans.benchmark(
+            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        "mfsgd": lambda: mfsgd.benchmark(
+            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                "epochs": 2, "chunk": 1024} if smoke else {})),
+        "lda": lambda: lda.benchmark(
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "chunk": 256} if smoke
+               else {})),
+        "mlp": lambda: mlp.benchmark(
+            **({"n": 4096, "batch": 512, "steps": 5} if smoke else {})),
+        "subgraph": lambda: subgraph.benchmark(
+            **({"n_vertices": 2000, "avg_degree": 4} if smoke else {})),
+        "rf": lambda: rf.benchmark(
+            **({"n": 4096, "f": 16, "max_depth": 3,
+                "n_trees": 2 * jax.device_count()} if smoke else {})),
+    }
+    env = {
+        "date": datetime.date.today().isoformat(),
+        "backend": jax.default_backend(),
+        "n_devices": jax.device_count(),
+        "jax": jax.__version__,
+        "smoke": smoke,
+    }
+    for name, fn in configs.items():
+        if only and name not in only:
+            continue
+        try:
+            result = fn()
+        except Exception as e:  # keep measuring the rest
+            yield {"config": name, "error": f"{type(e).__name__}: {e}", **env}
+            continue
+        yield {"config": name,
+               **{k: (round(v, 4) if isinstance(v, float) else v)
+                  for k, v in result.items()}, **env}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None, help="append JSONL records here")
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
+                   choices=["kmeans", "mfsgd", "lda", "mlp", "subgraph", "rf"],
+                   help="subset of configs to run (typo → argparse error, "
+                        "not a silent empty sweep)")
+    args = p.parse_args(argv)
+
+    sink = open(args.out, "a") if args.out else None
+    try:
+        for rec in run_all(args.smoke, args.only):
+            line = json.dumps(rec)
+            print(line, flush=True)
+            if sink:
+                sink.write(line + "\n")
+                sink.flush()
+    finally:
+        if sink:
+            sink.close()
+
+
+if __name__ == "__main__":
+    main()

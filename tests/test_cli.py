@@ -214,6 +214,35 @@ def test_triples_two_column_fallback_matches_native(tmp_path, monkeypatch):
     np.testing.assert_array_equal(native[2], [0.0, 0.0])
 
 
+def test_measure_all_script_smoke(tmp_path):
+    """The L8 measurement script runs a subset and writes JSONL."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "res.jsonl"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = ""  # let the script's process pick CPU via conftest-style forcing
+    code = (
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS','') + "
+        "' --xla_force_host_platform_device_count=8'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms','cpu')\n"
+        f"import sys; sys.argv = ['m','--smoke','--only','kmeans','--out',{str(out)!r}]\n"
+        f"import runpy; runpy.run_path({os.path.join(root,'scripts','measure_all.py')!r},"
+        " run_name='__main__')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert recs and recs[0]["config"] == "kmeans"
+    assert "iters_per_sec" in recs[0] and "error" not in recs[0]
+
+
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
                    "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
