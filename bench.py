@@ -12,9 +12,7 @@ return early on this machine's relay transport.
 """
 
 import json
-import os
 import sys
-import threading
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
@@ -22,31 +20,11 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 BASELINE_KMEANS_ITERS_PER_SEC = 400.0
 
 
-def _arm_watchdog():
-    """Fail fast instead of hanging the driver forever.
-
-    The axon TPU relay has been observed to hang for hours at first
-    backend use (even ``jax.devices()`` blocks, uninterruptible from
-    Python).  A daemon timer hard-exits after HARP_BENCH_TIMEOUT seconds
-    (default 1200 — a healthy run takes well under 60) so the caller gets
-    a clean nonzero exit and a diagnostic instead of an indefinite hang.
-    """
-    timeout = float(os.environ.get("HARP_BENCH_TIMEOUT", "1200"))
-
-    def boom():
-        print(f"bench.py: no result after {timeout:.0f}s — TPU relay "
-              "likely hung (see CLAUDE.md 'Environment gotchas'); exiting",
-              file=sys.stderr, flush=True)
-        os._exit(3)
-
-    t = threading.Timer(timeout, boom)
-    t.daemon = True
-    t.start()
-    return t
-
-
 def main():
-    watchdog = _arm_watchdog()
+    from harp_tpu.utils.timing import HangWatchdog
+
+    watchdog = HangWatchdog()  # HARP_BENCH_TIMEOUT (default 1200 s)
+    watchdog.arm("bench.py kmeans")
     smoke = "--smoke" in sys.argv
     from harp_tpu.models import kmeans as KM
 

@@ -109,10 +109,40 @@ def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
     return jax.tree.map(lambda x: lax.all_gather(x, axis, tiled=tiled), tree)
 
 
+_UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _broadcast_float(x, root: int, axis: str):
+    """Bit-exact float broadcast: the payload rides the masked psum as a
+    same-width integer (XLA CPU runs with FTZ/DAZ, so a float sum would
+    flush subnormal payloads to zero — broadcast is data movement, not
+    arithmetic).  bitcast has no derivative, hence the custom VJP below,
+    which is the transpose of the plain masked-psum formulation."""
+    keep = lax.axis_index(axis) == root
+    bits = lax.bitcast_convert_type(x, _UINT_OF_WIDTH[jnp.dtype(x.dtype).itemsize])
+    out = lax.psum(jnp.where(keep, bits, jnp.zeros_like(bits)), axis)
+    return lax.bitcast_convert_type(out, x.dtype)
+
+
+def _broadcast_float_fwd(x, root, axis):
+    return _broadcast_float(x, root, axis), None
+
+
+def _broadcast_float_bwd(root, axis, _res, g):
+    keep = lax.axis_index(axis) == root
+    return (jnp.where(keep, lax.psum(g, axis), jnp.zeros_like(g)),)
+
+
+_broadcast_float.defvjp(_broadcast_float_fwd, _broadcast_float_bwd)
+
+
 def broadcast(tree: Any, root: int = 0, *, axis: str = WORKER_AXIS):
     """Every worker receives root's value — Harp chain/MST ``broadcast``."""
 
     def bcast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return _broadcast_float(x, root, axis)
         keep = lax.axis_index(axis) == root
         y = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
         # where (not multiply-by-mask): non-root buffers may hold NaN/inf

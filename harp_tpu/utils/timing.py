@@ -9,12 +9,75 @@ device→host readback of a scalar.
 
 from __future__ import annotations
 
+import os
+import sys
+import threading
 import time
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+class HangWatchdog:
+    """Hard-exit instead of hanging the caller forever.
+
+    The axon TPU relay on this machine can hang for hours at first backend
+    use (even ``jax.devices()`` blocks, uninterruptible from Python — see
+    CLAUDE.md "Environment gotchas"), so benchmark entry points arm a daemon
+    timer that ``os._exit``\\ s with a diagnostic after ``timeout_s``.
+    ``arm`` may be called repeatedly to restart the clock per phase/config;
+    ``on_fire(what)`` runs first so the caller can emit a structured record
+    naming the hung phase (stdout lines already flushed are preserved).
+    """
+
+    def __init__(self, timeout_s: float | None = None, *, exit_code: int = 3,
+                 on_fire: Callable[[str], None] | None = None,
+                 _exit: Callable[[int], None] = os._exit):
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("HARP_BENCH_TIMEOUT", "1200"))
+        self.timeout_s = timeout_s
+        self.exit_code = exit_code
+        self.on_fire = on_fire
+        self._exit = _exit
+        self._timer: threading.Timer | None = None
+        # Timer.cancel() can't stop a _fire already past the waiting stage;
+        # the generation check below keeps a just-cancelled timer from
+        # emitting a spurious hang record and killing a healthy process.
+        self._lock = threading.Lock()
+        self._gen = 0
+
+    def arm(self, what: str = "benchmark") -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._gen += 1
+            t = threading.Timer(self.timeout_s, self._fire, (what, self._gen))
+            t.daemon = True
+            self._timer = t
+        t.start()
+
+    def cancel(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._gen += 1
+
+    def _fire(self, what: str, gen: int) -> None:
+        with self._lock:
+            if gen != self._gen:
+                return  # cancelled or re-armed as we left the waiting stage
+        print(f"watchdog: {what} produced no result after "
+              f"{self.timeout_s:.0f}s — TPU relay likely hung (see CLAUDE.md "
+              "'Environment gotchas'); exiting", file=sys.stderr, flush=True)
+        if self.on_fire is not None:
+            try:
+                self.on_fire(what)
+            except Exception:
+                pass  # never let the diagnostic path mask the exit
+        self._exit(self.exit_code)
 
 
 def device_sync(x: Any) -> float:

@@ -22,7 +22,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_all(smoke: bool, only):
+def run_all(smoke: bool, only, watchdog=None):
     import jax
 
     from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
@@ -57,6 +57,8 @@ def run_all(smoke: bool, only):
     for name, fn in configs.items():
         if only and name not in only:
             continue
+        if watchdog is not None:
+            watchdog.arm(name)  # restart the hang clock per config
         try:
             result = fn()
         except Exception as e:  # keep measuring the rest
@@ -65,6 +67,8 @@ def run_all(smoke: bool, only):
         yield {"config": name,
                **{k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in result.items()}, **env}
+    if watchdog is not None:
+        watchdog.cancel()
 
 
 def main(argv=None):
@@ -78,14 +82,32 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     sink = open(args.out, "a") if args.out else None
+
+    def emit(rec):
+        line = json.dumps(rec)
+        print(line, flush=True)
+        if sink:
+            sink.write(line + "\n")
+            sink.flush()
+
+    # A relay hang is uninterruptible from Python (CLAUDE.md), so recovery
+    # within the process is impossible: the watchdog names the hung config
+    # in a final error record (prior records are already flushed) and exits.
+    from harp_tpu.utils.timing import HangWatchdog
+
+    watchdog = HangWatchdog(
+        on_fire=lambda what: emit(
+            {"config": what,
+             "error": f"hang: no result after {watchdog.timeout_s:.0f}s "
+                      "(TPU relay suspected)"}))
+    # Armed before run_all's `import jax`: the relay hang strikes at first
+    # backend use, which happens while building the env dict.
+    watchdog.arm("backend init")
     try:
-        for rec in run_all(args.smoke, args.only):
-            line = json.dumps(rec)
-            print(line, flush=True)
-            if sink:
-                sink.write(line + "\n")
-                sink.flush()
+        for rec in run_all(args.smoke, args.only, watchdog):
+            emit(rec)
     finally:
+        watchdog.cancel()
         if sink:
             sink.close()
 

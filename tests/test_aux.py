@@ -147,3 +147,49 @@ def test_parse_into_tuple_field():
     cfg = parse_into(Cfg, ["--sizes", "4,8"])
     assert cfg.sizes == (4, 8)
     assert parse_into(Cfg, []).sizes == (8, 16, 2)
+
+
+def test_hang_watchdog_fires_with_record_and_exit():
+    import time
+
+    from harp_tpu.utils.timing import HangWatchdog
+
+    fired, exits = [], []
+    wd = HangWatchdog(timeout_s=0.05, on_fire=fired.append,
+                      _exit=exits.append)
+    wd.arm("lda")
+    time.sleep(0.4)
+    assert fired == ["lda"] and exits == [3]
+
+
+def test_hang_watchdog_cancel_and_rearm():
+    import time
+
+    from harp_tpu.utils.timing import HangWatchdog
+
+    fired = []
+    wd = HangWatchdog(timeout_s=0.05, on_fire=fired.append, _exit=lambda c: None)
+    wd.arm("a")
+    wd.arm("b")   # re-arm replaces the pending timer
+    wd.cancel()   # cancel before expiry: nothing fires
+    time.sleep(0.2)
+    assert fired == []
+    wd.arm("c")
+    time.sleep(0.2)
+    assert fired == ["c"]
+
+
+def test_hang_watchdog_stale_fire_is_noop():
+    """A timer that left the waiting stage right as cancel()/arm() ran must
+    not emit a hang record for a config that actually finished."""
+    from harp_tpu.utils.timing import HangWatchdog
+
+    fired, exits = [], []
+    wd = HangWatchdog(timeout_s=60, on_fire=fired.append, _exit=exits.append)
+    wd.arm("a")
+    stale_gen = wd._gen
+    wd.cancel()               # config "a" finished in time
+    wd._fire("a", stale_gen)  # the race: _fire already dispatched
+    assert fired == [] and exits == []
+    wd._fire("a", wd._gen)    # current generation still fires
+    assert fired == ["a"] and exits == [3]

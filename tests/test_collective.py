@@ -193,6 +193,41 @@ def test_broadcast_ignores_nonroot_nan(mesh):
     np.testing.assert_array_equal(np.asarray(out), np.full((1, 2), 7.0))
 
 
+def test_broadcast_bit_exact_on_subnormals(mesh):
+    """Broadcast is data movement: subnormal payloads must survive bit-for-bit
+    even though XLA CPU runs with FTZ/DAZ (a float psum would flush them)."""
+    x = np.full((N, 2), 2.1e-43, np.float32)  # subnormal for f32
+    x[1:] = np.nan
+    out = run_spmd(mesh, lambda v: C.broadcast(v, root=0), x, out_dim=None)
+    assert (np.asarray(out).view(np.uint32) == x[0].view(np.uint32)).all()
+
+
+def test_broadcast_is_differentiable(mesh):
+    """Autodiff through broadcast (pipeline-parallel training relies on it):
+    the cotangent must flow back to the root shard, not vanish in a bitcast."""
+    x = np.arange(N, dtype=np.float32)[:, None] + 1.0
+
+    def loss(v):
+        return (C.broadcast(v, root=2) ** 2).sum()
+
+    g = run_spmd(mesh, jax.grad(loss), x, out_dim=0)
+    g = np.asarray(g).reshape(N, 1)
+    # d/dx_root sum_w (x_root^2) = 2*N*x_root on the root shard, 0 elsewhere
+    expect = np.zeros((N, 1), np.float32)
+    expect[2] = 2.0 * N * x[2]
+    np.testing.assert_allclose(g, expect, rtol=1e-6)
+
+
+def test_broadcast_float8_traces(mesh):
+    """1-byte floats ride the uint8 bitcast path (pytree-polymorphic contract)."""
+    x = np.arange(N, dtype=np.float32)[:, None]
+    out = run_spmd(
+        mesh,
+        lambda v: C.broadcast(v.astype(jnp.float8_e4m3fn), root=3).astype(jnp.float32),
+        x, out_dim=None)
+    np.testing.assert_array_equal(np.asarray(out), np.full((1, 1), 3.0))
+
+
 def test_reduce_inf_safe_on_nonroot(mesh):
     x = np.full((N, 2), np.inf, np.float32)
     out = run_spmd(mesh, lambda v: C.reduce(v, Combiner.MAX, root=0)[None], x, out_dim=0)
