@@ -16,10 +16,21 @@ TPU-native design:
   (``resident_slice_index``) — every rating is visited exactly once per
   epoch, just like Harp.
 - Hogwild async updates become deterministic *mini-batched* SGD
-  (SURVEY.md §8 hard parts): a ``lax.scan`` over fixed-size chunks;
-  within a chunk, gradients for duplicate users/items are summed via
-  segment-sum semantics of scatter-add.  Convergence is validated by loss
-  curve, not bitwise (the reference is nondeterministic anyway).
+  (SURVEY.md §8 hard parts).  Two formulations, selected by
+  ``MFSGDConfig.algo``:
+
+  * ``"dense"`` (default): each block re-tiles into (u_tile × i_tile)
+    sub-tiles; row gathers AND duplicate-summing scatters are one-hot
+    matmuls over ``dynamic_slice``\\ d W/H tiles — four MXU dots per entry,
+    no XLA scatter anywhere.  TPU scatter of rank-64 rows moves ~25 GB/s;
+    the same permutations as matmuls measured 84–102M updates/s/chip vs
+    26.3M (ML-20M config, 1× v5e, 2026-07-30).
+  * ``"scatter"``: direct ``lax.scan`` over fixed-size chunks with
+    gather / scatter-add — the readable reference implementation, and the
+    exact-equivalence target for the numpy golden tests.
+
+  Convergence is validated by loss curve, not bitwise (the reference is
+  nondeterministic anyway).
 - The timer-bound lockstep is free: SPMD workers advance together.
 """
 
@@ -27,6 +38,8 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import partial
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -44,11 +57,37 @@ class MFSGDConfig:
     rank: int = 64
     lr: float = 0.01
     reg: float = 0.05  # λ, applied to touched rows only (as SGD does)
-    # minibatch size inside a block; 32768 measured best on 1× v5e
-    # (26.3M vs 14.4M ups/chip at 8192, identical RMSE — see benchmark()).
-    # Small datasets are safe: blocks narrower than this clamp themselves
+    # Update algorithm.  "dense" (default) re-tiles each rating block into
+    # (u_tile × i_tile) sub-tiles and runs every gather/scatter as a one-hot
+    # MXU matmul over dynamic-sliced W/H tiles — no XLA scatter anywhere.
+    # "scatter" is the direct gather/scatter-add formulation, kept as the
+    # readable reference and for exact-equivalence tests.  Measured on the
+    # ML-20M graded config (rank 64, 1× v5e, 2026-07-30): dense 84–102M
+    # updates/s/chip vs scatter 26.3M — TPU scatter of 256 B rows runs at
+    # ~25 GB/s while the same permutation as matmuls rides the MXU.
+    algo: str = "dense"
+    # dense tiling: 512×512 measured best on v5e (84–102M ups vs 60–80M at
+    # 1024/2048 tiles — one-hot traffic grows with tile width and dominates
+    # before scan-step overhead does)
+    u_tile: int = 512
+    i_tile: int = 512
+    # max ratings per dense entry; overfull tiles split into several entries
+    # (keeps padding bounded under power-law item skew)
+    entry_cap: int = 2048
+    # dense matmul operand dtype: bf16 is MXU-native (gather/scatter one-hots
+    # are exact 0/1 either way; W/H operands round to bf16 — noise well under
+    # SGD's own stochasticity, validated by the convergence tests).  Golden
+    # tests pin float32 to match numpy bit-for-bit on CPU.
+    compute_dtype: Any = jnp.bfloat16
+    # scatter algo: minibatch size inside a block; 32768 measured best on
+    # 1× v5e (26.3M vs 14.4M ups/chip at 8192, identical RMSE).  Small
+    # datasets are safe: blocks narrower than this clamp themselves
     # (partition_ratings pads only to the real max block size).
     chunk: int = 32768
+
+    def __post_init__(self):
+        if self.algo not in ("dense", "scatter"):
+            raise ValueError(f"algo must be 'dense' or 'scatter', got {self.algo!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -122,6 +161,98 @@ def partition_ratings(users, items, vals, n_users, n_items, n_workers, chunk,
     )
 
 
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _dense_bounds(n_users, n_items, n_workers, n_slices, u_tile, i_tile):
+    """Bounds for the dense algo, shared by partitioner and driver.
+
+    Ownership (``u_own``/``i_own``) stays UNROUNDED — the same balanced
+    ``id // ceil(size/N)`` placement Harp's partitioner and the scatter
+    algo use; rounding ownership to tile multiples would dump every row
+    on worker 0 whenever ``ceil(size/N) < tile``.  Storage per worker
+    (``u_bound``/``ib2``) rounds up to tile multiples so dynamic slices
+    are always full-size; the pad rows own no ids and stay untrained.
+    """
+    u_own = _ceil_div(n_users, n_workers)
+    i_own = _ceil_div(n_items, n_slices)
+    u_bound = u_tile * _ceil_div(u_own, u_tile)
+    ib2 = i_tile * _ceil_div(i_own, i_tile)
+    return u_own, i_own, u_bound, ib2
+
+
+def partition_ratings_tiles(users, items, vals, n_users, n_items, n_workers,
+                            u_tile, i_tile, entry_cap, n_slices=None):
+    """Partition triples into dense (u_tile × i_tile) sub-tiles per
+    (worker, half-slice) block — the layout the "dense" algo consumes.
+
+    Each *entry* is up to ``entry_cap`` ratings of one sub-tile (overfull
+    tiles split into several entries, so power-law item skew cannot blow up
+    the padding).  Returns worker-major stacked arrays
+
+    ``eu/ei/ev [n*ns, NE, C]`` — ids local to their tile (pad id = tile
+    width, which one-hot maps to an all-zero row), values;
+    ``ou/oi [n*ns, NE]`` — tile row offsets into the worker's W range /
+    the resident half-slice;
+    plus ``(u_own, i_own, u_bound, ib2)`` from :func:`_dense_bounds`
+    (balanced ownership sizes + tile-rounded storage sizes).
+    """
+    users = np.asarray(users)
+    items = np.asarray(items)
+    vals = np.asarray(vals, dtype=np.float32)
+    n = n_workers
+    ns = n_slices if n_slices is not None else 2 * n
+    u_own, i_own, u_bound, ib2 = _dense_bounds(
+        n_users, n_items, n, ns, u_tile, i_tile)
+
+    wid = users // u_own
+    sid = items // i_own
+    lu = users - wid * u_own
+    li = items - sid * i_own
+    tu = lu // u_tile
+    ti = li // i_tile
+    ntu, nti = u_bound // u_tile, ib2 // i_tile
+
+    # global tile id, sorted so each (worker, slice) lists tiles u-major
+    gtile = ((wid * ns + sid) * ntu + tu) * nti + ti
+    order = np.argsort(gtile, kind="stable")
+    lu, li, vals, gtile = lu[order], li[order], vals[order], gtile[order]
+
+    n_tiles = n * ns * ntu * nti
+    counts = np.bincount(gtile, minlength=n_tiles)
+    C = int(min(entry_cap, max(8, 8 * _ceil_div(int(counts.max(initial=0)), 8))))
+    ent_per_tile = _ceil_div(counts, C)  # elementwise ceil; 0 for empty tiles
+    ws_of_tile = np.arange(n_tiles) // (ntu * nti)
+    NE = max(1, int(np.bincount(ws_of_tile, weights=ent_per_tile,
+                                minlength=n * ns).max()))
+
+    eu = np.full((n * ns, NE, C), u_tile, np.int32)
+    ei = np.full((n * ns, NE, C), i_tile, np.int32)
+    ev = np.zeros((n * ns, NE, C), np.float32)
+    ou = np.zeros((n * ns, NE), np.int32)
+    oi = np.zeros((n * ns, NE), np.int32)
+    starts = np.zeros(n_tiles, np.int64)
+    starts[1:] = counts.cumsum()[:-1]
+    e_next = np.zeros(n * ns, np.int64)
+    for t in np.nonzero(counts)[0]:
+        ws = t // (ntu * nti)
+        t_u = (t // nti) % ntu
+        t_i = t % nti
+        lo, cnt = int(starts[t]), int(counts[t])
+        for off in range(0, cnt, C):
+            e = int(e_next[ws])
+            e_next[ws] = e + 1
+            c = min(C, cnt - off)
+            sl = slice(lo + off, lo + off + c)
+            eu[ws, e, :c] = lu[sl] - t_u * u_tile
+            ei[ws, e, :c] = li[sl] - t_i * i_tile
+            ev[ws, e, :c] = vals[sl]
+            ou[ws, e] = t_u * u_tile
+            oi[ws, e] = t_i * i_tile
+    return eu, ei, ev, ou, oi, u_own, i_own, u_bound, ib2
+
+
 # ---------------------------------------------------------------------------
 # Device compute.
 # ---------------------------------------------------------------------------
@@ -167,8 +298,50 @@ def _block_update(W, H, block, cfg: MFSGDConfig):
     return W, H, se, cnt
 
 
-def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
-    """Compile one full rotation epoch (every rating visited exactly once).
+def _tile_block_update(W, H, block, cfg: MFSGDConfig):
+    """Scan dense-tile entries of one (user-range × item-half-slice) block.
+
+    Per entry (≤ entry_cap ratings, all inside one u_tile × i_tile sub-tile):
+    gather W/H tile rows by ``dynamic_slice``, run BOTH the row gather and
+    the duplicate-summing scatter as one-hot matmuls — four MXU dots, zero
+    XLA scatters.  Pad ids equal the tile width, so their one-hot rows are
+    all-zero and they drop out of every product.
+    """
+    eu, ei, ev, ou, oi = block
+    UR, IR = cfg.u_tile, cfg.i_tile
+    cd = cfg.compute_dtype
+    dot = partial(lax.dot_general, preferred_element_type=jnp.float32)
+
+    def body(carry, xs):
+        W, H, se, cnt = carry
+        cu, ci, cv, tou, toi = xs
+        Wb = lax.dynamic_slice_in_dim(W, tou, UR, 0)
+        Hb = lax.dynamic_slice_in_dim(H, toi, IR, 0)
+        ohu = jax.nn.one_hot(cu, UR, dtype=cd)          # [C, UR]
+        ohi = jax.nn.one_hot(ci, IR, dtype=cd)          # [C, IR]
+        wu = dot(ohu, Wb.astype(cd), (((1,), (0,)), ((), ())))  # gather
+        hi = dot(ohi, Hb.astype(cd), (((1,), (0,)), ((), ())))
+        cm = (cu < UR).astype(jnp.float32)
+        err = cm * (cv - (wu * hi).sum(-1))
+        gw = (err[:, None] * hi - cfg.reg * cm[:, None] * wu).astype(cd)
+        gh = (err[:, None] * wu - cfg.reg * cm[:, None] * hi).astype(cd)
+        gW = dot(ohu, gw, (((0,), (0,)), ((), ())))     # scatter-add
+        gH = dot(ohi, gh, (((0,), (0,)), ((), ())))
+        W = lax.dynamic_update_slice_in_dim(W, Wb + cfg.lr * gW, tou, 0)
+        H = lax.dynamic_update_slice_in_dim(H, Hb + cfg.lr * gH, toi, 0)
+        return (W, H, se + (err * err).sum(), cnt + cm.sum()), None
+
+    (W, H, se, cnt), _ = lax.scan(
+        body, (W, H, jnp.float32(0.0), jnp.float32(0.0)), (eu, ei, ev, ou, oi)
+    )
+    return W, H, se, cnt
+
+
+_UPDATERS = {"dense": _tile_block_update, "scatter": _block_update}
+
+
+def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
+    """Build the device-view epoch callable (every rating visited once).
 
     This is the dymoro pipeline done the XLA way (SURVEY.md §4.3): each
     worker's H slice is **split into two halves** that alternate roles —
@@ -186,10 +359,11 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
     back home and every (worker, half) pair has met exactly once.
     """
     two_n = 2 * mesh.num_workers
+    update = _UPDATERS[cfg.algo]
 
-    def epoch(W, H_slice, bu, bi, bv, bm):
-        # bu… arrive as this worker's [2n_half_slices, B] block row; the
-        # resident H rows split into an even (front) and odd (back) half.
+    def epoch(W, H_slice, *blocks):
+        # block arrays arrive as this worker's [2n_half_slices, ...] row;
+        # the resident H rows split into an even (front) and odd (back) half.
         ib2 = H_slice.shape[0] // 2
         computing, inflight = H_slice[:ib2], H_slice[ib2:]
 
@@ -201,10 +375,8 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
                 2 * ((worker_id() - t // 2) % num_workers()),
                 2 * ((worker_id() - t // 2 - 1) % num_workers()) + 1,
             )
-            block = jax.tree.map(
-                lambda a: a[half_idx], (bu, bi, bv, bm)
-            )
-            W, computing, dse, dcnt = _block_update(W, computing, block, cfg)
+            block = jax.tree.map(lambda a: a[half_idx], blocks)
+            W, computing, dse, dcnt = update(W, computing, block, cfg)
             return (W, received, computing, se + dse, cnt + dcnt), None
 
         (W, computing, inflight, se, cnt), _ = lax.scan(
@@ -220,10 +392,49 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
         se, cnt = C.allreduce((se, cnt))
         return W, H_slice, se, cnt
 
+    return epoch
+
+
+def _n_block_args(cfg: MFSGDConfig) -> int:
+    return 5 if cfg.algo == "dense" else 4
+
+
+def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
+    """Compile one full rotation epoch — see :func:`_epoch_device_fn`."""
     return jax.jit(
         mesh.shard_map(
-            epoch,
-            in_specs=(mesh.spec(0),) * 6,
+            _epoch_device_fn(mesh, cfg),
+            in_specs=(mesh.spec(0),) * (2 + _n_block_args(cfg)),
+            out_specs=(mesh.spec(0), mesh.spec(0), P(), P()),
+        )
+    )
+
+
+def make_multi_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig, epochs: int):
+    """Compile ``epochs`` rotation epochs as ONE device program.
+
+    A single dispatch instead of one per epoch: host→device dispatch on a
+    relay-attached chip costs ~150 ms/call (measured 2026-07-30, v5e),
+    which at 186 ms of device time per ML-20M epoch nearly halves the
+    apparent throughput of per-epoch calls.  Returns per-epoch
+    ``(se[epochs], cnt[epochs])`` alongside the final W/H.
+    """
+    inner = _epoch_device_fn(mesh, cfg)
+
+    def many(W, H_slice, *blocks):
+        def body(carry, _):
+            W, H = carry
+            W, H, se, cnt = inner(W, H, *blocks)
+            return (W, H), (se, cnt)
+
+        (W, H_slice), (ses, cnts) = lax.scan(
+            body, (W, H_slice), None, length=epochs)
+        return W, H_slice, ses, cnts
+
+    return jax.jit(
+        mesh.shard_map(
+            many,
+            in_specs=(mesh.spec(0),) * (2 + _n_block_args(cfg)),
             out_specs=(mesh.spec(0), mesh.spec(0), P(), P()),
         )
     )
@@ -238,9 +449,15 @@ class MFSGD:
         self.cfg = cfg or MFSGDConfig()
         self.n_users, self.n_items = n_users, n_items
         n = self.mesh.num_workers
-        self.u_bound = -(-n_users // n)
-        # two half-slices per worker (pipelined rotation) → per-worker H rows
-        self.i_bound = 2 * (-(-n_items // (2 * n)))
+        if self.cfg.algo == "dense":
+            self.u_own, self.i_own, self.u_bound, ib2 = _dense_bounds(
+                n_users, n_items, n, 2 * n, self.cfg.u_tile, self.cfg.i_tile)
+            self.i_bound = 2 * ib2
+        else:
+            self.u_bound = self.u_own = _ceil_div(n_users, n)
+            # two half-slices per worker (pipelined rotation) → per-worker rows
+            self.i_bound = 2 * _ceil_div(n_items, 2 * n)
+            self.i_own = self.i_bound // 2
         k1, k2 = jax.random.split(jax.random.key(seed))
         scale = 1.0 / np.sqrt(self.cfg.rank)
         self.W = self.mesh.shard_array(
@@ -250,15 +467,27 @@ class MFSGD:
             np.asarray(jax.random.uniform(k2, (self.i_bound * n, self.cfg.rank),
                                           jnp.float32, 0, scale)), 0)
         self._epoch_fn = make_epoch_fn(self.mesh, self.cfg)
+        self._multi_fns: dict[int, Any] = {}
         self._blocks = None
 
     def set_ratings(self, users, items, vals):
         n = self.mesh.num_workers
-        bu, bi, bv, bm, ub, ib2 = partition_ratings(
-            users, items, vals, self.n_users, self.n_items, n, self.cfg.chunk
-        )
+        if self.cfg.algo == "dense":
+            eu, ei, ev, ou, oi, uo, io, ub, ib2 = partition_ratings_tiles(
+                users, items, vals, self.n_users, self.n_items, n,
+                self.cfg.u_tile, self.cfg.i_tile, self.cfg.entry_cap,
+            )
+            assert (uo, io) == (self.u_own, self.i_own)
+            blocks = (eu, ei, ev, ou, oi)
+        else:
+            bu, bi, bv, bm, ub, ib2 = partition_ratings(
+                users, items, vals, self.n_users, self.n_items, n,
+                self.cfg.chunk,
+            )
+            blocks = (bu, bi, bv, bm)
         assert (ub, 2 * ib2) == (self.u_bound, self.i_bound)
-        self._blocks = tuple(self.mesh.shard_array(a, 0) for a in (bu, bi, bv, bm))
+        self._blocks = tuple(self.mesh.shard_array(a, 0) for a in blocks)
+        self._multi_fns.clear()  # compiled executables bind to block shapes
         self.nnz = len(np.asarray(vals))
 
     def train_epoch(self):
@@ -267,6 +496,36 @@ class MFSGD:
             raise RuntimeError("call set_ratings() before train_epoch()")
         self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H, *self._blocks)
         return float(np.sqrt(max(device_sync(se), 0.0) / max(device_sync(cnt), 1.0)))
+
+    def compile_epochs(self, epochs: int):
+        """AOT-compile the ``epochs``-epoch program WITHOUT running it.
+
+        ``.lower().compile()`` is side-effect-free — benchmark warmup must
+        not secretly train extra epochs, or the reported RMSE describes a
+        different model than the epoch count claims.  The compiled
+        executable is cached and reused by :meth:`train_epochs`.
+        """
+        if self._blocks is None:
+            raise RuntimeError("call set_ratings() before compile_epochs()")
+        fn = self._multi_fns.get(epochs)
+        if fn is None:
+            jitted = make_multi_epoch_fn(self.mesh, self.cfg, epochs)
+            fn = self._multi_fns[epochs] = jitted.lower(
+                self.W, self.H, *self._blocks).compile()
+        return fn
+
+    def train_epochs(self, epochs: int):
+        """Run ``epochs`` epochs as one device program; returns per-epoch RMSEs.
+
+        One host→device dispatch instead of ``epochs`` (~150 ms/call saved
+        on the relay-attached v5e — see :func:`make_multi_epoch_fn`).  Use
+        ``fit()`` instead when checkpointing between epochs.
+        """
+        fn = self.compile_epochs(epochs)
+        self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
+        ses, cnts = np.asarray(ses), np.asarray(cnts)
+        return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
+                for s, c in zip(ses, cnts)]
 
     def fit(self, epochs: int, ckpt_dir: str | None = None, *,
             ckpt_every: int = 5, max_restarts: int = 3, fault=None):
@@ -283,6 +542,16 @@ class MFSGD:
         rmses: list[float] = []
 
         def set_state(state):
+            # np.shape only — np.asarray would drag the full factors over
+            # the device→host link every epoch just to compare shapes
+            w, h = tuple(np.shape(state["W"])), tuple(np.shape(state["H"]))
+            if w != tuple(self.W.shape) or h != tuple(self.H.shape):
+                raise ValueError(
+                    f"checkpoint shapes W{w}/H{h} do not match this model's "
+                    f"W{tuple(self.W.shape)}/H{tuple(self.H.shape)} — was the "
+                    "checkpoint written with a different algo/tile config? "
+                    "(dynamic slices would clamp and silently train wrong "
+                    "rows; refusing to resume)")
             if not isinstance(state["W"], jax.Array):  # numpy from restore
                 self.W = self.mesh.shard_array(np.asarray(state["W"]), 0)
                 self.H = self.mesh.shard_array(np.asarray(state["H"]), 0)
@@ -299,7 +568,22 @@ class MFSGD:
         return rmses
 
     def factors(self):
-        return np.asarray(self.W)[: self.n_users], np.asarray(self.H)[: self.n_items]
+        """Global (W, H) with storage padding stripped.
+
+        Dense storage pads each worker's W range (and each half-slice's H
+        range) to a tile multiple; user ``g`` lives at row
+        ``(g // u_own) * u_bound + g % u_own``, so the pad rows must be cut
+        per range, not just at the tail.
+        """
+        n = self.mesh.num_workers
+        W = np.asarray(self.W)
+        H = np.asarray(self.H)
+        if self.cfg.algo == "dense":
+            r = W.shape[-1]
+            W = W.reshape(n, self.u_bound, r)[:, : self.u_own].reshape(-1, r)
+            ib2 = self.i_bound // 2
+            H = H.reshape(2 * n, ib2, r)[:, : self.i_own].reshape(-1, r)
+        return W[: self.n_users], H[: self.n_items]
 
     def predict_rmse(self, users, items, vals):
         W, H = self.factors()
@@ -322,38 +606,54 @@ def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
     return u.astype(np.int32), i.astype(np.int32), v.astype(np.float32)
 
 
-def _make_config(rank: int, chunk: int | None) -> MFSGDConfig:
-    """chunk=None inherits MFSGDConfig's tuned default."""
-    return MFSGDConfig(rank=rank) if chunk is None else \
-        MFSGDConfig(rank=rank, chunk=chunk)
+def _make_config(rank: int, chunk: int | None, algo: str = "dense",
+                 u_tile: int | None = None, i_tile: int | None = None,
+                 entry_cap: int | None = None) -> MFSGDConfig:
+    """None inherits MFSGDConfig's tuned defaults.  Algo-specific knobs
+    raise when combined with the other algo — a silently-ignored tuning
+    flag wastes benchmark sweeps."""
+    kw: dict[str, Any] = {"rank": rank, "algo": algo}
+    if chunk is not None:
+        if algo == "dense":
+            raise ValueError("chunk is scatter-only; pass algo='scatter' or "
+                             "tune u_tile/i_tile/entry_cap for dense")
+        kw["chunk"] = chunk
+    for name, val in (("u_tile", u_tile), ("i_tile", i_tile),
+                      ("entry_cap", entry_cap)):
+        if val is not None:
+            if algo != "dense":
+                raise ValueError(f"{name} is dense-only (algo={algo!r})")
+            kw[name] = val
+    return MFSGDConfig(**kw)
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
-              epochs=3, mesh=None, seed=0, chunk=None):
+              epochs=3, mesh=None, seed=0, chunk=None, algo="dense",
+              u_tile=None, i_tile=None, entry_cap=None):
     """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
 
     One 'update' = one rating visit (one (w_u, h_i) SGD update pair),
     matching Harp-DAAL's MF-SGD throughput accounting.
 
-    chunk=None inherits MFSGDConfig's tuned default (32768, measured on
-    1× v5e 2026-07-29: 26.3M ups/chip vs 14.4M at 8192 — scatter dispatch
-    amortizes; RMSE identical to 4 decimal places).  65536 is within noise
-    of 32768; 131072 hit an XLA scatter compile/runtime pathology (>9 min,
-    killed) — do not default past 64k.
+    Measured on this config (1× v5e): algo="dense" (default) — see the
+    MFSGDConfig.algo comment and BASELINE.md for the dense-vs-scatter
+    numbers.  For algo="scatter", chunk=None inherits the tuned 32768
+    (2026-07-29: 26.3M ups/chip vs 14.4M at 8192; 65536 within noise;
+    131072 hit an XLA scatter compile pathology (>9 min, killed) — do not
+    default past 64k).
     """
     mesh = mesh or current_mesh()
-    cfg = _make_config(rank, chunk)
+    cfg = _make_config(rank, chunk, algo, u_tile, i_tile, entry_cap)
     model = MFSGD(n_users, n_items, cfg, mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     t0 = time.perf_counter()
     model.set_ratings(u, i, v)
     prep = time.perf_counter() - t0
 
-    rmse0 = model.train_epoch()  # warmup (includes compile)
+    rmse0 = model.train_epoch()    # warmup (includes single-epoch compile)
+    model.compile_epochs(epochs)   # AOT, off-clock, does NOT train
     t0 = time.perf_counter()
-    rmse = 0.0
-    for _ in range(epochs):
-        rmse = model.train_epoch()
+    rmse = model.train_epochs(epochs)[-1]
     dt = time.perf_counter() - t0
     ups = nnz * epochs / dt / mesh.num_workers
     return {
@@ -363,6 +663,7 @@ def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
         "rmse_final": rmse,
         "prep_sec": prep,
         "nnz": nnz, "rank": rank, "num_workers": mesh.num_workers,
+        "algo": algo,
     }
 
 
@@ -379,8 +680,19 @@ def main(argv=None):
     p.add_argument("--nnz", type=int, default=20_000_000)
     p.add_argument("--rank", type=int, default=64)
     p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--algo", choices=["dense", "scatter"], default="dense",
+                   help="dense: one-hot MXU tiles (fastest, default); "
+                        "scatter: direct gather/scatter-add reference")
     p.add_argument("--chunk", type=int, default=None,
-                   help="minibatch size (default: MFSGDConfig's tuned value)")
+                   help="scatter-only: minibatch size (default: tuned 32768); "
+                        "errors under --algo dense instead of silently "
+                        "doing nothing")
+    p.add_argument("--u-tile", type=int, default=None,
+                   help="dense-only: W tile rows (default 512)")
+    p.add_argument("--i-tile", type=int, default=None,
+                   help="dense-only: H tile rows (default 512)")
+    p.add_argument("--entry-cap", type=int, default=None,
+                   help="dense-only: max ratings per tile entry (default 2048)")
     p.add_argument("--ckpt-dir", default=None,
                    help="train with checkpoint/resume instead of benchmarking; "
                         "rerunning with the same dir resumes from the latest "
@@ -416,7 +728,9 @@ def main(argv=None):
             n_users = args.users or 138_493
             n_items = args.items or 26_744
             u, i, v = synthetic_ratings(n_users, n_items, args.nnz)
-        model = MFSGD(n_users, n_items, _make_config(args.rank, args.chunk))
+        model = MFSGD(n_users, n_items,
+                      _make_config(args.rank, args.chunk, args.algo,
+                                   args.u_tile, args.i_tile, args.entry_cap))
         model.set_ratings(u, i, v)
         rmses = model.fit(args.epochs, args.ckpt_dir,
                           ckpt_every=args.ckpt_every)
@@ -426,7 +740,9 @@ def main(argv=None):
                "ckpt_dir": args.ckpt_dir})
     else:
         print(benchmark(args.users or 138_493, args.items or 26_744,
-                        args.nnz, args.rank, args.epochs, chunk=args.chunk))
+                        args.nnz, args.rank, args.epochs, chunk=args.chunk,
+                        algo=args.algo, u_tile=args.u_tile,
+                        i_tile=args.i_tile, entry_cap=args.entry_cap))
 
 
 if __name__ == "__main__":

@@ -112,29 +112,28 @@ def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
 _UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@partial(jax.custom_jvp, nondiff_argnums=(1, 2))
 def _broadcast_float(x, root: int, axis: str):
     """Bit-exact float broadcast: the payload rides the masked psum as a
     same-width integer (XLA CPU runs with FTZ/DAZ, so a float sum would
     flush subnormal payloads to zero — broadcast is data movement, not
-    arithmetic).  bitcast has no derivative, hence the custom VJP below,
-    which is the transpose of the plain masked-psum formulation."""
+    arithmetic).  bitcast has no derivative, hence the custom JVP below:
+    broadcast is linear, so the tangent is the plain float masked-psum
+    broadcast of the tangent — and because that formulation is
+    transposable, reverse-mode (grad) falls out of it too, unlike a
+    custom_vjp which would reject jvp/jacfwd/hessian."""
     keep = lax.axis_index(axis) == root
     bits = lax.bitcast_convert_type(x, _UINT_OF_WIDTH[jnp.dtype(x.dtype).itemsize])
     out = lax.psum(jnp.where(keep, bits, jnp.zeros_like(bits)), axis)
     return lax.bitcast_convert_type(out, x.dtype)
 
 
-def _broadcast_float_fwd(x, root, axis):
-    return _broadcast_float(x, root, axis), None
-
-
-def _broadcast_float_bwd(root, axis, _res, g):
+@_broadcast_float.defjvp
+def _broadcast_float_jvp(root, axis, primals, tangents):
+    (x,), (xd,) = primals, tangents
     keep = lax.axis_index(axis) == root
-    return (jnp.where(keep, lax.psum(g, axis), jnp.zeros_like(g)),)
-
-
-_broadcast_float.defvjp(_broadcast_float_fwd, _broadcast_float_bwd)
+    tangent = lax.psum(jnp.where(keep, xd, jnp.zeros_like(xd)), axis)
+    return _broadcast_float(x, root, axis), tangent
 
 
 def broadcast(tree: Any, root: int = 0, *, axis: str = WORKER_AXIS):

@@ -34,6 +34,11 @@ def run_all(smoke: bool, only, watchdog=None):
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
+               if smoke else {})),
+        "mfsgd_scatter": lambda: mfsgd.benchmark(
+            algo="scatter",
+            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "chunk": 1024} if smoke else {})),
         "lda": lambda: lda.benchmark(
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -76,7 +81,8 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "mfsgd", "lda", "mlp", "subgraph", "rf"],
+                   choices=["kmeans", "mfsgd", "mfsgd_scatter", "lda", "mlp",
+                            "subgraph", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     args = p.parse_args(argv)

@@ -139,7 +139,8 @@ def test_dispatch_file_inputs(capsys, tmp_path):
              for _ in range(300)]
     (tmp_path / "r.txt").write_text("\n".join(lines) + "\n")
     assert cli.main(["mfsgd", "--input", str(tmp_path / "r.txt"),
-                     "--rank", "4", "--epochs", "2", "--chunk", "64"]) == 0
+                     "--rank", "4", "--epochs", "2",
+                     "--u-tile", "8", "--i-tile", "8"]) == 0
     out = capsys.readouterr().out
     assert "'nnz': 300" in out and "rmse_final" in out
 

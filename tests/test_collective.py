@@ -218,6 +218,22 @@ def test_broadcast_is_differentiable(mesh):
     np.testing.assert_allclose(g, expect, rtol=1e-6)
 
 
+def test_broadcast_supports_forward_mode(mesh):
+    """jvp/jacfwd must work through broadcast too (custom_jvp, not
+    custom_vjp — the latter rejects forward-mode)."""
+    x = np.arange(N, dtype=np.float32)[:, None] + 1.0
+
+    def f(v):
+        return C.broadcast(v, root=1) * 2.0
+
+    def jvp_fn(v):
+        _, tang = jax.jvp(f, (v,), (jnp.ones_like(v),))
+        return tang
+
+    t = run_spmd(mesh, jvp_fn, x, out_dim=None)
+    np.testing.assert_allclose(np.asarray(t), np.full((1, 1), 2.0), rtol=1e-6)
+
+
 def test_broadcast_float8_traces(mesh):
     """1-byte floats ride the uint8 bitcast path (pytree-polymorphic contract)."""
     x = np.arange(N, dtype=np.float32)[:, None]

@@ -48,8 +48,10 @@ def test_recovery_gives_up(tmp_path):
         _driver(tmp_path, fail_at=(5, 6, 7, 8), max_restarts=2)
 
 
-def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path):
-    """The MF-SGD driver survives an injected crash and a process 'restart'."""
+@pytest.mark.parametrize("algo", ["dense", "scatter"])
+def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path, algo):
+    """The MF-SGD driver survives an injected crash and a process 'restart'
+    — for BOTH update algos (recovery interacts with each epoch fn)."""
     from harp_tpu.models import mfsgd as MF
 
     rng = np.random.default_rng(0)
@@ -59,7 +61,9 @@ def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path):
     v = rng.normal(size=nnz).astype(np.float32)
 
     def make_model():
-        m = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, chunk=64), mesh=mesh)
+        m = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, algo=algo, chunk=64,
+                                            u_tile=8, i_tile=8, entry_cap=32),
+                     mesh=mesh)
         m.set_ratings(u, i, v)
         return m
 

@@ -64,7 +64,7 @@ def test_partition_ratings_small_data_does_not_pad_to_chunk(mesh):
     assert B % min(100, B) == 0
 
     # and training still works at the clamped width (single sub-chunk scan)
-    model = MF.MFSGD(64, 48, MF.MFSGDConfig(rank=4), mesh=mesh)
+    model = MF.MFSGD(64, 48, MF.MFSGDConfig(rank=4, algo="scatter"), mesh=mesh)
     model.set_ratings(u, i, v)
     r0 = model.train_epoch()
     for _ in range(3):
@@ -104,7 +104,7 @@ def test_epoch_matches_numpy_model(mesh):
     i = rng.integers(0, n_items, nnz).astype(np.int32)
     v = rng.normal(size=nnz).astype(np.float32)
 
-    cfg = MF.MFSGDConfig(rank=rank, chunk=chunk, lr=0.02, reg=0.01)
+    cfg = MF.MFSGDConfig(rank=rank, chunk=chunk, lr=0.02, reg=0.01, algo="scatter")
     model = MF.MFSGD(n_users, n_items, cfg, mesh, seed=3)
     W0 = np.asarray(model.W).copy()
     H0 = np.asarray(model.H).copy()
@@ -123,7 +123,7 @@ def test_epoch_matches_numpy_model(mesh):
 def test_convergence_on_low_rank(mesh):
     n_users, n_items, nnz = 256, 192, 20_000
     u, i, v = MF.synthetic_ratings(n_users, n_items, nnz, rank=4, noise=0.01, seed=0)
-    cfg = MF.MFSGDConfig(rank=8, chunk=512, lr=0.05, reg=0.002)
+    cfg = MF.MFSGDConfig(rank=8, chunk=512, lr=0.05, reg=0.002, algo="scatter")
     model = MF.MFSGD(n_users, n_items, cfg, mesh, seed=0)
     model.set_ratings(u, i, v)
     first = model.train_epoch()
@@ -139,7 +139,7 @@ def test_second_epoch_slices_home(mesh):
     """H slices must be back home after each epoch (factors() correctness):
     running two epochs must keep improving, which fails if slices misalign."""
     u, i, v = MF.synthetic_ratings(128, 96, 6_000, rank=4, noise=0.0, seed=2)
-    cfg = MF.MFSGDConfig(rank=8, chunk=256, lr=0.05, reg=0.0)
+    cfg = MF.MFSGDConfig(rank=8, chunk=256, lr=0.05, reg=0.0, algo="scatter")
     model = MF.MFSGD(128, 96, cfg, mesh, seed=1)
     model.set_ratings(u, i, v)
     r1 = model.train_epoch()
@@ -147,3 +147,158 @@ def test_second_epoch_slices_home(mesh):
     for _ in range(6):
         r5 = model.train_epoch()
     assert r5 < r1
+
+
+# -- dense (one-hot MXU tile) algo ------------------------------------------
+
+def numpy_dense_epoch(W, H, tiles, n, u_tile, i_tile, lr, reg):
+    """Numpy replica of the dense algo's epoch: same half-slice rotation
+    schedule, per-entry batched tile updates with duplicate gradients
+    summed (what the one-hot matmuls compute)."""
+    eu, ei, ev, ou, oi, u_own, i_own, u_bound, ib2 = tiles
+    ns = 2 * n
+    NE, C = eu.shape[1], eu.shape[2]
+    eu = eu.reshape(n, ns, NE, C); ei = ei.reshape(n, ns, NE, C)
+    ev = ev.reshape(n, ns, NE, C)
+    ou = ou.reshape(n, ns, NE); oi = oi.reshape(n, ns, NE)
+    se = cnt = 0.0
+    for t in range(ns):
+        for w in range(n):
+            s = 2 * ((w - t // 2) % n) if t % 2 == 0 else \
+                2 * ((w - t // 2 - 1) % n) + 1
+            Wv = W[w * u_bound:(w + 1) * u_bound]
+            Hv = H[s * ib2:(s + 1) * ib2]
+            for e in range(NE):
+                cu, ci, cv = eu[w, s, e], ei[w, s, e], ev[w, s, e]
+                m = (cu < u_tile).astype(np.float32)
+                Wb = Wv[ou[w, s, e]:ou[w, s, e] + u_tile]
+                Hb = Hv[oi[w, s, e]:oi[w, s, e] + i_tile]
+                wu = np.where(m[:, None] > 0, Wb[np.minimum(cu, u_tile - 1)], 0.0)
+                hi = np.where(m[:, None] > 0, Hb[np.minimum(ci, i_tile - 1)], 0.0)
+                err = m * (cv - (wu * hi).sum(-1))
+                gw = err[:, None] * hi - reg * m[:, None] * wu
+                gh = err[:, None] * wu - reg * m[:, None] * hi
+                gW = np.zeros_like(Wb); gH = np.zeros_like(Hb)
+                valid = m > 0
+                np.add.at(gW, cu[valid], gw[valid])
+                np.add.at(gH, ci[valid], gh[valid])
+                Wb += lr * gW
+                Hb += lr * gH
+                se += (err ** 2).sum()
+                cnt += m.sum()
+    return W, H, np.sqrt(se / max(cnt, 1))
+
+
+def test_partition_ratings_tiles_roundtrip():
+    rng = np.random.default_rng(0)
+    nnz, n_users, n_items = 700, 64, 48
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+    eu, ei, ev, ou, oi, uo, io, ub, ib2 = MF.partition_ratings_tiles(
+        u, i, v, n_users, n_items, N, u_tile=8, i_tile=8, entry_cap=16)
+    ns = 2 * N
+    got = []
+    for ws in range(N * ns):
+        w, s = ws // ns, ws % ns
+        for e in range(eu.shape[1]):
+            mask = eu[ws, e] < 8
+            got += list(zip(
+                (eu[ws, e][mask] + ou[ws, e] + w * uo).tolist(),
+                (ei[ws, e][mask] + oi[ws, e] + s * io).tolist(),
+                ev[ws, e][mask].tolist(),
+            ))
+    assert sorted(got) == sorted(zip(u.tolist(), i.tolist(), v.tolist()))
+
+
+def test_dense_epoch_matches_numpy_model(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n_users, n_items, nnz = 64, 48, 600
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+
+    cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                         entry_cap=16, compute_dtype=jnp.float32,
+                         lr=0.02, reg=0.01)
+    model = MF.MFSGD(n_users, n_items, cfg, mesh, seed=3)
+    W0 = np.asarray(model.W).copy()
+    H0 = np.asarray(model.H).copy()
+    model.set_ratings(u, i, v)
+    rmse = model.train_epoch()
+
+    tiles = MF.partition_ratings_tiles(u, i, v, n_users, n_items, N,
+                                       u_tile=8, i_tile=8, entry_cap=16)
+    Wr, Hr, rmse_ref = numpy_dense_epoch(
+        W0.copy(), H0.copy(), tiles, N, 8, 8, cfg.lr, cfg.reg)
+    np.testing.assert_allclose(np.asarray(model.W), Wr, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(model.H), Hr, rtol=2e-4, atol=2e-5)
+    assert abs(rmse - rmse_ref) < 1e-3
+
+
+def test_dense_matches_scatter_convergence(mesh):
+    """Same data, same seed: both algos must converge to the same ballpark
+    (they batch differently, so trajectories differ only slightly)."""
+    import jax.numpy as jnp
+
+    u, i, v = MF.synthetic_ratings(200, 150, 8_000, rank=4, noise=0.01, seed=0)
+    finals = {}
+    for algo in ("dense", "scatter"):
+        cfg = MF.MFSGDConfig(rank=8, lr=0.05, reg=0.002, algo=algo,
+                             u_tile=16, i_tile=16, entry_cap=64, chunk=64,
+                             compute_dtype=jnp.float32)
+        m = MF.MFSGD(200, 150, cfg, mesh, seed=0)
+        m.set_ratings(u, i, v)
+        for _ in range(8):
+            r = m.train_epoch()
+        finals[algo] = r
+    assert abs(finals["dense"] - finals["scatter"]) < 0.05, finals
+
+
+def test_dense_ownership_stays_balanced():
+    """Tile rounding must not change worker placement: with
+    ceil(n_users/N) < u_tile every rating would otherwise land on worker 0."""
+    rng = np.random.default_rng(2)
+    nnz = 4000
+    u = rng.integers(0, 512, nnz).astype(np.int32)
+    i = rng.integers(0, 256, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+    eu, *_ = MF.partition_ratings_tiles(
+        u, i, v, 512, 256, N, u_tile=512, i_tile=512, entry_cap=2048)
+    per_worker = (eu.reshape(N, -1) < 512).sum(axis=1)
+    assert (per_worker > 0).all(), per_worker  # every worker owns ratings
+    assert per_worker.max() < 2 * per_worker.min(), per_worker
+
+
+def test_dense_factors_strip_storage_padding(mesh):
+    """factors() must cut the per-range tile padding, not just the tail."""
+    import jax.numpy as jnp
+
+    u, i, v = MF.synthetic_ratings(100, 70, 2_000, rank=3, seed=4)
+    cfg = MF.MFSGDConfig(rank=4, u_tile=8, i_tile=8, entry_cap=32,
+                         compute_dtype=jnp.float32, lr=0.05)
+    m = MF.MFSGD(100, 70, cfg, mesh, seed=0)
+    m.set_ratings(u, i, v)
+    m.train_epoch()
+    W, H = m.factors()
+    assert W.shape == (100, 4) and H.shape == (70, 4)
+    # predict_rmse goes through factors(); a misaligned strip would blow it up
+    assert m.predict_rmse(u, i, v) < 2.0
+
+
+def test_resume_rejects_mismatched_checkpoint_shapes(mesh, tmp_path):
+    """A checkpoint from a different algo/tile config must refuse to resume
+    (dynamic slices would clamp and silently train wrong rows)."""
+    u, i, v = MF.synthetic_ratings(64, 48, 500, rank=2, seed=0)
+    ckpt = str(tmp_path / "mf")
+    m1 = MF.MFSGD(64, 48, MF.MFSGDConfig(rank=4, algo="scatter"), mesh, seed=0)
+    m1.set_ratings(u, i, v)
+    m1.fit(2, ckpt, ckpt_every=1)
+
+    m2 = MF.MFSGD(64, 48, MF.MFSGDConfig(rank=4, algo="dense", u_tile=16,
+                                         i_tile=16), mesh, seed=0)
+    m2.set_ratings(u, i, v)
+    with pytest.raises(ValueError, match="checkpoint shapes"):
+        m2.fit(2, ckpt, ckpt_every=1)
