@@ -13,10 +13,14 @@ TPU-native design:
 - tokens pre-partitioned into the (doc-range × word-slice) grid of
   :func:`harp_tpu.models.mfsgd.partition_ratings`-style blocks (2 half-
   slices per worker, pipelined rotation exactly like MF-SGD);
-- a rotation step samples all resident tokens in fixed-size chunks:
-  gather doc-topic and word-topic count rows, form the CGS posterior
+- a rotation step samples all resident tokens in batches: gather doc-topic
+  and word-topic count rows, form the CGS posterior
   ``(N_dk+α)(N_wk+β)/(N_k+Vβ)``, sample via Gumbel-argmax (on-device
-  ``jax.random``), scatter count deltas;
+  ``jax.random``), apply count deltas.  Two delta-application algorithms
+  (``LDAConfig.algo``): "dense" one-hot MXU matmuls into dynamic-sliced
+  tile blocks (default; 6.3M vs 3.3M tokens/s/chip on the graded config —
+  XLA scatter of K-wide rows was 2.2 s of the 2.87 s epoch) and the
+  "scatter" reference;
 - the global topic-totals vector ``N_k`` is synchronized with an
   ``allreduce`` of deltas every rotation step — the push/pull residue
   (dense K-vector, so psum ≡ push+pull at once);
@@ -38,7 +42,12 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, num_workers, worker_id
-from harp_tpu.models.mfsgd import partition_ratings
+from harp_tpu.models.mfsgd import (
+    _dense_bounds,
+    algo_kwargs,
+    partition_ratings,
+    partition_ratings_tiles,
+)
 from harp_tpu.utils.timing import device_sync
 
 
@@ -47,7 +56,24 @@ class LDAConfig:
     n_topics: int = 100
     alpha: float = 0.1  # doc-topic Dirichlet prior
     beta: float = 0.01  # word-topic Dirichlet prior
-    chunk: int = 8192   # tokens sampled per count-snapshot
+    # Count-update algorithm.  "dense" (default) groups tokens into
+    # (d_tile × w_tile) sub-tiles and applies count deltas as one-hot MXU
+    # matmuls into dynamic-sliced table blocks — no XLA scatter.  Profiled
+    # on the graded config (1k topics, 10M tokens, 1× v5e, 2026-07-30):
+    # the two scatters were 2.2 s of the 2.87 s epoch (~25 GB/s scatter
+    # floor), while the take-gathers cost only 0.23 s and stay as takes.
+    # "scatter" keeps the direct formulation as the readable reference.
+    # Delta matmuls are EXACT in bf16 (operands are 0/±1; f32 accumulate),
+    # so counts remain integers on both paths.
+    algo: str = "dense"
+    d_tile: int = 512   # dense: doc-topic tile rows
+    w_tile: int = 512   # dense: word-topic tile rows
+    entry_cap: int = 2048  # dense: max tokens per tile entry
+    chunk: int = 8192   # scatter: tokens sampled per count-snapshot
+
+    def __post_init__(self):
+        if self.algo not in ("dense", "scatter"):
+            raise ValueError(f"algo must be 'dense' or 'scatter', got {self.algo!r}")
 
 
 def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
@@ -79,19 +105,70 @@ def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
     return Ndk, Nwk, dNk, z_new
 
 
+def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
+    """Dense-tile resample of one (d_tile × w_tile) token entry.
+
+    Gathers stay ``jnp.take`` (profiled cheap); the count-delta scatters
+    become one-hot matmuls accumulated into dynamic-sliced table blocks
+    and written back with ``dynamic_update_slice`` — no XLA scatter.  The
+    matmuls are exact (0/±1 operands in bf16, f32 accumulation), so the
+    count tables stay integer-valued like the scatter path's.
+    """
+    cd, cw, od, ow = entry  # tile-local ids + tile offsets
+    K = cfg.n_topics
+    DR, WR = cfg.d_tile, cfg.w_tile
+    m = (cd < DR).astype(jnp.float32)
+
+    # Slice the tile blocks FIRST and gather from them (ids are tile-local):
+    # gathering straight from the scan-carried tables while also
+    # dynamic-update-slicing them makes XLA insert a full-table copy per
+    # entry (profiled: 20 s of a 29 s epoch).  Blocks in, blocks out keeps
+    # the tables update-in-place.
+    Db = lax.dynamic_slice_in_dim(Ndk, od, DR, 0)
+    Wb = lax.dynamic_slice_in_dim(Nwk, ow, WR, 0)
+    oh_old = jax.nn.one_hot(z, K, dtype=jnp.float32) * m[:, None]
+    ndk = jnp.take(Db, jnp.minimum(cd, DR - 1), axis=0) - oh_old
+    nwk = jnp.take(Wb, jnp.minimum(cw, WR - 1), axis=0) - oh_old
+    nk = Nk[None, :] - oh_old
+
+    logp = (
+        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
+        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
+        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
+    )
+    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
+    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
+    z_new = jnp.where(m > 0, z_new, z)
+
+    oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * m[:, None]
+    delta = (oh_new - oh_old).astype(jnp.bfloat16)  # entries ∈ {-1,0,1}: exact
+    ohd = jax.nn.one_hot(cd, DR, dtype=jnp.bfloat16)  # pad rows all-zero
+    ohw = jax.nn.one_hot(cw, WR, dtype=jnp.bfloat16)
+    dot = lambda a, b: lax.dot_general(  # noqa: E731 — contract dim 0 with 0
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    Ndk = lax.dynamic_update_slice_in_dim(Ndk, Db + dot(ohd, delta), od, 0)
+    Nwk = lax.dynamic_update_slice_in_dim(Nwk, Wb + dot(ohw, delta), ow, 0)
+    dNk = delta.astype(jnp.float32).sum(0)
+    return Ndk, Nwk, dNk, z_new
+
+
 def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
     """One full rotation epoch: every token resampled once.
 
     Pipelined half-slice schedule identical to MF-SGD's (see
     harp_tpu.models.mfsgd.make_epoch_fn): compute on one word-slice half
-    while the other is in flight.
+    while the other is in flight.  The per-step token pass dispatches on
+    ``cfg.algo``: scan over dense tile entries, or over fixed-size scatter
+    chunks (see :func:`_sample_entry` / :func:`_sample_chunk`).
     """
     two_n = 2 * mesh.num_workers
+    dense = cfg.algo == "dense"
 
-    def epoch(Ndk, Nwk_slice, Nk, z_grid, bd, bw, bm, key):
+    def epoch(Ndk, Nwk_slice, Nk, z_grid, *token_args):
+        key = token_args[-1][0]
+        tokens = token_args[:-1]
         ib2 = Nwk_slice.shape[0] // 2
         computing, inflight = Nwk_slice[:ib2], Nwk_slice[ib2:]
-        key = key[0]
 
         def body(carry, t):
             Ndk, computing, inflight, Nk, z_grid, key = carry
@@ -101,33 +178,52 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
                 2 * ((worker_id() - t // 2) % num_workers()),
                 2 * ((worker_id() - t // 2 - 1) % num_workers()) + 1,
             )
-            d_blk, w_blk, m_blk, z_blk = jax.tree.map(
-                lambda a: a[half_idx], (bd, bw, bm, z_grid)
-            )
-            # clamp to the static block width (blocks narrower than
-            # cfg.chunk arise on small corpora — see partition_ratings)
-            c = min(cfg.chunk, d_blk.shape[0])
-            nchunk = d_blk.shape[0] // c
+            blk = jax.tree.map(lambda a: a[half_idx], tokens)
+            z_blk = z_grid[half_idx]
             key, sub = jax.random.split(key)
-            chunk_keys = jax.random.split(sub, nchunk)
 
-            def chunk_body(st, inp):
-                Ndk, Nwk, dNk_acc = st
-                d, w, m, zc, k = inp
-                Ndk, Nwk, dNk, z_new = _sample_chunk(
-                    Ndk, Nwk, Nk + dNk_acc, zc, (d, w, m), k, cfg, vocab_size
+            if dense:
+                ed, ew, od, ow = blk  # [NE, C], [NE]
+                entry_keys = jax.random.split(sub, ed.shape[0])
+
+                def entry_body(st, inp):
+                    Ndk, Nwk, dNk_acc = st
+                    cd, cw, zc, eo, wo, k = inp
+                    Ndk, Nwk, dNk, z_new = _sample_entry(
+                        Ndk, Nwk, Nk + dNk_acc, zc, (cd, cw, eo, wo), k,
+                        cfg, vocab_size)
+                    return (Ndk, Nwk, dNk_acc + dNk), z_new
+
+                (Ndk, computing, dNk), z_new = lax.scan(
+                    entry_body, (Ndk, computing, jnp.zeros_like(Nk)),
+                    (ed, ew, z_blk, od, ow, entry_keys),
                 )
-                return (Ndk, Nwk, dNk_acc + dNk), z_new
+            else:
+                d_blk, w_blk, m_blk = blk
+                # clamp to the static block width (blocks narrower than
+                # cfg.chunk arise on small corpora — see partition_ratings)
+                c = min(cfg.chunk, d_blk.shape[0])
+                nchunk = d_blk.shape[0] // c
+                chunk_keys = jax.random.split(sub, nchunk)
 
-            (Ndk, computing, dNk), z_new = lax.scan(
-                chunk_body, (Ndk, computing, jnp.zeros_like(Nk)),
-                (d_blk.reshape(nchunk, c), w_blk.reshape(nchunk, c),
-                 m_blk.reshape(nchunk, c), z_blk.reshape(nchunk, c),
-                 chunk_keys),
-            )
+                def chunk_body(st, inp):
+                    Ndk, Nwk, dNk_acc = st
+                    d, w, m, zc, k = inp
+                    Ndk, Nwk, dNk, z_new = _sample_chunk(
+                        Ndk, Nwk, Nk + dNk_acc, zc, (d, w, m), k, cfg,
+                        vocab_size)
+                    return (Ndk, Nwk, dNk_acc + dNk), z_new
+
+                (Ndk, computing, dNk), z_new = lax.scan(
+                    chunk_body, (Ndk, computing, jnp.zeros_like(Nk)),
+                    (d_blk.reshape(nchunk, c), w_blk.reshape(nchunk, c),
+                     m_blk.reshape(nchunk, c), z_blk.reshape(nchunk, c),
+                     chunk_keys),
+                )
+                z_new = z_new.reshape(-1)
             # push/pull residue: topic totals sync via psum of deltas
             Nk = Nk + C.allreduce(dNk)
-            z_grid = z_grid.at[half_idx].set(z_new.reshape(-1))
+            z_grid = z_grid.at[half_idx].set(z_new)
             return (Ndk, received, computing, Nk, z_grid, key), None
 
         (Ndk, computing, inflight, Nk, z_grid, key), _ = lax.scan(
@@ -137,11 +233,12 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
         Nwk_slice = jnp.concatenate([computing, inflight], axis=0)
         return Ndk, Nwk_slice, Nk, z_grid
 
+    n_tok_args = 5 if dense else 4  # (+ keys)
     return jax.jit(
         mesh.shard_map(
             epoch,
-            in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0),
-                      mesh.spec(0), mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+            in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
+            + (mesh.spec(0),) * n_tok_args,
             out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
         )
     )
@@ -156,8 +253,14 @@ class LDA:
         self.cfg = cfg or LDAConfig()
         self.n_docs, self.vocab_size = n_docs, vocab_size
         n = self.mesh.num_workers
-        self.d_bound = -(-n_docs // n)
-        self.w_bound = 2 * (-(-vocab_size // (2 * n)))
+        if self.cfg.algo == "dense":
+            self.d_own, self.w_own, self.d_bound, wb2 = _dense_bounds(
+                n_docs, vocab_size, n, 2 * n, self.cfg.d_tile, self.cfg.w_tile)
+            self.w_bound = 2 * wb2
+        else:
+            self.d_bound = self.d_own = -(-n_docs // n)
+            self.w_bound = 2 * (-(-vocab_size // (2 * n)))
+            self.w_own = self.w_bound // 2
         self._epoch_fn = make_epoch_fn(self.mesh, self.cfg, vocab_size)
         self._seed = seed
         self._tokens = None
@@ -167,20 +270,31 @@ class LDA:
         n = self.mesh.num_workers
         K = self.cfg.n_topics
         rng = np.random.default_rng(self._seed)
-        # reuse the MF-SGD grid partitioner: "rating value" carries the
+        # reuse the MF-SGD grid partitioners: "rating value" carries the
         # initial topic assignment
         z0 = rng.integers(0, K, len(doc_ids)).astype(np.float32)
-        bd, bw, bz, bm, db, wb2 = partition_ratings(
-            doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
-            self.cfg.chunk,
-        )
-        assert (db, 2 * wb2) == (self.d_bound, self.w_bound)
-        z_grid = bz.astype(np.int32)
+        if self.cfg.algo == "dense":
+            ed, ew, ez, od, ow, do, wo, db, wb2 = partition_ratings_tiles(
+                doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
+                self.cfg.d_tile, self.cfg.w_tile, self.cfg.entry_cap,
+            )
+            assert (do, wo, db, 2 * wb2) == (
+                self.d_own, self.w_own, self.d_bound, self.w_bound)
+            z_grid = ez.astype(np.int32)
+            tokens = (ed, ew, od, ow)
+        else:
+            bd, bw, bz, bm, db, wb2 = partition_ratings(
+                doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
+                self.cfg.chunk,
+            )
+            assert (db, 2 * wb2) == (self.d_bound, self.w_bound)
+            z_grid = bz.astype(np.int32)
+            tokens = (bd, bw, bm)
 
         # initial count tables from the assignments (host, exact)
         Ndk = np.zeros((self.d_bound * n, K), np.float32)
         Nwk = np.zeros((self.w_bound * n, K), np.float32)
-        gd, gw, gm = self._global_token_ids(bd, bw, bm)
+        gd, gw, gm = self._global_token_ids(tokens)
         gz = z_grid.reshape(-1)
         np.add.at(Ndk, (gd[gm], gz[gm]), 1.0)
         np.add.at(Nwk, (gw[gm], gz[gm]), 1.0)
@@ -190,33 +304,63 @@ class LDA:
         self.Ndk, self.Nwk = sh(Ndk, 0), sh(Nwk, 0)
         self.Nk = jax.device_put(jnp.asarray(Nk), self.mesh.replicated())
         self.z_grid = sh(z_grid, 0)
-        self._tokens = tuple(sh(a, 0) for a in (bd, bw, bm))
+        self._tokens = tuple(sh(a, 0) for a in tokens)
         self.n_tokens = int(gm.sum())
         self._keys = np.asarray(
             jax.random.split(jax.random.PRNGKey(self._seed), n)
         )
 
-    def _global_token_ids(self, bd, bw, bm):
-        """Grid-local → global (doc, word) ids + valid mask, flattened.
+    def _global_token_ids(self, tokens):
+        """Grid-local → global STORAGE (doc, word) row ids + valid mask.
 
         Grid row r belongs to worker ``r // (2n)`` (doc range) and word
-        slice ``r % (2n)``.
+        slice ``r % (2n)``.  "Storage" rows: the dense layout pads each
+        range to a tile multiple, so storage row ≠ external id there (use
+        :meth:`doc_topic_table` / :meth:`word_topic_table` for external
+        views).
         """
         n = self.mesh.num_workers
         db, wb2 = self.d_bound, self.w_bound // 2
         rows = np.arange(n * 2 * n)
-        gd = (np.asarray(bd) + (rows // (2 * n) * db)[:, None]).reshape(-1)
-        gw = (np.asarray(bw) + (rows % (2 * n) * wb2)[:, None]).reshape(-1)
-        gm = np.asarray(bm).reshape(-1) > 0
+        if self.cfg.algo == "dense":
+            ed, ew, od, ow = (np.asarray(a) for a in tokens)
+            gm = (ed < self.cfg.d_tile).reshape(-1)
+            ld = np.minimum(ed, self.cfg.d_tile - 1) + od[:, :, None]
+            lw = np.minimum(ew, self.cfg.w_tile - 1) + ow[:, :, None]
+            gd = (ld + (rows // (2 * n) * db)[:, None, None]).reshape(-1)
+            gw = (lw + (rows % (2 * n) * wb2)[:, None, None]).reshape(-1)
+            return gd, gw, gm
+        bd, bw, bm = (np.asarray(a) for a in tokens)
+        gd = (bd + (rows // (2 * n) * db)[:, None]).reshape(-1)
+        gw = (bw + (rows % (2 * n) * wb2)[:, None]).reshape(-1)
+        gm = bm.reshape(-1) > 0
         return gd, gw, gm
+
+    def doc_topic_table(self):
+        """[n_docs, K] doc-topic counts with storage padding stripped."""
+        n = self.mesh.num_workers
+        Ndk = np.asarray(self.Ndk)
+        if self.cfg.algo == "dense":
+            K = Ndk.shape[-1]
+            Ndk = Ndk.reshape(n, self.d_bound, K)[:, : self.d_own].reshape(-1, K)
+        return Ndk[: self.n_docs]
+
+    def word_topic_table(self):
+        """[vocab_size, K] word-topic counts with storage padding stripped."""
+        n = self.mesh.num_workers
+        Nwk = np.asarray(self.Nwk)
+        if self.cfg.algo == "dense":
+            K = Nwk.shape[-1]
+            wb2 = self.w_bound // 2
+            Nwk = Nwk.reshape(2 * n, wb2, K)[:, : self.w_own].reshape(-1, K)
+        return Nwk[: self.vocab_size]
 
     def sample_epoch(self):
         if self._tokens is None:
             raise RuntimeError("call set_tokens() before sample_epoch()")
-        bd, bw, bm = self._tokens
         keys = self.mesh.shard_array(self._keys, 0)
         self.Ndk, self.Nwk, self.Nk, self.z_grid = self._epoch_fn(
-            self.Ndk, self.Nwk, self.Nk, self.z_grid, bd, bw, bm, keys
+            self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
         )
         self._keys = np.asarray(
             jax.random.split(jax.random.PRNGKey(int(self._keys[0][0]) ^ 0x9E37),
@@ -241,6 +385,19 @@ class LDA:
                     "z": self.z_grid, "keys": np.asarray(self._keys)}
 
         def set_state(state):
+            # np.shape only (no device→host transfer) — a checkpoint from a
+            # different algo/tile config must refuse to resume: dynamic
+            # slices would clamp and silently update wrong count rows
+            for name, cur in (("Ndk", self.Ndk), ("Nwk", self.Nwk),
+                              ("z", self.z_grid)):
+                got = tuple(np.shape(state[name]))
+                want = tuple(np.shape(cur))
+                if got != want:
+                    raise ValueError(
+                        f"checkpoint shapes {name}{got} do not match this "
+                        f"model's {name}{want} — was the checkpoint written "
+                        "with a different algo/tile config? (refusing to "
+                        "resume)")
             if not isinstance(state["Ndk"], jax.Array):  # numpy from restore
                 sh = self.mesh.shard_array
                 self.Ndk = sh(np.asarray(state["Ndk"]), 0)
@@ -265,8 +422,7 @@ class LDA:
         Nwk = np.asarray(self.Nwk)
         Nk = np.asarray(self.Nk)
         cfg = self.cfg
-        bd, bw, bm = self._tokens
-        gd, gw, gm = self._global_token_ids(bd, bw, bm)
+        gd, gw, gm = self._global_token_ids(self._tokens)
         gz = np.asarray(self.z_grid).reshape(-1)
         d, w, zz = gd[gm], gw[gm], gz[gm]
         nd = Ndk.sum(1)
@@ -290,15 +446,25 @@ def synthetic_corpus(n_docs, vocab_size, n_topics_true, tokens_per_doc, seed=0):
     return np.asarray(doc_ids, np.int32), np.asarray(word_ids, np.int32)
 
 
+def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
+              entry_cap=None):
+    """None inherits LDAConfig's defaults; algo-specific knobs raise when
+    combined with the other algo (shared contract: mfsgd.algo_kwargs)."""
+    return LDAConfig(n_topics=n_topics, **algo_kwargs(
+        algo, {"chunk": chunk},
+        {"d_tile": d_tile, "w_tile": w_tile, "entry_cap": entry_cap}))
+
+
 def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
-              tokens_per_doc=100, epochs=2, mesh=None, chunk=8192, seed=0):
+              tokens_per_doc=100, epochs=2, mesh=None, chunk=None, seed=0,
+              algo="dense", d_tile=None, w_tile=None, entry_cap=None):
     """Tokens/sec/chip on an enwiki-1M-scaled config (graded config #3).
 
     (Full enwiki-1M docs needs a multi-chip pod for the 1M×1k doc-topic
     table; this keeps per-chip load representative.)
     """
     mesh = mesh or current_mesh()
-    cfg = LDAConfig(n_topics=n_topics, chunk=chunk)
+    cfg = _make_cfg(n_topics, algo, chunk, d_tile, w_tile, entry_cap)
     model = LDA(n_docs, vocab_size, cfg, mesh, seed)
     rng = np.random.default_rng(seed)
     n_tok = n_docs * tokens_per_doc
@@ -333,7 +499,18 @@ def main(argv=None):
     p.add_argument("--topics", type=int, default=1000)
     p.add_argument("--tokens-per-doc", type=int, default=100)
     p.add_argument("--epochs", type=int, default=2)
-    p.add_argument("--chunk", type=int, default=8192)
+    p.add_argument("--algo", choices=["dense", "scatter"], default="dense",
+                   help="dense: one-hot MXU count updates (fastest, "
+                        "default); scatter: direct scatter-add reference")
+    p.add_argument("--chunk", type=int, default=None,
+                   help="scatter-only: tokens per count-snapshot "
+                        "(default 8192); errors under --algo dense")
+    p.add_argument("--d-tile", type=int, default=None,
+                   help="dense-only: doc-topic tile rows (default 512)")
+    p.add_argument("--w-tile", type=int, default=None,
+                   help="dense-only: word-topic tile rows (default 512)")
+    p.add_argument("--entry-cap", type=int, default=None,
+                   help="dense-only: max tokens per tile entry (default 2048)")
     p.add_argument("--ckpt-dir", default=None,
                    help="sample with checkpoint/resume instead of "
                         "benchmarking; rerunning with the same dir resumes "
@@ -372,14 +549,17 @@ def main(argv=None):
                                             max(2, args.topics // 8),
                                             args.tokens_per_doc)
         model = LDA(n_docs, vocab,
-                    LDAConfig(n_topics=args.topics, chunk=args.chunk))
+                    _make_cfg(args.topics, args.algo, args.chunk,
+                              args.d_tile, args.w_tile, args.entry_cap))
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
                "log_likelihood": round(model.log_likelihood(), 4)})
     else:
         print(benchmark(args.docs or 100_000, args.vocab or 50_000, args.topics,
-                        args.tokens_per_doc, args.epochs, chunk=args.chunk))
+                        args.tokens_per_doc, args.epochs, chunk=args.chunk,
+                        algo=args.algo, d_tile=args.d_tile,
+                        w_tile=args.w_tile, entry_cap=args.entry_cap))
 
 
 if __name__ == "__main__":

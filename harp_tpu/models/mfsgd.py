@@ -606,25 +606,32 @@ def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
     return u.astype(np.int32), i.astype(np.int32), v.astype(np.float32)
 
 
+def algo_kwargs(algo: str, scatter_knobs: dict, dense_knobs: dict) -> dict:
+    """Validated algo-specific config kwargs (shared by mfsgd and lda).
+
+    ``None`` values inherit the config defaults; a non-None knob combined
+    with the other algo raises — a silently-ignored tuning flag wastes
+    benchmark sweeps."""
+    kw: dict[str, Any] = {"algo": algo}
+    for knobs, owner in ((scatter_knobs, "scatter"), (dense_knobs, "dense")):
+        other = "dense" if owner == "scatter" else "scatter"
+        for name, val in knobs.items():
+            if val is None:
+                continue
+            if algo != owner:
+                raise ValueError(
+                    f"{name} is {owner}-only; pass algo='{owner}' or tune "
+                    f"the {other} knobs instead (algo={algo!r})")
+            kw[name] = val
+    return kw
+
+
 def _make_config(rank: int, chunk: int | None, algo: str = "dense",
                  u_tile: int | None = None, i_tile: int | None = None,
                  entry_cap: int | None = None) -> MFSGDConfig:
-    """None inherits MFSGDConfig's tuned defaults.  Algo-specific knobs
-    raise when combined with the other algo — a silently-ignored tuning
-    flag wastes benchmark sweeps."""
-    kw: dict[str, Any] = {"rank": rank, "algo": algo}
-    if chunk is not None:
-        if algo == "dense":
-            raise ValueError("chunk is scatter-only; pass algo='scatter' or "
-                             "tune u_tile/i_tile/entry_cap for dense")
-        kw["chunk"] = chunk
-    for name, val in (("u_tile", u_tile), ("i_tile", i_tile),
-                      ("entry_cap", entry_cap)):
-        if val is not None:
-            if algo != "dense":
-                raise ValueError(f"{name} is dense-only (algo={algo!r})")
-            kw[name] = val
-    return MFSGDConfig(**kw)
+    return MFSGDConfig(rank=rank, **algo_kwargs(
+        algo, {"chunk": chunk},
+        {"u_tile": u_tile, "i_tile": i_tile, "entry_cap": entry_cap}))
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,

@@ -42,6 +42,11 @@ def run_all(smoke: bool, only, watchdog=None):
                 "epochs": 2, "chunk": 1024} if smoke else {})),
         "lda": lambda: lda.benchmark(
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64} if smoke else {})),
+        "lda_scatter": lambda: lda.benchmark(
+            algo="scatter",
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                 "tokens_per_doc": 16, "epochs": 1, "chunk": 256} if smoke
                else {})),
         "mlp": lambda: mlp.benchmark(
@@ -81,8 +86,8 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "mfsgd", "mfsgd_scatter", "lda", "mlp",
-                            "subgraph", "rf"],
+                   choices=["kmeans", "mfsgd", "mfsgd_scatter", "lda",
+                            "lda_scatter", "mlp", "subgraph", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     args = p.parse_args(argv)

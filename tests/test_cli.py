@@ -106,7 +106,8 @@ def test_dispatch_lda_ckpt_resume(capsys, tmp_path, monkeypatch):
                         lambda self: (calls.append(1), orig(self))[1])
 
     args = ["lda", "--docs", "16", "--vocab", "16", "--topics", "2",
-            "--tokens-per-doc", "4", "--epochs", "2", "--chunk", "16",
+            "--tokens-per-doc", "4", "--epochs", "2",
+            "--d-tile", "8", "--w-tile", "8", "--entry-cap", "16",
             "--ckpt-dir", str(tmp_path / "c")]
     assert cli.main(args) == 0
     first = capsys.readouterr().out
@@ -148,7 +149,8 @@ def test_dispatch_file_inputs(capsys, tmp_path):
     tok = ["0 1 2", "0 3 1", "1 2 3", "2 0 1"]
     (tmp_path / "tok.txt").write_text("\n".join(tok) + "\n")
     assert cli.main(["lda", "--input", str(tmp_path / "tok.txt"),
-                     "--topics", "2", "--chunk", "16", "--epochs", "2",
+                     "--topics", "2", "--d-tile", "8", "--w-tile", "8",
+                     "--epochs", "2",
                      "--ckpt-dir", str(tmp_path / "lc")]) == 0
     out = capsys.readouterr().out
     assert "log_likelihood" in out
@@ -190,13 +192,15 @@ def test_lda_explicit_zero_counts_dropped(capsys, tmp_path):
 
     (tmp_path / "z.txt").write_text("0 1 2\n0 2 0\n1 0 1\n")
     assert cli.main(["lda", "--input", str(tmp_path / "z.txt"),
-                     "--topics", "2", "--chunk", "8", "--epochs", "1"]) == 0
+                     "--topics", "2", "--algo", "scatter", "--chunk", "8",
+                     "--epochs", "1"]) == 0
     capsys.readouterr()
 
     (tmp_path / "allz.txt").write_text("0 1 0\n1 2 0\n")
     with pytest.raises(SystemExit, match="all token counts are zero"):
         cli.main(["lda", "--input", str(tmp_path / "allz.txt"),
-                  "--topics", "2", "--chunk", "8", "--epochs", "1"])
+                  "--topics", "2", "--algo", "scatter", "--chunk", "8",
+                  "--epochs", "1"])
 
 
 def test_triples_two_column_fallback_matches_native(tmp_path, monkeypatch):

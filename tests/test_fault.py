@@ -106,12 +106,15 @@ def test_mfsgd_fit_checkpoint_resume(mesh, tmp_path, algo):
         make_model().fit(2, fault=FaultInjector(fail_at=(1,)))
 
 
-def test_lda_fit_checkpoint_resume(mesh, tmp_path):
+@pytest.mark.parametrize("algo", ["dense", "scatter"])
+def test_lda_fit_checkpoint_resume(mesh, tmp_path, algo):
     """LDA sampling recovers from a crash on the same chain as a clean run."""
     from harp_tpu.models import lda as L
 
     def make_model():
-        m = L.LDA(16, 24, L.LDAConfig(n_topics=4, chunk=32), mesh=mesh, seed=1)
+        m = L.LDA(16, 24, L.LDAConfig(n_topics=4, algo=algo, chunk=32,
+                                      d_tile=8, w_tile=8, entry_cap=16),
+                  mesh=mesh, seed=1)
         d, w = L.synthetic_corpus(16, 24, 2, tokens_per_doc=8, seed=1)
         m.set_tokens(d, w)
         return m

@@ -8,11 +8,13 @@ from harp_tpu.models import lda as L
 N = 8
 
 
-@pytest.fixture
-def small_model(mesh):
-    """Fresh model per test: shared state would make assertions depend on
-    test execution order."""
-    cfg = L.LDAConfig(n_topics=8, chunk=64, alpha=0.5, beta=0.1)
+@pytest.fixture(params=["dense", "scatter"])
+def small_model(mesh, request):
+    """Fresh model per test (both count-update algos): shared state would
+    make assertions depend on test execution order."""
+    cfg = L.LDAConfig(n_topics=8, algo=request.param, chunk=64,
+                      d_tile=16, w_tile=16, entry_cap=64,
+                      alpha=0.5, beta=0.1)
     d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
                               tokens_per_doc=50, seed=0)
     model = L.LDA(96, 64, cfg, mesh, seed=1)
@@ -57,7 +59,7 @@ def test_topic_recovery(small_model):
     model, _, _ = small_model
     for _ in range(5):
         model.sample_epoch()
-    Nwk = np.asarray(model.Nwk)[: model.vocab_size]
+    Nwk = model.word_topic_table()
     p = (Nwk + 1e-9) / (Nwk.sum(1, keepdims=True) + 1e-6)
     ent = -(p * np.log(p + 1e-12)).sum(1).mean()
     assert ent < 0.7 * np.log(model.cfg.n_topics)
@@ -67,3 +69,20 @@ def test_sample_before_set_raises(mesh):
     model = L.LDA(16, 16, L.LDAConfig(n_topics=4, chunk=16), mesh)
     with pytest.raises(RuntimeError, match="set_tokens"):
         model.sample_epoch()
+
+
+def test_resume_rejects_mismatched_checkpoint_shapes(mesh, tmp_path):
+    """A checkpoint from a different algo/tile config must refuse to resume
+    (same contract as MF-SGD's guard)."""
+    d, w = L.synthetic_corpus(32, 24, 2, tokens_per_doc=6, seed=0)
+    ckpt = str(tmp_path / "lda")
+    m1 = L.LDA(32, 24, L.LDAConfig(n_topics=4, algo="scatter", chunk=16),
+               mesh, seed=0)
+    m1.set_tokens(d, w)
+    m1.fit(2, ckpt, ckpt_every=1)
+
+    m2 = L.LDA(32, 24, L.LDAConfig(n_topics=4, algo="dense", d_tile=8,
+                                   w_tile=8, entry_cap=16), mesh, seed=0)
+    m2.set_tokens(d, w)
+    with pytest.raises(ValueError, match="checkpoint shapes"):
+        m2.fit(2, ckpt, ckpt_every=1)
