@@ -106,3 +106,56 @@ def test_tp_validates_divisibility(mesh):
     x, y = M.synthetic_mnist(n=63, d=16, classes=8)  # 63 % 2 != 0
     with pytest.raises(ValueError, match="batch size"):
         tp.train_batch(x, y)
+
+
+def test_fit_resident_trains_and_matches_api_contract(mesh):
+    """The single-dispatch resident path converges and returns per-epoch
+    stats; staging must be explicit (load_resident before fit_resident)."""
+    cfg = M.MLPConfig(sizes=(16, 32, 4), lr=0.1)
+    tr = M.MLPTrainer(cfg, mesh, seed=0)
+    with pytest.raises(RuntimeError, match="load_resident"):
+        tr.fit_resident(epochs=1)
+
+    x, y = M.synthetic_mnist(n=512, d=16, classes=4, seed=2)
+    usable = tr.load_resident(x, y, batch_size=64)
+    assert usable == 512
+    hist = tr.fit_resident(epochs=8)
+    assert len(hist) == 8
+    losses = [l for l, _ in hist]
+    assert losses[-1] < 0.5 * losses[0], losses  # it actually trains
+    accs = [a for _, a in hist]
+    assert accs[-1] > accs[0]
+
+
+def test_fit_resident_epoch_shuffle_changes_order(mesh):
+    """Different seeds shuffle batch order: training still converges and
+    histories differ (the on-device permutation is live, not a no-op)."""
+    cfg = M.MLPConfig(sizes=(16, 32, 4), lr=0.05)
+    x, y = M.synthetic_mnist(n=256, d=16, classes=4, seed=3)
+    hists = []
+    for seed in (0, 1):
+        tr = M.MLPTrainer(cfg, mesh, seed=0)
+        tr.load_resident(x, y, batch_size=32, seed=0)  # same rows
+        hists.append(tr.fit_resident(epochs=3, seed=seed))
+    assert hists[0] != hists[1]
+
+
+def test_fit_resident_sequential_calls_keep_reshuffling(mesh):
+    """Back-to-back fit_resident calls must not repeat one batch order:
+    the call counter advances the shuffle key."""
+    cfg = M.MLPConfig(sizes=(16, 32, 4), lr=0.05)
+    tr = M.MLPTrainer(cfg, mesh, seed=0)
+    x, y = M.synthetic_mnist(n=256, d=16, classes=4, seed=3)
+    tr.load_resident(x, y, batch_size=32, seed=0)
+    h1 = tr.fit_resident(epochs=2)
+    h2 = tr.fit_resident(epochs=2)
+
+    tr2 = M.MLPTrainer(cfg, mesh, seed=0)
+    tr2.load_resident(x, y, batch_size=32, seed=0)
+    g1 = tr2.fit_resident(epochs=2)
+    assert g1 == h1            # same starting state → reproducible
+    # a repeat-order bug would make call 2 equal a fresh run's call 1 stats
+    # trajectory after manually resetting params — instead simply check the
+    # counter actually changed the key path
+    assert tr._shuffle_counter == 4 and tr2._shuffle_counter == 2
+    assert h2 != h1
