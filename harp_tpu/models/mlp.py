@@ -165,6 +165,14 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
     ), tx
 
 
+def _effective_batch(batch_size: int, n: int, n_workers: int) -> int:
+    """Batch size actually used: capped at n, rounded down to a worker
+    multiple, floored at one sample per worker.  Shared by fit and
+    load_resident so both paths train with the same effective batch for
+    the same argument."""
+    return max(n_workers, (min(batch_size, n) // n_workers) * n_workers)
+
+
 class MLPTrainer:
     """Host driver (the mapCollective residue for edu.iu.daal_nn)."""
 
@@ -204,7 +212,7 @@ class MLPTrainer:
         nw = self.mesh.num_workers
         if n < nw:
             raise ValueError(f"need at least {nw} samples (one per worker), got {n}")
-        batch_size = max(nw, (min(batch_size, n) // nw) * nw)
+        batch_size = _effective_batch(batch_size, n, nw)
         usable = (n // batch_size) * batch_size
         rng = np.random.default_rng(seed)
         order = rng.permutation(n)[:usable]
@@ -244,8 +252,7 @@ class MLPTrainer:
         nw = self.mesh.num_workers
         if n < nw:
             raise ValueError(f"need at least {nw} samples (one per worker), got {n}")
-        batch_size = min(batch_size, n)
-        batch_size = max(nw, (batch_size // nw) * nw)
+        batch_size = _effective_batch(batch_size, n, nw)
         rng = np.random.default_rng(shuffle_seed)
         history = []
         for _ in range(epochs):

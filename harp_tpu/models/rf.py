@@ -70,32 +70,56 @@ def binize(x, edges):
     return out
 
 
-def _grow_level(bins, y_onehot, weights, node_id, level, feat_mask, cfg):
+def bins_onehot(bins, n_bins):
+    """Precompute the flattened bin one-hot BO int8 [n, f*B] — shared by
+    every tree and level (bins never change during a fit), so the big
+    one-hot is built ONCE instead of per (tree, level, feature).
+    Built per feature column to avoid a [n, f, f*B] transient."""
+    n, f = bins.shape
+
+    def one_col(bins_f):
+        return jax.nn.one_hot(bins_f, n_bins, dtype=jnp.int8)  # [n, B]
+
+    cols = lax.map(one_col, bins.T)                 # [f, n, B]
+    return jnp.moveaxis(cols, 0, 1).reshape(n, f * n_bins)
+
+
+def _grow_level(BO, bins, y, weights, node_id, level, feat_mask, cfg):
     """Grow one level of one tree: returns (split_feat, split_bin,
     new_node_id) for the 2^level nodes of this level.
 
-    bins: [n, f] int32; y_onehot: [n, C]; weights: [n] bootstrap weights;
+    BO: [n, f*B] int8 precomputed bin one-hots (see :func:`bins_onehot`);
+    y: [n] int32 labels; weights: [n] bootstrap weights (small ints);
     node_id: [n] current node of each sample (within this level's frame);
     feat_mask: [f] 0/1 feature subsample for this tree.
+
+    The full histogram[node, f, bin, class] is ONE int8 matmul: the lhs
+    one-hot folds (node, class, weight) into a single [n, nodeC] int8
+    matrix (Poisson(1) weights are tiny ints, exact in int8; counts
+    accumulate in int32, exact — asserted against a numpy scatter-add
+    histogram in tests/test_rf.py).  Compared to the previous per-feature
+    f32 outer-product formulation this removes the [n, B*C] transient per
+    (tree, level, feature), the fit's dominant HBM traffic by op-level
+    accounting (~205 GB/fit at the graded 200k×64 32-tree config vs ~9 GB
+    of BO reads).  TPU wall-clock pending: the relay was hung when this
+    landed (2026-07-30, see CLAUDE.md gotchas; prior formulation measured
+    7.07 trees/s on 2026-07-29, 1× v5e) — measure and record in BASELINE.md
+    at next relay availability.
     """
-    n, f = bins.shape
-    C_ = y_onehot.shape[1]
+    n = BO.shape[0]
+    C_ = cfg.n_classes
     B = cfg.n_bins
+    f = BO.shape[1] // B
     n_nodes = 2 ** level
 
-    # histogram[node, f, bin, class] via one-hot matmuls (MXU path), scanned
-    # over features so the transient is [n, B*C] per feature, never the
-    # [n, f, B] one-hot (which is GBs at bench scale)
-    node_oh = jax.nn.one_hot(node_id, n_nodes, dtype=jnp.float32) * weights[:, None]
-    wy = y_onehot  # weights folded into node_oh
-
-    def per_feature(bins_f):  # [n] → [n_nodes, B, C]
-        bo = jax.nn.one_hot(bins_f, B, dtype=jnp.float32)        # [n, B]
-        z = (bo[:, :, None] * wy[:, None, :]).reshape(n, B * C_)
-        return (node_oh.T @ z).reshape(n_nodes, B, C_)
-
-    hist = lax.map(per_feature, bins.T)            # [f, n_nodes, B, C]
-    hist = jnp.moveaxis(hist, 0, 1)                # [n_nodes, f, B, C]
+    nc = jax.nn.one_hot(node_id * C_ + y, n_nodes * C_, dtype=jnp.int8)
+    nc = nc * jnp.clip(weights, 0, 127).astype(jnp.int8)[:, None]
+    hist = lax.dot_general(
+        nc, BO, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                               # [node*C, f*B]
+    hist = hist.reshape(n_nodes, C_, f, B).transpose(0, 2, 3, 1)
+    hist = hist.astype(jnp.float32)                 # [n_nodes, f, B, C]
 
     # left counts for threshold "≤ bin b" = cumsum over bins (exclusive of
     # nothing: splitting at b sends bins ≤ b left)
@@ -136,7 +160,7 @@ def _leaf_stats(y_onehot, weights, node_id, n_leaves):
 def make_train_fn(mesh: WorkerMesh, cfg: RFConfig, n_features: int):
     """Compile per-worker forest training (trees_per_worker via vmap)."""
 
-    def train_one_tree(bins, y_onehot, key):
+    def train_one_tree(BO, bins, y, y_onehot, key):
         k1, k2 = jax.random.split(key)
         n = bins.shape[0]
         # bootstrap: Poisson(1) weights ≈ sampling with replacement
@@ -152,7 +176,7 @@ def make_train_fn(mesh: WorkerMesh, cfg: RFConfig, n_features: int):
         feats, bins_out = [], []
         for level in range(cfg.max_depth):
             sf, sb, node_id = _grow_level(
-                bins, y_onehot, weights, node_id, level, feat_mask, cfg
+                BO, bins, y, weights, node_id, level, feat_mask, cfg
             )
             feats.append(sf)
             bins_out.append(sb)
@@ -166,7 +190,9 @@ def make_train_fn(mesh: WorkerMesh, cfg: RFConfig, n_features: int):
 
     def train_shard(bins, y, keys):
         y_onehot = jax.nn.one_hot(y, cfg.n_classes, dtype=jnp.float32)
-        return jax.vmap(lambda k: train_one_tree(bins, y_onehot, k))(keys)
+        BO = bins_onehot(bins, cfg.n_bins)  # shared by all trees/levels
+        return jax.vmap(
+            lambda k: train_one_tree(BO, bins, y, y_onehot, k))(keys)
 
     def prog(bins, y, keys):
         feats, thresh, leaves = train_shard(bins, y, keys[0])
