@@ -235,6 +235,11 @@ def partition_ratings_tiles(users, items, vals, n_users, n_items, n_workers,
     starts = np.zeros(n_tiles, np.int64)
     starts[1:] = counts.cumsum()[:-1]
     e_next = np.zeros(n * ns, np.int64)
+    # Deliberately a per-entry loop: it copies CONTIGUOUS slices of the
+    # tile-sorted data (memcpy-speed, ~15k iterations at ML-20M).  A fully
+    # vectorized fancy-index formulation measured 2× SLOWER (12.6 s vs
+    # 6.3 s, 2026-07-30) — five 20M-element bounds-checked scatters beat
+    # no Python loop but lose to 15k memcpys.
     for t in np.nonzero(counts)[0]:
         ws = t // (ntu * nti)
         t_u = (t // nti) % ntu

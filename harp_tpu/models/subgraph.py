@@ -34,7 +34,6 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils.timing import device_sync
 
 
 # ---------------------------------------------------------------------------
@@ -78,12 +77,15 @@ _FN_CACHE: dict = {}
 
 
 def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
-    """Compile the color-coding DP: (nbr, msk, colors) → colorful rooted count.
+    """Compile the color-coding DP:
+    (nbr [n, deg], msk [n, deg], colors [trial_chunk, n]) → [trial_chunk]
+    colorful rooted counts — a chunk of trials per program (vmap over
+    colorings; the driver chunks, see SubgraphConfig.trial_chunk).
 
     Counts maps φ: template→graph with all image colors distinct (hence
     injective), rooted at template vertex 0 — the quantity Harp's DP
     levels accumulate before unbiasing.  Compiled fns are cached per
-    (template, colors, mesh).
+    (template, colors, mesh); jit re-specializes per trials count.
     """
     # key on the underlying jax Mesh (hashable, identity-stable), not the
     # WorkerMesh wrapper, whose id could be reused after collection
@@ -101,7 +103,7 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
         g = jnp.take(full_counts, nbr, axis=0)      # [n_loc, deg, S]
         return (g * msk[:, :, None]).sum(1)
 
-    def prog(nbr, msk, colors_shard):
+    def one_trial(nbr, msk, colors_shard):
         base = jnp.zeros((colors_shard.shape[0], n_subsets), jnp.float32)
         singleton = base.at[
             jnp.arange(colors_shard.shape[0]), 1 << colors_shard
@@ -130,10 +132,21 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
         else:
             full_cols = [m for m in range(n_subsets) if bin(m).count("1") == s]
             rooted = tables[0][:, jnp.asarray(full_cols)].sum(-1)
-        return C.allreduce(rooted.sum())
+        return rooted.sum()
+
+    def prog(nbr, msk, colors_shard):
+        # colors_shard [trial_chunk, n_loc]: a chunk of trials per program —
+        # each dispatch+readback round trip costs ~20–150 ms (1× v5e relay,
+        # 2026-07-30, BASELINE.md row 4), so a per-trial host loop would
+        # dominate multi-trial estimates; chunking (not all-trials-vmap)
+        # bounds the [chunk, n, 2^k] DP tables' HBM footprint
+        rooted = jax.vmap(lambda cs: one_trial(nbr, msk, cs))(colors_shard)
+        return C.allreduce(rooted)  # [trial_chunk], replicated
 
     fn = jax.jit(mesh.shard_map(
-        prog, in_specs=(mesh.spec(0),) * 3, out_specs=P()
+        prog,
+        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(1)),
+        out_specs=P(),
     ))
     _FN_CACHE[cache_key] = fn
     return fn
@@ -144,6 +157,11 @@ class SubgraphConfig:
     template: str = "u5-tree"
     n_colors: int = 0        # 0 → template size (standard color-coding)
     n_trials: int = 1        # average over colorings (variance reduction)
+    # trials per device program: chunking bounds the DP tables' HBM use at
+    # [trial_chunk, n, 2^k] floats while still amortizing the per-dispatch
+    # round trip over a chunk (vmapping ALL trials would OOM large graphs
+    # at high n_trials)
+    trial_chunk: int = 8
     max_degree: int = 64     # padded-CSR width
     seed: int = 0
 
@@ -224,15 +242,16 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
     fn = make_colorful_count_fn(tpl, k, mesh)
 
     rng = np.random.default_rng(cfg.seed)
-    estimates = []
     p_colorful = math.factorial(s) / (s ** s) if k == s else (
         math.factorial(k) / (math.factorial(k - s) * k ** s))
     n_auto = _count_automorphism_roots(tpl)
-    for _ in range(cfg.n_trials):
-        colors = rng.integers(0, k, n_pad).astype(np.int32)
-        out = fn(nbr_d, msk_d, mesh.shard_array(colors, 0))
-        colorful_rooted = float(device_sync(out))
-        estimates.append(colorful_rooted / p_colorful / n_auto)
+    chunk = max(1, min(cfg.n_trials, cfg.trial_chunk))
+    t_pad = -(-cfg.n_trials // chunk) * chunk  # equal chunks: one compile
+    colors = rng.integers(0, k, (t_pad, n_pad)).astype(np.int32)
+    outs = [fn(nbr_d, msk_d, mesh.shard_array(colors[lo:lo + chunk], 1))
+            for lo in range(0, t_pad, chunk)]  # async; ONE readback below
+    rooted = np.asarray(jnp.concatenate(outs))[: cfg.n_trials]
+    estimates = [float(r) / p_colorful / n_auto for r in rooted]
     return float(np.mean(estimates)), estimates, dropped
 
 

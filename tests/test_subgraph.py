@@ -57,8 +57,8 @@ def test_dp_matches_brute_force_colorful(mesh, tname):
     fn = SG.make_colorful_count_fn(tpl, s, mesh)
     out = float(np.asarray(fn(
         mesh.shard_array(nbr, 0), mesh.shard_array(msk, 0),
-        mesh.shard_array(colors, 0),
-    )))
+        mesh.shard_array(colors[None, :], 1),   # [trials=1, n]
+    ))[0])
     expect = brute_force_rooted_colorful(TINY_EDGES, TINY_N, tpl, colors)
     assert out == expect, (tname, out, expect)
 
