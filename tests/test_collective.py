@@ -276,3 +276,75 @@ def test_rotate_pipeline_rejects_partial_coverage_shift(mesh):
 
     with pytest.raises(ValueError, match="shares a factor"):
         run_spmd(mesh, prog, np.zeros((N, 1), np.float32))
+
+
+def test_allreduce_quantized_bf16(mesh):
+    x = np.linspace(-3, 3, N * 8, dtype=np.float32).reshape(N, 8)
+    out = run_spmd(mesh, lambda v: C.allreduce_quantized(v), x, out_dim=None)
+    np.testing.assert_allclose(np.asarray(out)[0], x.sum(0), rtol=2e-2, atol=1e-2)
+    assert np.asarray(out).dtype == np.float32
+
+
+def test_allreduce_quantized_int8(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, 64)).astype(np.float32)
+    out = run_spmd(
+        mesh, lambda v: C.allreduce_quantized(v, wire_dtype=jnp.int8),
+        x, out_dim=None)
+    ref = x.sum(0)
+    # per-worker error ≤ scale/2 with scale = max|x|/127; N workers add up
+    tol = N * np.abs(x).max() / 127.0 / 2 + 1e-6
+    assert np.abs(np.asarray(out)[0] - ref).max() <= tol
+
+
+def test_allreduce_quantized_int_leaves_exact(mesh):
+    x = np.arange(N * 4, dtype=np.int32).reshape(N, 4)
+    out = run_spmd(mesh, lambda v: C.allreduce_quantized(v), x, out_dim=None)
+    np.testing.assert_array_equal(np.asarray(out)[0], x.sum(0))
+
+
+def test_allreduce_quantized_rejects_unknown_wire(mesh):
+    import jax.numpy as jnp
+
+    x = np.ones((N, 4), np.float32)
+    with pytest.raises(ValueError, match="wire_dtype"):
+        run_spmd(mesh, lambda v: C.allreduce_quantized(v, wire_dtype=jnp.float16),
+                 x, out_dim=None)
+
+
+def test_allreduce_quantized_bool_stays_bool(mesh):
+    import jax.numpy as jnp
+
+    tree = {"g": np.ones((N, 8), np.float32),
+            "flag": np.zeros((N, 1), bool)}
+    tree["flag"][2] = True
+    out = run_spmd(
+        mesh, lambda t: C.allreduce_quantized(t, wire_dtype=jnp.int8),
+        tree, out_dim=None)
+    assert np.asarray(out["flag"]).dtype == np.bool_
+    assert bool(np.asarray(out["flag"])[0, 0])  # ADD on bool == any
+
+
+def test_allreduce_quantized_int8_one_pmax_for_tree(mesh):
+    """All leaves' scales ride a single fused pmax collective."""
+    import jax
+    import jax.numpy as jnp
+
+    tree = {chr(97 + i): np.ones((N, 4), np.float32) * (i + 1)
+            for i in range(6)}
+    fn = jax.jit(mesh.shard_map(
+        lambda t: C.allreduce_quantized(t, wire_dtype=jnp.int8),
+        in_specs=(jax.tree.map(lambda _: mesh.spec(0), tree),),
+        out_specs=jax.tree.map(lambda _: P(), tree)))
+    txt = fn.lower(tree).compile().as_text()
+    # count all-reduce ops with MAX reductions: must be 1, not 6
+    n_max_ar = sum(1 for line in txt.splitlines()
+                   if "all-reduce" in line and "max" in line.lower()
+                   and "=" in line)
+    assert n_max_ar <= 1, n_max_ar
+    out = fn(tree)
+    for i, k in enumerate(sorted(tree)):
+        np.testing.assert_allclose(np.asarray(out[k]),
+                                   np.full((1, 4), N * (i + 1.0)), rtol=0.02)

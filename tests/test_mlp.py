@@ -159,3 +159,21 @@ def test_fit_resident_sequential_calls_keep_reshuffling(mesh):
     # counter actually changed the key path
     assert tr._shuffle_counter == 4 and tr2._shuffle_counter == 2
     assert h2 != h1
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_quantized_grad_wire_trains(mesh, wire):
+    """Quantized gradient allreduce converges close to the exact wire."""
+    x, y = M.synthetic_mnist(n=512, d=16, classes=4, seed=1)
+    finals = {}
+    for gw in ("f32", wire):
+        cfg = M.MLPConfig(sizes=(16, 32, 4), lr=0.1, grad_wire=gw)
+        tr = M.MLPTrainer(cfg, mesh, seed=0)
+        tr.load_resident(x, y, batch_size=64)
+        finals[gw] = tr.fit_resident(epochs=8)[-1][0]
+    assert finals[wire] < 1.5 * finals["f32"] + 0.05, finals
+
+
+def test_bad_grad_wire_raises(mesh):
+    with pytest.raises(ValueError, match="grad_wire"):
+        M.MLPTrainer(M.MLPConfig(sizes=(16, 32, 4), grad_wire="fp4"), mesh)
