@@ -275,6 +275,55 @@ class MLPTrainer:
         stats = np.asarray(jnp.stack([losses, accs], axis=1))  # one readback
         return [(float(l), float(a)) for l, a in stats]
 
+    def fit_ckpt(self, x, y, epochs, ckpt_dir=None, *, batch_size=8192,
+                 ckpt_every=5, max_restarts=3, fault=None, seed=0):
+        """Epoch training with checkpoint/resume — the same recovery
+        contract as MF-SGD/LDA ``fit()`` (SURVEY.md §6: restart-from-entry
+        before the first checkpoint, resume installs restored state, fault
+        without ckpt_dir refused).  One epoch = one resident device program
+        (:meth:`fit_resident`); params AND optimizer state checkpoint, so a
+        resumed adam/momentum run continues the same trajectory.  Returns
+        [(last_loss, last_acc)] for the epochs this call ran.
+        """
+        from harp_tpu.utils.fault import fit_epochs
+
+        self.load_resident(x, y, batch_size=batch_size, seed=seed)
+        history: list = []
+
+        def set_state(state):
+            got = [np.shape(v) for v in jax.tree.leaves(state["params"])]
+            want = [np.shape(v) for v in jax.tree.leaves(self.params)]
+            if got != want:
+                raise ValueError(
+                    f"checkpoint param shapes {got} do not match this "
+                    f"model's {want} — was the checkpoint written with a "
+                    "different MLPConfig.sizes? (refusing to resume)")
+            if not isinstance(jax.tree.leaves(state["params"])[0], jax.Array):
+                # a checkpoint restore yields plain containers; rebuild on
+                # the LIVE treedefs so optax's named-tuple states survive
+                def put_like(template, restored):
+                    leaves = [np.asarray(v) for v in jax.tree.leaves(restored)]
+                    return jax.device_put(
+                        jax.tree.unflatten(jax.tree.structure(template), leaves),
+                        self.mesh.replicated())
+
+                self.params = put_like(self.params, state["params"])
+                self.opt_state = put_like(self.opt_state, state["opt_state"])
+            else:
+                self.params = state["params"]
+                self.opt_state = state["opt_state"]
+            self._shuffle_counter = int(np.asarray(state["shuffle"]))
+
+        fit_epochs(
+            lambda: history.append(self.fit_resident(epochs=1, seed=seed)[0]),
+            lambda: {"params": self.params, "opt_state": self.opt_state,
+                     "shuffle": np.int64(self._shuffle_counter)},
+            set_state,
+            epochs, ckpt_dir, ckpt_every=ckpt_every,
+            max_restarts=max_restarts, fault=fault,
+        )
+        return history
+
     def fit(self, x, y, batch_size=8192, epochs=1, shuffle_seed=0):
         n = x.shape[0]
         nw = self.mesh.num_workers
@@ -316,6 +365,12 @@ class TPMLPTrainer:
         from harp_tpu.parallel.mesh import mesh_2d
 
         self.cfg = cfg or MLPConfig()
+        if self.cfg.grad_wire != "f32":
+            raise ValueError(
+                f"grad_wire={self.cfg.grad_wire!r} is DP-only: under GSPMD "
+                "XLA inserts the TP collectives from sharding annotations, "
+                "so there is no explicit allreduce to quantize — use "
+                "MLPTrainer for a quantized gradient wire")
         if mesh is None:
             # largest model axis that divides every SHARDED layer dim (the
             # output dim of even layers, input dim of odd ones) AND the

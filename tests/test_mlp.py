@@ -177,3 +177,18 @@ def test_quantized_grad_wire_trains(mesh, wire):
 def test_bad_grad_wire_raises(mesh):
     with pytest.raises(ValueError, match="grad_wire"):
         M.MLPTrainer(M.MLPConfig(sizes=(16, 32, 4), grad_wire="fp4"), mesh)
+
+
+def test_tp_rejects_grad_wire(mesh):
+    with pytest.raises(ValueError, match="DP-only"):
+        M.TPMLPTrainer(M.MLPConfig(sizes=(16, 32, 4), grad_wire="int8"))
+
+
+def test_fit_ckpt_rejects_mismatched_sizes(mesh, tmp_path):
+    x, y = M.synthetic_mnist(n=128, d=16, classes=4, seed=0)
+    ck = str(tmp_path / "m")
+    M.MLPTrainer(M.MLPConfig(sizes=(16, 64, 4)), mesh, seed=0).fit_ckpt(
+        x, y, 2, ck, batch_size=32, ckpt_every=1)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        M.MLPTrainer(M.MLPConfig(sizes=(16, 32, 4)), mesh, seed=0).fit_ckpt(
+            x, y, 4, ck, batch_size=32, ckpt_every=1)
