@@ -46,4 +46,19 @@ u = kv_allreduce(t)
 assert u.keys() == [0, 1, 100], u.keys()
 assert int(u.get(100)) == 3, u.get(100)
 
+# a full dense MF-SGD rotation epoch spanning the process boundary: the
+# ring ppermute of H half-slices and the loss allreduce both cross DCN
+# (Gloo stand-in); every process feeds identical global inputs and reads
+# back the replicated RMSE
+from harp_tpu.models import mfsgd as MF
+
+u_ids, i_ids, vals = MF.synthetic_ratings(32, 24, 400, rank=3, seed=0)
+model = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, u_tile=8, i_tile=8,
+                                        entry_cap=32, lr=0.05),
+                 mesh, seed=0)
+model.set_ratings(u_ids, i_ids, vals)
+r1 = model.train_epoch()
+rs = model.train_epochs(3)
+assert np.isfinite(r1) and rs[-1] < r1, (r1, rs)
+
 print(f"proc {proc_id}: MULTIPROC OK", flush=True)
