@@ -152,8 +152,8 @@ def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
     return Ndk, Nwk, dNk, z_new
 
 
-def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
-    """One full rotation epoch: every token resampled once.
+def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+    """Device-view epoch body: every token resampled once.
 
     Pipelined half-slice schedule identical to MF-SGD's (see
     harp_tpu.models.mfsgd.make_epoch_fn): compute on one word-slice half
@@ -233,12 +233,55 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
         Nwk_slice = jnp.concatenate([computing, inflight], axis=0)
         return Ndk, Nwk_slice, Nk, z_grid
 
-    n_tok_args = 5 if dense else 4  # (+ keys)
+    return epoch
+
+
+def _n_token_args(cfg: LDAConfig) -> int:
+    return 5 if cfg.algo == "dense" else 4  # (+ keys)
+
+
+def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+    """Compile one rotation epoch — see :func:`_epoch_device_fn`."""
     return jax.jit(
         mesh.shard_map(
-            epoch,
+            _epoch_device_fn(mesh, cfg, vocab_size),
             in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
-            + (mesh.spec(0),) * n_tok_args,
+            + (mesh.spec(0),) * _n_token_args(cfg),
+            out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
+        )
+    )
+
+
+def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
+                        epochs: int):
+    """Compile ``epochs`` Gibbs sweeps as ONE device program.
+
+    Same dispatch-amortization as mfsgd.make_multi_epoch_fn (round trips
+    cost ~20–150 ms on the relay-attached v5e, 2026-07-30).  Each sweep's
+    RNG key is derived on device by folding the epoch index into the
+    worker's base key, so the chain is identical to per-epoch dispatches
+    with the same derivation.
+    """
+    inner = _epoch_device_fn(mesh, cfg, vocab_size)
+
+    def many(Ndk, Nwk_slice, Nk, z_grid, *token_args):
+        tokens = token_args[:-1]
+        base = jax.random.wrap_key_data(token_args[-1][0])
+
+        def body(carry, e):
+            Ndk, Nwk_slice, Nk, z_grid = carry
+            k = jax.random.key_data(jax.random.fold_in(base, e))[None]
+            return inner(Ndk, Nwk_slice, Nk, z_grid, *tokens, k), None
+
+        (Ndk, Nwk_slice, Nk, z_grid), _ = lax.scan(
+            body, (Ndk, Nwk_slice, Nk, z_grid), jnp.arange(epochs))
+        return Ndk, Nwk_slice, Nk, z_grid
+
+    return jax.jit(
+        mesh.shard_map(
+            many,
+            in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
+            + (mesh.spec(0),) * _n_token_args(cfg),
             out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
         )
     )
@@ -262,6 +305,7 @@ class LDA:
             self.w_bound = 2 * (-(-vocab_size // (2 * n)))
             self.w_own = self.w_bound // 2
         self._epoch_fn = make_epoch_fn(self.mesh, self.cfg, vocab_size)
+        self._multi_fns: dict = {}
         self._seed = seed
         self._tokens = None
 
@@ -305,6 +349,7 @@ class LDA:
         self.Nk = jax.device_put(jnp.asarray(Nk), self.mesh.replicated())
         self.z_grid = sh(z_grid, 0)
         self._tokens = tuple(sh(a, 0) for a in tokens)
+        self._multi_fns.clear()  # compiled programs bind to token shapes
         self.n_tokens = int(gm.sum())
         self._keys = np.asarray(
             jax.random.split(jax.random.PRNGKey(self._seed), n)
@@ -355,6 +400,35 @@ class LDA:
             Nwk = Nwk.reshape(2 * n, wb2, K)[:, : self.w_own].reshape(-1, K)
         return Nwk[: self.vocab_size]
 
+    def compile_epochs(self, epochs: int):
+        """AOT-compile the ``epochs``-sweep program WITHOUT sampling —
+        benchmark warmup must not double the workload (same contract as
+        :meth:`harp_tpu.models.mfsgd.MFSGD.compile_epochs`).  The compiled
+        executable is cached and reused by :meth:`sample_epochs`."""
+        if self._tokens is None:
+            raise RuntimeError("call set_tokens() before compile_epochs()")
+        fn = self._multi_fns.get(epochs)
+        if fn is None:
+            jitted = make_multi_epoch_fn(
+                self.mesh, self.cfg, self.vocab_size, epochs)
+            keys = self.mesh.shard_array(self._keys, 0)
+            fn = self._multi_fns[epochs] = jitted.lower(
+                self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
+                keys).compile()
+        return fn
+
+    def sample_epochs(self, epochs: int):
+        """Run ``epochs`` Gibbs sweeps as one device program (one dispatch,
+        one sync) — see :func:`make_multi_epoch_fn`.  Use :meth:`fit` when
+        checkpointing between sweeps."""
+        fn = self.compile_epochs(epochs)
+        keys = self.mesh.shard_array(self._keys, 0)
+        self.Ndk, self.Nwk, self.Nk, self.z_grid = fn(
+            self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
+        )
+        self._advance_keys()
+        device_sync(self.Nk)
+
     def sample_epoch(self):
         if self._tokens is None:
             raise RuntimeError("call set_tokens() before sample_epoch()")
@@ -362,11 +436,16 @@ class LDA:
         self.Ndk, self.Nwk, self.Nk, self.z_grid = self._epoch_fn(
             self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
         )
+        self._advance_keys()
+        device_sync(self.Nk)
+
+    def _advance_keys(self):
+        # PRNGKey(python_int) specializes on the int — a remote compile per
+        # distinct seed (CLAUDE.md) — so derive the next base seed on host
         self._keys = np.asarray(
             jax.random.split(jax.random.PRNGKey(int(self._keys[0][0]) ^ 0x9E37),
                              self.mesh.num_workers)
         )
-        device_sync(self.Nk)
 
     def fit(self, epochs: int, ckpt_dir: str | None = None, *,
             ckpt_every: int = 5, max_restarts: int = 3, fault=None):
@@ -475,10 +554,10 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
     model.set_tokens(d_ids, w_ids)
     prep = time.perf_counter() - t0
 
-    model.sample_epoch()  # warmup + compile
+    model.sample_epoch()         # warmup + single-epoch compile
+    model.compile_epochs(epochs)  # AOT, off-clock, does NOT sample
     t0 = time.perf_counter()
-    for _ in range(epochs):
-        model.sample_epoch()
+    model.sample_epochs(epochs)  # ONE dispatch + sync for all epochs
     dt = time.perf_counter() - t0
     return {
         "tokens_per_sec_per_chip": n_tok * epochs / dt / mesh.num_workers,

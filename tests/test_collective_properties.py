@@ -94,3 +94,17 @@ def test_regroup_is_block_transpose(mesh, data):
     blocks = data.reshape(N, N, 4)            # [src, dst, payload]
     ref = blocks.transpose(1, 0, 2).reshape(N * N, 4)
     np.testing.assert_array_equal(out, ref)
+
+
+@settings(max_examples=15, deadline=None)
+@given(data=data_st)
+def test_allreduce_quantized_int8_error_bound(mesh, data):
+    """int8 wire: |result − exact| ≤ N·scale/2 with scale = global_max/127
+    (each worker rounds once; int32 accumulation adds nothing)."""
+    import jax.numpy as jnp
+
+    out = np.asarray(_host(mesh, C.allreduce_quantized, None,
+                           wire_dtype=jnp.int8)(data))
+    ref = data.sum(0)
+    tol = N * np.abs(data).max() / 127.0 / 2 + 1e-6
+    assert np.abs(out - ref).max() <= tol

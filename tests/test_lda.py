@@ -86,3 +86,13 @@ def test_resume_rejects_mismatched_checkpoint_shapes(mesh, tmp_path):
     m2.set_tokens(d, w)
     with pytest.raises(ValueError, match="checkpoint shapes"):
         m2.fit(2, ckpt, ckpt_every=1)
+
+
+def test_sample_epochs_matches_convergence_contract(small_model):
+    """Multi-epoch single-dispatch sampling keeps the count invariants and
+    improves likelihood like per-epoch dispatches."""
+    model, _, _ = small_model
+    ll0 = model.log_likelihood()
+    model.sample_epochs(6)
+    counts_consistent(model)
+    assert model.log_likelihood() > ll0
