@@ -344,10 +344,46 @@ def main(argv=None):
     p.add_argument("--n", type=int, default=100_000)
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
+                   help="CSV shards (the Harp daal apps' HDFS input) instead "
+                        "of synthetic data; for naive/linreg/ridge the LAST "
+                        "column is the label/target, for als rows are "
+                        "'user item rating' triples")
     args = p.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
-    x = rng.normal(size=(args.n, args.d)).astype(np.float32)
+    y_file = None
+    if args.input and args.algo == "als":
+        from harp_tpu.native.datasource import load_triples_glob
+
+        try:
+            u_in, i_in, v_in, has_vals = load_triples_glob(args.input)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if not has_vals:
+            raise SystemExit(f"{args.input}: als needs 'user item rating' rows")
+        if int(u_in.min()) < 0 or int(i_in.min()) < 0:
+            raise SystemExit(
+                f"{args.input}: negative user/item ids (ids index factor "
+                "rows; JAX would silently wrap them to wrong rows)")
+        x = None
+    elif args.input:
+        from harp_tpu.native.datasource import load_csv_glob
+
+        try:
+            x = load_csv_glob(args.input)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if x.ndim != 2 or x.shape[1] < 1:
+            raise SystemExit(f"{args.input}: need a 2-D CSV matrix")
+        if args.algo in ("naive", "linreg", "ridge"):
+            if x.shape[1] < 2:
+                raise SystemExit(
+                    f"{args.input}: {args.algo} needs >= 2 columns "
+                    "(features..., label)")
+            y_file, x = x[:, -1], x[:, :-1].copy()
+    else:
+        x = rng.normal(size=(args.n, args.d)).astype(np.float32)
     if args.algo == "pca":
         _, evals = pca(x)
         print({"algo": "pca", "top5_evals": np.asarray(evals)[:5].tolist()})
@@ -360,17 +396,37 @@ def main(argv=None):
                "mean_norm": float(np.linalg.norm(np.asarray(m["mean"]))),
                "var_mean": float(np.mean(np.asarray(m["variance"])))})
     elif args.algo == "naive":
-        y = rng.integers(0, 4, args.n)
-        model = naive_bayes_fit(np.abs(x), y, n_classes=4)
+        if y_file is not None:
+            if not np.all(y_file == np.round(y_file)):
+                raise SystemExit(
+                    "naive: labels (last column) must be integers — "
+                    "fractional values would silently truncate to wrong "
+                    "classes")
+            y = y_file.astype(np.int64)
+            if y.min() < 0:
+                raise SystemExit("naive: labels (last column) must be >= 0")
+            n_classes = int(y.max()) + 1
+            if n_classes > 10_000:
+                raise SystemExit(
+                    f"naive: {n_classes} classes from the label column — "
+                    "is this a regression target? (refusing to allocate "
+                    "count tables that size)")
+        else:
+            y, n_classes = rng.integers(0, 4, args.n), 4
+        model = naive_bayes_fit(np.abs(x), y, n_classes=n_classes)
         acc = float((naive_bayes_predict(model, np.abs(x)) == y).mean())
         print({"algo": "naive_bayes", "train_acc": acc})
     elif args.algo in ("linreg", "ridge"):
-        w_true = rng.normal(size=args.d).astype(np.float32)
-        y = x @ w_true + 0.01 * rng.normal(size=args.n).astype(np.float32)
+        if y_file is not None:
+            y = y_file
+        else:
+            w_true = rng.normal(size=x.shape[1]).astype(np.float32)
+            y = x @ w_true + 0.01 * rng.normal(size=len(x)).astype(np.float32)
         fit = linear_regression if args.algo == "linreg" else ridge_regression
         coef, _intercept = fit(x, y)
-        err = float(np.linalg.norm(np.asarray(coef) - w_true))
-        print({"algo": args.algo, "coef_err": err})
+        pred = x @ np.asarray(coef) + float(np.asarray(_intercept))
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        print({"algo": args.algo, "fit_rmse": rmse})
     elif args.algo == "qr":
         q, r = tsqr(x)
         resid = float(np.linalg.norm(np.asarray(q) @ np.asarray(r) - x) /
@@ -380,11 +436,16 @@ def main(argv=None):
         u, s, vt = svd(x)
         print({"algo": "svd", "top5_sv": np.asarray(s)[:5].tolist()})
     elif args.algo == "als":
-        nnz = min(args.n, 200_000)
-        users = rng.integers(0, 1000, nnz).astype(np.int32)
-        items = rng.integers(0, 500, nnz).astype(np.int32)
-        vals = rng.normal(size=nnz).astype(np.float32)
-        _, _, hist = als(users, items, vals, 1000, 500, rank=8, iters=3)
+        if args.input:
+            users, items, vals = u_in, i_in, v_in
+            nu, ni = int(users.max()) + 1, int(items.max()) + 1
+        else:
+            nnz = min(args.n, 200_000)
+            users = rng.integers(0, 1000, nnz).astype(np.int32)
+            items = rng.integers(0, 500, nnz).astype(np.int32)
+            vals = rng.normal(size=nnz).astype(np.float32)
+            nu, ni = 1000, 500
+        _, _, hist = als(users, items, vals, nu, ni, rank=8, iters=3)
         print({"algo": "als", "rmse_history": [round(h, 4) for h in hist]})
 
 

@@ -254,3 +254,69 @@ def test_dispatch_bench_smoke(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "allreduce" in out
+
+
+def test_stats_file_inputs(capsys, tmp_path):
+    """The daal_* stats launchers consume CSV/triple files like HDFS paths."""
+    import numpy as np
+
+    from harp_tpu.models import stats
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 5)).astype(np.float32)
+    np.savetxt(tmp_path / "m.csv", x, delimiter=",")
+    stats.main(["pca", "--input", str(tmp_path / "m.csv")])
+    assert "top5_evals" in capsys.readouterr().out
+
+    # supervised: last column is the target
+    w = rng.normal(size=4).astype(np.float32)
+    xy = np.concatenate([x[:, :4], (x[:, :4] @ w)[:, None]], 1)
+    np.savetxt(tmp_path / "xy.csv", xy, delimiter=",")
+    stats.main(["linreg", "--input", str(tmp_path / "xy.csv")])
+    out = capsys.readouterr().out
+    assert "fit_rmse" in out
+    assert float(out.split("'fit_rmse': ")[1].split("}")[0]) < 1e-2
+
+    # naive bayes with integer labels in the last column
+    labels = rng.integers(0, 3, 64).astype(np.float32)
+    nb = np.concatenate([np.abs(x[:, :4]), labels[:, None]], 1)
+    np.savetxt(tmp_path / "nb.csv", nb, delimiter=",")
+    stats.main(["naive", "--input", str(tmp_path / "nb.csv")])
+    assert "train_acc" in capsys.readouterr().out
+
+    # als reads rating triples
+    (tmp_path / "r.txt").write_text(
+        "\n".join(f"{rng.integers(0, 12)} {rng.integers(0, 8)} "
+                  f"{rng.normal():.3f}" for _ in range(200)) + "\n")
+    stats.main(["als", "--input", str(tmp_path / "r.txt")])
+    assert "rmse_history" in capsys.readouterr().out
+
+    # single-column file for a supervised algo is refused
+    np.savetxt(tmp_path / "one.csv", x[:, :1], delimiter=",")
+    import pytest
+
+    with pytest.raises(SystemExit, match=">= 2 columns"):
+        stats.main(["ridge", "--input", str(tmp_path / "one.csv")])
+
+
+def test_stats_file_inputs_validation(tmp_path):
+    import numpy as np
+    import pytest
+
+    from harp_tpu.models import stats
+
+    (tmp_path / "neg.txt").write_text("-1 2 3.0\n0 1 1.0\n")
+    with pytest.raises(SystemExit, match="negative user/item ids"):
+        stats.main(["als", "--input", str(tmp_path / "neg.txt")])
+
+    rng = np.random.default_rng(0)
+    x = np.abs(rng.normal(size=(32, 3))).astype(np.float32)
+    frac = np.concatenate([x, rng.normal(size=(32, 1)).astype(np.float32)], 1)
+    np.savetxt(tmp_path / "frac.csv", frac, delimiter=",")
+    with pytest.raises(SystemExit, match="must be integers"):
+        stats.main(["naive", "--input", str(tmp_path / "frac.csv")])
+
+    big = np.concatenate([x, np.full((32, 1), 1e6, np.float32)], 1)
+    np.savetxt(tmp_path / "big.csv", big, delimiter=",")
+    with pytest.raises(SystemExit, match="regression target"):
+        stats.main(["naive", "--input", str(tmp_path / "big.csv")])
