@@ -32,6 +32,11 @@ VERBS = {
     "rotate": (C.rotate, {}, 0, lambda nw: 1.0),
     "push": (C.push, {}, 0, lambda nw: 1.0),
     "pull": (C.pull, {}, None, lambda nw: 1.0),
+    # quantized wires move half/quarter the bytes of allreduce's f32 wire
+    "allreduce_bf16": (C.allreduce_quantized, {"wire_dtype": jnp.bfloat16},
+                       None, lambda nw: 1.0),
+    "allreduce_int8": (C.allreduce_quantized, {"wire_dtype": jnp.int8},
+                       None, lambda nw: 0.5),
 }
 
 

@@ -193,3 +193,17 @@ def test_hang_watchdog_stale_fire_is_noop():
     assert fired == [] and exits == []
     wd._fire("a", wd._gen)    # current generation still fires
     assert fired == ["a"] and exits == [3]
+
+
+def test_example_mfsgd_app_runs():
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "mfsgd_app.py"),
+         "--cpu8", "--users", "64", "--items", "48", "--nnz", "600",
+         "--rank", "4", "--epochs", "4"],
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "rmse_final" in out.stdout

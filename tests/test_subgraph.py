@@ -84,3 +84,15 @@ def test_degree_truncation_reported():
     edges = [(0, i) for i in range(1, 7)]
     _, _, dropped = SG.pad_csr(edges, 7, 4)
     assert dropped == 2  # vertex 0 has degree 6, cap 4
+
+
+def test_u7_tree_runs_and_estimates(mesh):
+    """The deepest template (u7-tree, 2^7 subset columns) runs end-to-end
+    with batched trials and returns a sane nonnegative estimate."""
+    rng = np.random.default_rng(3)
+    n = 48
+    edges = np.stack([rng.integers(0, n, 300), rng.integers(0, n, 300)], 1)
+    est, trials, dropped = SG.count_template(
+        edges, n, SG.SubgraphConfig(template="u7-tree", n_trials=3,
+                                    trial_chunk=2, max_degree=24), mesh)
+    assert len(trials) == 3 and np.isfinite(est) and est >= 0
