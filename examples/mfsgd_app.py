@@ -28,6 +28,8 @@ def main():
     p.add_argument("--rank", type=int, default=16)
     p.add_argument("--epochs", type=int, default=10)
     args = p.parse_args()
+    if args.epochs < 1:
+        p.error("--epochs must be >= 1")
 
     if args.cpu8:
         os.environ["XLA_FLAGS"] = (

@@ -41,7 +41,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
-from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, num_workers, worker_id
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.parallel.rotate import resident_half_index
 from harp_tpu.models.mfsgd import (
     _dense_bounds,
     algo_kwargs,
@@ -173,11 +174,7 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
         def body(carry, t):
             Ndk, computing, inflight, Nk, z_grid, key = carry
             received = C.rotate(inflight)  # overlaps with sampling below
-            half_idx = jnp.where(
-                t % 2 == 0,
-                2 * ((worker_id() - t // 2) % num_workers()),
-                2 * ((worker_id() - t // 2 - 1) % num_workers()) + 1,
-            )
+            half_idx = resident_half_index(t)
             blk = jax.tree.map(lambda a: a[half_idx], tokens)
             z_blk = z_grid[half_idx]
             key, sub = jax.random.split(key)
@@ -457,26 +454,16 @@ class LDA:
         The RNG keys are part of the checkpoint, so a recovered run samples
         the same chain it would have without the crash.
         """
-        from harp_tpu.utils.fault import fit_epochs
+        from harp_tpu.utils.fault import check_restored_shapes, fit_epochs
 
         def get_state():
             return {"Ndk": self.Ndk, "Nwk": self.Nwk, "Nk": self.Nk,
                     "z": self.z_grid, "keys": np.asarray(self._keys)}
 
         def set_state(state):
-            # np.shape only (no device→host transfer) — a checkpoint from a
-            # different algo/tile config must refuse to resume: dynamic
-            # slices would clamp and silently update wrong count rows
-            for name, cur in (("Ndk", self.Ndk), ("Nwk", self.Nwk),
-                              ("z", self.z_grid)):
-                got = tuple(np.shape(state[name]))
-                want = tuple(np.shape(cur))
-                if got != want:
-                    raise ValueError(
-                        f"checkpoint shapes {name}{got} do not match this "
-                        f"model's {name}{want} — was the checkpoint written "
-                        "with a different algo/tile config? (refusing to "
-                        "resume)")
+            check_restored_shapes([("Ndk", state["Ndk"], self.Ndk),
+                                   ("Nwk", state["Nwk"], self.Nwk),
+                                   ("z", state["z"], self.z_grid)])
             if not isinstance(state["Ndk"], jax.Array):  # numpy from restore
                 sh = self.mesh.shard_array
                 self.Ndk = sh(np.asarray(state["Ndk"]), 0)

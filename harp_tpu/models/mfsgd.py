@@ -48,7 +48,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
-from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, num_workers, worker_id
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.parallel.rotate import resident_half_index
 from harp_tpu.utils.timing import device_sync
 
 
@@ -375,11 +376,7 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
         def body(carry, t):
             W, computing, inflight, se, cnt = carry
             received = C.rotate(inflight)  # overlaps with the update below
-            half_idx = jnp.where(
-                t % 2 == 0,
-                2 * ((worker_id() - t // 2) % num_workers()),
-                2 * ((worker_id() - t // 2 - 1) % num_workers()) + 1,
-            )
+            half_idx = resident_half_index(t)
             block = jax.tree.map(lambda a: a[half_idx], blocks)
             W, computing, dse, dcnt = update(W, computing, block, cfg)
             return (W, received, computing, se + dse, cnt + dcnt), None
@@ -542,21 +539,13 @@ class MFSGD:
         upgraded.  Returns the per-epoch RMSE list for the epochs this call
         actually ran.
         """
-        from harp_tpu.utils.fault import fit_epochs
+        from harp_tpu.utils.fault import check_restored_shapes, fit_epochs
 
         rmses: list[float] = []
 
         def set_state(state):
-            # np.shape only — np.asarray would drag the full factors over
-            # the device→host link every epoch just to compare shapes
-            w, h = tuple(np.shape(state["W"])), tuple(np.shape(state["H"]))
-            if w != tuple(self.W.shape) or h != tuple(self.H.shape):
-                raise ValueError(
-                    f"checkpoint shapes W{w}/H{h} do not match this model's "
-                    f"W{tuple(self.W.shape)}/H{tuple(self.H.shape)} — was the "
-                    "checkpoint written with a different algo/tile config? "
-                    "(dynamic slices would clamp and silently train wrong "
-                    "rows; refusing to resume)")
+            check_restored_shapes([("W", state["W"], self.W),
+                                   ("H", state["H"], self.H)])
             if not isinstance(state["W"], jax.Array):  # numpy from restore
                 self.W = self.mesh.shard_array(np.asarray(state["W"]), 0)
                 self.H = self.mesh.shard_array(np.asarray(state["H"]), 0)

@@ -285,19 +285,13 @@ class MLPTrainer:
         resumed adam/momentum run continues the same trajectory.  Returns
         [(last_loss, last_acc)] for the epochs this call ran.
         """
-        from harp_tpu.utils.fault import fit_epochs
+        from harp_tpu.utils.fault import check_restored_shapes, fit_epochs
 
         self.load_resident(x, y, batch_size=batch_size, seed=seed)
         history: list = []
 
         def set_state(state):
-            got = [np.shape(v) for v in jax.tree.leaves(state["params"])]
-            want = [np.shape(v) for v in jax.tree.leaves(self.params)]
-            if got != want:
-                raise ValueError(
-                    f"checkpoint param shapes {got} do not match this "
-                    f"model's {want} — was the checkpoint written with a "
-                    "different MLPConfig.sizes? (refusing to resume)")
+            check_restored_shapes([("params", state["params"], self.params)])
             if not isinstance(jax.tree.leaves(state["params"])[0], jax.Array):
                 # a checkpoint restore yields plain containers; rebuild on
                 # the LIVE treedefs so optax's named-tuple states survive

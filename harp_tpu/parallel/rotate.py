@@ -94,6 +94,25 @@ def rotate_pipeline(
     return carry, model_slice
 
 
+def resident_half_index(t, *, axis: str = WORKER_AXIS):
+    """Half-slice resident on this worker at step ``t`` of the pipelined
+    two-halves-per-worker rotation (the schedule MF-SGD and LDA share).
+
+    With n workers and 2n half-slices alternating compute/in-flight roles,
+    step t computes half ``2*((w - t//2) % n)`` when t is even and
+    ``2*((w - t//2 - 1) % n) + 1`` when odd; after 2n steps both halves
+    are home and every (worker, half) pair met exactly once (see
+    mfsgd._epoch_device_fn for the derivation).
+    """
+    w = lax.axis_index(axis)
+    n = lax.axis_size(axis)
+    return jnp.where(
+        t % 2 == 0,
+        2 * ((w - t // 2) % n),
+        2 * ((w - t // 2 - 1) % n) + 1,
+    )
+
+
 def resident_slice_index(t, *, shift: int = 1, axis: str = WORKER_AXIS):
     """Global index of the slice resident on this worker at rotation step t.
 

@@ -15,7 +15,28 @@ import logging
 import time
 from typing import Any, Callable
 
+import jax
+import numpy as np
+
 log = logging.getLogger("harp_tpu")
+
+
+def check_restored_shapes(named_pairs) -> None:
+    """Refuse a checkpoint whose array shapes don't match the live model.
+
+    ``named_pairs``: iterable of ``(name, restored, live)`` pytrees.  A
+    mismatched restore would not fail loudly — dynamic slices clamp and
+    silently train wrong rows — so every model ``fit`` guards with this
+    before installing state (shape reads only; no device transfer).
+    """
+    for name, restored, live in named_pairs:
+        got = [np.shape(v) for v in jax.tree.leaves(restored)]
+        want = [np.shape(v) for v in jax.tree.leaves(live)]
+        if got != want:
+            raise ValueError(
+                f"checkpoint shapes {name}{got} do not match this model's "
+                f"{name}{want} — was the checkpoint written with a different "
+                "algo/tile/size config? (refusing to resume)")
 
 
 class FaultInjector:
