@@ -18,7 +18,10 @@ Trade-offs vs ring (why both exist):
   The local attention here is blockwise online-softmax (ring attention's
   recurrence over resident K/V blocks), so scores stay O(seq·block_k),
   not O(seq²); ``block_k=None`` falls back to one dense block.
-- a2a needs ``heads % n_workers == 0``; ring has no head constraint.
+- a2a needs ``heads % n_workers == 0`` — and under GQA also
+  ``kv_heads % n_workers == 0``, since the all_to_all reshards the KV
+  head dim (so MQA's single KV head only works single-worker); ring has
+  no head constraint and carries GQA/MQA at the small head count.
 """
 
 from __future__ import annotations
@@ -39,6 +42,7 @@ def _local_attention(q, k, v, scale, causal, block_k):
     blockwise over K/V with the online-softmax recurrence so the score
     tensor is [b, h, s, block_k], never [b, h, s, s]."""
     b, s, h, d = q.shape
+    hk = k.shape[2]  # may be < h under GQA
     bk = s if block_k is None else block_k
     if s % bk != 0:
         raise ValueError(f"block_k={bk} must divide the sequence length {s}")
@@ -46,8 +50,8 @@ def _local_attention(q, k, v, scale, causal, block_k):
     m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
     acc0 = jnp.zeros((b, s, h, d), jnp.float32)
-    kb = k.reshape(b, s // bk, bk, h, d).transpose(1, 0, 2, 3, 4)
-    vb = v.reshape(b, s // bk, bk, h, d).transpose(1, 0, 2, 3, 4)
+    kb = k.reshape(b, s // bk, bk, hk, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, s // bk, bk, hk, d).transpose(1, 0, 2, 3, 4)
 
     def body(carry, inp):
         m, l, acc = carry
@@ -73,10 +77,16 @@ def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
     """
     n = lax.axis_size(axis)
     b, nq, h, d = q.shape
+    g = k.shape[2]
     if h % n != 0:
         raise ValueError(
             f"a2a attention needs heads ({h}) divisible by workers ({n}); "
             "use ring_attention for head counts that don't divide")
+    if g != h and (h % g != 0 or g % n != 0):
+        raise ValueError(
+            f"a2a GQA needs KV heads ({g}) dividing query heads ({h}) AND "
+            f"divisible by workers ({n}) — the all_to_all reshards the KV "
+            "head dim too; use ring_attention otherwise")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
     # seq-sharded → head-sharded ([b, s/n, h, d] → [b, s, h/n, d]) is one

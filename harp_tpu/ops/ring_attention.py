@@ -28,12 +28,25 @@ from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
 def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
     """One online-softmax update of (m, l, acc) with a K/V block.
 
-    q: [B, nq, H, D]; k, v: [B, nk, H, D]; m, l: [B, H, nq]; acc like q.
+    q: [B, nq, H, D]; k, v: [B, nk, G, D] with ``H % G == 0`` (G < H is
+    grouped-query attention: each KV head serves ``H/G`` query heads —
+    K/V are stored, rotated, and resharded with G heads, the whole point
+    of GQA's memory/traffic saving; the head expansion happens only here,
+    inside the block compute, where XLA keeps it fused); m, l: [B, H, nq];
+    acc like q.
 
     Shared API: this is the flash-attention recurrence both sequence-parallel
     schemes build on — ring attention scans it over rotating K/V blocks,
     a2a attention (:mod:`harp_tpu.ops.a2a_attention`) over resident ones.
     """
+    h, g = q.shape[2], k.shape[2]
+    if h != g:
+        if h % g != 0:
+            raise ValueError(
+                f"query heads ({h}) must be a multiple of KV heads ({g}) "
+                "for grouped-query attention")
+        k = jnp.repeat(k, h // g, axis=2)
+        v = jnp.repeat(v, h // g, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -57,7 +70,9 @@ def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
     """Exact multi-head attention, sequence sharded (device view).
 
     Args (per-worker shards, call inside ``shard_map``):
-      q, k, v: [batch, seq_local, heads, head_dim]
+      q: [batch, seq_local, heads, head_dim]; k, v: same with ``kv_heads``
+      dividing ``heads`` (GQA/MQA — K/V travel the ring with the smaller
+      head count, so ring traffic shrinks by the group factor).
       causal: apply causal masking using *global* positions.
     Returns: [batch, seq_local, heads, head_dim] attention output.
     """

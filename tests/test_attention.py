@@ -70,3 +70,56 @@ def test_flash_rejects_ragged_blocks():
     q = jnp.zeros((1, 100, 16))
     with pytest.raises(AssertionError):
         flash_attention(q, q, q, block_q=32, block_k=32, interpret=True)
+
+
+def _gqa_ref(q, k, v, causal):
+    """Dense GQA reference: repeat KV heads up to H, fold heads, attend."""
+    b, n, h, d = q.shape
+    g = k.shape[2]
+    kf = np.repeat(k, h // g, axis=2)
+    vf = np.repeat(v, h // g, axis=2)
+    qf = jnp.asarray(q).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    kf = jnp.asarray(kf).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    vf = jnp.asarray(vf).transpose(0, 2, 1, 3).reshape(b * h, n, d)
+    ref = np.asarray(reference_attention(qf, kf, vf, causal=causal))
+    return ref.reshape(b, h, n, d).transpose(0, 2, 1, 3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("g", [1, 2])  # MQA and 2-group GQA
+def test_ring_attention_gqa(mesh, causal, g):
+    """K/V ride the ring with g heads; output == dense GQA reference."""
+    rng = np.random.default_rng(4)
+    b, n, h, d = 2, 64, 4, 16
+    q = rng.normal(size=(b, n, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, n, g, d)).astype(np.float32)
+    v = rng.normal(size=(b, n, g, d)).astype(np.float32)
+    out = np.asarray(make_ring_attention_fn(mesh, causal=causal)(q, k, v))
+    np.testing.assert_allclose(out, _gqa_ref(q, k, v, causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_a2a_attention_gqa(mesh, causal):
+    """Ulysses reshards the smaller KV head dim too (g=8 over 8 workers)."""
+    rng = np.random.default_rng(5)
+    b, n, h, d = 2, 64, 16, 8
+    q = rng.normal(size=(b, n, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, n, 8, d)).astype(np.float32)
+    v = rng.normal(size=(b, n, 8, d)).astype(np.float32)
+    out = np.asarray(make_a2a_attention_fn(mesh, causal=causal)(q, k, v))
+    np.testing.assert_allclose(out, _gqa_ref(q, k, v, causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_attention_gqa_rejects_bad_group(mesh):
+    rng = np.random.default_rng(6)
+    q = rng.normal(size=(1, 64, 4, 8)).astype(np.float32)
+    k = rng.normal(size=(1, 64, 3, 8)).astype(np.float32)  # 3 ∤ 4
+    with pytest.raises(ValueError, match="multiple of KV heads"):
+        make_ring_attention_fn(mesh)(q, k, k)
+    # a2a: g=2 divides h=16 but not the 8 workers
+    q2 = rng.normal(size=(1, 64, 16, 8)).astype(np.float32)
+    k2 = rng.normal(size=(1, 64, 2, 8)).astype(np.float32)
+    with pytest.raises(ValueError, match="KV heads"):
+        make_a2a_attention_fn(mesh)(q2, k2, k2)
