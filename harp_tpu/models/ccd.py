@@ -46,7 +46,7 @@ class CCDConfig:
     sweeps: int = 1  # coordinate cycles per epoch
 
 
-def make_epoch_fn(mesh: WorkerMesh, cfg: CCDConfig, n_items: int):
+def _epoch_device_fn(mesh: WorkerMesh, cfg: CCDConfig, n_items: int):
     def epoch(W, H, bu, bi, bv, bm):
         # bu: [B] user ids local to this worker's range; bi: [B] GLOBAL
         # item ids; H replicated [n_items, r].
@@ -88,10 +88,41 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: CCDConfig, n_items: int):
         se, cnt = C.allreduce(((err * err).sum(), bm.sum()))
         return W, H, se, cnt
 
+    return epoch
+
+
+_IN_SPECS = lambda mesh: (mesh.spec(0), P(), mesh.spec(0), mesh.spec(0),  # noqa: E731
+                          mesh.spec(0), mesh.spec(0))
+
+
+def make_epoch_fn(mesh: WorkerMesh, cfg: CCDConfig, n_items: int):
     return jax.jit(mesh.shard_map(
-        epoch,
-        in_specs=(mesh.spec(0), P(), mesh.spec(0), mesh.spec(0),
-                  mesh.spec(0), mesh.spec(0)),
+        _epoch_device_fn(mesh, cfg, n_items),
+        in_specs=_IN_SPECS(mesh),
+        out_specs=(mesh.spec(0), P(), P(), P()),
+    ))
+
+
+def make_multi_epoch_fn(mesh: WorkerMesh, cfg: CCDConfig, n_items: int,
+                        epochs: int):
+    """``epochs`` coordinate-descent epochs as ONE device program — the
+    same dispatch amortization as mfsgd/lda (per-call round trips cost
+    ~20–150 ms on the relay-attached v5e, 2026-07-30).  Returns per-epoch
+    (se[epochs], cnt[epochs])."""
+    inner = _epoch_device_fn(mesh, cfg, n_items)
+
+    def many(W, H, bu, bi, bv, bm):
+        def body(carry, _):
+            W, H = carry
+            W, H, se, cnt = inner(W, H, bu, bi, bv, bm)
+            return (W, H), (se, cnt)
+
+        (W, H), (ses, cnts) = lax.scan(body, (W, H), None, length=epochs)
+        return W, H, ses, cnts
+
+    return jax.jit(mesh.shard_map(
+        many,
+        in_specs=_IN_SPECS(mesh),
         out_specs=(mesh.spec(0), P(), P(), P()),
     ))
 
@@ -115,6 +146,7 @@ class CCD:
             jax.random.uniform(k2, (n_items, self.cfg.rank), jnp.float32, 0, s),
             self.mesh.replicated())
         self._epoch_fn = make_epoch_fn(self.mesh, self.cfg, n_items)
+        self._multi_fns: dict = {}
         self._blocks = None
 
     def set_ratings(self, users, items, vals):
@@ -142,6 +174,7 @@ class CCD:
             bm[w, :c] = 1.0
         self._blocks = tuple(self.mesh.shard_array(a.reshape(n * B) if a.ndim == 2 else a, 0)
                              for a in (bu, bi, bv, bm))
+        self._multi_fns.clear()  # compiled executables bind to block shapes
 
     def train_epoch(self):
         if self._blocks is None:
@@ -149,6 +182,28 @@ class CCD:
         self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H, *self._blocks)
         return float(np.sqrt(max(device_sync(se), 0.0) /
                              max(device_sync(cnt), 1.0)))
+
+    def compile_epochs(self, epochs: int):
+        """AOT-compile the ``epochs``-epoch program WITHOUT training (same
+        contract as the mfsgd/lda drivers: benchmark warmup must not
+        secretly run extra epochs)."""
+        if self._blocks is None:
+            raise RuntimeError("call set_ratings() before compile_epochs()")
+        fn = self._multi_fns.get(epochs)
+        if fn is None:
+            jitted = make_multi_epoch_fn(
+                self.mesh, self.cfg, self.n_items, epochs)
+            fn = self._multi_fns[epochs] = jitted.lower(
+                self.W, self.H, *self._blocks).compile()
+        return fn
+
+    def train_epochs(self, epochs: int):
+        """Run ``epochs`` epochs as one device program; per-epoch RMSEs."""
+        fn = self.compile_epochs(epochs)
+        self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
+        stats = np.asarray(jnp.stack([ses, cnts]))  # one readback
+        return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
+                for s, c in zip(stats[0], stats[1])]
 
 
 def benchmark(n_users=50_000, n_items=20_000, nnz=2_000_000, rank=32,
@@ -159,11 +214,10 @@ def benchmark(n_users=50_000, n_items=20_000, nnz=2_000_000, rank=32,
     model = CCD(n_users, n_items, CCDConfig(rank=rank), mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     model.set_ratings(u, i, v)
-    r0 = model.train_epoch()  # warmup/compile
+    r0 = model.train_epoch()     # warmup + single-epoch compile
+    model.compile_epochs(epochs)  # AOT, off-clock, does NOT train
     t0 = time.perf_counter()
-    r = r0
-    for _ in range(epochs):
-        r = model.train_epoch()
+    r = model.train_epochs(epochs)[-1]
     dt = time.perf_counter() - t0
     return {"coord_updates_per_sec": nnz * rank * epochs / dt,
             "sec_per_epoch": dt / epochs, "rmse_first": r0, "rmse_final": r,

@@ -109,3 +109,36 @@ def test_tsqr_pads_and_validates(mesh):
     np.testing.assert_allclose(q @ r, x, rtol=1e-3, atol=1e-4)
     with pytest.raises(ValueError, match="tall-skinny"):
         S.tsqr(rng.normal(size=(64, 32)).astype(np.float32), mesh)
+
+
+def test_ccd_train_epochs_matches_per_epoch_protocol(mesh):
+    """Multi-epoch CCD program: RMSEs keep descending, counts stay sane,
+    and compile_epochs is side-effect-free."""
+    from harp_tpu.models import ccd as CD
+    from harp_tpu.models.mfsgd import synthetic_ratings
+
+    u, i, v = synthetic_ratings(128, 96, 4000, rank=4, noise=0.02, seed=0)
+    m = CD.CCD(128, 96, CD.CCDConfig(rank=8), mesh, seed=0)
+    m.set_ratings(u, i, v)
+    w_before = np.asarray(m.W).copy()
+    m.compile_epochs(3)
+    np.testing.assert_array_equal(np.asarray(m.W), w_before)  # no training
+    r1 = m.train_epoch()
+    rs = m.train_epochs(3)
+    assert rs[-1] < r1 and all(np.isfinite(rs))
+
+
+def test_ccd_multi_fn_cache_invalidates_on_new_ratings(mesh):
+    """Reloading a dataset with a different nnz must recompile, not crash
+    on the stale executable's shapes."""
+    from harp_tpu.models import ccd as CD
+    from harp_tpu.models.mfsgd import synthetic_ratings
+
+    m = CD.CCD(64, 48, CD.CCDConfig(rank=4), mesh, seed=0)
+    u, i, v = synthetic_ratings(64, 48, 2000, rank=2, seed=0)
+    m.set_ratings(u, i, v)
+    m.train_epochs(2)
+    u2, i2, v2 = synthetic_ratings(64, 48, 900, rank=2, seed=1)
+    m.set_ratings(u2, i2, v2)
+    rs = m.train_epochs(2)  # recompiles at the new block width
+    assert all(np.isfinite(rs))
