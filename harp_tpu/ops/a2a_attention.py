@@ -37,7 +37,7 @@ from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
 from harp_tpu.ops.ring_attention import online_softmax_block
 
 
-def _local_attention(q, k, v, scale, causal, block_k):
+def _local_attention(q, k, v, scale, causal, block_k, window=None):
     """Exact attention, everything resident ([b, s, h, d] each), computed
     blockwise over K/V with the online-softmax recurrence so the score
     tensor is [b, h, s, block_k], never [b, h, s, s]."""
@@ -57,7 +57,8 @@ def _local_attention(q, k, v, scale, causal, block_k):
         m, l, acc = carry
         kt, vt, t = inp
         m, l, acc = online_softmax_block(
-            q, kt, vt, m, l, acc, pos, t * bk + jnp.arange(bk), scale, causal)
+            q, kt, vt, m, l, acc, pos, t * bk + jnp.arange(bk), scale,
+            causal, window)
         return (m, l, acc), None
 
     (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
@@ -67,7 +68,8 @@ def _local_attention(q, k, v, scale, causal, block_k):
 
 
 def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
-                  scale: float | None = None, block_k: int | None = None):
+                  scale: float | None = None, block_k: int | None = None,
+                  window: int | None = None):
     """Exact multi-head attention, sequence sharded, via all-to-all (device view).
 
     Args (per-worker shards, call inside ``shard_map``):
@@ -78,6 +80,9 @@ def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
     n = lax.axis_size(axis)
     b, nq, h, d = q.shape
     g = k.shape[2]
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window} (window=0 would "
+                         "mask every key and silently return zeros)")
     if h % n != 0:
         raise ValueError(
             f"a2a attention needs heads ({h}) divisible by workers ({n}); "
@@ -92,14 +97,15 @@ def a2a_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
     # seq-sharded → head-sharded ([b, s/n, h, d] → [b, s, h/n, d]) is one
     # regroup (Harp's shuffle verb); the inverse restores sequence sharding
     qh, kh, vh = C.regroup((q, k, v), axis=axis, split_dim=2, concat_dim=1)
-    out = _local_attention(qh, kh, vh, scale, causal, block_k)
+    out = _local_attention(qh, kh, vh, scale, causal, block_k, window)
     return C.regroup(out, axis=axis, split_dim=1, concat_dim=2)
 
 
 def make_a2a_attention_fn(mesh: WorkerMesh, causal: bool = False,
-                          block_k: int | None = None):
+                          block_k: int | None = None,
+                          window: int | None = None):
     """Host-view compile: full arrays in, sequence-sharded underneath."""
     fn = functools.partial(a2a_attention, causal=causal, axis=mesh.axis,
-                           block_k=block_k)
+                           block_k=block_k, window=window)
     spec = mesh.spec(1, ndim=4)  # shard the sequence dim
     return jax.jit(mesh.shard_map(fn, in_specs=(spec,) * 3, out_specs=spec))

@@ -25,7 +25,8 @@ from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
 
 
-def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
+def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal,
+                         window=None):
     """One online-softmax update of (m, l, acc) with a K/V block.
 
     q: [B, nq, H, D]; k, v: [B, nk, G, D] with ``H % G == 0`` (G < H is
@@ -49,8 +50,16 @@ def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
         v = jnp.repeat(v, h // g, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    delta = q_pos[None, None, :, None] - k_pos[None, None, None, :]
+    mask = None
     if causal:
-        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        mask = delta >= 0
+    if window is not None:
+        # sliding window: causal form attends to the last `window` keys
+        # (incl. self); bidirectional to |q_pos - k_pos| < window
+        near = (delta < window) if causal else (jnp.abs(delta) < window)
+        mask = near if mask is None else (mask & near)
+    if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     m_blk = scores.max(-1)                               # [B, H, nq]
     m_new = jnp.maximum(m, m_blk)
@@ -66,7 +75,7 @@ def online_softmax_block(q, k, v, m, l, acc, q_pos, k_pos, scale, causal):
 
 
 def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
-                   scale: float | None = None):
+                   scale: float | None = None, window: int | None = None):
     """Exact multi-head attention, sequence sharded (device view).
 
     Args (per-worker shards, call inside ``shard_map``):
@@ -74,12 +83,26 @@ def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
       dividing ``heads`` (GQA/MQA — K/V travel the ring with the smaller
       head count, so ring traffic shrinks by the group factor).
       causal: apply causal masking using *global* positions.
+      window: sliding-window attention — each query attends to the last
+        ``window`` keys (incl. itself) when causal, or to keys within
+        ``window - 1`` positions either side when not.  Exact: blocks
+        fully outside the window contribute -inf scores and drop out of
+        the online softmax.
     Returns: [batch, seq_local, heads, head_dim] attention output.
     """
     n = lax.axis_size(axis)
     me = lax.axis_index(axis)
     b, nq, h, d = q.shape
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window} (window=0 would "
+                         "mask every key and silently return zeros)")
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    steps = n
+    if window is not None and causal:
+        # a causal window only reaches back ceil((window-1)/nq) shards, so
+        # later ring steps hold fully-masked blocks — truncating the scan
+        # is exact and cuts compute/ICI from O(n) to O(window/nq) steps
+        steps = min(n, -(-(window - 1) // nq) + 1)
 
     q_pos = me * nq + jnp.arange(nq)
     m0 = jnp.full((b, h, nq), -jnp.inf, jnp.float32)
@@ -95,16 +118,19 @@ def ring_attention(q, k, v, *, causal: bool = False, axis: str = WORKER_AXIS,
         src = (me - t) % n                      # whose block is resident
         k_pos = src * nq + jnp.arange(k_cur.shape[1])
         m, l, acc = online_softmax_block(q, k_cur, v_cur, m, l, acc,
-                                  q_pos, k_pos, scale, causal)
+                                  q_pos, k_pos, scale, causal, window)
         return (m, l, acc, k_nxt, v_nxt), None
 
-    (m, l, acc, _, _), _ = lax.scan(body, (m0, l0, acc0, k, v), jnp.arange(n))
+    (m, l, acc, _, _), _ = lax.scan(body, (m0, l0, acc0, k, v),
+                                    jnp.arange(steps))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
-def make_ring_attention_fn(mesh: WorkerMesh, causal: bool = False):
+def make_ring_attention_fn(mesh: WorkerMesh, causal: bool = False,
+                           window: int | None = None):
     """Host-view compile: full arrays in, sequence-sharded underneath."""
-    fn = functools.partial(ring_attention, causal=causal, axis=mesh.axis)
+    fn = functools.partial(ring_attention, causal=causal, axis=mesh.axis,
+                           window=window)
     spec = mesh.spec(1, ndim=4)  # shard the sequence dim
     return jax.jit(mesh.shard_map(fn, in_specs=(spec,) * 3, out_specs=spec))

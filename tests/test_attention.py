@@ -126,7 +126,8 @@ def test_attention_gqa_rejects_bad_group(mesh):
 
 
 @pytest.mark.parametrize("scheme", ["ring", "a2a"])
-def test_attention_gradients_match_dense(mesh, scheme):
+@pytest.mark.parametrize("window", [None, 12])
+def test_attention_gradients_match_dense(mesh, scheme, window):
     """Training through sequence-parallel attention: grads w.r.t. q/k/v via
     autodiff (through the ppermute ring / all_to_alls) == dense grads."""
     from harp_tpu.ops.a2a_attention import a2a_attention
@@ -140,7 +141,7 @@ def test_attention_gradients_match_dense(mesh, scheme):
     spec = mesh.spec(1, ndim=4)
 
     def loss(q, k, v):
-        return (attn(q, k, v, causal=True) ** 2).sum()
+        return (attn(q, k, v, causal=True, window=window) ** 2).sum()
 
     gq, gk, gv = jax.jit(mesh.shard_map(
         lambda q, k, v: jax.grad(loss, argnums=(0, 1, 2))(q, k, v),
@@ -150,10 +151,75 @@ def test_attention_gradients_match_dense(mesh, scheme):
         qf = q.transpose(0, 2, 1, 3).reshape(b * h, n, d)
         kf = k.transpose(0, 2, 1, 3).reshape(b * h, n, d)
         vf = v.transpose(0, 2, 1, 3).reshape(b * h, n, d)
-        return (reference_attention(qf, kf, vf, causal=True) ** 2).sum()
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) / (d ** 0.5)
+        delta = jnp.arange(n)[:, None] - jnp.arange(n)[None, :]
+        mask = delta >= 0
+        if window is not None:
+            mask = mask & (delta < window)
+        s = jnp.where(mask[None], s, -jnp.inf)
+        o = jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, axis=-1), vf)
+        return (o ** 2).sum()
 
     ref = jax.grad(dense_loss, argnums=(0, 1, 2))(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
     for a, r in zip((gq, gk, gv), ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=5e-3, atol=5e-4)
+
+
+def _windowed_ref(q, k, v, causal, window):
+    """Dense sliding-window reference with the documented mask contract."""
+    b, n, h, d = q.shape
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, n, d).astype(np.float64)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, n, d).astype(np.float64)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, n, d).astype(np.float64)
+    s = np.einsum("bqd,bkd->bqk", qf, kf) * scale
+    delta = np.arange(n)[:, None] - np.arange(n)[None, :]
+    mask = np.ones((n, n), bool)
+    if causal:
+        mask &= delta >= 0
+    mask &= (delta < window) if causal else (np.abs(delta) < window)
+    s = np.where(mask[None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    out = np.einsum("bqk,bkd->bqd", p, vf)
+    return out.reshape(b, h, n, d).transpose(0, 2, 1, 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("scheme", ["ring", "a2a"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sliding_window_attention(mesh, scheme, causal):
+    """window spanning worker boundaries == dense windowed reference."""
+    rng = np.random.default_rng(8)
+    b, n, h, d = 1, 64, 8, 8
+    q, k, v = (rng.normal(size=(b, n, h, d)).astype(np.float32)
+               for _ in range(3))
+    window = 12  # crosses the 8-token worker shards
+    make = make_ring_attention_fn if scheme == "ring" else make_a2a_attention_fn
+    out = np.asarray(make(mesh, causal=causal, window=window)(q, k, v))
+    np.testing.assert_allclose(out, _windowed_ref(q, k, v, causal, window),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sliding_window_with_block_k(mesh, causal):
+    """a2a windowed attention with multi-block K/V (fully-masked blocks in
+    the scan) still matches the dense windowed reference."""
+    rng = np.random.default_rng(9)
+    b, n, h, d = 1, 64, 8, 8
+    q, k, v = (rng.normal(size=(b, n, h, d)).astype(np.float32)
+               for _ in range(3))
+    out = np.asarray(make_a2a_attention_fn(
+        mesh, causal=causal, window=10, block_k=16)(q, k, v))
+    np.testing.assert_allclose(out, _windowed_ref(q, k, v, causal, 10),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_window_zero_rejected(mesh):
+    rng = np.random.default_rng(10)
+    q = rng.normal(size=(1, 64, 8, 8)).astype(np.float32)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        make_ring_attention_fn(mesh, window=0)(q, q, q)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        make_a2a_attention_fn(mesh, window=0)(q, q, q)
