@@ -15,6 +15,7 @@ Pallas kernel (VMEM-blocked online softmax) the local steps can use;
 from harp_tpu.ops.a2a_attention import a2a_attention, make_a2a_attention_fn
 from harp_tpu.ops.moe import moe_ffn
 from harp_tpu.ops.ring_attention import make_ring_attention_fn, ring_attention
+from harp_tpu.ops.rope import apply_rope, make_rope_fn
 
 __all__ = ["ring_attention", "make_ring_attention_fn", "a2a_attention",
-           "make_a2a_attention_fn", "moe_ffn"]
+           "make_a2a_attention_fn", "moe_ffn", "apply_rope", "make_rope_fn"]

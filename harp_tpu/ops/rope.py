@@ -1,0 +1,54 @@
+"""Rotary position embeddings for sequence-sharded tensors.
+
+Long-context support (SURVEY.md §6, companion to
+:mod:`harp_tpu.ops.ring_attention` / :mod:`harp_tpu.ops.a2a_attention`):
+RoPE needs each token's GLOBAL position, but under sequence parallelism a
+worker holds only its local shard — the helper derives global positions
+from the worker index the same way the attention schemes derive their
+mask positions, so Q/K can be rotated shard-locally before attention with
+no gather of position tables.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from harp_tpu.parallel.mesh import WORKER_AXIS, WorkerMesh
+
+
+def rope_angles(positions, head_dim: int, base: float = 10000.0):
+    """[S] positions → (cos [S, head_dim/2], sin [S, head_dim/2])."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    inv_freq = 1.0 / (base ** (jnp.arange(head_dim // 2) / (head_dim // 2)))
+    ang = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, *, axis: str = WORKER_AXIS, base: float = 10000.0):
+    """Rotate a sequence-SHARDED [batch, seq_local, heads, head_dim] tensor
+    by its tokens' global positions (device view — call inside shard_map,
+    before :func:`ring_attention` / :func:`a2a_attention`).
+
+    Pairs dimension ``2i`` with ``2i+1`` (the interleaved convention).
+    """
+    b, nq, h, d = x.shape
+    pos = lax.axis_index(axis) * nq + jnp.arange(nq)
+    cos, sin = rope_angles(pos, d, base)
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(b, nq, h, d).astype(x.dtype)
+
+
+def make_rope_fn(mesh: WorkerMesh, base: float = 10000.0):
+    """Host-view compile: full array in, sequence-sharded underneath."""
+    fn = functools.partial(apply_rope, axis=mesh.axis, base=base)
+    spec = mesh.spec(1, ndim=4)
+    return jax.jit(mesh.shard_map(fn, in_specs=(spec,), out_specs=spec))

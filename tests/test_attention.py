@@ -223,3 +223,56 @@ def test_window_zero_rejected(mesh):
         make_ring_attention_fn(mesh, window=0)(q, q, q)
     with pytest.raises(ValueError, match="window must be >= 1"):
         make_a2a_attention_fn(mesh, window=0)(q, q, q)
+
+
+def test_sharded_rope_matches_full_array(mesh):
+    """RoPE over 8 sequence shards (global positions from the worker index)
+    == RoPE applied to the unsharded array."""
+    from harp_tpu.ops.rope import apply_rope, make_rope_fn, rope_angles
+
+    rng = np.random.default_rng(11)
+    b, n, h, d = 2, 64, 4, 16
+    x = rng.normal(size=(b, n, h, d)).astype(np.float32)
+    out = np.asarray(make_rope_fn(mesh)(x))
+
+    cos, sin = rope_angles(jnp.arange(n), d)
+    cos, sin = np.asarray(cos), np.asarray(sin)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    ref = np.stack([x1 * c - x2 * s, x1 * s + x2 * c], -1).reshape(b, n, h, d)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-6)
+
+    # rotation preserves norms (sanity of the pairing/reshape)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1),
+                               np.linalg.norm(x, axis=-1), rtol=2e-5)
+
+    with pytest.raises(ValueError, match="even head_dim"):
+        rope_angles(jnp.arange(4), 7)
+
+
+def test_rope_attention_shift_consistency(mesh):
+    """The RoPE+causal-ring pipeline is usable end to end: rotating q/k
+    before ring attention runs and yields finite, position-dependent out."""
+    from harp_tpu.ops.ring_attention import ring_attention
+    from harp_tpu.ops.rope import apply_rope
+
+    rng = np.random.default_rng(12)
+    b, n, h, d = 1, 64, 2, 8
+    q, k, v = (rng.normal(size=(b, n, h, d)).astype(np.float32)
+               for _ in range(3))
+    spec = mesh.spec(1, ndim=4)
+
+    def prog(q, k, v):
+        return ring_attention(apply_rope(q), apply_rope(k), v, causal=True)
+
+    out = np.asarray(jax.jit(mesh.shard_map(
+        prog, in_specs=(spec,) * 3, out_specs=spec))(q, k, v))
+    assert np.isfinite(out).all()
+    # without RoPE the first token's output equals v[0]; with RoPE too
+    # (single attendable key) — but later rows must differ from no-RoPE
+    def prog2(q, k, v):
+        return ring_attention(q, k, v, causal=True)
+    base = np.asarray(jax.jit(mesh.shard_map(
+        prog2, in_specs=(spec,) * 3, out_specs=spec))(q, k, v))
+    assert not np.allclose(out[0, -1], base[0, -1])
