@@ -207,3 +207,20 @@ def test_example_mfsgd_app_runs():
         capture_output=True, text=True, timeout=240)
     assert out.returncode == 0, out.stderr[-800:]
     assert "rmse_final" in out.stdout
+
+
+def test_example_longctx_layer_runs():
+    """The long-context stack example (RoPE + windowed GQA ring attention +
+    DP allreduce) trains and its loss descends."""
+    import ast
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "longctx_layer.py"),
+         "--cpu8", "--seq", "128", "--steps", "12", "--window", "24"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = ast.literal_eval(out.stdout.strip().splitlines()[-1])
+    assert rec["loss_final"] < rec["loss_first"]
