@@ -276,3 +276,32 @@ def test_rope_attention_shift_consistency(mesh):
     base = np.asarray(jax.jit(mesh.shard_map(
         prog2, in_specs=(spec,) * 3, out_specs=spec))(q, k, v))
     assert not np.allclose(out[0, -1], base[0, -1])
+
+
+def test_rope_scores_depend_only_on_relative_position():
+    """The RoPE invariant: ⟨rope_p(q), rope_k(k)⟩ is a function of p−k
+    alone — the property that makes shard-local global-position rotation
+    equivalent to any consistent position offset."""
+    from harp_tpu.ops.rope import rope_angles
+
+    rng = np.random.default_rng(13)
+    d = 16
+    q = rng.normal(size=d).astype(np.float64)
+    k = rng.normal(size=d).astype(np.float64)
+
+    def rot(x, p):
+        cos, sin = rope_angles(jnp.asarray([p]), d)
+        c, s = np.asarray(cos, np.float64)[0], np.asarray(sin, np.float64)[0]
+        x1, x2 = x[0::2], x[1::2]
+        out = np.empty_like(x)
+        out[0::2] = x1 * c - x2 * s
+        out[1::2] = x1 * s + x2 * c
+        return out
+
+    # same relative offset (5), different absolute positions
+    s1 = rot(q, 9) @ rot(k, 4)
+    s2 = rot(q, 104) @ rot(k, 99)
+    np.testing.assert_allclose(s1, s2, rtol=1e-6)
+    # different offsets disagree (the invariant is not a constant)
+    s3 = rot(q, 9) @ rot(k, 2)
+    assert abs(s1 - s3) > 1e-6
