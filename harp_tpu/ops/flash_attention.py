@@ -23,7 +23,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale, causal, block_q, block_k):
+                  *, scale, causal, window, block_q, block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -33,9 +33,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: K blocks entirely above the diagonal contribute nothing —
-    # skip their MXU work (≈2× for long sequences)
-    fully_masked = (ki * block_k > qi * block_q + block_q - 1) if causal else False
+    # Skip whole K blocks that cannot contribute (≈2× for causal; O(W/N)
+    # of the work for sliding windows): above the diagonal, or entirely
+    # outside the window on either side.
+    q0, q1 = qi * block_q, qi * block_q + block_q - 1
+    k0, k1 = ki * block_k, ki * block_k + block_k - 1
+    fully_masked = False
+    if causal:
+        fully_masked = k0 > q1
+    if window is not None:
+        if causal:
+            fully_masked = fully_masked | (k1 < q0 - window + 1)
+        else:
+            min_dist = jnp.maximum(0, jnp.maximum(k0 - q1, q0 - k1))
+            fully_masked = min_dist >= window
 
     @pl.when(jnp.logical_not(fully_masked))
     def _compute():
@@ -45,12 +56,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
 
-        if causal:
+        if causal or window is not None:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
-            masked = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
+            delta = q_pos - k_pos
+            keep = jnp.ones_like(delta, jnp.bool_)
+            if causal:
+                keep = delta >= 0
+            if window is not None:
+                near = (delta < window) if causal else (jnp.abs(delta) < window)
+                keep = keep & near
+            masked = jnp.where(keep, scores, -jnp.inf)
         else:
             masked = scores
 
@@ -75,10 +93,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
 
 def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None,
+                    window: int | None = None,
                     block_q: int = 256, block_k: int = 256,
                     interpret: bool = False):
-    """Blockwise attention. q/k/v: [BH, N, D] (fold batch×heads upstream)."""
+    """Blockwise attention. q/k/v: [BH, N, D] (fold batch×heads upstream;
+    for GQA repeat the K/V heads before folding — the kernel sees folded
+    rows).  ``window`` follows the ring/a2a mask contract: last ``window``
+    keys when causal, ``window − 1`` either side when not; out-of-window
+    K blocks are skipped entirely."""
     bh, n, d = q.shape
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     block_q = min(block_q, n)
     block_k = min(block_k, n)
     assert n % block_q == 0 and n % block_k == 0, (n, block_q, block_k)
@@ -86,7 +111,7 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     grid = (bh, n // block_q, n // block_k)
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
+        _flash_kernel, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k)
     return pl.pallas_call(
         kernel,
@@ -107,13 +132,17 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float | None = None
     )(q, k, v)
 
 
-def reference_attention(q, k, v, *, causal=False, scale=None):
+def reference_attention(q, k, v, *, causal=False, scale=None, window=None):
     """Straight-line reference for tests."""
     bh, n, d = q.shape
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    delta = jnp.arange(n)[:, None] - jnp.arange(n)[None, :]
+    mask = jnp.ones((n, n), bool)
     if causal:
-        mask = jnp.tril(jnp.ones((n, n), bool))
-        s = jnp.where(mask[None], s, -jnp.inf)
+        mask = delta >= 0
+    if window is not None:
+        mask = mask & ((delta < window) if causal else (jnp.abs(delta) < window))
+    s = jnp.where(mask[None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p, v)

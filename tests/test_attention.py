@@ -305,3 +305,19 @@ def test_rope_scores_depend_only_on_relative_position():
     # different offsets disagree (the invariant is not a constant)
     s3 = rot(q, 9) @ rot(k, 2)
     assert abs(s1 - s3) > 1e-6
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_window_interpret(causal):
+    """Pallas kernel with a sliding window (block skipping + in-block mask)
+    == the dense windowed reference, in interpret mode."""
+    rng = np.random.default_rng(14)
+    bh, n, d = 2, 128, 16
+    q, k, v = (rng.normal(size=(bh, n, d)).astype(np.float32)
+               for _ in range(3))
+    out = np.asarray(flash_attention(q, k, v, causal=causal, window=20,
+                                     block_q=32, block_k=32, interpret=True))
+    ref = np.asarray(reference_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=causal, window=20))
+    np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
