@@ -13,6 +13,7 @@ return early on this machine's relay transport.
 
 import json
 import sys
+import threading
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
@@ -23,9 +24,26 @@ BASELINE_KMEANS_ITERS_PER_SEC = 400.0
 def main():
     from harp_tpu.utils.timing import HangWatchdog
 
-    watchdog = HangWatchdog()  # HARP_BENCH_TIMEOUT (default 1200 s)
-    watchdog.arm("bench.py kmeans")
     smoke = "--smoke" in sys.argv
+    done = threading.Event()  # set once the real result line is out
+
+    def emit_hang_record(what):
+        # the driver expects ONE JSON line; a hang should still produce a
+        # parseable record rather than silence + exit code 3 — but never a
+        # SECOND line if the timer fires in the completion/cancel window
+        if done.is_set():
+            return
+        print(json.dumps({
+            "metric": ("kmeans_iters_per_sec_smoke" if smoke
+                       else "kmeans_iters_per_sec_1Mx300_k100"),
+            "value": 0.0,
+            "unit": "iter/s",
+            "vs_baseline": None if smoke else 0.0,
+            "error": f"TPU relay hang during {what} (watchdog)",
+        }), flush=True)
+
+    watchdog = HangWatchdog(on_fire=emit_hang_record)  # HARP_BENCH_TIMEOUT
+    watchdog.arm("bench.py kmeans")
     from harp_tpu.models import kmeans as KM
 
     if smoke:
@@ -34,13 +52,14 @@ def main():
         res = KM.benchmark(n=1_000_000, d=300, k=100, iters=100, warmup=5)
 
     value = res["iters_per_sec"]
+    watchdog.cancel()
+    done.set()
     print(json.dumps({
         "metric": "kmeans_iters_per_sec_1Mx300_k100" if not smoke else "kmeans_iters_per_sec_smoke",
         "value": round(value, 2),
         "unit": "iter/s",
         "vs_baseline": round(value / BASELINE_KMEANS_ITERS_PER_SEC, 4) if not smoke else None,
     }))
-    watchdog.cancel()
 
 
 if __name__ == "__main__":
