@@ -27,3 +27,50 @@ def trace(logdir: str = "/tmp/harp_tpu_trace"):
 def annotate(name: str):
     """Named region that shows up in the trace timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+def op_breakdown(logdir: str, top: int = 15, host_events: bool = False):
+    """Top device ops by total duration from the LATEST :func:`trace`
+    capture under ``logdir``.
+
+    Parses the newest profile session's ``*.trace.json.gz`` event dump
+    (each ``trace()`` writes a fresh ``plugins/profile/<ts>/`` session, so
+    reusing a logdir must not double-count older runs) and sums durations
+    per op name — the quick "where did the time go" table behind
+    BASELINE.md's measurements.  Spans are filtered to device tracks via
+    the trace's process metadata; when no device track exists (CPU
+    backend) all non-Python-frame spans are kept instead.  Set
+    ``host_events`` to include everything.  Returns
+    ``[(name, total_seconds)]``, largest first.
+    """
+    import glob
+    import gzip
+    import json
+    import os
+
+    sessions = sorted(glob.glob(f"{logdir}/plugins/profile/*/"))
+    root = sessions[-1] if sessions else logdir  # newest session only
+    files = sorted(glob.glob(f"{root}/**/*.trace.json.gz", recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json.gz under {logdir!r} — "
+                                "was this directory written by trace()?")
+    totals: dict[str, float] = {}
+    for f in files:
+        events = json.loads(gzip.open(f).read()).get("traceEvents", [])
+        device_pids = {
+            e.get("pid") for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "/device:" in str(e.get("args", {}).get("name", ""))
+        }
+        for e in events:
+            if e.get("ph") != "X" or "dur" not in e:
+                continue
+            name = e.get("name", "?")
+            if not host_events:
+                if device_pids:
+                    if e.get("pid") not in device_pids:
+                        continue
+                elif name.startswith("$"):  # CPU backend: no device track
+                    continue
+            totals[name] = totals.get(name, 0.0) + e["dur"] / 1e6
+    return sorted(totals.items(), key=lambda kv: -kv[1])[:top]

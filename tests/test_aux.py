@@ -224,3 +224,34 @@ def test_example_longctx_layer_runs():
     assert out.returncode == 0, out.stderr[-800:]
     rec = ast.literal_eval(out.stdout.strip().splitlines()[-1])
     assert rec["loss_final"] < rec["loss_first"]
+
+
+def test_profiling_op_breakdown(mesh, tmp_path):
+    """trace() + op_breakdown: capture a jitted run, get a per-op table."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.utils.profiling import op_breakdown, trace
+
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: (a @ a).sum())
+    float(f(x))  # compile outside the trace
+    with trace(str(tmp_path / "tr")) as d:
+        float(f(x))
+    rows = op_breakdown(d, top=5)
+    assert rows and all(isinstance(n, str) and s >= 0 for n, s in rows)
+
+    # a second capture into the SAME dir: totals must come from the newest
+    # session only, not the sum of both (reused default logdirs double)
+    import time
+
+    time.sleep(1.1)  # session dirs are timestamped at second granularity
+    with trace(d):
+        float(f(x))
+    rows2 = op_breakdown(d, top=5)
+    t1 = dict(rows).get(rows[0][0], 0.0)
+    t2 = dict(rows2).get(rows[0][0], 0.0)
+    assert t2 < 1.8 * t1 + 1e-4, (t1, t2)  # not accumulated across sessions
+
+    with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+        op_breakdown(str(tmp_path / "nope"))
