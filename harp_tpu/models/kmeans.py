@@ -53,10 +53,25 @@ class KMeansConfig:
     # opt-in single-pass Pallas kernel; the default XLA path measured faster
     # on v5e (see harp_tpu/ops/kmeans_kernel.py for the numbers)
     use_pallas: bool = False
+    # opt-in int8 point quantization: per-feature symmetric scales, distances
+    # and partial sums as int8 MXU matmuls with exact int32 accumulation —
+    # quarter the per-iteration HBM traffic of f32 points.  Accuracy
+    # contract (measured on CPU sim, 2026-07-30): near-equidistant
+    # assignments may flip within the ~1/127 relative distance resolution;
+    # from a non-degenerate init the result matches f32 to 5 digits of
+    # inertia, but a degenerate random init (duplicate-cluster seeds) can
+    # select a different Lloyd basin — the same sensitivity any metric
+    # perturbation has.  TPU wall-clock pending (relay outage, BASELINE.md).
+    quantize: str | None = None
 
     def __post_init__(self):
         if self.k < 1:
             raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {self.quantize!r}")
+        if self.quantize and (self.use_pallas or self.block_points):
+            raise ValueError("quantize='int8' is incompatible with use_pallas/"
+                             "block_points (one fused int8 path)")
         if self.variant not in ("allreduce", "regroupallgather"):
             raise ValueError(
                 f"variant must be 'allreduce' or 'regroupallgather', "
@@ -89,6 +104,48 @@ def _partials_block(points, centroids, c2):
     return sums, counts, inertia
 
 
+# one worker-local cluster may sum at most 2^31/127 int8 contributions
+# before the exact int32 accumulator could wrap
+_INT8_SUM_ROW_LIMIT = (1 << 31) // 127
+
+
+def quantize_points_int8(points):
+    """Per-feature symmetric int8 quantization: (q int8 [n, d], scale [d]).
+
+    ``points ≈ q * scale[None, :]`` with per-entry error ≤ scale/2."""
+    points = np.asarray(points, np.float32)
+    q, scale = C.quantize_to_int8(jnp.asarray(points),
+                                  jnp.abs(jnp.asarray(points)).max(0))
+    return np.asarray(q), np.asarray(scale, np.float32)
+
+
+def _partials_block_int8(pts_q, col_scale, centroids, c2):
+    """Quantized twin of :func:`_partials_block`: both matmuls run int8 on
+    the MXU (v5e: 2× the bf16 rate, ¼ the f32 bytes); accumulation is
+    exact int32, dequantized once per [k, d]/[k] output.  The centroid
+    operand requantizes per iteration with a per-centroid scale, so the
+    only approximation is the two int8 roundings inside the argmin."""
+    k = centroids.shape[0]
+    cs = centroids.astype(jnp.float32) * col_scale[None, :]      # [k, d]
+    c_q, c_scale_col = C.quantize_to_int8(cs, jnp.abs(cs).max(1, keepdims=True))
+    c_scale = c_scale_col[:, 0]                                  # [k]
+    dots_i = jax.lax.dot_general(
+        pts_q, c_q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)                        # [n, k]
+    dots = dots_i.astype(jnp.float32) * c_scale[None, :]
+    scores = c2[None, :] - 2.0 * dots
+    assign = jnp.argmin(scores, axis=1)
+    x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum()
+    inertia = x2 + scores.min(axis=1).sum()
+    onehot = jax.nn.one_hot(assign, k, dtype=jnp.int8)
+    sums_i = jax.lax.dot_general(
+        onehot, pts_q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                        # [k, d]
+    sums = sums_i.astype(jnp.float32) * col_scale[None, :]
+    counts = jnp.sum(onehot, axis=0, dtype=jnp.int32).astype(jnp.float32)
+    return sums, counts, inertia
+
+
 def kmeans_kernel_supported(n: int) -> bool:
     """use_pallas falls back to the XLA path when no tile divides the shard."""
     from harp_tpu.ops import kmeans_kernel
@@ -102,6 +159,14 @@ def kmeans_step(points, centroids, cfg: KMeansConfig):
     Returns (new_centroids, inertia).  The partial-sums → allreduce is
     exactly Harp's regroup+allgather phase, fused to one psum.
     """
+    if cfg.quantize == "int8":
+        pts_q, col_scale = points  # (int8 [n, d], f32 [d]) — see fit()
+        c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)
+        sums, counts, partial_inertia = _partials_block_int8(
+            pts_q, col_scale, centroids, c2)
+        nw = lax.axis_size(C.WORKER_AXIS)
+        return _combine_partials(sums, counts, partial_inertia, centroids,
+                                 cfg, nw)
     n = points.shape[0]
     block = cfg.block_points
     if cfg.use_pallas and kmeans_kernel_supported(n):
@@ -125,6 +190,13 @@ def kmeans_step(points, centroids, cfg: KMeansConfig):
         sums, counts = sums.sum(0), counts.sum(0)
         partial_inertia = partial_inertia.sum()
 
+    nw = lax.axis_size(C.WORKER_AXIS)
+    return _combine_partials(sums, counts, partial_inertia, centroids, cfg, nw)
+
+
+def _combine_partials(sums, counts, partial_inertia, centroids, cfg, nw):
+    """The collective+normalize tail every partials formulation shares."""
+
     def normalize(sums, counts, old):
         # empty cluster keeps its old centroid (shared by both variants —
         # a change here, e.g. reseeding, must apply to both identically)
@@ -132,7 +204,6 @@ def kmeans_step(points, centroids, cfg: KMeansConfig):
             counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), old
         ).astype(old.dtype)
 
-    nw = lax.axis_size(C.WORKER_AXIS)
     if cfg.variant == "regroupallgather" and sums.shape[0] % nw == 0:
         # Harp's regroup+allgather: reduce-scatter the partials so worker w
         # owns centroid block w (the regroup/push phase), normalize locally,
@@ -174,14 +245,16 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
 
         return lax.fori_loop(0, cfg.iters, body, (centroids, jnp.float32(0.0)))
 
+    pts_spec = ((mesh.spec(0), P()) if cfg.quantize == "int8"
+                else mesh.spec(0))  # (q shards, replicated col scales)
     return jax.jit(
-        mesh.shard_map(run, in_specs=(mesh.spec(0), P()), out_specs=(P(), P()))
+        mesh.shard_map(run, in_specs=(pts_spec, P()), out_specs=(P(), P()))
     )
 
 
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         dtype=jnp.float32, block_points=0, use_pallas=False,
-        variant="allreduce"):
+        variant="allreduce", quantize=None):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
     ``points``: [n, d] host or device array; sharded over workers on dim 0.
@@ -193,14 +266,25 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
     cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points,
-                       use_pallas=use_pallas, variant=variant)
+                       use_pallas=use_pallas, variant=variant, quantize=quantize)
     n = points.shape[0]
     if seed is None:
         init_idx = np.arange(k)
     else:
         init_idx = np.random.default_rng(seed).choice(n, size=k, replace=False)
     centroids = jnp.asarray(np.asarray(points[np.sort(init_idx)]), dtype=dtype)
-    pts = mesh.shard_array(np.asarray(points, dtype=np.dtype(jnp.dtype(dtype).name)), 0)
+    if quantize == "int8":
+        if -(-n // mesh.num_workers) > _INT8_SUM_ROW_LIMIT:
+            raise ValueError(
+                f"quantize='int8': {n} points over {mesh.num_workers} workers "
+                f"exceeds the {_INT8_SUM_ROW_LIMIT} rows/worker exact-int32 "
+                "accumulation bound — use more workers or the f32 path")
+        q, scale = quantize_points_int8(points)
+        pts = (mesh.shard_array(q, 0),
+               jax.device_put(jnp.asarray(scale), mesh.replicated()))
+    else:
+        pts = mesh.shard_array(
+            np.asarray(points, dtype=np.dtype(jnp.dtype(dtype).name)), 0)
     centroids = jax.device_put(centroids, mesh.replicated())
     fit_fn = make_fit_fn(mesh, cfg)
     new_c, inertia = fit_fn(pts, centroids)
@@ -208,12 +292,13 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
-              warmup=2, seed=0, use_pallas=False, variant="allreduce"):
+              warmup=2, seed=0, use_pallas=False, variant="allreduce",
+              quantize=None):
     """Measure iter/sec on the graded 1M×300 k=100 config (north-star metric)."""
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
     cfg = KMeansConfig(k=k, iters=1, dtype=dtype, use_pallas=use_pallas,
-                       variant=variant)
+                       variant=variant, quantize=quantize)
     nw = mesh.num_workers
     n = (n // nw) * nw  # actual points generated/processed (and reported)
 
@@ -226,6 +311,20 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
         mesh.shard_map(lambda ks: gen(ks[0]), in_specs=(mesh.spec(0),),
                        out_specs=mesh.spec(0))
     )(keys)
+    if quantize == "int8":
+        if n // nw > _INT8_SUM_ROW_LIMIT:
+            raise ValueError(
+                f"quantize='int8': {n // nw} rows/worker exceeds the "
+                f"{_INT8_SUM_ROW_LIMIT} exact-int32 accumulation bound")
+        # on-device quantization: per-feature |max| needs a cross-shard pmax
+        def quant(x):
+            amax = C.allreduce(jnp.abs(x).max(0), C.Combiner.MAX)
+            return C.quantize_to_int8(x, amax[None, :])[0], \
+                jnp.maximum(amax, 1e-30) / 127.0
+
+        points = jax.jit(mesh.shard_map(
+            quant, in_specs=(mesh.spec(0),),
+            out_specs=(mesh.spec(0), P())))(points)
     centroids = jax.device_put(
         jax.random.normal(jax.random.key(seed + 1), (k, d), dtype=dtype),
         mesh.replicated(),
@@ -243,9 +342,10 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
 
         return lax.fori_loop(0, n_iters, body, (centroids, jnp.float32(0.0)))
 
+    pts_spec = ((mesh.spec(0), P()) if quantize == "int8" else mesh.spec(0))
     run_fn = jax.jit(
         mesh.shard_map(
-            run, in_specs=(mesh.spec(0), P(), P()), out_specs=(P(), P()),
+            run, in_specs=(pts_spec, P(), P()), out_specs=(P(), P()),
         )
     )
     c_w, inertia = run_fn(points, centroids, jnp.int32(max(warmup, 1)))
@@ -263,6 +363,7 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
         "n": n, "d": d, "k": k, "num_workers": nw,
         "dtype": str(jnp.dtype(dtype).name),
         "variant": variant,  # the variant that actually ran (post-fallback)
+        "quantize": quantize,
     }
 
 
@@ -282,13 +383,16 @@ def main(argv=None):
     p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
                    help="CSV/whitespace point files (one point per row) — "
                         "the Harp app's HDFS input; default: synthetic")
+    p.add_argument("--quantize", choices=["int8"], default=None,
+                   help="opt-in int8 point quantization (¼ the HBM traffic; "
+                        "see KMeansConfig.quantize for the accuracy contract)")
     p.add_argument("--bench", action="store_true", help="synthetic benchmark mode")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     if args.bench:
         out = benchmark(args.n, args.d, args.k, args.iters, dtype=dtype,
-                        variant=args.variant)
+                        variant=args.variant, quantize=args.quantize)
         print(out)
     else:
         if args.input:
@@ -302,7 +406,7 @@ def main(argv=None):
             rng = np.random.default_rng(0)
             pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
-                         variant=args.variant)
+                         variant=args.variant, quantize=args.quantize)
         print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
                "d": pts.shape[1], "inertia": inertia})
 

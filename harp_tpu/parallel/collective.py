@@ -128,13 +128,12 @@ def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
     leaves, treedef = jax.tree.flatten(tree)
     is_float = [jnp.issubdtype(x.dtype, jnp.floating) for x in leaves]
 
-    scales = None
+    amaxes = None
     if wd == jnp.dtype(jnp.int8) and any(is_float):
         # one fused collective for every leaf's scale, not one per leaf
         amax = jnp.stack([jnp.max(jnp.abs(x)).astype(jnp.float32)
                           for x, f in zip(leaves, is_float) if f])
-        amax = lax.pmax(amax, axis)
-        scales = iter(jnp.maximum(amax, 1e-30) / 127.0)
+        amaxes = iter(lax.pmax(amax, axis))
 
     out = []
     for x, f in zip(leaves, is_float):
@@ -143,8 +142,7 @@ def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
         elif wd == jnp.dtype(jnp.bfloat16):
             out.append(lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype))
         else:
-            scale = next(scales)
-            q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+            q, scale = quantize_to_int8(x, next(amaxes))
             total = lax.psum(q.astype(jnp.int32), axis)
             out.append((total.astype(jnp.float32) * scale).astype(x.dtype))
     return jax.tree.unflatten(treedef, out)
@@ -161,6 +159,17 @@ def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
 
 
 _UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def quantize_to_int8(x, amax):
+    """Symmetric int8 quantization against a precomputed |max|:
+    ``(q, scale)`` with ``scale = max(amax, 1e-30)/127`` and
+    ``x ≈ q * scale`` (broadcasting ``amax``'s shape).  The one formula
+    behind the quantized wire and the int8 compute paths — callers pick
+    the amax granularity (global, per-row, per-feature, pmax'd)."""
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 @partial(jax.custom_jvp, nondiff_argnums=(1, 2))

@@ -32,6 +32,10 @@ def run_all(smoke: bool, only, watchdog=None):
         "kmeans": lambda: kmeans.benchmark(
             **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        "kmeans_int8": lambda: kmeans.benchmark(
+            quantize="int8",
+            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
@@ -86,8 +90,8 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "mfsgd", "mfsgd_scatter", "lda",
-                            "lda_scatter", "mlp", "subgraph", "rf"],
+                   choices=["kmeans", "kmeans_int8", "mfsgd", "mfsgd_scatter",
+                            "lda", "lda_scatter", "mlp", "subgraph", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     args = p.parse_args(argv)
