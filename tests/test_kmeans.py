@@ -185,3 +185,48 @@ def test_quantize_config_validation(mesh):
         KMeansConfig(quantize="fp4")
     with pytest.raises(ValueError, match="incompatible"):
         KMeansConfig(quantize="int8", use_pallas=True)
+
+
+def test_kmeanspp_init_rescues_degenerate_seeds(mesh):
+    """On well-separated clusters, kmeans++ lands near the optimum for
+    seeds where random-row init strands Lloyd in a 2x-worse basin."""
+    from harp_tpu.models.kmeans import fit
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(8, 16)).astype(np.float32) * 8
+    pts = np.concatenate([
+        centers[i] + 0.2 * rng.normal(size=(128, 16)).astype(np.float32)
+        for i in range(8)
+    ])
+
+    def true_inertia(c):
+        return ((pts[:, None] - c[None]) ** 2).sum(-1).min(1).sum()
+
+    # near-optimal reference: Lloyd from the TRUE centers
+    c_opt, _ = fit(np.concatenate([centers, pts]), k=8, iters=8, mesh=mesh,
+                   seed=None)
+    opt = true_inertia(c_opt)
+    worst = 0.0
+    for seed in range(5):
+        cpp, _ = fit(pts, k=8, iters=8, mesh=mesh, seed=seed, init="kmeans++")
+        worst = max(worst, true_inertia(cpp))
+    # every seed lands within 5% of optimal (random init measured ~2x off
+    # on 2 of these 5 seeds)
+    assert worst < 1.05 * opt, (worst, opt)
+
+
+def test_fit_rejects_unknown_init(mesh):
+    from harp_tpu.models.kmeans import fit
+
+    with pytest.raises(ValueError, match="init must be"):
+        fit(np.zeros((16, 2), np.float32), k=2, mesh=mesh, init="zzz")
+
+
+def test_kmeanspp_handles_fewer_distinct_rows_than_k(mesh):
+    from harp_tpu.models.kmeans import fit, kmeanspp_init
+
+    pts = np.tile(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32), (8, 1))
+    c = kmeanspp_init(pts, k=4, seed=0)
+    assert c.shape == (4, 2) and np.isfinite(c).all()
+    cf, _ = fit(pts, k=4, iters=3, mesh=mesh, init="kmeans++")
+    assert np.isfinite(cf).all()
