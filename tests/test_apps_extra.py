@@ -142,3 +142,55 @@ def test_ccd_multi_fn_cache_invalidates_on_new_ratings(mesh):
     m.set_ratings(u2, i2, v2)
     rs = m.train_epochs(2)  # recompiles at the new block width
     assert all(np.isfinite(rs))
+
+
+def test_wdamds_weighted_matches_unweighted_with_unit_weights(mesh):
+    from harp_tpu.models.wdamds import MDSConfig, mds
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(48, 3)).astype(np.float32)
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    cfg = MDSConfig(dim=3, iters=30, cg_iters=12)
+    _, s_u = mds(delta, cfg, mesh, seed=1)
+    _, s_w = mds(delta, cfg, mesh, seed=1, weights=np.ones_like(delta))
+    # same objective: stresses agree (CG vs closed form, loose tolerance)
+    assert abs(s_w - s_u) < 0.05 * max(s_u, 1e-3) + 1e-3, (s_u, s_w)
+
+
+def test_wdamds_zero_weights_ignore_corrupted_entries(mesh):
+    """The point of the W: zero-weighted (corrupt) dissimilarities must not
+    distort the embedding, while the unweighted solver is thrown off."""
+    from harp_tpu.models.wdamds import MDSConfig, mds
+
+    rng = np.random.default_rng(1)
+    pts = rng.normal(size=(48, 3)).astype(np.float32)
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    corrupt = delta.copy()
+    ii, jj = np.triu_indices(48, k=1)
+    sel = rng.choice(len(ii), size=80, replace=False)
+    corrupt[ii[sel], jj[sel]] = 50.0  # garbage entries
+    corrupt[jj[sel], ii[sel]] = 50.0
+    w = np.ones_like(delta)
+    w[ii[sel], jj[sel]] = 0.0
+    w[jj[sel], ii[sel]] = 0.0
+
+    cfg = MDSConfig(dim=3, iters=40, cg_iters=12)
+    Xw, _ = mds(corrupt, cfg, mesh, seed=1, weights=w)
+    Xu, _ = mds(corrupt, cfg, mesh, seed=1)
+
+    def true_stress(X):
+        d = np.sqrt(((X[:, None] - X[None]) ** 2).sum(-1))
+        return ((delta - d) ** 2)[np.triu_indices(48, k=1)].sum()
+
+    assert true_stress(Xw) < 0.3 * true_stress(Xu), (
+        true_stress(Xw), true_stress(Xu))
+
+
+def test_wdamds_weights_validation(mesh):
+    from harp_tpu.models.wdamds import mds
+
+    d = np.ones((8, 8), np.float32)
+    with pytest.raises(ValueError, match="shape"):
+        mds(d, mesh=mesh, weights=np.ones((4, 4), np.float32))
+    with pytest.raises(ValueError, match="nonnegative"):
+        mds(d, mesh=mesh, weights=-np.ones((8, 8), np.float32))
