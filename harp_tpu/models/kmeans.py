@@ -269,14 +269,17 @@ def kmeanspp_init(points, k, seed=0, sample=50_000):
     centers = [pts[rng.integers(len(pts))]]
     d2 = ((pts - centers[0]) ** 2).sum(1)
     for _ in range(k - 1):
-        total = float(d2.sum())
+        # float64 so the probabilities pass numpy's sum-to-one check even
+        # when one entry dominates (f32 rounding can exceed the tolerance)
+        d2_64 = d2.astype(np.float64)
+        total = float(d2_64.sum())
         if total <= 0.0:
             # fewer than k distinct rows: every point already coincides
             # with a center — fall back to uniform picks (Lloyd's
             # keep-old-centroid rule handles the resulting empty clusters)
             nxt = pts[rng.integers(len(pts))]
         else:
-            nxt = pts[rng.choice(len(pts), p=d2 / total)]
+            nxt = pts[rng.choice(len(pts), p=d2_64 / total)]
         centers.append(nxt)
         d2 = np.minimum(d2, ((pts - nxt) ** 2).sum(1))
     return np.stack(centers)

@@ -291,7 +291,13 @@ class MLPTrainer:
         history: list = []
 
         def set_state(state):
-            check_restored_shapes([("params", state["params"], self.params)])
+            # opt_state too: matching params but a different optimizer
+            # (sgd vs adam) would otherwise die inside tree.unflatten with
+            # an obscure structure error instead of this clear refusal
+            check_restored_shapes([
+                ("params", state["params"], self.params),
+                ("opt_state", state["opt_state"], self.opt_state),
+            ])
             if not isinstance(jax.tree.leaves(state["params"])[0], jax.Array):
                 # a checkpoint restore yields plain containers; rebuild on
                 # the LIVE treedefs so optax's named-tuple states survive

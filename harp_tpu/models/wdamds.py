@@ -109,8 +109,6 @@ def make_wsmacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
             d2 = x2 - 2.0 * (Xl @ X.T) + y2
             return jnp.sqrt(jnp.maximum(d2, 0.0)), Xl
 
-        live = None  # built per-call below (traced)
-
         def center(X):
             # kill V's translation null space: center over live rows
             m = jnp.where(jnp.arange(n_pad) < n_real, 1.0, 0.0)[:, None]
@@ -141,21 +139,30 @@ def make_wsmacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
             p = r
             rs = (r * r).sum()
             rs0 = rs
+            rhs_sq = (rhs * rhs).sum()
 
             def cg_step(st, _):
                 x, r, p, rs = st
-                # freeze once converged: on the singular (translation null
-                # space) system, iterating past convergence divides f32
-                # noise by f32 noise and explodes
-                live_step = rs > 1e-12 * rs0 + 1e-30
+                # freeze once converged: on the singular system (zero
+                # weights enlarge V's null space beyond translations, and
+                # can even disconnect the weight graph), iterating past
+                # convergence divides f32 noise by f32 noise and explodes.
+                # Two guards: a relative one vs the initial residual AND an
+                # absolute floor vs |rhs|² (rs0 itself can already be f32
+                # noise when the solve starts at convergence); plus a
+                # curvature gate — on a direction with ~0/negative p·Vp the
+                # step is meaningless, so take alpha = 0 and restart p ← r.
                 vp = v_apply(p, w_live, vdiag)
-                alpha = jnp.where(live_step,
-                                  rs / jnp.maximum((p * vp).sum(), 1e-30),
-                                  0.0)
+                pvp = (p * vp).sum()
+                step_ok = ((rs > 1e-12 * rs0 + 1e-30)
+                           & (rs > 1e-10 * rhs_sq + 1e-30)
+                           & (pvp > 1e-12 * (p * p).sum()))
+                alpha = jnp.where(step_ok,
+                                  rs / jnp.maximum(pvp, 1e-30), 0.0)
                 x = x + alpha * p
                 r = r - alpha * vp
                 rs_new = (r * r).sum()
-                beta = jnp.where(live_step,
+                beta = jnp.where(step_ok,
                                  rs_new / jnp.maximum(rs, 1e-30), 0.0)
                 p = r + beta * p
                 return (x, r, p, rs_new), None

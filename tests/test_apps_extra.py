@@ -186,6 +186,50 @@ def test_wdamds_zero_weights_ignore_corrupted_entries(mesh):
         true_stress(Xw), true_stress(Xu))
 
 
+def test_wdamds_disconnected_weight_graph_stays_finite(mesh):
+    """Zero weights can disconnect the weight graph entirely — V becomes
+    block-diagonal with a per-component translation null space (bigger
+    than the global-translation one centering removes).  The CG guards
+    (absolute residual floor + curvature gate) must keep the solve finite
+    and still recover within-component geometry."""
+    from harp_tpu.models.wdamds import MDSConfig, mds
+
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(48, 3)).astype(np.float32)
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    # two components: no weight crosses the 24/24 split
+    w = np.zeros_like(delta)
+    w[:24, :24] = 1.0
+    w[24:, 24:] = 1.0
+    X, stress = mds(delta, MDSConfig(dim=3, iters=60, cg_iters=12),
+                    mesh, seed=1, weights=w)
+    assert np.isfinite(X).all() and np.isfinite(stress)
+    # within-component distances recovered (cross-component are free)
+    d = np.sqrt(((X[:, None] - X[None]) ** 2).sum(-1))
+    for sl in (slice(0, 24), slice(24, 48)):
+        blk_err = np.abs(delta[sl, sl] - d[sl, sl])
+        assert blk_err.mean() < 0.15 * delta[sl, sl].mean(), blk_err.mean()
+
+
+def test_wdamds_weighted_long_run_past_convergence_stays_finite(mesh):
+    """Once the outer SMACOF loop converges, every later CG solve starts
+    at (f32-noise) convergence: rs0 is already noise, so the old
+    relative-only freeze kept stepping and alpha = rs/~0 exploded.  A long
+    run must stay finite and keep the converged embedding accurate."""
+    from harp_tpu.models.wdamds import MDSConfig, mds
+
+    rng = np.random.default_rng(4)
+    pts = rng.normal(size=(32, 3)).astype(np.float32)
+    delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
+    w = np.ones_like(delta)
+    X, stress = mds(delta, MDSConfig(dim=3, iters=300, cg_iters=10),
+                    mesh, seed=2, weights=w)
+    assert np.isfinite(X).all() and np.isfinite(stress)
+    d = np.sqrt(((X[:, None] - X[None]) ** 2).sum(-1))
+    rel = np.abs(delta - d)[np.triu_indices(32, 1)].mean()
+    assert rel < 0.05 * delta[np.triu_indices(32, 1)].mean(), rel
+
+
 def test_wdamds_weights_validation(mesh):
     from harp_tpu.models.wdamds import mds
 

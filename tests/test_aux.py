@@ -249,9 +249,14 @@ def test_profiling_op_breakdown(mesh, tmp_path):
     with trace(d):
         float(f(x))
     rows2 = op_breakdown(d, top=5)
-    t1 = dict(rows).get(rows[0][0], 0.0)
-    t2 = dict(rows2).get(rows[0][0], 0.0)
-    assert t2 < 1.8 * t1 + 1e-4, (t1, t2)  # not accumulated across sessions
+    # newest-session-only, asserted structurally (device-op durations vary
+    # run to run, so a wall-clock ratio between captures would flake):
+    # the logdir parse must equal a parse of the newest session dir alone
+    import glob
+
+    sessions = sorted(glob.glob(f"{d}/plugins/profile/*/"))
+    assert len(sessions) == 2, sessions
+    assert rows2 == op_breakdown(sessions[-1], top=5)
 
     with pytest.raises(FileNotFoundError, match="trace.json.gz"):
         op_breakdown(str(tmp_path / "nope"))

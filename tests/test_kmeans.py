@@ -230,3 +230,21 @@ def test_kmeanspp_handles_fewer_distinct_rows_than_k(mesh):
     assert c.shape == (4, 2) and np.isfinite(c).all()
     cf, _ = fit(pts, k=4, iters=3, mesh=mesh, init="kmeans++")
     assert np.isfinite(cf).all()
+
+
+def test_kmeanspp_dominated_distances_never_reject_probabilities():
+    """One far outlier makes d2 mass concentrate on a single entry; the
+    selection probabilities are computed in float64 so rng.choice's
+    sum-to-one check holds regardless of numpy's dtype-dependent
+    tolerance policy (f32 division noise is ~6e-8 per entry; the f64
+    path keeps the deviation at ~1e-16).  Sweeps seeds as a canary —
+    any future revert to f32 probabilities risks intermittent
+    'probabilities do not sum to 1' on skewed data."""
+    from harp_tpu.models.kmeans import kmeanspp_init
+
+    rng = np.random.default_rng(0)
+    pts = (rng.normal(size=(512, 8)) * 1e-3).astype(np.float32)
+    pts[0] = 1e4  # dominating outlier
+    for seed in range(25):
+        c = kmeanspp_init(pts, k=4, seed=seed)
+        assert c.shape == (4, 8) and np.isfinite(c).all()

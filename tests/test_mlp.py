@@ -192,3 +192,16 @@ def test_fit_ckpt_rejects_mismatched_sizes(mesh, tmp_path):
     with pytest.raises(ValueError, match="refusing to resume"):
         M.MLPTrainer(M.MLPConfig(sizes=(16, 32, 4)), mesh, seed=0).fit_ckpt(
             x, y, 4, ck, batch_size=32, ckpt_every=1)
+
+
+def test_fit_ckpt_rejects_mismatched_optimizer(mesh, tmp_path):
+    # same param shapes, different optimizer state (sgd vs adam): must hit
+    # the clear shape guard, not an obscure tree.unflatten structure error
+    x, y = M.synthetic_mnist(n=128, d=16, classes=4, seed=0)
+    ck = str(tmp_path / "m")
+    M.MLPTrainer(M.MLPConfig(sizes=(16, 64, 4), optimizer="sgd"),
+                 mesh, seed=0).fit_ckpt(x, y, 2, ck, batch_size=32, ckpt_every=1)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        M.MLPTrainer(M.MLPConfig(sizes=(16, 64, 4), optimizer="adam"),
+                     mesh, seed=0).fit_ckpt(x, y, 4, ck, batch_size=32,
+                                            ckpt_every=1)
