@@ -1,14 +1,24 @@
 #!/usr/bin/env python
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Primary metric: KMeans iter/sec on the graded config #1 (k=100, 1M×300
-dense, BASELINE.json) on real TPU.  ``vs_baseline`` compares against the
-v0 number recorded in BASELINE.md (measured on this machine's single
-v5e chip, 2026-07-29, commit of first kmeans milestone).
+Covers the north-star pair (SURVEY.md §1: KMeans iter/s + MF-SGD
+updates/s/chip) and the other graded configs (LDA, MLP, subgraph, RF) in
+a single record: the headline metric/value/unit/vs_baseline fields are
+KMeans on graded config #1 (k=100, 1M×300 dense), and ``submetrics``
+carries one entry per additional config so `BENCH_r*.json` parses with
+kmeans AND mfsgd values (VERDICT round 1, item 3).
 
-Timing notes (see harp_tpu/utils/timing.py): all iterations run inside one
-jitted fori_loop; sync is a scalar readback, because block_until_ready can
-return early on this machine's relay transport.
+``vs_baseline`` compares against the v0 numbers in BASELINE.md (measured
+on this machine's single v5e chip, 2026-07-29/30) — a regression guard
+vs our own best, not a reference claim (no published Harp figure is
+pinned; BASELINE.json ``published`` is empty).
+
+Timing notes (see harp_tpu/utils/timing.py): all iterations run inside
+one jitted program; sync is a scalar readback, because block_until_ready
+can return early on this machine's relay transport.  The watchdog
+re-arms per config; if the TPU relay hangs mid-sweep the record still
+carries every config measured before the hang, with ``error`` naming the
+hung one.
 """
 
 import json
@@ -17,49 +27,114 @@ import threading
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
-# v0 regression baseline: KMeans 1M×300 k=100 f32, 1× TPU v5e, 2026-07-29.
-BASELINE_KMEANS_ITERS_PER_SEC = 400.0
+# v0 regression baselines, 1× TPU v5e (BASELINE.md, 2026-07-29/30).
+BASELINES = {
+    "kmeans": 400.0,        # iter/s, 1M×300 k=100 f32
+    "mfsgd": 96.4e6,        # updates/s/chip, ML-20M shapes, dense algo
+    "lda": 6.3e6,           # tokens/s/chip, 100k docs × 1k topics, dense
+    "mlp": 21.2e6,          # samples/s, MNIST shapes, device-resident
+    "subgraph": 83.6e3,     # vertices/s, u5-tree on 100k vertices
+    "rf": 7.07,             # trees/s, 32 trees depth 6 on 200k×64
+}
+
+
+def _configs(smoke):
+    """(name, unit, result_key, thunk) per graded config, headline first."""
+    from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+
+    import jax
+
+    return [
+        ("kmeans", "iter/s", "iters_per_sec", lambda: kmeans.benchmark(
+            **({"n": 8192, "d": 32, "k": 16, "iters": 20, "warmup": 2}
+               if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100,
+                "warmup": 5}))),
+        ("mfsgd", "updates/s/chip", "updates_per_sec_per_chip",
+         lambda: mfsgd.benchmark(
+             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
+                if smoke else {}))),
+        ("lda", "tokens/s/chip", "tokens_per_sec_per_chip",
+         lambda: lda.benchmark(
+             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                 "w_tile": 16, "entry_cap": 64} if smoke else {}))),
+        ("mlp", "samples/s", "samples_per_sec", lambda: mlp.benchmark(
+            **({"n": 4096, "batch": 512, "steps": 5} if smoke else {}))),
+        ("subgraph", "vertices/s", "vertices_per_sec",
+         lambda: subgraph.benchmark(
+             **({"n_vertices": 2000, "avg_degree": 4} if smoke else {}))),
+        ("rf", "trees/s", "trees_per_sec", lambda: rf.benchmark(
+            **({"n": 4096, "f": 16, "max_depth": 3,
+                "n_trees": 2 * jax.device_count()} if smoke else {}))),
+    ]
 
 
 def main():
     from harp_tpu.utils.timing import HangWatchdog
 
     smoke = "--smoke" in sys.argv
-    done = threading.Event()  # set once the real result line is out
+    only = [a for a in sys.argv[1:] if not a.startswith("-")]
+    unknown = set(only) - set(BASELINES)
+    if unknown:
+        # typo → loud error, not a clean-looking all-zero record
+        print(f"bench.py: unknown config(s) {sorted(unknown)}; "
+              f"choose from {sorted(BASELINES)}", file=sys.stderr)
+        raise SystemExit(2)
+    done = threading.Event()  # set once the result line is out
+    sub: dict = {}            # filled as configs complete (thread-shared)
+    suffix = "_smoke" if smoke else ""
+
+    def record(error=None):
+        km = sub.get("kmeans", {})
+        rec = {
+            "metric": ("kmeans_iters_per_sec" + suffix if smoke
+                       else "kmeans_iters_per_sec_1Mx300_k100"),
+            "value": km.get("value", 0.0),
+            # vs_baseline only when kmeans actually ran: an unmeasured or
+            # failed headline must not parse as a clean 0× regression
+            "unit": "iter/s",
+            "vs_baseline": (km.get("vs_baseline") if not smoke else None),
+            "submetrics": {k: v for k, v in sub.items() if k != "kmeans"},
+        }
+        # a kmeans exception must surface on the headline, not vanish
+        # when submetrics drops the kmeans key
+        error = error or km.get("error")
+        if error:
+            rec["error"] = error
+        return rec
 
     def emit_hang_record(what):
         # the driver expects ONE JSON line; a hang should still produce a
-        # parseable record rather than silence + exit code 3 — but never a
-        # SECOND line if the timer fires in the completion/cancel window
+        # parseable record (with every config measured so far) rather than
+        # silence + exit code 3 — but never a SECOND line if the timer
+        # fires in the completion/cancel window
         if done.is_set():
             return
-        print(json.dumps({
-            "metric": ("kmeans_iters_per_sec_smoke" if smoke
-                       else "kmeans_iters_per_sec_1Mx300_k100"),
-            "value": 0.0,
-            "unit": "iter/s",
-            "vs_baseline": None if smoke else 0.0,
-            "error": f"TPU relay hang during {what} (watchdog)",
-        }), flush=True)
+        done.set()
+        print(json.dumps(record(
+            error=f"TPU relay hang during {what} (watchdog)")), flush=True)
 
     watchdog = HangWatchdog(on_fire=emit_hang_record)  # HARP_BENCH_TIMEOUT
-    watchdog.arm("bench.py kmeans")
-    from harp_tpu.models import kmeans as KM
-
-    if smoke:
-        res = KM.benchmark(n=8192, d=32, k=16, iters=20, warmup=2)
-    else:
-        res = KM.benchmark(n=1_000_000, d=300, k=100, iters=100, warmup=5)
-
-    value = res["iters_per_sec"]
+    watchdog.arm("backend init")  # first backend use is inside _configs
+    for name, unit, key, thunk in _configs(smoke):
+        if only and name not in only:
+            continue
+        watchdog.arm(f"bench.py {name}")
+        try:
+            res = thunk()
+        except Exception as e:  # keep measuring the rest
+            sub[name] = {"value": 0.0, "unit": unit,
+                         "error": f"{type(e).__name__}: {e}"}
+            continue
+        value = float(res[key])
+        sub[name] = {"value": round(value, 2), "unit": unit,
+                     "vs_baseline": (None if smoke else
+                                     round(value / BASELINES[name], 4))}
     watchdog.cancel()
     done.set()
-    print(json.dumps({
-        "metric": "kmeans_iters_per_sec_1Mx300_k100" if not smoke else "kmeans_iters_per_sec_smoke",
-        "value": round(value, 2),
-        "unit": "iter/s",
-        "vs_baseline": round(value / BASELINE_KMEANS_ITERS_PER_SEC, 4) if not smoke else None,
-    }))
+    print(json.dumps(record()), flush=True)
 
 
 if __name__ == "__main__":

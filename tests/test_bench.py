@@ -1,0 +1,70 @@
+"""Driver-contract tests for bench.py — ONE JSON line, north-star pair.
+
+The driver parses bench.py's stdout as a single JSON record
+(`BENCH_r*.json`); round-1 VERDICT item 3 requires it to carry kmeans
+AND mfsgd values.  Runs bench.main() in-process (conftest already forced
+the 8-device CPU sim; a subprocess would hit the axon platform pin).
+"""
+
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def _run_bench(argv):
+    import runpy
+
+    buf = io.StringIO()
+    old = sys.argv
+    sys.argv = ["bench.py"] + argv
+    try:
+        with redirect_stdout(buf):
+            runpy.run_path(BENCH, run_name="__main__")
+    finally:
+        sys.argv = old
+    return buf.getvalue()
+
+
+def test_bench_smoke_emits_one_line_with_north_star_pair(mesh):
+    out = _run_bench(["--smoke", "kmeans", "mfsgd"])
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out
+    rec = json.loads(lines[0])
+    # headline contract fields
+    assert {"metric", "value", "unit", "vs_baseline"} <= rec.keys()
+    assert rec["unit"] == "iter/s"
+    assert rec["value"] > 0, rec
+    # the north-star pair: kmeans (headline) AND mfsgd (submetric)
+    assert rec["submetrics"]["mfsgd"]["value"] > 0, rec
+    assert rec["submetrics"]["mfsgd"]["unit"] == "updates/s/chip"
+    assert "error" not in rec
+
+
+def test_bench_rejects_unknown_config_names(mesh):
+    import pytest
+
+    with pytest.raises(SystemExit) as ei:
+        _run_bench(["--smoke", "kmaens"])
+    assert ei.value.code == 2
+
+
+def test_bench_headline_failure_surfaces_error(mesh, monkeypatch):
+    # a kmeans exception must appear as rec["error"], not parse as a
+    # clean 0× regression; vs_baseline must be absent, not 0.0
+    from harp_tpu.models import kmeans
+
+    def boom(**kw):
+        raise RuntimeError("synthetic kmeans failure")
+
+    monkeypatch.setattr(kmeans, "benchmark", boom)
+    out = _run_bench(["kmeans"])  # full mode so vs_baseline logic runs
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out
+    rec = json.loads(lines[0])
+    assert rec["value"] == 0.0
+    assert rec["vs_baseline"] is None
+    assert "synthetic kmeans failure" in rec["error"]
