@@ -28,8 +28,10 @@ import threading
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 # v0 regression baselines, 1× TPU v5e (BASELINE.md, 2026-07-29/30).
+# None = no TPU number recorded yet (vs_baseline stays null until one is).
 BASELINES = {
     "kmeans": 400.0,        # iter/s, 1M×300 k=100 f32
+    "kmeans_stream": None,  # iter/s, 100M×300 k=1000 blocked-epoch (new)
     "mfsgd": 96.4e6,        # updates/s/chip, ML-20M shapes, dense algo
     "lda": 6.3e6,           # tokens/s/chip, 100k docs × 1k topics, dense
     "mlp": 21.2e6,          # samples/s, MNIST shapes, device-resident
@@ -40,7 +42,8 @@ BASELINES = {
 
 def _configs(smoke):
     """(name, unit, result_key, thunk) per graded config, headline first."""
-    from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+    from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp, rf,
+                                 subgraph)
 
     import jax
 
@@ -50,6 +53,12 @@ def _configs(smoke):
                if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100,
                 "warmup": 5}))),
+        ("kmeans_stream", "iter/s", "iters_per_sec",
+         lambda: kmeans_stream.benchmark_streaming(
+             **({"n": 65536, "d": 16, "k": 16, "iters": 2,
+                 "chunk_points": 8192} if smoke else
+                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
+                 "chunk_points": 262_144}))),
         ("mfsgd", "updates/s/chip", "updates_per_sec_per_chip",
          lambda: mfsgd.benchmark(
              **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
@@ -129,9 +138,10 @@ def main():
                          "error": f"{type(e).__name__}: {e}"}
             continue
         value = float(res[key])
+        base = BASELINES[name]
         sub[name] = {"value": round(value, 2), "unit": unit,
-                     "vs_baseline": (None if smoke else
-                                     round(value / BASELINES[name], 4))}
+                     "vs_baseline": (None if smoke or base is None else
+                                     round(value / base, 4))}
     watchdog.cancel()
     done.set()
     print(json.dumps(record()), flush=True)

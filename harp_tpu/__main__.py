@@ -18,6 +18,8 @@ from importlib import import_module
 
 APPS = {
     "kmeans": ("harp_tpu.models.kmeans", "KMeans Lloyd iterations (allreduce)"),
+    "kmeans-stream": ("harp_tpu.models.kmeans_stream",
+                      "streaming KMeans for beyond-HBM datasets (1B-point path)"),
     "mfsgd": ("harp_tpu.models.mfsgd", "MF-SGD matrix factorization (rotate)"),
     "ccd": ("harp_tpu.models.ccd", "CCD++ matrix factorization (rotate)"),
     "lda": ("harp_tpu.models.lda", "LDA-CGS topic model (rotate + push/pull)"),

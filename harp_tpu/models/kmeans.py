@@ -78,7 +78,7 @@ class KMeansConfig:
                 f"got {self.variant!r}")
 
 
-def _partials_block(points, centroids, c2):
+def _partials_block(points, centroids, c2, mask=None):
     """Per-block partials: (sums [k,d], counts [k], inertia scalar).
 
     Everything routes through the MXU: the score matrix comes from
@@ -86,6 +86,9 @@ def _partials_block(points, centroids, c2):
     no gather (both are pathological on TPU; measured 180 ms/iter vs
     5.7 ms/iter fused on the 1M×300 k=100 config).  ||x||² is dropped from
     the argmin (assignment-invariant) and re-added only to the inertia.
+
+    ``mask`` (optional [b], 0/1): rows with mask 0 contribute nothing —
+    the streaming path pads its tail chunk to a fixed shape with these.
     """
     dots = jax.lax.dot_general(
         points, centroids.T, (((1,), (0,)), ((), ())),
@@ -93,9 +96,15 @@ def _partials_block(points, centroids, c2):
     )  # [b, k]
     scores = c2[None, :] - 2.0 * dots
     assign = jnp.argmin(scores, axis=1)
-    x2 = (points.astype(jnp.float32) ** 2).sum()
-    inertia = x2 + scores.min(axis=1).sum()
     onehot = jax.nn.one_hot(assign, c2.shape[0], dtype=points.dtype)
+    if mask is None:
+        x2 = (points.astype(jnp.float32) ** 2).sum()
+        inertia = x2 + scores.min(axis=1).sum()
+    else:
+        w = mask.astype(jnp.float32)
+        x2 = ((points.astype(jnp.float32) ** 2).sum(1) * w).sum()
+        inertia = x2 + (scores.min(axis=1) * w).sum()
+        onehot = onehot * mask.astype(onehot.dtype)[:, None]
     sums = jax.lax.dot_general(
         onehot, points, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -122,12 +131,14 @@ def quantize_points_int8(points):
     return q, scale.astype(np.float32)
 
 
-def _partials_block_int8(pts_q, col_scale, centroids, c2):
+def _partials_block_int8(pts_q, col_scale, centroids, c2, mask=None):
     """Quantized twin of :func:`_partials_block`: both matmuls run int8 on
     the MXU (v5e: 2× the bf16 rate, ¼ the f32 bytes); accumulation is
     exact int32, dequantized once per [k, d]/[k] output.  The centroid
     operand requantizes per iteration with a per-centroid scale, so the
-    only approximation is the two int8 roundings inside the argmin."""
+    only approximation is the two int8 roundings inside the argmin.
+    ``mask`` as in :func:`_partials_block` (int8 0/1 keeps the sums
+    matmul int8; a padded row contributes exact zeros)."""
     k = centroids.shape[0]
     cs = centroids.astype(jnp.float32) * col_scale[None, :]      # [k, d]
     c_q, c_scale_col = C.quantize_to_int8(cs, jnp.abs(cs).max(1, keepdims=True))
@@ -138,9 +149,16 @@ def _partials_block_int8(pts_q, col_scale, centroids, c2):
     dots = dots_i.astype(jnp.float32) * c_scale[None, :]
     scores = c2[None, :] - 2.0 * dots
     assign = jnp.argmin(scores, axis=1)
-    x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum()
-    inertia = x2 + scores.min(axis=1).sum()
     onehot = jax.nn.one_hot(assign, k, dtype=jnp.int8)
+    if mask is None:
+        x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum()
+        inertia = x2 + scores.min(axis=1).sum()
+    else:
+        w = mask.astype(jnp.float32)
+        x2 = (((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum(1)
+              * w).sum()
+        inertia = x2 + (scores.min(axis=1) * w).sum()
+        onehot = onehot * mask.astype(jnp.int8)[:, None]
     sums_i = jax.lax.dot_general(
         onehot, pts_q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)                        # [k, d]
@@ -197,15 +215,18 @@ def kmeans_step(points, centroids, cfg: KMeansConfig):
     return _combine_partials(sums, counts, partial_inertia, centroids, cfg, nw)
 
 
+def _normalize_centroids(sums, counts, old):
+    """Empty cluster keeps its old centroid — the ONE empty-cluster policy,
+    shared by every path (both fit variants AND the streaming module); a
+    change here, e.g. reseeding, must apply to all of them identically."""
+    return jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), old
+    ).astype(old.dtype)
+
+
 def _combine_partials(sums, counts, partial_inertia, centroids, cfg, nw):
     """The collective+normalize tail every partials formulation shares."""
-
-    def normalize(sums, counts, old):
-        # empty cluster keeps its old centroid (shared by both variants —
-        # a change here, e.g. reseeding, must apply to both identically)
-        return jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), old
-        ).astype(old.dtype)
+    normalize = _normalize_centroids
 
     if cfg.variant == "regroupallgather" and sums.shape[0] % nw == 0:
         # Harp's regroup+allgather: reduce-scatter the partials so worker w

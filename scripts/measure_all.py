@@ -25,7 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def run_all(smoke: bool, only, watchdog=None):
     import jax
 
-    from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+    from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp, rf,
+                                 subgraph)
 
     # (name, callable) — each returns the model module's benchmark dict
     configs = {
@@ -36,6 +37,13 @@ def run_all(smoke: bool, only, watchdog=None):
             quantize="int8",
             **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        # north-star shape (SURVEY.md §1): blocked-epoch streaming at
+        # 100M×300 k=1000 (full 1B runs via --n on the app CLI)
+        "kmeans_stream": lambda: kmeans_stream.benchmark_streaming(
+            **({"n": 65536, "d": 16, "k": 16, "iters": 2,
+                "chunk_points": 8192} if smoke else
+               {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
+                "chunk_points": 262_144})),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
@@ -90,8 +98,9 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "kmeans_int8", "mfsgd", "mfsgd_scatter",
-                            "lda", "lda_scatter", "mlp", "subgraph", "rf"],
+                   choices=["kmeans", "kmeans_int8", "kmeans_stream", "mfsgd",
+                            "mfsgd_scatter", "lda", "lda_scatter", "mlp",
+                            "subgraph", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     args = p.parse_args(argv)
