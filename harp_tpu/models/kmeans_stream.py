@@ -46,6 +46,7 @@ from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.utils.timing import device_sync
 
 from harp_tpu.models.kmeans import (  # shared MXU partials formulation
+    _INT8_SUM_ROW_LIMIT,
     _normalize_centroids,
     _partials_block,
     _partials_block_int8,
@@ -169,6 +170,13 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                                mesh.replicated())
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     scale_dev = None
+    if quantize == "int8" and chunk // nw > _INT8_SUM_ROW_LIMIT:
+        # same exact-int32 accumulation bound as kmeans.fit — here it
+        # applies PER CHUNK (cross-chunk accumulation is f32)
+        raise ValueError(
+            f"quantize='int8': {chunk // nw} chunk rows/worker exceeds the "
+            f"{_INT8_SUM_ROW_LIMIT} exact-int32 accumulation bound — "
+            "use a smaller chunk_points")
     if quantize == "int8":
         scales = _int8_scales(points, n, chunk)
         scale_dev = jax.device_put(jnp.asarray(scales), mesh.replicated())

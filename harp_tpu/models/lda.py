@@ -64,17 +64,38 @@ class LDAConfig:
     # the two scatters were 2.2 s of the 2.87 s epoch (~25 GB/s scatter
     # floor), while the take-gathers cost only 0.23 s and stay as takes.
     # "scatter" keeps the direct formulation as the readable reference.
+    # "pushpull" is Harp's OTHER edu.iu.lda variant (SURVEY.md §4.4):
+    # the word-topic table stays row-sharded (never rotated, never
+    # materialized); each chunk pulls exactly the word rows its tokens
+    # touch (table.pull_rows_sparse — O(touched rows) wire), samples, and
+    # pushes the deltas back (push_rows_sparse).  The right variant when
+    # the word-topic table outgrows one chip's HBM.
     # Delta matmuls are EXACT in bf16 (operands are 0/±1; f32 accumulate),
-    # so counts remain integers on both paths.
+    # so counts remain integers on all paths.
     algo: str = "dense"
     d_tile: int = 512   # dense: doc-topic tile rows
     w_tile: int = 512   # dense: word-topic tile rows
     entry_cap: int = 2048  # dense: max tokens per tile entry
-    chunk: int = 8192   # scatter: tokens sampled per count-snapshot
+    chunk: int = 8192   # scatter/pushpull: tokens sampled per count-snapshot
+    # pushpull: row-request slots per (worker, owner) pair and chunk.  The
+    # default (= chunk) guarantees zero drops (a chunk can never request
+    # more rows than it has tokens); lower caps shrink the all_to_all
+    # buffers ([nw·cap, K] each way) at the cost of counted drops —
+    # dropped tokens simply keep their topic that sweep (still a valid
+    # Gibbs chain: skipping a site preserves the stationary distribution).
+    pull_cap: int | None = None
 
     def __post_init__(self):
-        if self.algo not in ("dense", "scatter"):
-            raise ValueError(f"algo must be 'dense' or 'scatter', got {self.algo!r}")
+        if self.algo not in ("dense", "scatter", "pushpull"):
+            raise ValueError(
+                f"algo must be 'dense', 'scatter' or 'pushpull', "
+                f"got {self.algo!r}")
+        if self.pull_cap is not None and self.algo != "pushpull":
+            raise ValueError("pull_cap only applies to algo='pushpull'")
+        if self.pull_cap is not None and self.pull_cap < 1:
+            raise ValueError(
+                f"pull_cap must be >= 1, got {self.pull_cap} (0 would "
+                "silently fall back to the full-chunk default)")
 
 
 def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
@@ -104,6 +125,51 @@ def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
     Nwk = Nwk.at[w].add(delta, mode="drop")
     dNk = delta.sum(0)
     return Ndk, Nwk, dNk, z_new
+
+
+def _sample_chunk_pushpull(Ndk, Nwk_shard, Nk, z, chunk, key,
+                           cfg: LDAConfig, vocab_size):
+    """Pull → sample → push for one token chunk (Harp's edu.iu.lda
+    pull/push variant, SURVEY.md §4.4).
+
+    ``Nwk_shard`` is this worker's row block of the GLOBAL word-topic
+    table; the chunk's word rows arrive via ``pull_rows_sparse`` (wire =
+    touched rows, the table itself never moves) and the deltas return via
+    ``push_rows_sparse``.  A capacity-dropped token keeps its topic this
+    sweep — skipping a Gibbs site preserves the stationary distribution —
+    and pull-drop ⇒ its delta is zero, so the matching push slot (same
+    ids, same bucket order) carries nothing.
+    """
+    from harp_tpu.table import pull_rows_sparse, push_rows_sparse
+
+    d, w, m = chunk  # worker-local doc rows, GLOBAL word ids, valid mask
+    K = cfg.n_topics
+    cap = cfg.pull_cap if cfg.pull_cap is not None else d.shape[0]
+
+    # padding tokens (m == 0) issue no request and take no capacity slot
+    rows, ok, _ = pull_rows_sparse(Nwk_shard, w, capacity=cap, valid=m > 0)
+    mm = m * ok.astype(m.dtype)
+    oh_old = jax.nn.one_hot(z, K, dtype=jnp.float32) * mm[:, None]
+    ndk = jnp.take(Ndk, d, axis=0) - oh_old
+    nwk = rows - oh_old
+    nk = Nk[None, :] - oh_old
+
+    logp = (
+        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
+        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
+        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
+    )
+    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
+    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
+    z_new = jnp.where(mm > 0, z_new, z)
+
+    oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * mm[:, None]
+    delta = oh_new - oh_old
+    Ndk = Ndk.at[d].add(delta, mode="drop")
+    Nwk_shard, _ = push_rows_sparse(Nwk_shard, w, delta, capacity=cap,
+                                    valid=mm > 0)
+    dNk = delta.sum(0)
+    return Ndk, Nwk_shard, dNk, z_new
 
 
 def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
@@ -233,15 +299,54 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
     return epoch
 
 
+def _pushpull_epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig,
+                              vocab_size: int):
+    """Device-view epoch for ``algo="pushpull"``: no rotation — the
+    word-topic table stays row-sharded; each chunk is one
+    pull → sample → push round plus a psum of the topic-total deltas
+    (Harp's per-iteration pull/push granularity, SURVEY.md §4.4)."""
+
+    def epoch(Ndk, Nwk_shard, Nk, z, d, w, m, keys):
+        key = keys[0]
+        T = d.shape[0]
+        c = min(cfg.chunk, T)
+        nchunk = T // c
+        chunk_keys = jax.random.split(key, nchunk)
+
+        def body(st, inp):
+            Ndk, Nwk_shard, Nk = st
+            dc, wc, mc, zc, k = inp
+            Ndk, Nwk_shard, dNk, z_new = _sample_chunk_pushpull(
+                Ndk, Nwk_shard, Nk, zc, (dc, wc, mc), k, cfg, vocab_size)
+            Nk = Nk + C.allreduce(dNk)
+            return (Ndk, Nwk_shard, Nk), z_new
+
+        (Ndk, Nwk_shard, Nk), z_new = lax.scan(
+            body, (Ndk, Nwk_shard, Nk),
+            (d.reshape(nchunk, c), w.reshape(nchunk, c),
+             m.reshape(nchunk, c), z.reshape(nchunk, c), chunk_keys))
+        return Ndk, Nwk_shard, Nk, z_new.reshape(-1)
+
+    return epoch
+
+
+def _device_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+    """Pick the epoch body for ``cfg.algo`` (rotation vs pull/push)."""
+    if cfg.algo == "pushpull":
+        return _pushpull_epoch_device_fn(mesh, cfg, vocab_size)
+    return _epoch_device_fn(mesh, cfg, vocab_size)
+
+
 def _n_token_args(cfg: LDAConfig) -> int:
     return 5 if cfg.algo == "dense" else 4  # (+ keys)
 
 
 def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
-    """Compile one rotation epoch — see :func:`_epoch_device_fn`."""
+    """Compile one epoch — see :func:`_epoch_device_fn` (rotation algos)
+    and :func:`_pushpull_epoch_device_fn`."""
     return jax.jit(
         mesh.shard_map(
-            _epoch_device_fn(mesh, cfg, vocab_size),
+            _device_epoch_fn(mesh, cfg, vocab_size),
             in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
             + (mesh.spec(0),) * _n_token_args(cfg),
             out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
@@ -259,7 +364,7 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
     worker's base key, so the chain is identical to per-epoch dispatches
     with the same derivation.
     """
-    inner = _epoch_device_fn(mesh, cfg, vocab_size)
+    inner = _device_epoch_fn(mesh, cfg, vocab_size)
 
     def many(Ndk, Nwk_slice, Nk, z_grid, *token_args):
         tokens = token_args[:-1]
@@ -284,6 +389,34 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
     )
 
 
+def partition_tokens_by_doc(doc_ids, word_ids, z0, n_docs, n_workers,
+                            chunk):
+    """Partition tokens to their doc-owning worker (pushpull layout).
+
+    Docs are block-partitioned: worker w owns docs [w·d_bound, (w+1)·
+    d_bound).  Returns ``(d [n, T_pad] worker-LOCAL doc rows, w [n, T_pad]
+    GLOBAL word ids, z [n, T_pad], m [n, T_pad] mask, d_bound)`` with
+    T_pad a common multiple of ``min(chunk, T_pad)`` so the epoch scan
+    has static chunk shapes.  Padding slots use doc/word 0 with mask 0.
+    """
+    d_bound = -(-n_docs // n_workers)
+    owner = np.asarray(doc_ids) // d_bound
+    per = [np.flatnonzero(owner == wk) for wk in range(n_workers)]
+    t_max = max((len(p) for p in per), default=0)
+    T_pad = max(chunk, -(-t_max // chunk) * chunk) if t_max else chunk
+    d = np.zeros((n_workers, T_pad), np.int32)
+    w = np.zeros((n_workers, T_pad), np.int32)
+    z = np.zeros((n_workers, T_pad), np.int32)
+    m = np.zeros((n_workers, T_pad), np.float32)
+    for wk, idx in enumerate(per):
+        t = len(idx)
+        d[wk, :t] = np.asarray(doc_ids)[idx] - wk * d_bound
+        w[wk, :t] = np.asarray(word_ids)[idx]
+        z[wk, :t] = np.asarray(z0)[idx]
+        m[wk, :t] = 1.0
+    return d, w, z, m, d_bound
+
+
 class LDA:
     """Host driver (the mapCollective residue for edu.iu.lda)."""
 
@@ -297,6 +430,10 @@ class LDA:
             self.d_own, self.w_own, self.d_bound, wb2 = _dense_bounds(
                 n_docs, vocab_size, n, 2 * n, self.cfg.d_tile, self.cfg.w_tile)
             self.w_bound = 2 * wb2
+        elif self.cfg.algo == "pushpull":
+            self.d_bound = self.d_own = -(-n_docs // n)
+            # word-topic rows this worker OWNS (row-sharded global table)
+            self.w_bound = self.w_own = -(-vocab_size // n)
         else:
             self.d_bound = self.d_own = -(-n_docs // n)
             self.w_bound = 2 * (-(-vocab_size // (2 * n)))
@@ -323,6 +460,12 @@ class LDA:
                 self.d_own, self.w_own, self.d_bound, self.w_bound)
             z_grid = ez.astype(np.int32)
             tokens = (ed, ew, od, ow)
+        elif self.cfg.algo == "pushpull":
+            pd, pw, pz, pm, db = partition_tokens_by_doc(
+                doc_ids, word_ids, z0, self.n_docs, n, self.cfg.chunk)
+            assert db == self.d_bound
+            z_grid = pz.reshape(-1)
+            tokens = (pd.reshape(-1), pw.reshape(-1), pm.reshape(-1))
         else:
             bd, bw, bz, bm, db, wb2 = partition_ratings(
                 doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
@@ -362,6 +505,11 @@ class LDA:
         views).
         """
         n = self.mesh.num_workers
+        if self.cfg.algo == "pushpull":
+            pd, pw, pm = (np.asarray(a) for a in tokens)
+            t_pad = pd.shape[0] // n
+            gd = pd + (np.arange(n).repeat(t_pad) * self.d_bound)
+            return gd, pw, pm > 0  # word ids are already global
         db, wb2 = self.d_bound, self.w_bound // 2
         rows = np.arange(n * 2 * n)
         if self.cfg.algo == "dense":
@@ -513,24 +661,28 @@ def synthetic_corpus(n_docs, vocab_size, n_topics_true, tokens_per_doc, seed=0):
 
 
 def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
-              entry_cap=None):
+              entry_cap=None, pull_cap=None):
     """None inherits LDAConfig's defaults; algo-specific knobs raise when
-    combined with the other algo (shared contract: mfsgd.algo_kwargs)."""
-    return LDAConfig(n_topics=n_topics, **algo_kwargs(
-        algo, {"chunk": chunk},
-        {"d_tile": d_tile, "w_tile": w_tile, "entry_cap": entry_cap}))
+    combined with a non-owning algo (shared contract: mfsgd.algo_kwargs)."""
+    return LDAConfig(n_topics=n_topics, **algo_kwargs(algo, {
+        ("scatter", "pushpull"): {"chunk": chunk},
+        "dense": {"d_tile": d_tile, "w_tile": w_tile, "entry_cap": entry_cap},
+        "pushpull": {"pull_cap": pull_cap},
+    }))
 
 
 def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
               tokens_per_doc=100, epochs=2, mesh=None, chunk=None, seed=0,
-              algo="dense", d_tile=None, w_tile=None, entry_cap=None):
+              algo="dense", d_tile=None, w_tile=None, entry_cap=None,
+              pull_cap=None):
     """Tokens/sec/chip on an enwiki-1M-scaled config (graded config #3).
 
     (Full enwiki-1M docs needs a multi-chip pod for the 1M×1k doc-topic
     table; this keeps per-chip load representative.)
     """
     mesh = mesh or current_mesh()
-    cfg = _make_cfg(n_topics, algo, chunk, d_tile, w_tile, entry_cap)
+    cfg = _make_cfg(n_topics, algo, chunk, d_tile, w_tile, entry_cap,
+                    pull_cap)
     model = LDA(n_docs, vocab_size, cfg, mesh, seed)
     rng = np.random.default_rng(seed)
     n_tok = n_docs * tokens_per_doc
@@ -565,12 +717,19 @@ def main(argv=None):
     p.add_argument("--topics", type=int, default=1000)
     p.add_argument("--tokens-per-doc", type=int, default=100)
     p.add_argument("--epochs", type=int, default=2)
-    p.add_argument("--algo", choices=["dense", "scatter"], default="dense",
+    p.add_argument("--algo", choices=["dense", "scatter", "pushpull"],
+                   default="dense",
                    help="dense: one-hot MXU count updates (fastest, "
-                        "default); scatter: direct scatter-add reference")
+                        "default); scatter: direct scatter-add reference; "
+                        "pushpull: row-sharded word-topic table, sparse "
+                        "pull/push of touched rows (Harp's other edu.iu.lda "
+                        "variant; for tables beyond one chip's HBM)")
     p.add_argument("--chunk", type=int, default=None,
-                   help="scatter-only: tokens per count-snapshot "
+                   help="scatter/pushpull: tokens per count-snapshot "
                         "(default 8192); errors under --algo dense")
+    p.add_argument("--pull-cap", type=int, default=None,
+                   help="pushpull-only: row-request slots per (worker, "
+                        "owner) pair (default: chunk — zero drops)")
     p.add_argument("--d-tile", type=int, default=None,
                    help="dense-only: doc-topic tile rows (default 512)")
     p.add_argument("--w-tile", type=int, default=None,
@@ -616,7 +775,8 @@ def main(argv=None):
                                             args.tokens_per_doc)
         model = LDA(n_docs, vocab,
                     _make_cfg(args.topics, args.algo, args.chunk,
-                              args.d_tile, args.w_tile, args.entry_cap))
+                              args.d_tile, args.w_tile, args.entry_cap,
+                              args.pull_cap))
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
@@ -625,7 +785,8 @@ def main(argv=None):
         print(benchmark(args.docs or 100_000, args.vocab or 50_000, args.topics,
                         args.tokens_per_doc, args.epochs, chunk=args.chunk,
                         algo=args.algo, d_tile=args.d_tile,
-                        w_tile=args.w_tile, entry_cap=args.entry_cap))
+                        w_tile=args.w_tile, entry_cap=args.entry_cap,
+                        pull_cap=args.pull_cap))
 
 
 if __name__ == "__main__":

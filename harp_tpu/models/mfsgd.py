@@ -600,22 +600,24 @@ def synthetic_ratings(n_users, n_items, nnz, rank=8, noise=0.1, seed=0):
     return u.astype(np.int32), i.astype(np.int32), v.astype(np.float32)
 
 
-def algo_kwargs(algo: str, scatter_knobs: dict, dense_knobs: dict) -> dict:
+def algo_kwargs(algo: str, groups: dict) -> dict:
     """Validated algo-specific config kwargs (shared by mfsgd and lda).
 
-    ``None`` values inherit the config defaults; a non-None knob combined
-    with the other algo raises — a silently-ignored tuning flag wastes
-    benchmark sweeps."""
+    ``groups``: ``{owner_algo(s): {knob: value}}`` — the key is one algo
+    name or a tuple of them (a knob like lda's ``chunk`` can belong to
+    several).  ``None`` values inherit the config defaults; a non-None
+    knob combined with a non-owning algo raises — a silently-ignored
+    tuning flag wastes benchmark sweeps."""
     kw: dict[str, Any] = {"algo": algo}
-    for knobs, owner in ((scatter_knobs, "scatter"), (dense_knobs, "dense")):
-        other = "dense" if owner == "scatter" else "scatter"
+    for owners, knobs in groups.items():
+        owners_t = (owners,) if isinstance(owners, str) else tuple(owners)
         for name, val in knobs.items():
             if val is None:
                 continue
-            if algo != owner:
+            if algo not in owners_t:
                 raise ValueError(
-                    f"{name} is {owner}-only; pass algo='{owner}' or tune "
-                    f"the {other} knobs instead (algo={algo!r})")
+                    f"{name} is {'/'.join(owners_t)}-only; pass one of "
+                    f"those algos or tune the {algo!r} knobs instead")
             kw[name] = val
     return kw
 
@@ -623,9 +625,10 @@ def algo_kwargs(algo: str, scatter_knobs: dict, dense_knobs: dict) -> dict:
 def _make_config(rank: int, chunk: int | None, algo: str = "dense",
                  u_tile: int | None = None, i_tile: int | None = None,
                  entry_cap: int | None = None) -> MFSGDConfig:
-    return MFSGDConfig(rank=rank, **algo_kwargs(
-        algo, {"chunk": chunk},
-        {"u_tile": u_tile, "i_tile": i_tile, "entry_cap": entry_cap}))
+    return MFSGDConfig(rank=rank, **algo_kwargs(algo, {
+        "scatter": {"chunk": chunk},
+        "dense": {"u_tile": u_tile, "i_tile": i_tile, "entry_cap": entry_cap},
+    }))
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 
-def bucket_by_destination(dest, payloads, capacity: int, n_dest: int):
+def bucket_by_destination(dest, payloads, capacity: int, n_dest: int,
+                          valid=None):
     """Pack items into per-destination capacity buckets.
 
     Args:
@@ -24,18 +25,28 @@ def bucket_by_destination(dest, payloads, capacity: int, n_dest: int):
       payloads: tuple of arrays with leading dim n (any trailing shape).
       capacity: slots per destination bucket.
       n_dest: number of destinations.
+      valid: optional [n] bool — False items are intentionally skipped:
+        they take no bucket slot, send nothing, and are NOT counted as
+        dropped (capacity-drop accounting stays meaningful for padding-
+        heavy callers like LDA pushpull chunks).
     Returns ``(bufs, keep, slot, dropped_local)``:
       bufs — tuple of [n_dest, capacity, ...] arrays, item i stored at
       ``(dest[i], slot[i])`` when kept, zeros elsewhere;
-      keep — [n] bool, False for over-capacity items;
+      keep — [n] bool, False for over-capacity (and invalid) items;
       slot — [n] int, the in-bucket position (== capacity for dropped
       items; pair with ``keep`` when gathering back);
-      dropped_local — scalar count of THIS shard's dropped items.
+      dropped_local — scalar count of THIS shard's dropped VALID items.
     """
     n = dest.shape[0]
     onehot = jax.nn.one_hot(dest, n_dest, dtype=jnp.int32)     # [n, n_dest]
+    if valid is None:
+        valid = jnp.ones(n, bool)
+    else:
+        onehot = onehot * valid[:, None].astype(onehot.dtype)
+    # compact slots over VALID items only (invalid rows are all-zero in
+    # the cumsum, so they never displace a valid item's position)
     pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(n), dest]
-    keep = pos < capacity
+    keep = (pos < capacity) & valid
     slot = jnp.where(keep, pos, capacity)  # trash slot, sliced off below
 
     bufs = []
@@ -43,4 +54,4 @@ def bucket_by_destination(dest, payloads, capacity: int, n_dest: int):
         buf = jnp.zeros((n_dest, capacity + 1) + p.shape[1:], p.dtype)
         masked = p * keep.reshape((n,) + (1,) * (p.ndim - 1)).astype(p.dtype)
         bufs.append(buf.at[dest, slot].set(masked)[:, :capacity])
-    return tuple(bufs), keep, slot, jnp.sum(~keep)
+    return tuple(bufs), keep, slot, jnp.sum(~keep & valid)

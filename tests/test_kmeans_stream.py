@@ -85,6 +85,18 @@ def test_streaming_config_validation():
         KS.StreamConfig(chunk_points=0)
 
 
+def test_streaming_int8_rejects_wrap_prone_chunk(mesh, monkeypatch):
+    # the exact-int32 accumulation bound applies per chunk (same guard
+    # as kmeans.fit; cross-chunk accumulation is f32 so only the chunk
+    # row count matters).  The real limit needs ~135M rows to trip, so
+    # shrink it — the guard reads the module global at call time.
+    monkeypatch.setattr(KS, "_INT8_SUM_ROW_LIMIT", 4)
+    pts = _blobs(n=256)
+    with pytest.raises(ValueError, match="accumulation bound"):
+        KS.fit_streaming(pts, k=4, iters=1, chunk_points=256,
+                         mesh=mesh, quantize="int8")
+
+
 def test_synthetic_fused_benchmark_converges(mesh):
     # the ONE-jit full-scale formulation: same dataset every epoch, so
     # inertia must descend across separate calls with more iters

@@ -8,10 +8,11 @@ from harp_tpu.models import lda as L
 N = 8
 
 
-@pytest.fixture(params=["dense", "scatter"])
+@pytest.fixture(params=["dense", "scatter", "pushpull"])
 def small_model(mesh, request):
-    """Fresh model per test (both count-update algos): shared state would
-    make assertions depend on test execution order."""
+    """Fresh model per test (all three count-update algos — dense/scatter
+    rotation and the pull/push variant): shared state would make
+    assertions depend on test execution order."""
     cfg = L.LDAConfig(n_topics=8, algo=request.param, chunk=64,
                       d_tile=16, w_tile=16, entry_cap=64,
                       alpha=0.5, beta=0.1)
@@ -96,3 +97,51 @@ def test_sample_epochs_matches_convergence_contract(small_model):
     model.sample_epochs(6)
     counts_consistent(model)
     assert model.log_likelihood() > ll0
+
+
+def test_pushpull_word_table_never_materialized_contract(mesh):
+    """The pushpull variant's word-topic table is row-sharded and exchanged
+    only through the sparse pull/push verbs — counts stay exact integers
+    and the chain converges, matching the rotation algos' invariants."""
+    d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                              tokens_per_doc=50, seed=0)
+    model = L.LDA(96, 64, L.LDAConfig(n_topics=8, algo="pushpull", chunk=64,
+                                      alpha=0.5, beta=0.1), mesh, seed=1)
+    model.set_tokens(d, w)
+    ll0 = model.log_likelihood()
+    for _ in range(6):
+        model.sample_epoch()
+    counts_consistent(model)
+    Nwk = model.word_topic_table()
+    assert np.all(Nwk == np.round(Nwk))  # pull/push kept counts integral
+    assert model.log_likelihood() > ll0 + 0.2
+
+
+def test_pushpull_small_pull_cap_still_valid_chain(mesh):
+    """A pull_cap below the worst-case demand drops tokens (they keep
+    their topic that sweep — still a valid Gibbs chain): count invariants
+    must hold exactly and likelihood must still ascend."""
+    d, w = L.synthetic_corpus(n_docs=64, vocab_size=32, n_topics_true=2,
+                              tokens_per_doc=32, seed=1)
+    model = L.LDA(64, 32, L.LDAConfig(n_topics=4, algo="pushpull", chunk=64,
+                                      pull_cap=16), mesh, seed=1)
+    model.set_tokens(d, w)
+    ll0 = model.log_likelihood()
+    for _ in range(8):
+        model.sample_epoch()
+    Ndk = np.asarray(model.Ndk)
+    Nwk = np.asarray(model.Nwk)
+    assert Ndk.sum() == model.n_tokens and Nwk.sum() == model.n_tokens
+    np.testing.assert_allclose(Nwk.sum(0), np.asarray(model.Nk))
+    assert model.log_likelihood() > ll0
+
+
+def test_pushpull_rejects_dense_knobs():
+    with pytest.raises(ValueError, match="pull_cap only applies"):
+        L.LDAConfig(algo="dense", pull_cap=8)
+    with pytest.raises(ValueError, match="dense-only"):
+        L._make_cfg(4, algo="pushpull", d_tile=8)
+    with pytest.raises(ValueError, match="pushpull-only"):
+        L._make_cfg(4, algo="scatter", chunk=16, pull_cap=8)
+    with pytest.raises(ValueError, match="pull_cap must be >= 1"):
+        L.LDAConfig(algo="pushpull", pull_cap=0)

@@ -16,7 +16,9 @@ from harp_tpu.table import (
     kv_allreduce,
     modulo_partitioner,
     pull_rows,
+    pull_rows_sparse,
     push_rows,
+    push_rows_sparse,
 )
 
 N = 8
@@ -80,6 +82,122 @@ def test_pull_push_rows(mesh):
     expect = global_table.copy()
     expect[[0, 5, 15]] += N  # every one of the N workers pushed +1
     np.testing.assert_allclose(np.asarray(updated), expect)
+
+
+def _sparse_pull_fn(mesh, capacity):
+    return jax.jit(mesh.shard_map(
+        lambda shard, ids: pull_rows_sparse(shard, ids, capacity=capacity),
+        in_specs=(mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), mesh.spec(0), P()),
+    ))
+
+
+def test_pull_rows_sparse_matches_dense(mesh):
+    """Property: the request/serve pull returns exactly table[row_ids],
+    per worker, with DIFFERENT ids on every worker (the dense-path test
+    uses replicated ids; this is the general case)."""
+    rng = np.random.default_rng(0)
+    rpw, d, m = 6, 3, 7
+    table = rng.normal(size=(N * rpw, d)).astype(np.float32)
+    ids = rng.integers(0, N * rpw, size=(N * m)).astype(np.int32)
+
+    rows, ok, dropped = _sparse_pull_fn(mesh, capacity=m)(table, ids)
+    assert int(dropped) == 0
+    assert np.asarray(ok).all()
+    np.testing.assert_allclose(np.asarray(rows), table[ids])
+
+
+def test_pull_rows_sparse_duplicates_and_1d(mesh):
+    # duplicate ids on one worker + a 1-D value table
+    rng = np.random.default_rng(1)
+    rpw = 4
+    table = rng.normal(size=(N * rpw,)).astype(np.float32)
+    ids = np.tile(np.array([5, 5, 0, 31], np.int32), N)
+    rows, ok, dropped = _sparse_pull_fn(mesh, capacity=4)(table, ids)
+    assert int(dropped) == 0 and np.asarray(ok).all()
+    np.testing.assert_allclose(np.asarray(rows), table[ids])
+
+
+def test_pull_rows_sparse_capacity_overflow_counted(mesh):
+    # every worker asks owner 0 for rpw*... more rows than capacity:
+    # overflow must be dropped, masked, and counted globally
+    rpw, d = 2, 3
+    table = np.arange(N * rpw * d, dtype=np.float32).reshape(N * rpw, d)
+    ids = np.zeros(N * 5, np.int32)  # all want row 0 (owner 0), 5 each
+    rows, ok, dropped = _sparse_pull_fn(mesh, capacity=3)(table, ids)
+    ok = np.asarray(ok).reshape(N, 5)
+    assert (ok.sum(1) == 3).all()          # 3 kept per worker
+    assert int(dropped) == N * 2           # 2 dropped per worker
+    rows = np.asarray(rows).reshape(N, 5, d)
+    np.testing.assert_allclose(rows[ok], np.tile(table[0], (N * 3, 1)))
+    np.testing.assert_allclose(rows[~ok], 0.0)
+
+
+def test_pull_rows_sparse_valid_mask_skips_padding(mesh):
+    """valid=False entries issue no request, take no capacity slot, and
+    are not counted dropped — padding must not crowd real requests."""
+    rpw, d = 2, 3
+    table = np.arange(N * rpw * d, dtype=np.float32).reshape(N * rpw, d)
+    # per worker: 2 real requests for row 0 + 3 padding entries also
+    # pointing at row 0; capacity 2 → without the mask, padding would
+    # overflow the bucket and drop real requests
+    ids = np.zeros(N * 5, np.int32)
+    valid = np.tile(np.array([1, 1, 0, 0, 0], bool), N)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda shard, i, v: pull_rows_sparse(shard, i, capacity=2, valid=v),
+        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), mesh.spec(0), P()),
+    ))
+    rows, ok, dropped = fn(table, ids, valid)
+    assert int(dropped) == 0                      # padding never counts
+    np.testing.assert_array_equal(np.asarray(ok), valid)
+    rows = np.asarray(rows)
+    np.testing.assert_allclose(rows[valid], np.tile(table[0], (N * 2, 1)))
+    np.testing.assert_allclose(rows[~valid], 0.0)
+
+
+def test_push_rows_sparse_matches_dense(mesh):
+    """Property: sparse push ≡ np scatter-add of every worker's deltas."""
+    rng = np.random.default_rng(2)
+    rpw, d, m = 6, 3, 9
+    table = rng.normal(size=(N * rpw, d)).astype(np.float32)
+    ids = rng.integers(0, N * rpw, size=(N * m)).astype(np.int32)
+    deltas = rng.normal(size=(N * m, d)).astype(np.float32)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda shard, i, dv: push_rows_sparse(shard, i, dv, capacity=m),
+        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), P()),
+    ))
+    new_table, dropped = fn(table, ids, deltas)
+    assert int(dropped) == 0
+    expect = table.copy()
+    np.add.at(expect, ids, deltas)
+    np.testing.assert_allclose(np.asarray(new_table), expect, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_push_then_pull_sparse_roundtrip(mesh):
+    # push deltas then pull the same rows back: reads see the writes
+    rpw, d = 3, 2
+    table = np.zeros((N * rpw, d), np.float32)
+    ids = (np.arange(N, dtype=np.int32) * rpw).repeat(2)  # 2 pushes each
+
+    def prog(shard, i):
+        dv = jnp.ones((i.shape[0], d), jnp.float32)
+        shard, dropped = push_rows_sparse(shard, i, dv, capacity=4)
+        rows, ok, _ = pull_rows_sparse(shard, i, capacity=4)
+        return shard, rows, ok, dropped
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(0), P())))
+    shard, rows, ok, dropped = fn(table, ids)
+    assert int(dropped) == 0 and np.asarray(ok).all()
+    # each pushed row got +1 from each of its 2 duplicate pushes... from
+    # every worker that owns the same id (ids differ per worker here)
+    np.testing.assert_allclose(np.asarray(rows), 2.0)
 
 
 def test_regroup_by_key_routes_to_owner(mesh):
