@@ -66,10 +66,14 @@ class LDAConfig:
     # "scatter" keeps the direct formulation as the readable reference.
     # "pushpull" is Harp's OTHER edu.iu.lda variant (SURVEY.md §4.4):
     # the word-topic table stays row-sharded (never rotated, never
-    # materialized); each chunk pulls exactly the word rows its tokens
-    # touch (table.pull_rows_sparse — O(touched rows) wire), samples, and
-    # pushes the deltas back (push_rows_sparse).  The right variant when
-    # the word-topic table outgrows one chip's HBM.
+    # materialized); each chunk pulls the word rows its tokens touch
+    # (table.pull_rows_sparse), samples, and pushes the deltas back
+    # (push_rows_sparse).  The exchange travels in [nw, pull_cap, K]
+    # capacity buffers, so wire is O(nw·pull_cap) per chunk — independent
+    # of TABLE size (the point: the right variant when the word-topic
+    # table outgrows one chip's HBM), but nw× the touched rows at the
+    # zero-drop default cap; size pull_cap ≈ chunk/nw when drops are
+    # acceptable.
     # Delta matmuls are EXACT in bf16 (operands are 0/±1; f32 accumulate),
     # so counts remain integers on all paths.
     algo: str = "dense"
@@ -98,6 +102,20 @@ class LDAConfig:
                 "silently fall back to the full-chunk default)")
 
 
+def _cgs_resample(ndk, nwk, nk, z, mask, key, cfg: LDAConfig, vocab_size):
+    """The ONE CGS posterior + Gumbel-argmax draw, shared by all three
+    algos — a change here (clamps, priors, denominator) applies to
+    dense, scatter and pushpull identically."""
+    logp = (
+        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
+        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
+        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
+    )
+    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
+    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(mask > 0, z_new, z)
+
+
 def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
     """Blocked-Gibbs resample of one token chunk against a count snapshot."""
     d, w, m = chunk  # local doc ids, local word ids, valid mask  [c]
@@ -109,14 +127,7 @@ def _sample_chunk(Ndk, Nwk, Nk, z, chunk, key, cfg: LDAConfig, vocab_size):
     nwk = jnp.take(Nwk, w, axis=0) - oh_old          # [c, K]
     nk = Nk[None, :] - oh_old                        # [c, K]
 
-    logp = (
-        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
-        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
-        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
-    )
-    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
-    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
-    z_new = jnp.where(m > 0, z_new, z)
+    z_new = _cgs_resample(ndk, nwk, nk, z, m, key, cfg, vocab_size)
 
     # apply count deltas (scatter; chunk-granular like Harp's schedulers)
     oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * m[:, None]
@@ -147,29 +158,25 @@ def _sample_chunk_pushpull(Ndk, Nwk_shard, Nk, z, chunk, key,
     cap = cfg.pull_cap if cfg.pull_cap is not None else d.shape[0]
 
     # padding tokens (m == 0) issue no request and take no capacity slot
-    rows, ok, _ = pull_rows_sparse(Nwk_shard, w, capacity=cap, valid=m > 0)
+    rows, ok, pull_drop = pull_rows_sparse(Nwk_shard, w, capacity=cap,
+                                           valid=m > 0)
     mm = m * ok.astype(m.dtype)
     oh_old = jax.nn.one_hot(z, K, dtype=jnp.float32) * mm[:, None]
     ndk = jnp.take(Ndk, d, axis=0) - oh_old
     nwk = rows - oh_old
     nk = Nk[None, :] - oh_old
 
-    logp = (
-        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
-        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
-        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
-    )
-    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
-    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
-    z_new = jnp.where(mm > 0, z_new, z)
+    z_new = _cgs_resample(ndk, nwk, nk, z, mm, key, cfg, vocab_size)
 
     oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * mm[:, None]
     delta = oh_new - oh_old
     Ndk = Ndk.at[d].add(delta, mode="drop")
+    # push validity ⊆ pull ok, so push can never drop — pull_drop is the
+    # whole per-chunk drop count, surfaced through the epoch scan
     Nwk_shard, _ = push_rows_sparse(Nwk_shard, w, delta, capacity=cap,
                                     valid=mm > 0)
     dNk = delta.sum(0)
-    return Ndk, Nwk_shard, dNk, z_new
+    return Ndk, Nwk_shard, dNk, z_new, pull_drop
 
 
 def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
@@ -198,14 +205,7 @@ def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
     nwk = jnp.take(Wb, jnp.minimum(cw, WR - 1), axis=0) - oh_old
     nk = Nk[None, :] - oh_old
 
-    logp = (
-        jnp.log(jnp.maximum(ndk + cfg.alpha, 1e-10))
-        + jnp.log(jnp.maximum(nwk + cfg.beta, 1e-10))
-        - jnp.log(jnp.maximum(nk + vocab_size * cfg.beta, 1e-10))
-    )
-    gumbel = jax.random.gumbel(key, logp.shape, logp.dtype)
-    z_new = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)
-    z_new = jnp.where(m > 0, z_new, z)
+    z_new = _cgs_resample(ndk, nwk, nk, z, m, key, cfg, vocab_size)
 
     oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * m[:, None]
     delta = (oh_new - oh_old).astype(jnp.bfloat16)  # entries ∈ {-1,0,1}: exact
@@ -314,18 +314,18 @@ def _pushpull_epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig,
         chunk_keys = jax.random.split(key, nchunk)
 
         def body(st, inp):
-            Ndk, Nwk_shard, Nk = st
+            Ndk, Nwk_shard, Nk, drop = st
             dc, wc, mc, zc, k = inp
-            Ndk, Nwk_shard, dNk, z_new = _sample_chunk_pushpull(
+            Ndk, Nwk_shard, dNk, z_new, d_chunk = _sample_chunk_pushpull(
                 Ndk, Nwk_shard, Nk, zc, (dc, wc, mc), k, cfg, vocab_size)
             Nk = Nk + C.allreduce(dNk)
-            return (Ndk, Nwk_shard, Nk), z_new
+            return (Ndk, Nwk_shard, Nk, drop + d_chunk), z_new
 
-        (Ndk, Nwk_shard, Nk), z_new = lax.scan(
-            body, (Ndk, Nwk_shard, Nk),
+        (Ndk, Nwk_shard, Nk, drop), z_new = lax.scan(
+            body, (Ndk, Nwk_shard, Nk, jnp.int32(0)),
             (d.reshape(nchunk, c), w.reshape(nchunk, c),
              m.reshape(nchunk, c), z.reshape(nchunk, c), chunk_keys))
-        return Ndk, Nwk_shard, Nk, z_new.reshape(-1)
+        return Ndk, Nwk_shard, Nk, z_new.reshape(-1), drop
 
     return epoch
 
@@ -341,6 +341,12 @@ def _n_token_args(cfg: LDAConfig) -> int:
     return 5 if cfg.algo == "dense" else 4  # (+ keys)
 
 
+def _epoch_out_specs(mesh, cfg):
+    """Pushpull epochs also return the global drop counter (replicated)."""
+    base = (mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
+    return base + ((P(),) if cfg.algo == "pushpull" else ())
+
+
 def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
     """Compile one epoch — see :func:`_epoch_device_fn` (rotation algos)
     and :func:`_pushpull_epoch_device_fn`."""
@@ -349,7 +355,7 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
             _device_epoch_fn(mesh, cfg, vocab_size),
             in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
             + (mesh.spec(0),) * _n_token_args(cfg),
-            out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
+            out_specs=_epoch_out_specs(mesh, cfg),
         )
     )
 
@@ -366,25 +372,30 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
     """
     inner = _device_epoch_fn(mesh, cfg, vocab_size)
 
+    pp = cfg.algo == "pushpull"
+
     def many(Ndk, Nwk_slice, Nk, z_grid, *token_args):
         tokens = token_args[:-1]
         base = jax.random.wrap_key_data(token_args[-1][0])
 
         def body(carry, e):
-            Ndk, Nwk_slice, Nk, z_grid = carry
+            st, drop = carry[:4], carry[4:]
             k = jax.random.key_data(jax.random.fold_in(base, e))[None]
-            return inner(Ndk, Nwk_slice, Nk, z_grid, *tokens, k), None
+            out = inner(*st, *tokens, k)
+            if pp:  # accumulate the drop counter across sweeps
+                out = out[:4] + (drop[0] + out[4],)
+            return out, None
 
-        (Ndk, Nwk_slice, Nk, z_grid), _ = lax.scan(
-            body, (Ndk, Nwk_slice, Nk, z_grid), jnp.arange(epochs))
-        return Ndk, Nwk_slice, Nk, z_grid
+        init = (Ndk, Nwk_slice, Nk, z_grid) + ((jnp.int32(0),) if pp else ())
+        out, _ = lax.scan(body, init, jnp.arange(epochs))
+        return out
 
     return jax.jit(
         mesh.shard_map(
             many,
             in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
             + (mesh.spec(0),) * _n_token_args(cfg),
-            out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0)),
+            out_specs=_epoch_out_specs(mesh, cfg),
         )
     )
 
@@ -442,6 +453,9 @@ class LDA:
         self._multi_fns: dict = {}
         self._seed = seed
         self._tokens = None
+        # pushpull only: tokens skipped by pull_cap capacity drops in the
+        # most recent sample_epoch/sample_epochs call (0 = none skipped)
+        self.last_dropped = 0
 
     def set_tokens(self, doc_ids, word_ids):
         """Load the token corpus (one entry per token occurrence)."""
@@ -562,27 +576,36 @@ class LDA:
                 keys).compile()
         return fn
 
+    def _install_epoch_out(self, out):
+        self.Ndk, self.Nwk, self.Nk, self.z_grid = out[:4]
+        if self.cfg.algo == "pushpull":
+            # surface the pull_cap drop count (the "counted, never
+            # silently wrong" half of the capacity contract); reading it
+            # back doubles as the device sync
+            self.last_dropped = int(np.asarray(out[4]))
+        else:
+            device_sync(self.Nk)
+
     def sample_epochs(self, epochs: int):
         """Run ``epochs`` Gibbs sweeps as one device program (one dispatch,
         one sync) — see :func:`make_multi_epoch_fn`.  Use :meth:`fit` when
         checkpointing between sweeps."""
         fn = self.compile_epochs(epochs)
         keys = self.mesh.shard_array(self._keys, 0)
-        self.Ndk, self.Nwk, self.Nk, self.z_grid = fn(
-            self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
-        )
+        out = fn(self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
+                 keys)
         self._advance_keys()
-        device_sync(self.Nk)
+        self._install_epoch_out(out)
 
     def sample_epoch(self):
         if self._tokens is None:
             raise RuntimeError("call set_tokens() before sample_epoch()")
         keys = self.mesh.shard_array(self._keys, 0)
-        self.Ndk, self.Nwk, self.Nk, self.z_grid = self._epoch_fn(
+        out = self._epoch_fn(
             self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
         )
         self._advance_keys()
-        device_sync(self.Nk)
+        self._install_epoch_out(out)
 
     def _advance_keys(self):
         # PRNGKey(python_int) specializes on the int — a remote compile per
@@ -698,12 +721,15 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
     t0 = time.perf_counter()
     model.sample_epochs(epochs)  # ONE dispatch + sync for all epochs
     dt = time.perf_counter() - t0
-    return {
+    out = {
         "tokens_per_sec_per_chip": n_tok * epochs / dt / mesh.num_workers,
         "sec_per_epoch": dt / epochs,
         "n_tokens": n_tok, "n_topics": n_topics,
         "prep_sec": prep, "num_workers": mesh.num_workers,
     }
+    if algo == "pushpull":
+        out["dropped_tokens"] = model.last_dropped  # pull_cap overflow
+    return out
 
 
 def main(argv=None):

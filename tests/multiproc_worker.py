@@ -1,10 +1,12 @@
-"""Worker script for the two-process jax.distributed test (not a pytest module).
+"""Worker script for the multi-process jax.distributed tests (not a pytest module).
 
 Launched by tests/test_multiprocess.py as ``python multiproc_worker.py
-<process_id> <port>``.  Validates the multi-host code paths without TPU
-hardware: ``init_distributed`` bootstrap, a mesh spanning processes, a
-device collective crossing the process boundary (Gloo on CPU — the DCN
-stand-in), and ``kv_allreduce``'s host-side cross-process union.
+<process_id> <port> [num_processes]``.  Validates the multi-host code
+paths without TPU hardware: ``init_distributed`` bootstrap, a mesh
+spanning processes, and EVERY collective family crossing a real process
+boundary (Gloo on CPU — the DCN stand-in): allreduce, regroup /
+all_to_all, dense push/pull, the sparse request/serve pull/push, the
+host-side ``kv_allreduce`` union, and a full MF-SGD rotation epoch.
 """
 
 import os
@@ -12,6 +14,7 @@ import sys
 
 proc_id = int(sys.argv[1])
 port = sys.argv[2]
+n_procs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 
 import jax
 
@@ -22,29 +25,94 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from harp_tpu import Int2IntKVTable, WorkerMesh, init_distributed, kv_allreduce
 from harp_tpu.parallel import collective as C
 
-init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=proc_id)
-assert jax.process_count() == 2, jax.process_count()
+init_distributed(f"127.0.0.1:{port}", num_processes=n_procs,
+                 process_id=proc_id)
+assert jax.process_count() == n_procs, jax.process_count()
 
 import numpy as np
 
-mesh = WorkerMesh()  # 2 devices, one per process
-assert mesh.num_workers == 2
+mesh = WorkerMesh()  # one device per process
+nw = mesh.num_workers
+assert nw == n_procs
 
 # device collective across the process boundary; in multi-process each
 # host reads only its addressable shard of the global result
 op = C.host_op(mesh, C.allreduce, in_dim=0, out_dim=0)
-x = np.arange(4, dtype=np.float32).reshape(2, 2)
+x = np.arange(2 * nw, dtype=np.float32).reshape(nw, 2)
 out = op(x)
 local = np.asarray(out.addressable_shards[0].data)
 np.testing.assert_allclose(local, x.sum(0)[None, :])
+
+# regroup / all_to_all across the boundary: worker w sends block j of
+# its [nw] vector to worker j; worker w ends holding every peer's block w
+rg = C.host_op(mesh, C.regroup, in_dim=0, out_dim=0)
+xr = (np.arange(nw)[:, None] * 10 + np.arange(nw)[None, :]).astype(
+    np.float32).reshape(-1)  # worker w holds [10w+0 .. 10w+(nw-1)]
+rout = rg(xr)
+local_rg = np.asarray(rout.addressable_shards[0].data)
+np.testing.assert_allclose(local_rg,
+                           np.arange(nw) * 10.0 + proc_id)
+
+# dense push (psum_scatter: combined owner shards) and pull (all_gather)
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pushpull_prog(contrib):
+    mine = C.push(contrib)          # [rows/nw, d] owner block, summed
+    full = C.pull(mine)             # re-materialized [rows, d]
+    return mine, full
+
+
+pp = jax.jit(mesh.shard_map(
+    pushpull_prog, in_specs=(P(),), out_specs=(mesh.spec(0), P())))
+contrib = np.arange(nw * 3, dtype=np.float32).reshape(nw, 3)
+mine, full = pp(contrib)
+np.testing.assert_allclose(np.asarray(mine.addressable_shards[0].data),
+                           contrib[None, proc_id] * nw)
+np.testing.assert_allclose(np.asarray(full.addressable_shards[0].data),
+                           contrib * nw)
+
+# sparse request/serve pull + push: two all_to_alls cross the boundary
+from harp_tpu.table import pull_rows_sparse, push_rows_sparse
+
+
+def sparse_prog(shard, ids):
+    rows, ok, dropped = pull_rows_sparse(shard, ids, capacity=2)
+    new_shard, pdrop = push_rows_sparse(
+        shard, ids, jnp.ones((ids.shape[0],) + shard.shape[1:],
+                             shard.dtype), capacity=2)
+    return rows, ok, dropped, new_shard, pdrop
+
+
+sp = jax.jit(mesh.shard_map(
+    sparse_prog, in_specs=(mesh.spec(0), mesh.spec(0)),
+    out_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0), P())))
+table = np.arange(nw * 2 * 3, dtype=np.float32).reshape(nw * 2, 3)
+# every worker asks for row 0 (owner 0) and its right neighbor's first row
+ids = np.stack([np.zeros(nw, np.int64),
+                ((np.arange(nw) + 1) % nw) * 2], 1).reshape(-1)
+rows, ok, dropped, new_tab, pdrop = sp(table, ids.astype(np.int32))
+assert int(np.asarray(dropped)) == 0 and int(np.asarray(pdrop)) == 0
+got = np.asarray(rows.addressable_shards[0].data)
+want = table[ids[2 * proc_id:2 * proc_id + 2]]
+np.testing.assert_allclose(got, want)
+assert bool(np.asarray(ok.addressable_shards[0].data).all())
+# each worker's shard of the pushed table: row 0 got +nw (all workers),
+# each neighbor-row got +1, others unchanged
+exp = table.copy()
+np.add.at(exp, ids, 1.0)
+np.testing.assert_allclose(
+    np.asarray(new_tab.addressable_shards[0].data),
+    exp[2 * proc_id:2 * proc_id + 2])
 
 # host-side KV union across processes
 t = Int2IntKVTable()
 t.add(proc_id, 1)        # unique key per process
 t.add(100, proc_id + 1)  # shared: combined 1+2
 u = kv_allreduce(t)
-assert u.keys() == [0, 1, 100], u.keys()
-assert int(u.get(100)) == 3, u.get(100)
+assert u.keys() == list(range(n_procs)) + [100], u.keys()
+assert int(u.get(100)) == sum(range(1, n_procs + 1)), u.get(100)
 
 # a full dense MF-SGD rotation epoch spanning the process boundary: the
 # ring ppermute of H half-slices and the loss allreduce both cross DCN

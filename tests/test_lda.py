@@ -134,6 +134,24 @@ def test_pushpull_small_pull_cap_still_valid_chain(mesh):
     assert Ndk.sum() == model.n_tokens and Nwk.sum() == model.n_tokens
     np.testing.assert_allclose(Nwk.sum(0), np.asarray(model.Nk))
     assert model.log_likelihood() > ll0
+    assert model.last_dropped >= 0  # surfaced, not swallowed
+
+
+def test_pushpull_drop_counter_surfaces_capacity_pressure(mesh):
+    """All tokens share one word → every request targets one owner; a
+    tiny pull_cap must DROP most of them and say so via last_dropped."""
+    n_tok_per_doc = 8
+    d = np.repeat(np.arange(16, dtype=np.int32), n_tok_per_doc)
+    w = np.zeros(16 * n_tok_per_doc, np.int32)  # one hot word
+    model = L.LDA(16, 16, L.LDAConfig(n_topics=4, algo="pushpull",
+                                      chunk=16, pull_cap=1), mesh, seed=0)
+    model.set_tokens(d, w)
+    model.sample_epoch()
+    assert model.last_dropped > 0
+    # dropped tokens kept their topics; counts stay exactly consistent
+    assert np.asarray(model.Ndk).sum() == model.n_tokens
+    np.testing.assert_allclose(np.asarray(model.Nwk).sum(0),
+                               np.asarray(model.Nk))
 
 
 def test_pushpull_rejects_dense_knobs():

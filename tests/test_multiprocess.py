@@ -18,24 +18,31 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed():
+import pytest
+
+
+@pytest.mark.parametrize("n_procs", [2, 4])
+def test_multi_process_distributed(n_procs):
+    """Every collective family crosses a REAL process boundary (see
+    multiproc_worker.py), at 2 and at 4 processes — ring direction,
+    all_to_all block layout and bucket routing all degenerate at 2."""
     here = os.path.dirname(os.path.abspath(__file__))
     script = os.path.join(here, "multiproc_worker.py")
     port = str(_free_port())
     # strip the harness overrides: conftest forces 8 CPU devices per process
-    # via XLA_FLAGS, but this test wants 1 device per process (2 total)
+    # via XLA_FLAGS, but this test wants 1 device per process
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     procs = [
-        subprocess.Popen([sys.executable, script, str(i), port],
+        subprocess.Popen([sys.executable, script, str(i), port, str(n_procs)],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=360)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:

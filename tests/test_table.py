@@ -178,6 +178,29 @@ def test_push_rows_sparse_matches_dense(mesh):
                                atol=1e-5)
 
 
+def test_push_rows_sparse_capacity_overflow_not_folded(mesh):
+    """Over-capacity pushes must be counted AND excluded — the trash-slot
+    masking is the one place a bug would corrupt the table rather than
+    just lose a read."""
+    rpw, d = 8, 3
+    table = np.zeros((N * rpw, d), np.float32)
+    # every worker pushes 6 distinct rows of owner 0, capacity 4: rows
+    # 0..3 (bucket order = appearance order) land, rows 4..5 are dropped
+    ids = np.tile(np.arange(6, dtype=np.int32), N)
+    deltas = np.ones((N * 6, d), np.float32)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda shard, i, dv: push_rows_sparse(shard, i, dv, capacity=4),
+        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), P()),
+    ))
+    new_table, dropped = fn(table, ids, deltas)
+    assert int(dropped) == N * 2
+    expect = np.zeros_like(table)
+    expect[:4] = N  # kept rows: +1 from every worker
+    np.testing.assert_allclose(np.asarray(new_table), expect)
+
+
 def test_push_then_pull_sparse_roundtrip(mesh):
     # push deltas then pull the same rows back: reads see the writes
     rpw, d = 3, 2
