@@ -56,6 +56,15 @@ def run_all(smoke: bool, only, watchdog=None):
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
                 "w_tile": 16, "entry_cap": 64} if smoke else {})),
+        # graded-scale ladder (VERDICT r1 item 5): 500k docs × 1k topics
+        # with the int16 doc-topic table (2 GB instead of 4 GB at 1M docs)
+        "lda_scale": lambda: lda.benchmark(
+            **({"n_docs": 512, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
+               if smoke else
+               {"n_docs": 500_000, "vocab_size": 50_000, "n_topics": 1000,
+                "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16"})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -99,8 +108,8 @@ def main(argv=None):
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
                    choices=["kmeans", "kmeans_int8", "kmeans_stream", "mfsgd",
-                            "mfsgd_scatter", "lda", "lda_scatter", "mlp",
-                            "subgraph", "rf"],
+                            "mfsgd_scatter", "lda", "lda_scale",
+                            "lda_scatter", "mlp", "subgraph", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     args = p.parse_args(argv)

@@ -154,6 +154,50 @@ def test_pushpull_drop_counter_surfaces_capacity_pressure(mesh):
                                np.asarray(model.Nk))
 
 
+@pytest.mark.parametrize("algo", ["dense", "scatter", "pushpull"])
+def test_int16_ndk_bit_identical_to_f32(mesh, algo):
+    """ndk_dtype='int16' halves the doc-topic HBM (the 1M-doc × 1k-topic
+    graded config: 2 GB vs 4 GB) and must be EXACT: counts are integers
+    bounded by doc length and deltas are ±1, so the sampled chain —
+    same corpus, same seed — is bit-identical to f32."""
+    d, w = L.synthetic_corpus(n_docs=48, vocab_size=32, n_topics_true=3,
+                              tokens_per_doc=24, seed=2)
+    kw = dict(n_topics=6, algo=algo, chunk=32, d_tile=8, w_tile=8,
+              entry_cap=32)
+    models = []
+    for ndk_dtype in ("float32", "int16"):
+        m = L.LDA(48, 32, L.LDAConfig(ndk_dtype=ndk_dtype, **kw),
+                  mesh, seed=3)
+        m.set_tokens(d, w)
+        m.sample_epochs(4)
+        models.append(m)
+    f32m, i16m = models
+    assert np.asarray(i16m.Ndk).dtype == np.int16
+    np.testing.assert_array_equal(f32m.doc_topic_table(),
+                                  i16m.doc_topic_table().astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(f32m.z_grid),
+                                  np.asarray(i16m.z_grid))
+    np.testing.assert_array_equal(np.asarray(f32m.Nwk), np.asarray(i16m.Nwk))
+
+
+def test_ndk_dtype_validation():
+    with pytest.raises(ValueError, match="ndk_dtype"):
+        L.LDAConfig(ndk_dtype="int8")
+
+
+def test_int16_rejects_overlong_document(mesh, monkeypatch):
+    # a doc longer than int16 max would WRAP counts silently; set_tokens
+    # must refuse (real limit needs 33k tokens — shrink via monkeypatch
+    # is impossible for np.iinfo, so build the real thing, tiny vocab)
+    n_tok = np.iinfo(np.int16).max + 1
+    d = np.zeros(n_tok, np.int32)
+    w = np.zeros(n_tok, np.int32)
+    model = L.LDA(8, 8, L.LDAConfig(n_topics=2, algo="scatter", chunk=64,
+                                    ndk_dtype="int16"), mesh, seed=0)
+    with pytest.raises(ValueError, match="would[\\s\\S]*wrap|wrap"):
+        model.set_tokens(d, w)
+
+
 def test_pushpull_rejects_dense_knobs():
     with pytest.raises(ValueError, match="pull_cap only applies"):
         L.LDAConfig(algo="dense", pull_cap=8)
