@@ -1,0 +1,119 @@
+"""Roofline annotation — "X% of chip peak", not "faster than yesterday".
+
+Reference parity (SURVEY.md §7 / round-1 VERDICT item 9): BASELINE.md's
+numbers need a roofline column so a measured rate reads as a fraction of
+what the chip can do.  Each graded config gets an ANALYTIC work model
+(FLOPs and minimum HBM bytes per unit of its throughput metric); paired
+with a measured benchmark dict it yields achieved TFLOP/s, achieved
+GB/s, percent-of-peak for both, and which wall the config is against.
+
+The models are deliberately lower-bound byte models (inputs read once,
+outputs written once — XLA fusion can't do better) and exact FLOP
+counts for the dominant kernels; percentages can therefore slightly
+UNDERSTATE achieved bandwidth but never flatter it.  Peaks are the
+public TPU v5e datasheet figures.
+"""
+
+from __future__ import annotations
+
+# Public v5e (v5 lite) per-chip datasheet peaks.
+V5E_PEAKS = {
+    "bf16_flops": 197e12,   # MXU bf16 FLOP/s
+    "int8_ops": 394e12,     # MXU int8 OP/s
+    "f32_flops": 49.25e12,  # bf16/4: f32 matmul passes through the MXU
+    "hbm_gbs": 819e9,       # HBM bandwidth, bytes/s
+}
+
+
+def _kmeans_work(r):
+    """Per iteration: distance matmul 2ndk + one-hot sums matmul 2nkd;
+    min bytes = points once (dtype-sized) + scores [n,k] write+read.
+    iters_per_sec is a WHOLE-MESH rate over the whole-n workload, so the
+    per-chip comparison divides by num_workers."""
+    n, d, k = r["n"], r["d"], r["k"]
+    dsize = 1 if r.get("quantize") == "int8" else 4
+    return {
+        "flops": 4.0 * n * d * k,
+        "bytes": n * d * dsize + 8.0 * n * k,
+        "per": ("iters_per_sec", 1.0 / r.get("num_workers", 1)),
+        "peak": ("int8_ops" if r.get("quantize") == "int8" else "f32_flops"),
+    }
+
+
+def _mfsgd_work(r):
+    """Per update (one rating): dot(W_u, H_i) + two axpy rows ≈ 6·rank
+    FLOPs; min bytes = both rows read + written = 16·rank."""
+    rank = r.get("rank", 64)
+    return {"flops": 6.0 * rank, "bytes": 16.0 * rank,
+            "per": ("updates_per_sec_per_chip", 1.0), "peak": "f32_flops"}
+
+
+def _lda_work(r):
+    """Per token: K-wide posterior (two logs + gumbel argmax ≈ 10K flops)
+    + one-hot delta matmuls ≈ 4K; min bytes = 3 K-rows read + 2 written."""
+    K = r["n_topics"]
+    return {"flops": 14.0 * K, "bytes": 20.0 * K,
+            "per": ("tokens_per_sec_per_chip", 1.0), "peak": "f32_flops"}
+
+
+def _mlp_work(r):
+    """Per sample: ≈ 6·params FLOPs (fwd 2P + bwd 4P), MNIST-shape MLP
+    (784·512 + 512·256 + 256·10 ≈ 535k params); min bytes per sample =
+    16·params/batch (params read fwd + bwd, grads written + optimizer
+    read-modify-write ≈ 4 param-sized streams of 4 B, amortized over the
+    batch).  samples_per_sec is whole-mesh → divide by num_workers."""
+    params = 535_818
+    return {"flops": 6.0 * params,
+            "bytes": 16.0 * params / r.get("batch", 8192),
+            "per": ("samples_per_sec", 1.0 / r.get("num_workers", 1)),
+            "peak": "f32_flops"}
+
+
+# configs without a trustworthy closed-form model (irregular access
+# patterns dominate) are intentionally absent: no number beats a wrong one
+WORK_MODELS = {
+    "kmeans": _kmeans_work,
+    "kmeans_int8": _kmeans_work,
+    "kmeans_stream": _kmeans_work,
+    "mfsgd": _mfsgd_work,
+    "mfsgd_scatter": _mfsgd_work,
+    "lda": _lda_work,
+    "lda_scale": _lda_work,
+    "lda_scatter": _lda_work,
+    "mlp": _mlp_work,
+}
+
+
+def annotate(config: str, result: dict, peaks: dict = V5E_PEAKS) -> dict:
+    """Add roofline fields to a benchmark result dict (returns a copy).
+
+    Adds ``achieved_tflops``, ``achieved_gbs``, ``pct_peak_flops``,
+    ``pct_peak_bw`` and ``bound`` ("compute" | "memory" — whichever wall
+    is closer).  Configs without a work model pass through unchanged.
+    """
+    model = WORK_MODELS.get(config)
+    if model is None:
+        return dict(result)
+    try:
+        w = model(result)
+    except KeyError:  # result lacks the shape fields (partial/error record)
+        return dict(result)
+    metric, scale = w["per"]
+    if metric not in result:
+        return dict(result)
+    rate = float(result[metric]) * scale          # units/s
+    flops_s = rate * w["flops"]
+    bytes_s = rate * w["bytes"]
+    peak_f = peaks[w["peak"]]
+    pf = 100.0 * flops_s / peak_f
+    pb = 100.0 * bytes_s / peaks["hbm_gbs"]
+    out = dict(result)
+    out.update({
+        "achieved_tflops": round(flops_s / 1e12, 3),
+        "achieved_gbs": round(bytes_s / 1e9, 2),
+        "pct_peak_flops": round(pf, 2),
+        "pct_peak_bw": round(pb, 2),
+        "roofline_peak": w["peak"],
+        "bound": "compute" if pf >= pb else "memory",
+    })
+    return out

@@ -95,6 +95,9 @@ def run_all(smoke: bool, only, watchdog=None):
         except Exception as e:  # keep measuring the rest
             yield {"config": name, "error": f"{type(e).__name__}: {e}", **env}
             continue
+        from harp_tpu.utils.roofline import annotate
+
+        result = annotate(name, result)  # % of v5e peak, where modeled
         yield {"config": name,
                **{k: (round(v, 4) if isinstance(v, float) else v)
                   for k, v in result.items()}, **env}

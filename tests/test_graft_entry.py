@@ -14,8 +14,11 @@ def test_entry_compiles_and_runs():
     fn, args = ge.entry()
     out = jax.jit(fn).lower(*args).compile()(*args)
     centroids, inertia = out
-    assert centroids.shape[0] == 16
+    # real graded kernel shapes (k=100, d=300) on real data: the check
+    # runs the production program, not a toy
+    assert centroids.shape == (100, 300)
     assert inertia.shape == ()
+    assert float(inertia) > 0
 
 
 def test_dryrun_multichip_8(mesh):

@@ -1,0 +1,72 @@
+"""Roofline annotation math (utils/roofline.py)."""
+
+import numpy as np
+
+from harp_tpu.utils import roofline as R
+
+
+def test_kmeans_annotation_math():
+    # 1M×300 k=100 at 400 iter/s: flops = 4ndk·rate
+    r = R.annotate("kmeans", {"n": 1_000_000, "d": 300, "k": 100,
+                              "iters_per_sec": 400.0, "quantize": None})
+    want_tflops = 4 * 1e6 * 300 * 100 * 400 / 1e12
+    np.testing.assert_allclose(r["achieved_tflops"], round(want_tflops, 3))
+    assert 0 < r["pct_peak_flops"] < 100
+    assert r["roofline_peak"] == "f32_flops"
+    assert r["bound"] in ("compute", "memory")
+
+
+def test_int8_uses_int8_peak_and_smaller_bytes():
+    base = {"n": 1_000_000, "d": 300, "k": 100, "iters_per_sec": 400.0}
+    f32 = R.annotate("kmeans", {**base, "quantize": None})
+    i8 = R.annotate("kmeans_int8", {**base, "quantize": "int8"})
+    assert i8["roofline_peak"] == "int8_ops"
+    assert i8["pct_peak_flops"] < f32["pct_peak_flops"]  # higher peak
+    assert i8["achieved_gbs"] < f32["achieved_gbs"]      # 1-byte points
+
+
+def test_mesh_aggregate_metrics_divided_per_chip():
+    # whole-mesh rates (kmeans iters/s, mlp samples/s) must be divided by
+    # num_workers before the single-chip peak comparison — an 8-chip run
+    # must not report 8x the per-chip utilization
+    base = {"n": 1_000_000, "d": 300, "k": 100, "iters_per_sec": 400.0,
+            "quantize": None}
+    one = R.annotate("kmeans", {**base, "num_workers": 1})
+    eight = R.annotate("kmeans", {**base, "num_workers": 8})
+    np.testing.assert_allclose(eight["pct_peak_flops"] * 8,
+                               one["pct_peak_flops"], rtol=1e-2)  # 2-dp rounding
+
+
+def test_unmodeled_config_passes_through():
+    r = {"trees_per_sec": 7.0}
+    assert R.annotate("rf", r) == r
+    assert R.annotate("rf", r) is not r  # copy, not alias
+
+
+def test_missing_metric_passes_through():
+    assert "pct_peak_flops" not in R.annotate("kmeans", {"n": 1})
+
+
+def test_memory_vs_compute_bound_classification():
+    # tiny k makes kmeans memory-bound (few flops per byte of points);
+    # big k makes it compute-bound
+    lo_k = R.annotate("kmeans", {"n": 1 << 20, "d": 4, "k": 2,
+                                 "iters_per_sec": 100.0, "quantize": None})
+    hi_k = R.annotate("kmeans", {"n": 1 << 20, "d": 300, "k": 1000,
+                                 "iters_per_sec": 100.0, "quantize": None})
+    assert lo_k["bound"] == "memory"
+    assert hi_k["bound"] == "compute"
+
+
+def test_measure_all_smoke_record_carries_roofline(mesh):
+    # end-to-end: the measure_all pipeline annotates modeled configs
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "measure_all", os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts", "measure_all.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    recs = list(mod.run_all(smoke=True, only=["kmeans"]))
+    assert len(recs) == 1 and "pct_peak_flops" in recs[0], recs
