@@ -322,16 +322,22 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--chunk", type=int, default=262_144)
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
-    p.add_argument("--input", default=None, metavar="NPY",
-                   help="stream a .npy file (np.memmap) instead of the "
-                        "device-synthetic benchmark")
+    p.add_argument("--input", default=None, metavar="NPY_OR_CSV",
+                   help="stream a .npy file (np.memmap) or a CSV/text "
+                        "file (native prefetch-threaded reader, bounded "
+                        "memory) instead of the device-synthetic benchmark")
     p.add_argument("--quantize", choices=["int8"], default=None)
     p.add_argument("--init", choices=["random", "kmeans++"], default="random")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     if args.input:
-        pts = np.load(args.input, mmap_mode="r")
+        if args.input.endswith(".npy"):
+            pts = np.load(args.input, mmap_mode="r")
+        else:  # text: native streaming reader, never materialized
+            from harp_tpu.native.datasource import CSVPoints
+
+            pts = CSVPoints(args.input, chunk_rows=args.chunk)
         c, inertia = fit_streaming(pts, args.k, args.iters, args.chunk,
                                    dtype=dtype, quantize=args.quantize,
                                    init=args.init)

@@ -9,6 +9,8 @@ is available, so the framework never hard-requires the toolchain.
 
 from harp_tpu.native.build import load_native, native_available
 from harp_tpu.native.datasource import (
+    CSVPoints,
+    CSVStream,
     csr_to_ell,
     load_csv,
     load_libsvm,
@@ -16,4 +18,4 @@ from harp_tpu.native.datasource import (
 )
 
 __all__ = ["load_native", "native_available", "load_csv", "load_libsvm",
-           "load_triples", "csr_to_ell"]
+           "load_triples", "csr_to_ell", "CSVStream", "CSVPoints"]

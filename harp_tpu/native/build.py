@@ -81,5 +81,14 @@ def load_native():
         i64p, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
     lib.harp_load_libsvm.restype = ctypes.c_int
+    lib.harp_csv_stream_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.harp_csv_stream_open.restype = ctypes.c_void_p
+    lib.harp_csv_stream_cols.argtypes = [ctypes.c_void_p]
+    lib.harp_csv_stream_cols.restype = ctypes.c_int64
+    lib.harp_csv_stream_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    lib.harp_csv_stream_next.restype = ctypes.c_int64
+    lib.harp_csv_stream_close.argtypes = [ctypes.c_void_p]
+    lib.harp_csv_stream_close.restype = None
     _LIB = lib
     return _LIB

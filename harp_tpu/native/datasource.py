@@ -279,3 +279,239 @@ def load_triples(path: str, n_threads: int = 0):
     if rc != 0:
         raise OSError(f"native loader failed to parse {path!r} (rc={rc})")
     return u, i, v
+
+
+# ---------------------------------------------------------------------------
+# Streaming CSV — beyond-RAM text corpora for the blocked-epoch apps.
+# ---------------------------------------------------------------------------
+
+
+class CSVStream:
+    """Iterate [≤chunk_rows, cols] float32 blocks of a CSV/whitespace file.
+
+    Native path: the C++ reader parses the NEXT chunk on a background
+    thread while the caller consumes the current one (double-buffered —
+    disk+parse overlaps device compute); memory is bounded by two parsed
+    slots regardless of file size.  Python fallback parses line blocks
+    with the same separator/comment semantics.  Use as an iterator or a
+    context manager; ``cols`` blocks until the first block is parsed.
+    """
+
+    def __init__(self, path: str, chunk_rows: int = 65_536):
+        if chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        self.path, self.chunk_rows = path, chunk_rows
+        self._lib = load_native()
+        self._h = None
+        self._f = None
+        if self._lib is not None:
+            h = self._lib.harp_csv_stream_open(path.encode(), chunk_rows)
+            if not h:
+                raise OSError(f"native stream failed to open {path!r}")
+            self._h = h
+            self._cols = int(self._lib.harp_csv_stream_cols(h))
+            if self._cols < 0:
+                raise OSError(f"native stream failed to read {path!r}")
+        else:
+            self._f = open(path)
+            self._cols = None  # discovered on first block
+            self._py_buf: list = []
+
+    @property
+    def cols(self) -> int:
+        if self._cols is None:
+            self._py_fill()
+        return self._cols or 0
+
+    def _py_fill(self):
+        """Fallback: read chunk_rows raw lines, parse non-blank ones.
+
+        Matches the NATIVE parser's semantics, not np.loadtxt's: comments
+        stripped at '#', cols fixed by the first data line, short rows
+        zero-padded, extra trailing columns ignored, unparseable tokens
+        read as 0.0 — so behavior never depends on g++ availability.
+        """
+        lines = []
+        for line in self._f:
+            lines.append(line)
+            if len(lines) >= self.chunk_rows:
+                break
+        rows = []
+        for line in lines:
+            body = line.split("#", 1)[0].replace(",", " ").split()
+            if not body:
+                continue
+            if self._cols is None:
+                self._cols = len(body)
+            vals = []
+            for tok in body[: self._cols]:
+                try:
+                    vals.append(float(tok))
+                except ValueError:
+                    vals.append(0.0)
+            vals += [0.0] * (self._cols - len(vals))
+            rows.append(vals)
+        arr = (np.asarray(rows, np.float32) if rows
+               else np.zeros((0, self._cols or 0), np.float32))
+        self._py_buf = [arr] if arr.size else []
+        return bool(lines)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        if self._h is not None:
+            buf = np.empty((self.chunk_rows, self._cols), np.float32)
+            rows = int(self._lib.harp_csv_stream_next(
+                self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.chunk_rows))
+            if rows < 0:
+                raise OSError(f"native stream error reading {self.path!r}")
+            if rows == 0:
+                raise StopIteration
+            return buf[:rows]
+        while True:
+            if self._py_buf:
+                return self._py_buf.pop()
+            if not self._py_fill():
+                raise StopIteration
+            if not self._py_buf:   # block of blanks/comments: keep reading
+                continue
+
+    def close(self):
+        if self._h is not None:
+            self._lib.harp_csv_stream_close(self._h)
+            self._h = None
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # belt-and-braces; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class CSVPoints:
+    """Sequential-access view of a CSV file shaped like an array —
+    the ``points`` source contract of
+    :func:`harp_tpu.models.kmeans_stream.fit_streaming` for text corpora
+    too large for RAM.
+
+    Supports exactly the access pattern the streaming apps use:
+    ``points[lo:hi]`` with ascending, contiguous ``lo`` that restarts at
+    0 each epoch (each restart reopens the underlying stream), plus
+    ``points[sorted_index_array]`` row gathers (one dedicated streaming
+    pass — used by centroid init).  ``shape`` comes from the native
+    row-count pass.  Anything else raises, loudly.
+    """
+
+    def __init__(self, path: str, chunk_rows: int = 65_536):
+        self.path, self.chunk_rows = path, chunk_rows
+        lib = load_native()
+        if lib is not None:
+            rows = ctypes.c_int64()
+            cols = ctypes.c_int64()
+            rc = lib.harp_count_rows(path.encode(), os.cpu_count() or 1,
+                                     ctypes.byref(rows), ctypes.byref(cols))
+            if rc != 0:
+                raise OSError(f"native loader failed to read {path!r}")
+            self.shape = (int(rows.value), int(cols.value))
+        else:
+            n, c = 0, 0
+            with CSVStream(path, chunk_rows) as st:
+                for blk in st:
+                    n += blk.shape[0]
+                    c = blk.shape[1]
+            self.shape = (n, c)
+        self._stream: CSVStream | None = None
+        self._pos = 0
+        self._pending: np.ndarray | None = None  # rows read but not consumed
+
+    def __len__(self):
+        return self.shape[0]
+
+    def _restart(self):
+        if self._stream is not None:
+            self._stream.close()
+        self._stream = CSVStream(self.path, self.chunk_rows)
+        self._pos = 0
+        self._pending = None
+
+    def _read(self, count: int) -> np.ndarray:
+        parts = []
+        need = count
+        while need > 0:
+            if self._pending is not None and len(self._pending):
+                take = self._pending[:need]
+                self._pending = self._pending[need:]
+                parts.append(take)
+                need -= len(take)
+                continue
+            try:
+                self._pending = next(self._stream)
+            except StopIteration:
+                break
+        self._pos += count - need
+        return np.concatenate(parts, 0) if parts else \
+            np.zeros((0, self.shape[1]), np.float32)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            lo = key.start or 0
+            hi = self.shape[0] if key.stop is None else min(key.stop,
+                                                            self.shape[0])
+            if key.step not in (None, 1):
+                raise ValueError("CSVPoints slices must be contiguous")
+            if lo == 0 or self._stream is None:
+                self._restart()
+                if lo:
+                    self._read(lo)  # skip forward (init paths)
+            elif lo != self._pos:
+                raise ValueError(
+                    f"CSVPoints is sequential: asked for rows {lo}:{hi} at "
+                    f"position {self._pos} (slices must ascend contiguously "
+                    "and restart at 0)")
+            return self._read(hi - lo)
+        idx = np.asarray(key)
+        if idx.ndim != 1 or not np.issubdtype(idx.dtype, np.integer):
+            raise TypeError("CSVPoints supports slices or 1-D integer "
+                            "index arrays")
+        if len(idx) and (np.diff(idx) < 0).any():
+            raise ValueError("CSVPoints index arrays must be sorted")
+        if len(idx) and int(idx[0]) < 0:
+            raise IndexError("CSVPoints does not support negative indices "
+                             f"(got {int(idx[0])})")
+        out = np.empty((len(idx), self.shape[1]), np.float32)
+        with CSVStream(self.path, self.chunk_rows) as st:
+            base, j = 0, 0
+            for blk in st:
+                hi = base + blk.shape[0]
+                while j < len(idx) and idx[j] < hi:
+                    out[j] = blk[idx[j] - base]
+                    j += 1
+                base = hi
+                if j >= len(idx):
+                    break
+        if j < len(idx):
+            raise IndexError(f"index {int(idx[j])} out of range "
+                             f"({self.shape[0]} rows)")
+        return out
+
+    def close(self):
+        if self._stream is not None:
+            self._stream.close()
+            self._stream = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

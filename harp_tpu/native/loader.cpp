@@ -14,10 +14,13 @@
 //   harp_load_triples(path, n_threads, u_buf, i_buf, v_buf, n) -> 0
 // Caller (Python) allocates the numpy buffers after harp_count_rows.
 
+#include <algorithm>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -443,6 +446,228 @@ int harp_load_triples(const char* path, int n_threads, int32_t* u_buf,
   for (auto& t : ts) t.join();
   std::free(m.data);
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Streaming CSV reader — the native ingest path for beyond-RAM text
+// corpora (feeds harp_tpu.models.kmeans_stream.fit_streaming).  A single
+// background thread reads + parses the NEXT chunk while the caller
+// consumes the current one (two parsed slots, classic double buffer), so
+// disk+parse overlaps device compute.  Bounded memory: two slots of
+// [chunk_rows, cols] floats plus one byte block.
+//
+//   harp_csv_stream_open(path, chunk_rows)        -> handle (NULL = error)
+//   harp_csv_stream_cols(h)                       -> cols (-1 error/empty)
+//   harp_csv_stream_next(h, buf, buf_rows)        -> rows written
+//                                                    (0 = EOF, -1 = error)
+//   harp_csv_stream_close(h)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// Parse up to max_rows non-blank lines of [begin, end) into out[cols].
+// Missing trailing columns parse as 0 (matches the dense loader).
+int64_t parse_block_rows(const char* p, const char* end, int64_t cols,
+                         float* out, int64_t max_rows) {
+  int64_t r = 0;
+  while (p < end && r < max_rows) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* le = strip_comment(p, nl ? nl : end);
+    if (le > p && !blank_line(p, le)) {
+      const char* q = p;
+      for (int64_t c = 0; c < cols; ++c) {
+        skip_seps(q, le);
+        out[r * cols + c] = (q < le) ? parse_float(q) : 0.0f;
+      }
+      ++r;
+    }
+    p = nl ? nl + 1 : end;
+  }
+  return r;
+}
+
+// Columns of the first non-blank line in [p, end); 0 if none.
+int64_t first_line_cols(const char* p, const char* end) {
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* le = strip_comment(p, nl ? nl : end);
+    if (le > p && !blank_line(p, le)) {
+      int64_t c = 0;
+      const char* q = p;
+      while (q < le) {
+        skip_seps(q, le);
+        if (q >= le) break;
+        parse_float(q);
+        ++c;
+      }
+      return c;
+    }
+    p = nl ? nl + 1 : end;
+  }
+  return 0;
+}
+
+struct CsvStream {
+  std::FILE* f = nullptr;
+  int64_t chunk_rows = 0;
+  int64_t cols = -1;          // -1 until the first block is seen
+  std::string carry;          // bytes after the last complete line
+  bool read_eof = false;
+  bool io_error = false;      // fread failed (ferror), not clean EOF
+
+  // two parsed slots (producer fills, consumer drains)
+  std::vector<float> slot[2];
+  int64_t slot_rows[2] = {0, 0};
+  bool full[2] = {false, false};
+  int prod = 0, cons = 0;
+  bool finished = false;      // producer delivered EOF
+  bool error = false;
+  bool closing = false;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread worker;
+};
+
+// Gather bytes holding ~chunk_rows lines; the remainder goes to carry.
+// Returns false when nothing is left (true EOF).
+bool stream_build_block(CsvStream* s, std::string& block) {
+  block.clear();
+  block.swap(s->carry);
+  int64_t nl = std::count(block.begin(), block.end(), '\n');
+  std::vector<char> tmp(1 << 20);
+  while (nl < s->chunk_rows && !s->read_eof) {
+    size_t got = std::fread(tmp.data(), 1, tmp.size(), s->f);
+    if (got == 0) {
+      s->read_eof = true;
+      if (std::ferror(s->f)) s->io_error = true;  // NOT a clean EOF
+      break;
+    }
+    nl += std::count(tmp.data(), tmp.data() + got, '\n');
+    block.append(tmp.data(), got);
+  }
+  // Split after the chunk_rows-th newline.  >= (not >): with EXACTLY
+  // chunk_rows newlines plus trailing partial-line bytes, those bytes
+  // must go to carry — leaving them in the block would drop them (the
+  // parse caps at chunk_rows rows) and the next block would start
+  // mid-number.
+  if (nl >= s->chunk_rows) {
+    int64_t seen = 0;
+    size_t pos = 0;
+    while (seen < s->chunk_rows) {
+      pos = block.find('\n', pos) + 1;
+      ++seen;
+    }
+    s->carry.assign(block, pos, std::string::npos);
+    block.resize(pos);
+  }
+  return !block.empty();
+}
+
+void stream_worker(CsvStream* s) {
+  std::string block;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [s] { return s->closing || !s->full[s->prod]; });
+      if (s->closing) return;
+    }
+    // A block can parse to ZERO data rows (all comments/blank lines —
+    // including the very first block, before cols is known).  That must
+    // not look like EOF: keep pulling blocks until data rows appear or
+    // the file truly ends.
+    int64_t rows = 0;
+    bool got = false;
+    do {
+      got = stream_build_block(s, block);  // only this thread reads f
+      if (!got) break;
+      if (s->cols < 0) {
+        int64_t c = first_line_cols(block.data(), block.data() + block.size());
+        if (c > 0) {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->cols = c;
+          s->cv.notify_all();
+        }
+      }
+      if (s->cols > 0) {
+        auto& sl = s->slot[s->prod];
+        sl.resize(s->chunk_rows * s->cols);
+        rows = parse_block_rows(block.data(), block.data() + block.size(),
+                                s->cols, sl.data(), s->chunk_rows);
+      }
+    } while (rows == 0);
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (s->io_error) {
+        s->error = true;
+        s->cv.notify_all();
+        return;
+      }
+      if (!got) {  // clean EOF (cols stays 0 for an all-blank file)
+        if (s->cols < 0) s->cols = 0;
+        s->finished = true;
+        s->cv.notify_all();
+        return;
+      }
+      s->slot_rows[s->prod] = rows;
+      s->full[s->prod] = true;
+      s->prod ^= 1;
+      s->cv.notify_all();
+    }
+  }
+}
+
+}  // namespace
+
+void* harp_csv_stream_open(const char* path, int64_t chunk_rows) {
+  if (chunk_rows < 1) return nullptr;
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  CsvStream* s = new CsvStream();
+  s->f = f;
+  s->chunk_rows = chunk_rows;
+  s->worker = std::thread(stream_worker, s);
+  return s;
+}
+
+int64_t harp_csv_stream_cols(void* h) {
+  CsvStream* s = static_cast<CsvStream*>(h);
+  std::unique_lock<std::mutex> lk(s->mu);
+  s->cv.wait(lk, [s] { return s->cols >= 0 || s->finished || s->error; });
+  return s->error ? -1 : s->cols;
+}
+
+int64_t harp_csv_stream_next(void* h, float* buf, int64_t buf_rows) {
+  CsvStream* s = static_cast<CsvStream*>(h);
+  int64_t rows;
+  {
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv.wait(lk, [s] { return s->full[s->cons] || s->finished || s->error; });
+    if (s->error) return -1;
+    if (!s->full[s->cons]) return 0;  // finished, queue drained
+    rows = s->slot_rows[s->cons];
+    if (rows > buf_rows) return -1;   // caller buffer too small
+  }
+  std::memcpy(buf, s->slot[s->cons].data(),
+              static_cast<size_t>(rows) * s->cols * sizeof(float));
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->full[s->cons] = false;
+    s->cons ^= 1;
+    s->cv.notify_all();
+  }
+  return rows;
+}
+
+void harp_csv_stream_close(void* h) {
+  CsvStream* s = static_cast<CsvStream*>(h);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->closing = true;
+    s->cv.notify_all();
+  }
+  if (s->worker.joinable()) s->worker.join();
+  std::fclose(s->f);
+  delete s;
 }
 
 }  // extern "C"

@@ -196,3 +196,150 @@ def test_csr_to_ell_roundtrip():
     # truncation at fixed width
     ids2, vals2, mask2 = csr_to_ell(indptr, indices, values, width=2)
     assert mask2.sum() == 4  # row 2 lost one entry
+
+
+# ---------------------------------------------------------------------------
+# Streaming CSV reader (CSVStream / CSVPoints) — beyond-RAM ingest.
+# ---------------------------------------------------------------------------
+
+
+def _write_csv(path, pts, blanks=False):
+    with open(path, "w") as f:
+        f.write("# header\n")
+        for i, row in enumerate(pts):
+            f.write(",".join(f"{v:.7e}" for v in row) + "\n")
+            if blanks and i % 97 == 0:
+                f.write("\n")
+
+
+def test_csv_stream_blocks_concatenate_to_full_matrix(native_lib, tmp_path):
+    from harp_tpu.native.datasource import CSVStream
+
+    pts = np.random.default_rng(0).normal(size=(3001, 5)).astype(np.float32)
+    p = str(tmp_path / "s.csv")
+    _write_csv(p, pts, blanks=True)
+    with CSVStream(p, chunk_rows=450) as st:
+        assert st.cols == 5
+        blocks = list(st)
+    assert all(b.shape[0] <= 450 for b in blocks)
+    np.testing.assert_allclose(np.concatenate(blocks, 0), pts, rtol=2e-6)
+
+
+def test_csv_stream_python_fallback_equivalent(tmp_path, monkeypatch):
+    import harp_tpu.native.build as B
+    from harp_tpu.native.datasource import CSVStream
+
+    monkeypatch.setattr(B, "_LIB", None)
+    monkeypatch.setattr(B, "_TRIED", True)  # force the fallback
+    pts = np.random.default_rng(1).normal(size=(800, 4)).astype(np.float32)
+    p = str(tmp_path / "f.csv")
+    _write_csv(p, pts)
+    with CSVStream(p, chunk_rows=123) as st:
+        got = np.concatenate(list(st), 0)
+    np.testing.assert_allclose(got, pts, rtol=2e-6)
+
+
+def test_csv_points_sequential_contract(native_lib, tmp_path):
+    from harp_tpu.native.datasource import CSVPoints
+
+    pts = np.random.default_rng(2).normal(size=(1200, 3)).astype(np.float32)
+    p = str(tmp_path / "p.csv")
+    _write_csv(p, pts)
+    cp = CSVPoints(p, chunk_rows=256)
+    assert cp.shape == (1200, 3) and len(cp) == 1200
+    np.testing.assert_allclose(cp[0:300], pts[:300], rtol=2e-6)
+    np.testing.assert_allclose(cp[300:900], pts[300:900], rtol=2e-6)
+    np.testing.assert_allclose(cp[0:50], pts[:50], rtol=2e-6)  # restart
+    with pytest.raises(ValueError, match="sequential"):
+        cp[500:600]  # non-contiguous mid-stream
+    idx = np.arange(0, 1200, 37)
+    np.testing.assert_allclose(cp[idx], pts[idx], rtol=2e-6)  # gather pass
+    with pytest.raises(IndexError):
+        cp[np.array([5, 1200])]
+    cp.close()
+
+
+def test_csv_points_feeds_fit_streaming(native_lib, mesh, tmp_path):
+    from harp_tpu.models import kmeans as K
+    from harp_tpu.models import kmeans_stream as KS
+    from harp_tpu.native.datasource import CSVPoints
+
+    rng = np.random.default_rng(3)
+    pts = (rng.normal(size=(2000, 6))
+           + rng.integers(0, 3, size=(2000, 1)) * 8).astype(np.float32)
+    p = str(tmp_path / "k.csv")
+    _write_csv(p, pts)
+    with CSVPoints(p, chunk_rows=700) as cp:
+        c0, i0 = K.fit(pts, k=6, iters=5, mesh=mesh, seed=2)
+        c1, i1 = KS.fit_streaming(cp, k=6, iters=5, chunk_points=700,
+                                  mesh=mesh, seed=2)
+    assert abs(i0 - i1) < 1e-3 * abs(i0) + 1.0
+    assert np.allclose(c0, c1, rtol=1e-3, atol=1e-3)
+
+
+def test_csv_stream_exact_chunk_newline_split(native_lib, tmp_path):
+    # a block landing with EXACTLY chunk_rows newlines plus a partial
+    # trailing line must carry the partial bytes, not drop/corrupt them
+    pts = np.arange(21, dtype=np.float32).reshape(7, 3)
+    p = str(tmp_path / "e.csv")
+    with open(p, "w") as f:
+        for row in pts:
+            f.write(",".join(str(v) for v in row) + "\n")
+    from harp_tpu.native.datasource import CSVStream
+
+    for chunk in (1, 2, 3, 7):
+        with CSVStream(p, chunk_rows=chunk) as st:
+            got = np.concatenate(list(st), 0)
+        np.testing.assert_allclose(got, pts, err_msg=f"chunk={chunk}")
+
+
+def test_csv_stream_comment_prefix_and_blank_runs(native_lib, tmp_path):
+    # chunk_rows=1 with a leading comment line: the first block parses to
+    # zero rows and must NOT read as EOF; same for long blank runs
+    pts = np.random.default_rng(4).normal(size=(20, 2)).astype(np.float32)
+    p = str(tmp_path / "c.csv")
+    with open(p, "w") as f:
+        f.write("# header\n# more\n")
+        for i, row in enumerate(pts):
+            f.write(" ".join(f"{v:.7e}" for v in row) + "\n")
+            if i == 9:
+                f.write("\n" * 5)  # blank run longer than chunk_rows
+    from harp_tpu.native.datasource import CSVStream
+
+    for chunk in (1, 4):
+        with CSVStream(p, chunk_rows=chunk) as st:
+            got = np.concatenate(list(st), 0)
+        np.testing.assert_allclose(got, pts, rtol=2e-6,
+                                   err_msg=f"chunk={chunk}")
+
+
+def test_csv_points_rejects_negative_indices(native_lib, tmp_path):
+    from harp_tpu.native.datasource import CSVPoints
+
+    p = str(tmp_path / "n.csv")
+    _write_csv(p, np.ones((10, 2), np.float32))
+    with CSVPoints(p) as cp:
+        with pytest.raises(IndexError, match="negative"):
+            cp[np.array([-1])]
+
+
+def test_csv_stream_fallback_pads_ragged_rows_like_native(native_lib,
+                                                          tmp_path,
+                                                          monkeypatch):
+    # short rows zero-pad, extra columns are ignored — on BOTH paths
+    p = str(tmp_path / "r.csv")
+    with open(p, "w") as f:
+        f.write("1,2,3\n4,5\n6,7,8,9\n")
+    from harp_tpu.native.datasource import CSVStream
+
+    with CSVStream(p, chunk_rows=10) as st:
+        nat = np.concatenate(list(st), 0)
+    import harp_tpu.native.build as B
+
+    monkeypatch.setattr(B, "_LIB", None)
+    monkeypatch.setattr(B, "_TRIED", True)
+    with CSVStream(p, chunk_rows=10) as st:
+        py = np.concatenate(list(st), 0)
+    expect = np.array([[1, 2, 3], [4, 5, 0], [6, 7, 8]], np.float32)
+    np.testing.assert_allclose(nat, expect)
+    np.testing.assert_allclose(py, expect)
