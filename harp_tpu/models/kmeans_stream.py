@@ -146,17 +146,25 @@ def _int8_scales(points, n, chunk):
 def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                   mesh: WorkerMesh | None = None, seed=0,
                   dtype=jnp.float32, quantize=None, init="random",
-                  return_history=False):
+                  return_history=False, ckpt_dir=None, ckpt_every=5,
+                  max_restarts=3, fault=None):
     """Blocked-epoch Lloyd over a source too large for HBM.
 
-    ``points``: [n, d] numpy array or ``np.memmap`` (disk-backed sources
-    larger than RAM stream chunk by chunk).  Semantics are identical to
-    ``kmeans.fit`` — one epoch assigns EVERY point against the
-    epoch-start centroids, so the result is full-batch Lloyd, not
-    minibatch — only the execution is chunked.  Returns
+    ``points``: [n, d] numpy array, ``np.memmap``, or any sequential
+    source honoring the slice contract (``harp_tpu.native.CSVPoints``).
+    Semantics are identical to ``kmeans.fit`` — one epoch assigns EVERY
+    point against the epoch-start centroids, so the result is full-batch
+    Lloyd, not minibatch — only the execution is chunked.  Returns
     ``(centroids [k, d], inertia)`` (+ per-epoch inertia history with
     ``return_history=True``; the history is read back in one stacked
     transfer at the end — never per epoch, per the relay dispatch trap).
+
+    ``ckpt_dir`` enables checkpoint/resume with the same recovery
+    contract as the other model ``fit``\\ s (utils.fault.fit_epochs):
+    a 1B-point run is exactly the multi-hour job that needs to survive a
+    preemption.  Epochs are deterministic given the centroids (the data
+    is re-read each sweep), so centroids + completed history are the
+    whole state.
     """
     mesh = mesh or current_mesh()
     n, d = points.shape
@@ -208,8 +216,10 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
         return (np.asarray(init_c, np.float32), 0.0, np.zeros(0, np.float32)
                 ) if return_history else (np.asarray(init_c, np.float32), 0.0)
     offsets = list(range(0, n, chunk))
-    history = []
-    for _ in range(iters):
+    history: list = []
+
+    def train_one():
+        nonlocal centroids
         sums, counts, inertia = zeros()
         nxt = put_chunk(offsets[0])  # double buffer: transfer j+1 during j
         for j in range(len(offsets)):
@@ -218,8 +228,33 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                 nxt = put_chunk(offsets[j + 1])
             sums, counts, inertia = accum_fn(cur[0], cur[1], centroids,
                                              sums, counts, inertia)
-        centroids, ep_inertia = finish_fn(sums, counts, inertia, centroids)
+        new_c, ep_inertia = finish_fn(sums, counts, inertia, centroids)
+        centroids = new_c
         history.append(ep_inertia)
+
+    def get_state():
+        # LIVE objects, zero syncs: fit_epochs calls this every epoch (not
+        # just at checkpoints) and CheckpointManager.save materializes at
+        # save time itself; a per-epoch jnp.stack+readback here would cost
+        # two relay round trips per sweep and break the double buffer
+        return {"centroids": centroids, "hist": list(history)}
+
+    def set_state(state):
+        nonlocal centroids, history
+        check_restored_shapes([("centroids", state["centroids"], centroids)])
+        c = state["centroids"]
+        if isinstance(c, jax.Array):      # normal step-to-step flow
+            centroids = c
+            history = list(state["hist"])
+        else:                             # numpy from a fresh restore
+            centroids = jax.device_put(
+                jnp.asarray(np.asarray(c), dtype=dtype), mesh.replicated())
+            history = [np.float32(v) for v in state["hist"]]
+
+    from harp_tpu.utils.fault import check_restored_shapes, fit_epochs
+
+    fit_epochs(train_one, get_state, set_state, iters, ckpt_dir,
+               ckpt_every=ckpt_every, max_restarts=max_restarts, fault=fault)
     final = np.asarray(jnp.stack(history))  # ONE readback for all epochs
     c_host = np.asarray(centroids)
     if return_history:
@@ -328,6 +363,10 @@ def main(argv=None):
                         "memory) instead of the device-synthetic benchmark")
     p.add_argument("--quantize", choices=["int8"], default=None)
     p.add_argument("--init", choices=["random", "kmeans++"], default="random")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint/resume for long runs (rerunning with "
+                        "the same dir resumes from the latest epoch)")
+    p.add_argument("--ckpt-every", type=int, default=5)
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
@@ -340,7 +379,8 @@ def main(argv=None):
             pts = CSVPoints(args.input, chunk_rows=args.chunk)
         c, inertia = fit_streaming(pts, args.k, args.iters, args.chunk,
                                    dtype=dtype, quantize=args.quantize,
-                                   init=args.init)
+                                   init=args.init, ckpt_dir=args.ckpt_dir,
+                                   ckpt_every=args.ckpt_every)
         print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
                "d": pts.shape[1], "inertia": inertia})
     else:

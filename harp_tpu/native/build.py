@@ -81,6 +81,8 @@ def load_native():
         i64p, ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
     lib.harp_load_libsvm.restype = ctypes.c_int
+    lib.harp_csv_count_stream.argtypes = [ctypes.c_char_p, i64p, i64p]
+    lib.harp_csv_count_stream.restype = ctypes.c_int
     lib.harp_csv_stream_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.harp_csv_stream_open.restype = ctypes.c_void_p
     lib.harp_csv_stream_cols.argtypes = [ctypes.c_void_p]

@@ -319,9 +319,12 @@ class CSVStream:
 
     @property
     def cols(self) -> int:
-        if self._cols is None:
-            self._py_fill()
-        return self._cols or 0
+        # loop: the first chunk_rows lines can be all comments/blanks —
+        # matching the native reader, which scans until a data line or EOF
+        while self._cols is None:
+            if not self._py_fill():
+                return 0
+        return self._cols
 
     def _py_fill(self):
         """Fallback: read chunk_rows raw lines, parse non-blank ones.
@@ -417,10 +420,13 @@ class CSVPoints:
         self.path, self.chunk_rows = path, chunk_rows
         lib = load_native()
         if lib is not None:
+            # streaming count (bounded memory) — harp_count_rows reads the
+            # whole file into RAM, which this class exists to avoid
             rows = ctypes.c_int64()
             cols = ctypes.c_int64()
-            rc = lib.harp_count_rows(path.encode(), os.cpu_count() or 1,
-                                     ctypes.byref(rows), ctypes.byref(cols))
+            rc = lib.harp_csv_count_stream(path.encode(),
+                                           ctypes.byref(rows),
+                                           ctypes.byref(cols))
             if rc != 0:
                 raise OSError(f"native loader failed to read {path!r}")
             self.shape = (int(rows.value), int(cols.value))
@@ -445,14 +451,17 @@ class CSVPoints:
         self._pos = 0
         self._pending = None
 
-    def _read(self, count: int) -> np.ndarray:
-        parts = []
+    def _read(self, count: int, keep: bool = True) -> np.ndarray:
+        """Consume ``count`` rows; ``keep=False`` drains them in O(chunk)
+        memory (the skip-forward path must not materialize the prefix)."""
+        parts: list = []
         need = count
         while need > 0:
             if self._pending is not None and len(self._pending):
                 take = self._pending[:need]
                 self._pending = self._pending[need:]
-                parts.append(take)
+                if keep:
+                    parts.append(take)
                 need -= len(take)
                 continue
             try:
@@ -466,14 +475,18 @@ class CSVPoints:
     def __getitem__(self, key):
         if isinstance(key, slice):
             lo = key.start or 0
-            hi = self.shape[0] if key.stop is None else min(key.stop,
-                                                            self.shape[0])
+            hi = self.shape[0] if key.stop is None else key.stop
             if key.step not in (None, 1):
                 raise ValueError("CSVPoints slices must be contiguous")
+            if lo < 0 or hi < 0:
+                raise IndexError(
+                    "CSVPoints does not support negative slice bounds "
+                    f"(got {lo}:{hi})")
+            hi = min(hi, self.shape[0])
             if lo == 0 or self._stream is None:
                 self._restart()
                 if lo:
-                    self._read(lo)  # skip forward (init paths)
+                    self._read(lo, keep=False)  # skip forward (init paths)
             elif lo != self._pos:
                 raise ValueError(
                     f"CSVPoints is sequential: asked for rows {lo}:{hi} at "

@@ -618,6 +618,42 @@ void stream_worker(CsvStream* s) {
 
 }  // namespace
 
+// Streaming row/column count: bounded memory (one 4 MB block + a line
+// carry), unlike harp_count_rows whose read_file() malloc's the whole
+// file — CSVPoints' shape pass on a beyond-RAM corpus must not OOM.
+int harp_csv_count_stream(const char* path, int64_t* rows, int64_t* cols) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  std::vector<char> buf(4 << 20);
+  std::string carry;
+  int64_t r = 0, c = 0;
+  while (true) {
+    size_t got = std::fread(buf.data(), 1, buf.size(), f);
+    if (got == 0) {
+      if (std::ferror(f)) { std::fclose(f); return 1; }
+      break;
+    }
+    carry.append(buf.data(), got);
+    size_t last_nl = carry.rfind('\n');
+    if (last_nl == std::string::npos) continue;  // no complete line yet
+    int64_t br = 0, bc = 0;
+    count_range(carry.data(), 0, last_nl + 1, &br, &bc);
+    r += br;
+    if (c == 0) c = bc;
+    carry.erase(0, last_nl + 1);
+  }
+  if (!carry.empty()) {  // final line without trailing newline
+    int64_t br = 0, bc = 0;
+    count_range(carry.data(), 0, carry.size(), &br, &bc);
+    r += br;
+    if (c == 0) c = bc;
+  }
+  std::fclose(f);
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
 void* harp_csv_stream_open(const char* path, int64_t chunk_rows) {
   if (chunk_rows < 1) return nullptr;
   std::FILE* f = std::fopen(path, "rb");

@@ -97,6 +97,35 @@ def test_streaming_int8_rejects_wrap_prone_chunk(mesh, monkeypatch):
                          mesh=mesh, quantize="int8")
 
 
+def test_streaming_checkpoint_crash_recovery_equals_clean_run(mesh, tmp_path):
+    """Same recovery contract as the other fits: a crash mid-run resumes
+    from the checkpoint and produces the identical result (epochs are
+    deterministic given centroids — data is re-read each sweep)."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    pts = _blobs()
+    clean_c, clean_i, clean_h = KS.fit_streaming(
+        pts, k=8, iters=6, chunk_points=1000, mesh=mesh, seed=3,
+        return_history=True)
+    ck = str(tmp_path / "ks")
+    c, i, h = KS.fit_streaming(
+        pts, k=8, iters=6, chunk_points=1000, mesh=mesh, seed=3,
+        return_history=True, ckpt_dir=ck, ckpt_every=2,
+        fault=FaultInjector(fail_at=(4,)))
+    np.testing.assert_allclose(c, clean_c, rtol=1e-6)
+    np.testing.assert_allclose(h, clean_h, rtol=1e-6)
+
+
+def test_streaming_ckpt_rejects_mismatched_k(mesh, tmp_path):
+    pts = _blobs()
+    ck = str(tmp_path / "ks")
+    KS.fit_streaming(pts, k=8, iters=2, chunk_points=1000, mesh=mesh,
+                     seed=3, ckpt_dir=ck, ckpt_every=1)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        KS.fit_streaming(pts, k=4, iters=4, chunk_points=1000, mesh=mesh,
+                         seed=3, ckpt_dir=ck, ckpt_every=1)
+
+
 def test_synthetic_fused_benchmark_converges(mesh):
     # the ONE-jit full-scale formulation: same dataset every epoch, so
     # inertia must descend across separate calls with more iters

@@ -343,3 +343,42 @@ def test_csv_stream_fallback_pads_ragged_rows_like_native(native_lib,
     expect = np.array([[1, 2, 3], [4, 5, 0], [6, 7, 8]], np.float32)
     np.testing.assert_allclose(nat, expect)
     np.testing.assert_allclose(py, expect)
+
+
+def test_csv_points_rejects_negative_slice_bounds(native_lib, tmp_path):
+    from harp_tpu.native.datasource import CSVPoints
+
+    p = str(tmp_path / "ns.csv")
+    _write_csv(p, np.ones((10, 2), np.float32))
+    with CSVPoints(p) as cp:
+        with pytest.raises(IndexError, match="negative"):
+            cp[-5:]
+        with pytest.raises(IndexError, match="negative"):
+            cp[0:-1]
+
+
+def test_csv_count_stream_matches_whole_file_count(native_lib, tmp_path):
+    # the bounded-memory count pass must agree with the dense loader
+    import ctypes
+
+    pts = np.random.default_rng(5).normal(size=(777, 4)).astype(np.float32)
+    p = str(tmp_path / "cnt.csv")
+    _write_csv(p, pts, blanks=True)  # blanks + header comment
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = native_lib.harp_csv_count_stream(p.encode(), ctypes.byref(rows),
+                                          ctypes.byref(cols))
+    assert rc == 0 and (rows.value, cols.value) == (777, 4)
+
+
+def test_csv_stream_fallback_cols_past_comment_prefix(tmp_path, monkeypatch):
+    import harp_tpu.native.build as B
+    from harp_tpu.native.datasource import CSVStream
+
+    monkeypatch.setattr(B, "_LIB", None)
+    monkeypatch.setattr(B, "_TRIED", True)
+    p = str(tmp_path / "cp.csv")
+    with open(p, "w") as f:
+        f.write("# one\n# two\n1 2 3\n")
+    with CSVStream(p, chunk_rows=1) as st:
+        assert st.cols == 3  # must scan past the comment-only first chunk
