@@ -135,3 +135,27 @@ def test_synthetic_fused_benchmark_converges(mesh):
                                 chunk_points=8192, mesh=mesh, warmup=1)
     assert r4["inertia"] < r1["inertia"]
     assert r1["n_chunks"] == 8 and r1["n"] == 65536
+    assert "gen_sec_per_iter" not in r1  # calibration is opt-in
+
+
+def test_gen_calibration_post_processing():
+    # falsifiable unit contract of the calibration arithmetic: a credible
+    # gen time subtracts; a gen time eating >= 90% of the run must yield
+    # None, never an absurd 1e9x "ex-gen" rate
+    ok = KS._ex_gen_fields(dt=10.0, gen_dt=4.0, iters=2)
+    assert ok["gen_sec_per_iter"] == 2.0
+    np.testing.assert_allclose(ok["iters_per_sec_ex_gen"], 2 / 6.0)
+    bad = KS._ex_gen_fields(dt=10.0, gen_dt=9.5, iters=2)
+    assert bad["iters_per_sec_ex_gen"] is None
+    assert "invalid" in bad["gen_calibration"]
+    worse = KS._ex_gen_fields(dt=1.0, gen_dt=2.0, iters=2)  # gen > total
+    assert worse["iters_per_sec_ex_gen"] is None
+
+
+def test_gen_calibration_runs_end_to_end(mesh):
+    r = KS.benchmark_streaming(n=65536, d=16, k=16, iters=4,
+                               chunk_points=8192, mesh=mesh, warmup=1,
+                               calibrate_gen=True)
+    assert r["gen_sec_per_iter"] > 0  # the twin really ran the RNG
+    # either a credible subtraction or an explicit invalid flag
+    assert (r["iters_per_sec_ex_gen"] is None) == ("gen_calibration" in r)
