@@ -129,4 +129,24 @@ r1 = model.train_epoch()
 rs = model.train_epochs(3)
 assert np.isfinite(r1) and rs[-1] < r1, (r1, rs)
 
+# LDA pull/push epoch across the boundary: the word-topic table is
+# row-sharded over PROCESSES here, so every chunk's pull/push request/
+# serve round trips cross Gloo (the sparse-verb production use)
+from harp_tpu.models.lda import LDA, LDAConfig, synthetic_corpus
+
+dl, wl = synthetic_corpus(n_docs=8 * nw, vocab_size=8 * nw,
+                          n_topics_true=2, tokens_per_doc=8, seed=0)
+lda = LDA(8 * nw, 8 * nw, LDAConfig(n_topics=4, algo="pushpull", chunk=16),
+          mesh, seed=0)
+lda.set_tokens(dl, wl)
+for _ in range(3):
+    lda.sample_epoch()
+assert lda.last_dropped == 0  # default pull_cap: zero drops guaranteed
+# multi-host: a process can only read its own shards — check the
+# replicated Nk (global topic totals must still equal the token count)
+Nk = np.asarray(lda.Nk.addressable_shards[0].data)
+np.testing.assert_allclose(Nk.sum(), lda.n_tokens)
+local_Nwk = np.asarray(lda.Nwk.addressable_shards[0].data)
+assert (local_Nwk >= 0).all() and np.isfinite(local_Nwk).all()
+
 print(f"proc {proc_id}: MULTIPROC OK", flush=True)
