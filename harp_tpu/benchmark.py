@@ -61,9 +61,65 @@ def bench_verb(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
             "gb_per_sec": payload / dt / 1e9, "num_workers": nw}
 
 
+SPARSE_VERBS = ("pull_sparse", "push_sparse")
+
+
+def bench_sparse(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
+    """Characterize the request/serve sparse row exchange
+    (table.pull_rows_sparse / push_rows_sparse): ``size_bytes`` is the
+    GLOBAL requested-row payload (bench_verb's convention); the table is
+    sized 4× past it, which must NOT change the timing — that is the
+    verbs' point.  Requests spread evenly over owners so
+    ``capacity == m/nw`` exactly: every wire slot carries a real row and
+    the accounted payload equals the bytes the fabric moves."""
+    from harp_tpu.table import pull_rows_sparse, push_rows_sparse
+
+    nw = mesh.num_workers
+    d = 128
+    # m requested rows per worker, an exact multiple of nw
+    m = max(nw, size_bytes // (4 * d * nw) // nw * nw)
+    cap = m // nw
+    rows_local = max(4 * m, 128)            # table >> requests
+    rng = np.random.default_rng(0)
+    table = rng.normal(size=(nw * rows_local, d)).astype(np.float32)
+    # worker w requests rows cap*[0..cap) from EVERY owner: zero drops,
+    # zero padding slots in the [nw, cap] exchange buffers
+    ids = np.concatenate([
+        np.concatenate([o * rows_local + np.arange(cap, dtype=np.int32)
+                        for o in range(nw)])
+        for _ in range(nw)])
+    # device-resident inputs: re-uploading host arrays per rep would time
+    # the transfer of the deliberately-oversized table, not the exchange
+    table_d = mesh.shard_array(table, 0)
+    ids_d = mesh.shard_array(ids, 0)
+    if name == "pull_sparse":
+        fn = jax.jit(mesh.shard_map(
+            lambda t, i: pull_rows_sparse(t, i, capacity=cap)[0],
+            in_specs=(mesh.spec(0), mesh.spec(0)), out_specs=mesh.spec(0)))
+        run = lambda: fn(table_d, ids_d)  # noqa: E731
+    else:
+        deltas_d = mesh.shard_array(
+            rng.normal(size=(nw * m, d)).astype(np.float32), 0)
+        fn = jax.jit(mesh.shard_map(
+            lambda t, i, dv: push_rows_sparse(t, i, dv, capacity=cap)[0],
+            in_specs=(mesh.spec(0),) * 3, out_specs=mesh.spec(0)))
+        run = lambda: fn(table_d, ids_d, deltas_d)  # noqa: E731
+    device_sync(run())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run()
+    device_sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    payload = nw * m * d * 4  # global row bytes == actual wire slots
+    return {"verb": name, "bytes": payload, "sec": dt,
+            "gb_per_sec": payload / dt / 1e9, "num_workers": nw,
+            "table_rows": nw * rows_local, "requested_rows_per_worker": m}
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description="harp-tpu collective micro-benchmarks")
-    p.add_argument("--verbs", nargs="*", default=sorted(VERBS))
+    p.add_argument("--verbs", nargs="*",
+                   default=sorted(VERBS) + list(SPARSE_VERBS))
     p.add_argument("--min-kb", type=int, default=64)
     p.add_argument("--max-mb", type=int, default=64)
     p.add_argument("--reps", type=int, default=20)
@@ -75,8 +131,9 @@ def main(argv=None):
         sizes.append(size)
         size *= 4
     for verb in args.verbs:
+        bench = bench_sparse if verb in SPARSE_VERBS else bench_verb
         for s in sizes:
-            print(json.dumps(bench_verb(verb, mesh, s, args.reps)))
+            print(json.dumps(bench(verb, mesh, s, args.reps)))
 
 
 if __name__ == "__main__":

@@ -91,6 +91,10 @@ def test_collective_bench_all_verbs_run(mesh):
     for verb in sorted(B.VERBS):
         out = B.bench_verb(verb, mesh, 64 * 1024, reps=1)
         assert out["sec"] > 0, verb
+    for verb in B.SPARSE_VERBS:  # request/serve sparse row exchange
+        out = B.bench_sparse(verb, mesh, 64 * 1024, reps=1)
+        assert out["sec"] > 0
+        assert out["table_rows"] > out["requested_rows_per_worker"]
 
 
 def test_moments_large_mean_no_cancellation(mesh):
