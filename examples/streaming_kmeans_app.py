@@ -60,7 +60,7 @@ def main():
         with open(csv, "w") as f:
             f.write("# synthetic blobs\n")
             for row in pts:
-                f.write(",".join(f"{v:.7e}" for v in row) + "\n")
+                f.write(",".join(f"{v:.9e}" for v in row) + "\n")  # f32 round-trips at 9 sig digits
 
         src = CSVPoints(csv, chunk_rows=args.chunk)
         print(f"source: {src.shape[0]} rows x {src.shape[1]} cols "

@@ -81,3 +81,92 @@ def test_kvtable_array_roundtrip_preserves_state(pairs, op):
     for k in a.keys():
         np.testing.assert_allclose(float(a.get(k)), float(b.get(k)),
                                    rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Sparse pull/push properties: random ids/capacities vs an exact numpy
+# model INCLUDING the deterministic drop rule (per-(worker, owner)
+# arrival order, capacity slots each).
+# ---------------------------------------------------------------------------
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from harp_tpu.table import pull_rows_sparse, push_rows_sparse
+
+_N = 8           # workers
+_RPW = 4         # table rows per worker
+_M = 6           # requests per worker
+_D = 3
+
+ids_st = st.lists(st.integers(0, _N * _RPW - 1), min_size=_N * _M,
+                  max_size=_N * _M)
+cap_st = st.integers(1, _M)
+# allow_subnormal=False: XLA flushes f32 denormals to zero (FTZ) while
+# numpy keeps them — a float-semantics artifact, not a verb property
+table_st = st.lists(st.floats(-100, 100, allow_nan=False,
+                              allow_infinity=False, width=32,
+                              allow_subnormal=False),
+                    min_size=_N * _RPW * _D, max_size=_N * _RPW * _D)
+
+_sparse_cache: dict = {}
+
+
+def _fns(mesh, capacity):
+    if capacity not in _sparse_cache:
+        pull = jax.jit(mesh.shard_map(
+            lambda t, i: pull_rows_sparse(t, i, capacity=capacity),
+            in_specs=(mesh.spec(0), mesh.spec(0)),
+            out_specs=(mesh.spec(0), mesh.spec(0), P())))
+        push = jax.jit(mesh.shard_map(
+            lambda t, i, dv: push_rows_sparse(t, i, dv, capacity=capacity),
+            in_specs=(mesh.spec(0),) * 3,
+            out_specs=(mesh.spec(0), P())))
+        _sparse_cache[capacity] = (pull, push)
+    return _sparse_cache[capacity]
+
+
+def _model_keep(ids, capacity):
+    """The deterministic drop rule: per (worker, owning-destination)
+    arrival order, ``capacity`` slots each."""
+    keep = np.zeros(ids.shape, bool)
+    for w in range(_N):
+        counts: dict = {}
+        for j in range(_M):
+            dest = ids[w * _M + j] // _RPW
+            c = counts.get(dest, 0)
+            keep[w * _M + j] = c < capacity
+            counts[dest] = c + 1
+    return keep
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids=ids_st, cap=cap_st, tvals=table_st)
+def test_pull_rows_sparse_property(mesh, ids, cap, tvals):
+    ids = np.asarray(ids, np.int32)
+    table = np.asarray(tvals, np.float32).reshape(_N * _RPW, _D)
+    pull, _ = _fns(mesh, cap)
+    rows, ok, dropped = pull(table, ids)
+    keep = _model_keep(ids, cap)
+    np.testing.assert_array_equal(np.asarray(ok), keep)
+    assert int(dropped) == int((~keep).sum())
+    rows = np.asarray(rows)
+    np.testing.assert_allclose(rows[keep], table[ids[keep]])
+    np.testing.assert_allclose(rows[~keep], 0.0)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids=ids_st, cap=cap_st)
+def test_push_rows_sparse_property(mesh, ids, cap):
+    ids = np.asarray(ids, np.int32)
+    table = np.zeros((_N * _RPW, _D), np.float32)
+    deltas = (np.arange(_N * _M * _D, dtype=np.float32)
+              .reshape(_N * _M, _D) / 7.0)
+    _, push = _fns(mesh, cap)
+    new_table, dropped = push(table, ids, deltas)
+    keep = _model_keep(ids, cap)
+    assert int(dropped) == int((~keep).sum())
+    expect = np.zeros_like(table)
+    np.add.at(expect, ids[keep], deltas[keep])
+    np.testing.assert_allclose(np.asarray(new_table), expect, rtol=1e-6,
+                               atol=1e-6)
