@@ -101,12 +101,26 @@ inline float parse_float(const char*& p) {
     if (*s == '-') { eneg = true; ++s; }
     else if (*s == '+') { ++s; }
     int e = 0;
-    while (*s >= '0' && *s <= '9') { e = e * 10 + (*s - '0'); ++s; }
+    while (*s >= '0' && *s <= '9') {
+      if (e < 100000) e = e * 10 + (*s - '0');  // clamp: no int overflow
+      ++s;
+    }
     exp10 += eneg ? -e : e;
   }
+  // Clamp to double's decimal range BEFORE the stepped loops: a corrupt
+  // "1e2000000000" token must parse in O(1) (to inf/0, like strtof), not
+  // spin |exp10|/38 iterations, and a clamped exponent can never index
+  // kPow10 out of bounds.
+  if (exp10 > 700) exp10 = 700;
+  else if (exp10 < -700) exp10 = -700;
   double v = static_cast<double>(mant);
-  if (exp10 > 0) v *= (exp10 <= 38) ? kPow10[exp10] : 1e308;
-  else if (exp10 < 0) v /= (-exp10 <= 38) ? kPow10[-exp10] : 1e308;
+  // Apply the decimal exponent in <=38 steps: a LONG mantissa plus a small
+  // value can push the combined exponent past the table (e.g.
+  // "9.9999999999999991e-31" has exp10 = -47) — the old 1e308 clamp
+  // misparsed such values to 0/inf even though they are ordinary floats.
+  int e = exp10;
+  while (e > 0) { int step = e > 38 ? 38 : e; v *= kPow10[step]; e -= step; }
+  while (e < 0) { int step = -e > 38 ? 38 : -e; v /= kPow10[step]; e += step; }
   p = s;
   return static_cast<float>(neg ? -v : v);
 }

@@ -382,3 +382,32 @@ def test_csv_stream_fallback_cols_past_comment_prefix(tmp_path, monkeypatch):
         f.write("# one\n# two\n1 2 3\n")
     with CSVStream(p, chunk_rows=1) as st:
         assert st.cols == 3  # must scan past the comment-only first chunk
+
+
+def test_parser_long_mantissa_with_small_exponent(native_lib, tmp_path):
+    # regression: "9.9999999999999991e-31" pushed the combined decimal
+    # exponent to -47; the old table clamp misparsed it to 0
+    p = str(tmp_path / "exp.csv")
+    cases = [9.9999999999999991e-31, 1e-30, -1.2345678901234567e-35,
+             9.87654321e37, 1.1754944e-38]
+    with open(p, "w") as f:
+        f.write(" ".join(f"{v:.17g}" for v in cases) + "\n")
+    got = load_csv(p)[0]
+    expect = np.asarray(cases, np.float32)
+    ulp = np.spacing(np.abs(expect)) + 1e-45
+    assert (np.abs(got - expect) <= ulp).all(), (got, expect)
+
+
+def test_parser_huge_exponent_is_fast_and_saturates(native_lib, tmp_path):
+    # a corrupt exponent must parse O(1) to inf/0 (like strtof), never
+    # spin the stepped-pow10 loop or index the table out of bounds
+    import time
+
+    p = str(tmp_path / "huge.csv")
+    with open(p, "w") as f:
+        f.write("1e2000000000 1e-2000000000 1.0\n" * 64)
+    t0 = time.perf_counter()
+    got = load_csv(p)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"corrupt exponents took {dt:.2f}s"
+    assert np.isinf(got[0, 0]) and got[0, 1] == 0.0 and got[0, 2] == 1.0

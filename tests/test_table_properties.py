@@ -170,3 +170,47 @@ def test_push_rows_sparse_property(mesh, ids, cap):
     np.add.at(expect, ids[keep], deltas[keep])
     np.testing.assert_allclose(np.asarray(new_table), expect, rtol=1e-6,
                                atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Native CSV parser property: the hand-rolled C++ float scanner must
+# round-trip arbitrary f32 values written at full precision, agreeing
+# with numpy's parse to 1 ulp (the scanner accumulates in double and
+# rounds once, so exact equality is not guaranteed for long mantissas).
+# ---------------------------------------------------------------------------
+
+from harp_tpu.native.build import load_native
+from harp_tpu.native.datasource import CSVStream
+
+f32_st = st.floats(allow_nan=False, allow_infinity=False, width=32,
+                   allow_subnormal=False)
+
+
+@settings(max_examples=30, deadline=None)
+@given(rows=st.lists(st.tuples(f32_st, f32_st, f32_st), min_size=2,
+                     max_size=8),
+       sep=st.sampled_from([",", " ", "\t", ", "]),
+       fmt=st.sampled_from(["{:.9e}", "{:.17g}", "{:g}", "{:.6f}"]))
+def test_native_csv_parser_roundtrip_property(tmp_path_factory, rows, sep,
+                                              fmt):
+    if load_native() is None:
+        import pytest
+
+        pytest.skip("no native lib")
+    vals = np.asarray(rows, np.float32)
+    p = tmp_path_factory.mktemp("csvprop") / "v.csv"
+    with open(p, "w") as f:
+        for row in vals:
+            f.write(sep.join(fmt.format(float(v)) for v in row) + "\n")
+    # what numpy parses from the same text (the fallback's semantics)
+    expect = np.loadtxt(str(p), dtype=np.float64,
+                        delimiter=None if sep != "," and sep != ", " else ",",
+                        ndmin=2).astype(np.float32)
+    with CSVStream(str(p), chunk_rows=4) as stream:
+        got = np.concatenate(list(stream), 0)
+    assert got.shape == expect.shape
+    # agreement to 1 ulp of the numpy-parsed value (spacing at f32 max
+    # overflows to inf — a permissive bound there, which is fine)
+    with np.errstate(over="ignore"):
+        ulp = np.spacing(np.abs(expect).astype(np.float32)) + 1e-45
+    assert (np.abs(got - expect) <= ulp).all(), (got, expect)
