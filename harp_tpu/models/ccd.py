@@ -205,6 +205,32 @@ class CCD:
         return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
                 for s, c in zip(stats[0], stats[1])]
 
+    def fit(self, epochs: int, ckpt_dir: str | None = None, *,
+            ckpt_every: int = 5, max_restarts: int = 3, fault=None):
+        """Train with optional checkpoint/resume — the same recovery
+        contract as MF-SGD/LDA/MLP ``fit`` (SURVEY.md §6): with
+        ``ckpt_dir`` set, a crashed run (or a rerun pointing at the same
+        dir) resumes from the latest saved epoch, and a checkpoint from a
+        different rank/shape config refuses to restore.  Returns the
+        per-epoch RMSEs this call actually ran."""
+        from harp_tpu.utils.fault import factor_state_io, fit_epochs
+
+        rmses: list[float] = []
+        get_state, set_state = factor_state_io(self, {
+            "W": lambda a: self.mesh.shard_array(a, 0),
+            # device_put directly (no jnp.asarray detour: the relay ships
+            # big compile-time literals — CLAUDE.md trap — and H can be
+            # hundreds of MB at graded scale)
+            "H": lambda a: jax.device_put(a, self.mesh.replicated()),
+        })
+        fit_epochs(
+            lambda: rmses.append(self.train_epoch()),
+            get_state, set_state,
+            epochs, ckpt_dir, ckpt_every=ckpt_every,
+            max_restarts=max_restarts, fault=fault,
+        )
+        return rmses
+
 
 def benchmark(n_users=50_000, n_items=20_000, nnz=2_000_000, rank=32,
               epochs=2, mesh=None, seed=0):

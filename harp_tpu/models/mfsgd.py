@@ -539,23 +539,16 @@ class MFSGD:
         upgraded.  Returns the per-epoch RMSE list for the epochs this call
         actually ran.
         """
-        from harp_tpu.utils.fault import check_restored_shapes, fit_epochs
+        from harp_tpu.utils.fault import factor_state_io, fit_epochs
 
         rmses: list[float] = []
-
-        def set_state(state):
-            check_restored_shapes([("W", state["W"], self.W),
-                                   ("H", state["H"], self.H)])
-            if not isinstance(state["W"], jax.Array):  # numpy from restore
-                self.W = self.mesh.shard_array(np.asarray(state["W"]), 0)
-                self.H = self.mesh.shard_array(np.asarray(state["H"]), 0)
-            else:
-                self.W, self.H = state["W"], state["H"]
-
+        get_state, set_state = factor_state_io(self, {
+            "W": lambda a: self.mesh.shard_array(a, 0),
+            "H": lambda a: self.mesh.shard_array(a, 0),
+        })
         fit_epochs(
             lambda: rmses.append(self.train_epoch()),
-            lambda: {"W": self.W, "H": self.H},
-            set_state,
+            get_state, set_state,
             epochs, ckpt_dir, ckpt_every=ckpt_every,
             max_restarts=max_restarts, fault=fault,
         )

@@ -39,6 +39,34 @@ def check_restored_shapes(named_pairs) -> None:
                 "algo/tile/size config? (refusing to resume)")
 
 
+def factor_state_io(obj, fields: dict):
+    """(get_state, set_state) for models whose checkpoint state is named
+    array attributes — the ONE restore contract shared by the factor
+    models (MF-SGD, CCD), so shape-guarding and live-vs-numpy handling
+    cannot drift between them.
+
+    ``fields``: ``{attr_name: placer}`` where ``placer(np_array)`` puts a
+    freshly-restored HOST array on the right devices (live arrays from
+    the normal step-to-step flow are installed as-is, no transfers).
+    """
+
+    def get_state():
+        return {k: getattr(obj, k) for k in fields}
+
+    def set_state(state):
+        check_restored_shapes(
+            [(k, state[k], getattr(obj, k)) for k in fields])
+        first = state[next(iter(fields))]
+        if isinstance(first, jax.Array):   # normal flow: install as-is
+            for k in fields:
+                setattr(obj, k, state[k])
+        else:                              # numpy from a fresh restore
+            for k, place in fields.items():
+                setattr(obj, k, place(np.asarray(state[k])))
+
+    return get_state, set_state
+
+
 class FaultInjector:
     """Deterministic fault hook for tests — raise at chosen iterations.
 

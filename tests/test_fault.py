@@ -200,3 +200,38 @@ def test_mlp_fit_ckpt_checkpoint_resume(mesh, tmp_path):
 
     with pytest.raises(ValueError, match="ckpt_dir"):
         make().fit_ckpt(x, y, 2, None, fault=FaultInjector(fail_at=(1,)))
+
+
+def test_ccd_fit_checkpoint_resume(mesh, tmp_path):
+    """CCD gets the same recovery contract as MF-SGD/LDA: crash-recovery
+    reproduces the clean run, resume installs restored factors, and a
+    mismatched-rank checkpoint refuses."""
+    from harp_tpu.models import ccd as CC
+    from harp_tpu.models.mfsgd import synthetic_ratings
+
+    u, i, v = synthetic_ratings(32, 24, 400, rank=3, seed=0)
+
+    def make_model(rank=4):
+        m = CC.CCD(32, 24, CC.CCDConfig(rank=rank), mesh, seed=0)
+        m.set_ratings(u, i, v)
+        return m
+
+    clean = make_model()
+    clean_rmses = clean.fit(4)
+    assert clean_rmses[-1] < clean_rmses[0]
+
+    ckpt = str(tmp_path / "ccd")
+    crashed = make_model()
+    rmses = crashed.fit(4, ckpt, ckpt_every=2,
+                        fault=FaultInjector(fail_at=(3,)))
+    assert len(rmses) >= 4
+    np.testing.assert_allclose(np.asarray(crashed.W), np.asarray(clean.W),
+                               rtol=1e-5, atol=1e-6)
+
+    resumed = make_model()
+    assert resumed.fit(4, ckpt, ckpt_every=2) == []  # nothing left to run
+    np.testing.assert_allclose(np.asarray(resumed.H), np.asarray(crashed.H),
+                               rtol=1e-6)
+
+    with pytest.raises(ValueError, match="refusing to resume"):
+        make_model(rank=8).fit(4, ckpt, ckpt_every=2)
