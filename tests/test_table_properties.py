@@ -214,3 +214,53 @@ def test_native_csv_parser_roundtrip_property(tmp_path_factory, rows, sep,
     with np.errstate(over="ignore"):
         ulp = np.spacing(np.abs(expect).astype(np.float32)) + 1e-45
     assert (np.abs(got - expect) <= ulp).all(), (got, expect)
+
+
+# ---------------------------------------------------------------------------
+# libsvm parser property: native and Python-fallback parses must agree on
+# random sparse data across formats (same contract as the CSV parser —
+# behavior must not depend on g++ availability).
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=20, deadline=None)
+@given(rows=st.lists(
+    st.tuples(f32_st,                       # label
+              st.lists(st.tuples(st.integers(1, 30), f32_st),
+                       min_size=0, max_size=6)),    # (idx, val) pairs
+    min_size=1, max_size=6),
+    fmt=st.sampled_from(["{:.9e}", "{:.17g}", "{:g}"]))
+def test_libsvm_native_matches_fallback_property(tmp_path_factory, rows,
+                                                 fmt):
+    import harp_tpu.native.build as B
+    from harp_tpu.native.datasource import load_libsvm
+
+    if load_native() is None:
+        import pytest
+
+        pytest.skip("no native lib")
+    p = tmp_path_factory.mktemp("svmprop") / "d.svm"
+    with open(p, "w") as f:
+        for label, pairs in rows:
+            # ascending indices per line (the format's contract)
+            pairs = sorted({i: v for i, v in pairs}.items())
+            toks = [fmt.format(float(label))] + [
+                f"{i}:{fmt.format(float(v))}" for i, v in pairs]
+            f.write(" ".join(toks) + "\n")
+
+    native = load_libsvm(str(p))
+    saved = (B._LIB, B._TRIED)
+    try:
+        B._LIB, B._TRIED = None, True   # force the fallback
+        fallback = load_libsvm(str(p))
+    finally:
+        B._LIB, B._TRIED = saved
+    for a, b, name in zip(native, fallback,
+                          ("labels", "indptr", "indices", "values", "nf")):
+        with np.errstate(over="ignore"):
+            ulp = (np.spacing(np.abs(np.asarray(a, np.float64))
+                              .astype(np.float32)) + 1e-45
+                   if name in ("labels", "values") else 0)
+        assert np.all(np.abs(np.asarray(a, np.float64)
+                             - np.asarray(b, np.float64)) <= ulp), \
+            (name, a, b)
