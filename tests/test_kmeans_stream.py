@@ -159,3 +159,24 @@ def test_gen_calibration_runs_end_to_end(mesh):
     assert r["gen_sec_per_iter"] > 0  # the twin really ran the RNG
     # either a credible subtraction or an explicit invalid flag
     assert (r["iters_per_sec_ex_gen"] is None) == ("gen_calibration" in r)
+
+
+def test_north_star_1b_program_lowers(mesh):
+    """The REAL 1B×300 k=1000 program (3814-chunk scan × fori epochs)
+    must trace and lower at its true shapes — proving the north-star
+    config is formulable — without executing (that needs the TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = KS.StreamConfig(k=1000, chunk_points=262_144)
+    n_chunks = 1_000_000_000 // cfg.chunk_points  # 3814
+    fn = KS.make_synthetic_run_fn(mesh, cfg, d=300, n_chunks=n_chunks)
+    keys = jax.random.split(jax.random.key(0), mesh.num_workers)
+    lowered = fn.lower(
+        jax.ShapeDtypeStruct(keys.shape, keys.dtype,
+                             sharding=mesh.sharding(mesh.spec(0))),
+        jax.ShapeDtypeStruct((1000, 300), jnp.float32,
+                             sharding=mesh.replicated()),
+        jax.ShapeDtypeStruct((), jnp.int32, sharding=mesh.replicated()))
+    text = lowered.as_text()
+    assert "while" in text  # the chunk scan is in the program
