@@ -521,6 +521,17 @@ def push_rows(global_shard, row_ids, deltas, *, axis: str = WORKER_AXIS):
 # ---------------------------------------------------------------------------
 
 
+def _guard_row_requests(row_ids, valid, n_rows):
+    """(requested, oor_local) — the ONE out-of-range guard both sparse
+    verbs share: bad ids are excluded from the exchange (they would clamp
+    into the last destination's bucket — silent corruption) and counted
+    as drops, UNLIKE `valid` padding which is free to skip."""
+    in_range = (row_ids >= 0) & (row_ids < n_rows)
+    if valid is None:
+        return in_range, jnp.sum(~in_range)
+    return valid & in_range, jnp.sum(valid & ~in_range)
+
+
 def pull_rows_sparse(global_shard, row_ids, *, capacity: int,
                      valid=None, axis: str = WORKER_AXIS):
     """Fetch rows of a row-sharded global table without materializing it.
@@ -528,7 +539,8 @@ def pull_rows_sparse(global_shard, row_ids, *, capacity: int,
     Call inside ``shard_map``.  The global table has ``nw * rows_local``
     rows, block-partitioned: worker w owns rows ``[w*rows_local,
     (w+1)*rows_local)``.  ``row_ids [m]``: global row indices this worker
-    needs (duplicates fine; must be in range).  ``capacity``: static slot
+    needs (duplicates fine; out-of-range ids come back ``ok=False`` and
+    count as dropped — never silently served).  ``capacity``: static slot
     count this worker may request from EACH owner — requests beyond it
     are dropped (counted, never silently wrong).  ``valid`` (optional [m]
     bool): False entries are padding — they issue no request, occupy no
@@ -536,7 +548,9 @@ def pull_rows_sparse(global_shard, row_ids, *, capacity: int,
 
     Returns ``(rows [m, ...], ok [m] bool, dropped)`` where ``rows[i]``
     is zeros when ``ok[i]`` is False and ``dropped`` is the GLOBAL count
-    of capacity-dropped (valid) requests.
+    of requests not served: capacity overflow PLUS out-of-range ids
+    (a nonzero count from in-range ids means raise ``capacity``; from
+    bad ids it means fix the caller).
     """
     from harp_tpu.parallel.collective import allreduce as _allreduce
     from harp_tpu.parallel.collective import regroup as _regroup
@@ -547,10 +561,12 @@ def pull_rows_sparse(global_shard, row_ids, *, capacity: int,
     rows_local = global_shard.shape[0]
     row_ids = row_ids.astype(jnp.int32)
     dest = row_ids // rows_local                       # owning worker
+    requested, oor_local = _guard_row_requests(row_ids, valid,
+                                               nw * rows_local)
     # ids travel +1 so zero-filled padding decodes to the -1 sentinel
     (req,), keep, slot, dropped_local = bucket_by_destination(
-        dest, (row_ids + 1,), capacity, nw, valid)     # [nw, capacity]
-    dropped = _allreduce(dropped_local, axis=axis)
+        dest, (row_ids + 1,), capacity, nw, requested)  # [nw, capacity]
+    dropped = _allreduce(dropped_local + oor_local, axis=axis)
 
     # request phase: recv[p, j] = row id peer p wants from me (slot j)
     recv = _regroup(req, axis=axis, split_dim=0, concat_dim=0)
@@ -578,9 +594,11 @@ def push_rows_sparse(global_shard, row_ids, deltas, *, capacity: int,
     Call inside ``shard_map``.  Each (row_id, delta) pair is routed to the
     owning worker (one all_to_all of ``nw * capacity`` rows) and folded in
     with ADD — Harp's ``LocalGlobalSyncCollective.push``.  ``capacity`` =
-    static slots per destination; over-capacity pushes are dropped and
-    counted.  ``valid`` as in :func:`pull_rows_sparse` (padding pushes
-    nothing and takes no slot).  Returns ``(new_shard, dropped)``.
+    static slots per destination; over-capacity pushes AND out-of-range
+    ids are dropped and counted (never folded, never clamped into the
+    wrong bucket).  ``valid`` as in :func:`pull_rows_sparse` (padding
+    pushes nothing, takes no slot, counts as nothing).  Returns
+    ``(new_shard, dropped)``.
     """
     from harp_tpu.parallel.collective import allreduce as _allreduce
     from harp_tpu.parallel.collective import regroup as _regroup
@@ -591,9 +609,11 @@ def push_rows_sparse(global_shard, row_ids, deltas, *, capacity: int,
     rows_local = global_shard.shape[0]
     row_ids = row_ids.astype(jnp.int32)
     dest = row_ids // rows_local
+    requested, oor_local = _guard_row_requests(row_ids, valid,
+                                               nw * rows_local)
     (ids1, dv), keep, _, dropped_local = bucket_by_destination(
-        dest, (row_ids + 1, deltas), capacity, nw, valid)
-    dropped = _allreduce(dropped_local, axis=axis)
+        dest, (row_ids + 1, deltas), capacity, nw, requested)
+    dropped = _allreduce(dropped_local + oor_local, axis=axis)
 
     rids1, rdv = _regroup((ids1, dv), axis=axis, split_dim=0, concat_dim=0)
     flat_ids = rids1.reshape(nw * capacity) - 1

@@ -437,3 +437,32 @@ def test_kvtable_matches_combine_by_key():
     dense = np.asarray(combine_by_key(jnp.asarray(keys), jnp.asarray(vals), 16))
     for k in t.keys():
         np.testing.assert_allclose(t.get(k), dense[k], rtol=1e-5)
+
+
+def test_sparse_verbs_out_of_range_ids_counted_not_corrupting(mesh):
+    """An out-of-range row id must come back ok=False and counted — the
+    naive path would clamp it into the LAST worker's bucket and silently
+    serve/corrupt the wrong row."""
+    rpw, d = 2, 3
+    table = np.arange(N * rpw * d, dtype=np.float32).reshape(N * rpw, d)
+    # per worker: one good id, one out of range (beyond the table)
+    ids = np.tile(np.array([3, N * rpw + 5], np.int32), N)
+
+    rows, ok, dropped = _sparse_pull_fn(mesh, capacity=2)(table, ids)
+    ok = np.asarray(ok)
+    assert int(dropped) == N          # every bad id counted
+    np.testing.assert_array_equal(ok, np.tile([True, False], N))
+    np.testing.assert_allclose(np.asarray(rows)[ok],
+                               np.tile(table[3], (N, 1)))
+    np.testing.assert_allclose(np.asarray(rows)[~ok], 0.0)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda shard, i, dv: push_rows_sparse(shard, i, dv, capacity=2),
+        in_specs=(mesh.spec(0), mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), P()),
+    ))
+    new_table, pdrop = fn(table, ids, np.ones((N * 2, d), np.float32))
+    assert int(pdrop) == N
+    expect = table.copy()
+    expect[3] += N                    # only the in-range pushes landed
+    np.testing.assert_allclose(np.asarray(new_table), expect)
