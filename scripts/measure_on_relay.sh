@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot TPU measurement sweep — run the moment the relay answers.
+#
+# Probes the relay (bounded, per CLAUDE.md: never block on it), then runs
+# the full measurement checklist from BASELINE.md's outage list:
+#   1. scripts/measure_all.py  → BENCH_local.jsonl (all graded configs +
+#      roofline annotations; per-config watchdog)
+#   2. bench.py                → one driver-contract JSON line
+# Each step is watchdogged (HARP_BENCH_TIMEOUT, default 1200 s/config), so
+# a relay that dies mid-sweep still leaves parseable partial records.
+# After it finishes: update BASELINE.md rows from BENCH_local.jsonl and
+# commit immediately (the relay can die again).
+
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probing relay (45 s bound) =="
+if ! timeout 45 python -c "import jax; print(jax.devices())"; then
+  echo "relay not answering — try again later (poll, don't block)" >&2
+  exit 1
+fi
+
+echo "== full graded sweep → BENCH_local.jsonl =="
+python scripts/measure_all.py --out BENCH_local.jsonl
+
+echo "== driver bench line =="
+python bench.py | tee -a BENCH_local.jsonl
+
+echo "== 1B-point formulation (2 epochs, ~minutes) =="
+python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
+  | tee -a BENCH_local.jsonl
+
+echo "done — update BASELINE.md from BENCH_local.jsonl and COMMIT NOW"
