@@ -32,6 +32,7 @@ the [k, d] state — the ``chunk_points`` knob bounds it explicitly.
 from __future__ import annotations
 
 import dataclasses
+import json
 import time
 from typing import Any
 
@@ -56,8 +57,10 @@ from harp_tpu.models.kmeans import (  # shared MXU partials formulation
 
 @dataclasses.dataclass
 class StreamConfig:
+    # epoch counts are runtime arguments (fit_streaming(iters=...) /
+    # run_fn(..., n_iters)), never config state: the synthetic program
+    # traces n_iters as a scalar so changing it can't recompile
     k: int = 1000
-    iters: int = 10
     # rows per streamed chunk (across the whole mesh; rounded up to a
     # multiple of num_workers).  Bounds peak HBM: the dominant buffers are
     # the [chunk/nw, d] points block and [chunk/nw, k] score matrix —
@@ -147,14 +150,19 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                   mesh: WorkerMesh | None = None, seed=0,
                   dtype=jnp.float32, quantize=None, init="random",
                   return_history=False, ckpt_dir=None, ckpt_every=5,
-                  max_restarts=3, fault=None):
+                  max_restarts=3, fault=None, instrument=None):
     """Blocked-epoch Lloyd over a source too large for HBM.
 
     ``points``: [n, d] numpy array, ``np.memmap``, or any sequential
     source honoring the slice contract (``harp_tpu.native.CSVPoints``).
     Semantics are identical to ``kmeans.fit`` — one epoch assigns EVERY
     point against the epoch-start centroids, so the result is full-batch
-    Lloyd, not minibatch — only the execution is chunked.  Returns
+    Lloyd, not minibatch — only the execution is chunked.  One deliberate
+    seeding divergence: ``init="kmeans++"`` runs the D² seeding on a
+    uniform subsample of at most 50 000 rows (``_init_centroids``), not
+    the full source — exact kmeans++ needs k full passes over the data
+    (k=1000 → 1000 sweeps of a 1.2 TB file); the subsample keeps seeding
+    O(1) while Lloyd itself remains exact full-batch.  Returns
     ``(centroids [k, d], inertia)`` (+ per-epoch inertia history with
     ``return_history=True``; the history is read back in one stacked
     transfer at the end — never per epoch, per the relay dispatch trap).
@@ -165,11 +173,21 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
     preemption.  Epochs are deterministic given the centroids (the data
     is re-read each sweep), so centroids + completed history are the
     whole state.
+
+    ``instrument``: pass an empty dict to collect per-epoch pipeline
+    timing under key ``"epochs"``: ``host_s`` (time blocked in
+    ``put_chunk`` — disk read/parse + pad + H2D dispatch; the part device
+    compute is supposed to hide behind), ``sync_s`` (device tail NOT
+    hidden: blocking wait on the epoch result after the last chunk), and
+    ``epoch_s`` (wall).  Instrumented runs deliberately pay ONE extra
+    device sync per epoch (a relay round trip, 20–150 ms — negligible
+    against multi-second epochs, but don't instrument micro-runs you
+    intend to time).  Consumed by :func:`benchmark_ingest`.
     """
     mesh = mesh or current_mesh()
     n, d = points.shape
     nw = mesh.num_workers
-    cfg = StreamConfig(k=k, iters=iters, chunk_points=chunk_points,
+    cfg = StreamConfig(k=k, chunk_points=chunk_points,
                        dtype=dtype, quantize=quantize)
     chunk = -(-min(cfg.chunk_points, n) // nw) * nw  # static chunk shape
 
@@ -220,17 +238,31 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
 
     def train_one():
         nonlocal centroids
+        ep0 = time.perf_counter()
+        host_s = 0.0
         sums, counts, inertia = zeros()
+        t = time.perf_counter()
         nxt = put_chunk(offsets[0])  # double buffer: transfer j+1 during j
+        host_s += time.perf_counter() - t
         for j in range(len(offsets)):
             cur = nxt
             if j + 1 < len(offsets):
+                t = time.perf_counter()
                 nxt = put_chunk(offsets[j + 1])
+                host_s += time.perf_counter() - t
             sums, counts, inertia = accum_fn(cur[0], cur[1], centroids,
                                              sums, counts, inertia)
         new_c, ep_inertia = finish_fn(sums, counts, inertia, centroids)
         centroids = new_c
         history.append(ep_inertia)
+        if instrument is not None:  # one deliberate sync/epoch (docstring)
+            t = time.perf_counter()
+            device_sync(ep_inertia)
+            instrument.setdefault("epochs", []).append({
+                "host_s": host_s,
+                "sync_s": time.perf_counter() - t,
+                "epoch_s": time.perf_counter() - ep0,
+            })
 
     def get_state():
         # LIVE objects, zero syncs: fit_epochs calls this every epoch (not
@@ -364,7 +396,7 @@ def benchmark_streaming(n=100_000_000, d=300, k=1000, iters=3,
     nw = mesh.num_workers
     # chunk never exceeds n: a small-n request must not silently measure a
     # 262144-point epoch (the dict reports the points actually processed)
-    cfg = StreamConfig(k=k, iters=iters,
+    cfg = StreamConfig(k=k,
                        chunk_points=-(-min(chunk_points, n) // nw) * nw,
                        dtype=dtype)
     n_chunks = max(1, n // cfg.chunk_points)
@@ -417,6 +449,83 @@ def _ex_gen_fields(dt: float, gen_dt: float, iters: int) -> dict:
     return fields
 
 
+def benchmark_ingest(points, k=1000, iters=2, chunk_points=262_144,
+                     mesh=None, dtype=jnp.float32, quantize=None, seed=0,
+                     disk_bytes=None, compare_synthetic=False):
+    """End-to-end rate of :func:`fit_streaming` on a REAL disk source —
+    the honest half of the 1B-point story (SURVEY.md §1 north-star, §4.2
+    "load points shard" phase).  :func:`benchmark_streaming` measures the
+    compute *formulation* with device-regenerated data; this measures the
+    ingest-bound *reality*: disk read + host parse/pad + H2D transfer,
+    with device compute double-buffered behind it.
+
+    ``points`` is any ``fit_streaming`` source (``np.memmap``,
+    ``CSVPoints``, ndarray).  ``disk_bytes``: actual on-disk bytes per
+    epoch (file size) — defaults to ``n*d*itemsize`` when the source
+    exposes a dtype, else the f32 logical size; float16/int8 sources and
+    text files should pass the real file size so GB/s is honest.
+
+    Reported fields:
+
+    - ``points_per_sec`` — end-to-end, total points × epochs / wall
+      (includes centroid init and compile; the per-epoch fields exclude
+      them).
+    - ``host_sec_per_epoch`` / ``host_gb_per_sec`` — time blocked in the
+      host half (read+parse+pad+dispatch) and the disk-byte rate over it.
+      This is the pipeline's hard floor: device speed cannot fix it.
+    - ``sync_sec_per_epoch`` — device tail NOT hidden behind host work
+      (blocking wait after the last chunk).
+    - ``overlap_efficiency`` — host_s / (host_s + sync_s) ∈ (0, 1]:
+      1.0 means device compute is fully hidden behind ingest (the run is
+      purely ingest-bound); lower means the device is the straggler.
+    - ``ingest_bound_fraction`` — host_s / epoch_s: the share of epoch
+      wall spent in the host half (the remainder is dispatch overhead +
+      the unhidden device tail).
+    - with ``compare_synthetic=True``: ``synthetic_sec_per_epoch`` — the
+      device-regenerated formulation at the SAME shapes/chunking (a
+      second compile + timed run); ``epoch_s`` ≈ max(host, synthetic)
+      when the double buffer overlaps perfectly.
+    """
+    mesh = mesh or current_mesh()
+    n, d = points.shape
+    inst: dict = {}
+    t0 = time.perf_counter()
+    _, inertia = fit_streaming(points, k=k, iters=iters,
+                               chunk_points=chunk_points, mesh=mesh,
+                               seed=seed, dtype=dtype, quantize=quantize,
+                               instrument=inst)
+    wall = time.perf_counter() - t0
+    eps = inst["epochs"]
+    host = sum(e["host_s"] for e in eps) / len(eps)
+    sync = sum(e["sync_s"] for e in eps) / len(eps)
+    epoch = sum(e["epoch_s"] for e in eps) / len(eps)
+    if disk_bytes is None:
+        itemsize = getattr(getattr(points, "dtype", None), "itemsize", 4)
+        disk_bytes = n * d * itemsize
+    out = {
+        "points_per_sec": n * iters / wall,
+        "epoch_sec": epoch,
+        "host_sec_per_epoch": host,
+        "host_gb_per_sec": disk_bytes / 1e9 / host if host else None,
+        "sync_sec_per_epoch": sync,
+        "overlap_efficiency": host / (host + sync) if host + sync else None,
+        "ingest_bound_fraction": host / epoch if epoch else None,
+        "disk_gb_per_epoch": disk_bytes / 1e9,
+        "inertia": float(inertia),
+        "n": n, "d": d, "k": k, "iters": iters,
+        "chunk_points": chunk_points, "quantize": quantize,
+        "num_workers": mesh.num_workers,
+        "source": type(points).__name__,
+    }
+    if compare_synthetic:
+        syn = benchmark_streaming(n=n, d=d, k=k, iters=iters,
+                                  chunk_points=chunk_points, mesh=mesh,
+                                  dtype=dtype, seed=seed)
+        out["synthetic_sec_per_epoch"] = syn["sec_per_iter"]
+        out["synthetic_points_per_sec"] = syn["points_per_sec"]
+    return out
+
+
 def main(argv=None):
     import argparse
 
@@ -452,11 +561,14 @@ def main(argv=None):
                                    dtype=dtype, quantize=args.quantize,
                                    init=args.init, ckpt_dir=args.ckpt_dir,
                                    ckpt_every=args.ckpt_every)
-        print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
-               "d": pts.shape[1], "inertia": inertia})
+        # JSON, not dict repr: measure_on_relay.sh tees this into a .jsonl
+        print(json.dumps({"k": args.k, "iters": args.iters,
+                          "n": int(pts.shape[0]), "d": int(pts.shape[1]),
+                          "inertia": float(inertia)}))
     else:
-        print(benchmark_streaming(args.n, args.d, args.k, args.iters,
-                                  args.chunk, dtype=dtype))
+        print(json.dumps(benchmark_streaming(args.n, args.d, args.k,
+                                             args.iters, args.chunk,
+                                             dtype=dtype)))
 
 
 if __name__ == "__main__":

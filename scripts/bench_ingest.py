@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Real-ingest benchmark for streaming KMeans — the disk-resident half of
+the 1B-point north-star (SURVEY.md §1, §4.2 "load points shard").
+
+``benchmark_streaming`` proves the compute formulation; THIS measures the
+ingest-bound reality: a .npy memmap (or CSV via the native streaming
+parser) on local disk, streamed through ``fit_streaming`` with device
+compute double-buffered behind the host read/parse/transfer pipeline.
+Prints one JSON line (same fields as
+``kmeans_stream.benchmark_ingest``).
+
+Usage:
+    python scripts/bench_ingest.py                       # 100M×300 f16 npy
+    python scripts/bench_ingest.py --format csv --rows 2000000
+    python scripts/bench_ingest.py --smoke --platform cpu
+    python scripts/bench_ingest.py --rows 1000000000 ... # if disk allows
+
+Dataset notes (measured constraints, 2026-07-30, this host):
+- 100M×300 f32 = 120 GB > the 79 GB free on /; the default disk dtype is
+  float16 (60 GB) so the TRUE 100M-row count runs — GB/s is computed on
+  actual on-disk bytes, so the rate is honest for the format streamed.
+  Pass ``--disk-dtype float32 --rows 40000000`` for a pure-f32 run.
+- CSV text is ~2.4 GB per 1M rows at 300 cols; the CSV default is 2M
+  rows (parse rate is row-width-independent enough to project).
+- The file lands in ``.bench_data/`` (gitignored) and is DELETED after
+  the run unless ``--keep`` — it is most of the disk.
+- With 125 GB RAM the OS page cache holds the whole default file after
+  generation, so ``host_gb_per_sec`` measures the warm-cache pipeline
+  (parse+pad+dispatch), not cold spindle reads; ``--drop-caches`` echoes
+  3 > /proc/sys/vm/drop_caches first (needs root) for the cold number.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DATA_DIR = os.path.join(REPO, ".bench_data")
+
+
+def gen_points_npy(path: str, rows: int, cols: int, dtype="float16",
+                   seed=0, chunk_rows=1 << 20) -> None:
+    """Write a [rows, cols] standard-normal .npy in bounded memory."""
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    out = np.lib.format.open_memmap(path, mode="w+", dtype=np.dtype(dtype),
+                                    shape=(rows, cols))
+    rng = np.random.default_rng(seed)
+    for lo in range(0, rows, chunk_rows):
+        hi = min(lo + chunk_rows, rows)
+        out[lo:hi] = rng.standard_normal((hi - lo, cols),
+                                         dtype=np.float32).astype(out.dtype)
+    out.flush()
+    del out
+
+
+def gen_points_csv(path: str, rows: int, cols: int, seed=0,
+                   chunk_rows=1 << 16) -> None:
+    """Write a [rows, cols] CSV in bounded memory (%.4f ≈ 7 B/value)."""
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for lo in range(0, rows, chunk_rows):
+            hi = min(lo + chunk_rows, rows)
+            blk = rng.standard_normal((hi - lo, cols), dtype=np.float32)
+            np.savetxt(f, blk, fmt="%.4f", delimiter=",")
+
+
+def ensure_dataset(fmt: str, rows: int, cols: int, disk_dtype: str,
+                   verbose=True) -> tuple[str, bool]:
+    """Generate (or reuse) the benchmark file → (path, generated_now).
+
+    ``generated_now`` lets run() clean up only files THIS invocation
+    created — a cached file another run kept (bench.py/measure_all's
+    reusable 12 GB dataset) must survive a no-``--keep`` run that merely
+    reused it."""
+    name = (f"pts_{rows}x{cols}_{disk_dtype}.npy" if fmt == "npy"
+            else f"pts_{rows}x{cols}.csv")
+    path = os.path.join(DATA_DIR, name)
+    if os.path.exists(path):
+        return path, False
+    t0 = time.perf_counter()
+    if verbose:
+        print(f"generating {path} ...", file=sys.stderr, flush=True)
+    if fmt == "npy":
+        gen_points_npy(path, rows, cols, disk_dtype)
+    else:
+        gen_points_csv(path, rows, cols)
+    if verbose:
+        gb = os.path.getsize(path) / 1e9
+        print(f"  {gb:.1f} GB in {time.perf_counter() - t0:.0f}s",
+              file=sys.stderr, flush=True)
+    return path, True
+
+
+def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
+        k=1000, iters=2, chunk_points=262_144, keep=False,
+        compare_synthetic=False, drop_caches=False, verbose=True) -> dict:
+    import numpy as np
+
+    from harp_tpu.models.kmeans_stream import benchmark_ingest
+
+    path, generated = ensure_dataset(fmt, rows, cols, disk_dtype,
+                                     verbose=verbose)
+    try:
+        if drop_caches:
+            os.system("sync; echo 3 > /proc/sys/vm/drop_caches")
+        if fmt == "npy":
+            pts = np.load(path, mmap_mode="r")
+        else:
+            from harp_tpu.native.datasource import CSVPoints
+
+            pts = CSVPoints(path, chunk_rows=chunk_points)
+        res = benchmark_ingest(pts, k=k, iters=iters,
+                               chunk_points=chunk_points,
+                               disk_bytes=os.path.getsize(path),
+                               compare_synthetic=compare_synthetic)
+        res.update({"format": fmt, "disk_dtype":
+                    (disk_dtype if fmt == "npy" else "text"),
+                    "cold_cache": bool(drop_caches)})
+        return res
+    finally:
+        # delete only what this run created: a cached file another run
+        # kept must survive a no-keep rerun that merely reused it
+        if not keep and generated and os.path.exists(path):
+            os.remove(path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--format", choices=["npy", "csv"], default="npy")
+    p.add_argument("--rows", type=int, default=None,
+                   help="default: 100M npy / 2M csv (smoke: 20k)")
+    p.add_argument("--cols", type=int, default=300)
+    p.add_argument("--disk-dtype", choices=["float16", "float32"],
+                   default="float16",
+                   help="npy on-disk dtype (f16 default: 100M×300 must "
+                        "fit the 79 GB free on this host)")
+    p.add_argument("--k", type=int, default=1000)
+    p.add_argument("--iters", type=int, default=2)
+    p.add_argument("--chunk", type=int, default=262_144)
+    p.add_argument("--keep", action="store_true",
+                   help="keep the generated file (it is most of the disk)")
+    p.add_argument("--compare-synthetic", action="store_true",
+                   help="also time the device-regenerated formulation at "
+                        "the same shapes (second compile + run)")
+    p.add_argument("--drop-caches", action="store_true")
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--platform", default=None, choices=["cpu"],
+                   help="force the CPU backend (the axon relay can hang; "
+                        "host-side rates are chip-independent)")
+    args = p.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.smoke:
+        rows, cols, k, chunk = 20_000, 32, 16, 4096
+    else:
+        rows = args.rows or (100_000_000 if args.format == "npy"
+                             else 2_000_000)
+        cols, k, chunk = args.cols, args.k, args.chunk
+    res = run(args.format, rows, cols, args.disk_dtype, k, args.iters,
+              chunk, keep=args.keep,
+              compare_synthetic=args.compare_synthetic,
+              drop_caches=args.drop_caches)
+    print(json.dumps({k2: (round(v, 4) if isinstance(v, float) else v)
+                      for k2, v in res.items()}))
+
+
+if __name__ == "__main__":
+    main()

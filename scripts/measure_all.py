@@ -22,6 +22,18 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _bench_ingest(smoke: bool):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import bench_ingest
+
+    if smoke:
+        return bench_ingest.run("npy", 20_000, 32, "float32", k=16,
+                                iters=2, chunk_points=4096, verbose=False)
+    return bench_ingest.run("npy", 20_000_000, 300, "float16", k=1000,
+                            iters=2, chunk_points=262_144, keep=True,
+                            compare_synthetic=True)
+
+
 def run_all(smoke: bool, only, watchdog=None):
     import jax
 
@@ -46,6 +58,11 @@ def run_all(smoke: bool, only, watchdog=None):
                # scaffolding a real ingest wouldn't pay (ex-gen rate)
                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
                 "chunk_points": 262_144, "calibrate_gen": True})),
+        # the REAL-ingest half of the north-star (disk npy memmap through
+        # fit_streaming; VERDICT r2 item 2) — full mode keeps a 12 GB
+        # float16 file in .bench_data/ for reuse; the honest 100M-row run
+        # is scripts/bench_ingest.py directly (60 GB, host-bound)
+        "kmeans_ingest": lambda: _bench_ingest(smoke),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
@@ -67,6 +84,17 @@ def run_all(smoke: bool, only, watchdog=None):
                if smoke else
                {"n_docs": 500_000, "vocab_size": 50_000, "n_topics": 1000,
                 "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16"})),
+        # TRUE graded shapes (enwiki-1M: 1M docs × 1k topics, 100M tokens,
+        # int16 Ndk — fits one chip: 2 GB Ndk + 0.23 GB Nwk; the program
+        # is lowering-proven in tests/test_lda_scale.py, this EXECUTES it
+        "lda_scale_1m": lambda: lda.benchmark(
+            **({"n_docs": 1024, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
+               if smoke else
+               {"n_docs": 1_000_000, "vocab_size": 50_000,
+                "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
+                "ndk_dtype": "int16"})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -76,6 +104,15 @@ def run_all(smoke: bool, only, watchdog=None):
             **({"n": 4096, "batch": 512, "steps": 5} if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
             **({"n_vertices": 2000, "avg_degree": 4} if smoke else {})),
+        # the graded template at graded scale (VERDICT r2 item 4): u5-tree
+        # on a 1M-vertex power-law graph — hub mass rides the exact
+        # overflow segment-sum path (overflow_share reported; 0 dropped)
+        "subgraph_1m": lambda: subgraph.benchmark(
+            graph="powerlaw",
+            **({"n_vertices": 2000, "avg_degree": 4, "max_degree": 8}
+               if smoke else
+               {"n_vertices": 1_000_000, "avg_degree": 8,
+                "max_degree": 16, "template": "u5-tree"})),
         "rf": lambda: rf.benchmark(
             **({"n": 4096, "f": 16, "max_depth": 3,
                 "n_trees": 2 * jax.device_count()} if smoke else {})),
@@ -112,12 +149,22 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "kmeans_int8", "kmeans_stream", "mfsgd",
-                            "mfsgd_scatter", "lda", "lda_scale",
-                            "lda_scatter", "mlp", "subgraph", "rf"],
+                   choices=["kmeans", "kmeans_int8", "kmeans_stream",
+                            "kmeans_ingest", "mfsgd", "mfsgd_scatter",
+                            "lda", "lda_scale", "lda_scale_1m",
+                            "lda_scatter", "mlp", "subgraph",
+                            "subgraph_1m", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
+    p.add_argument("--platform", choices=["cpu"], default=None,
+                   help="force the CPU backend (the axon site pin would "
+                        "otherwise send even --smoke runs to the TPU "
+                        "relay, which can hang — CLAUDE.md)")
     args = p.parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     sink = open(args.out, "a") if args.out else None
 

@@ -30,4 +30,8 @@ echo "== 1B-point formulation (2 epochs, ~minutes) =="
 python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
   | tee -a BENCH_local.jsonl
 
+echo "== real-ingest 100M×300 (writes+frees a 60 GB f16 npy; host-bound) =="
+python scripts/bench_ingest.py --iters 2 --compare-synthetic \
+  | tee -a BENCH_local.jsonl
+
 echo "done — update BASELINE.md from BENCH_local.jsonl and COMMIT NOW"

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Per-config XLA op-breakdown capture — the trace half of the perf story.
+
+VERDICT r2 item 7: the roofline annotations (utils/roofline.py) are
+analytic models; this script backs them with traces.  For each graded
+config it runs a SHORT benchmark inside ``utils.profiling.trace``, then
+records the top device ops by total time next to the benchmark dict and
+its roofline fields, one JSON line per config → ``PROFILE_local.jsonl``.
+
+Read the output asking two questions per config:
+1. does the op class the roofline model says is the bound (matmul vs
+   memory-bound scatter/gather) actually dominate the trace?
+2. is there an op eating >10% that the model has no term for?
+
+Run on the TPU relay (`./scripts/measure_on_relay.sh` does NOT call this
+— traces are large and the relay can die; run it after the sweep
+commits).  Works on CPU too for plumbing checks (--smoke --platform
+cpu), but CPU traces have no device track so compile/host events appear
+in the table (op_breakdown's device filter only engages on TPU, where
+each benchmark's internal compile lands on the host track and the op
+table is pure device time).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def profiled_configs(smoke: bool):
+    """Short-running variants: one trace needs seconds, not minutes."""
+    from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+
+    small = {"kmeans": {"n": 8192, "d": 32, "k": 16, "iters": 10},
+             "mfsgd": {"n_users": 512, "n_items": 256, "nnz": 20_000,
+                       "rank": 8, "epochs": 2, "u_tile": 16, "i_tile": 16,
+                       "entry_cap": 256},
+             "lda": {"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                     "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                     "w_tile": 16, "entry_cap": 64},
+             "mlp": {"n": 4096, "batch": 512, "steps": 5},
+             "subgraph": {"n_vertices": 2000, "avg_degree": 4},
+             "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2}}
+    full = {"kmeans": {"n": 1_000_000, "d": 300, "k": 100, "iters": 10},
+            "mfsgd": {"epochs": 2},
+            "lda": {"epochs": 1},
+            "mlp": {"steps": 50},
+            "subgraph": {},
+            "rf": {}}
+    mods = {"kmeans": kmeans, "mfsgd": mfsgd, "lda": lda, "mlp": mlp,
+            "subgraph": subgraph, "rf": rf}
+    kw = small if smoke else full
+    return {name: (mods[name], kw[name]) for name in mods}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="PROFILE_local.jsonl")
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument("--only", nargs="+", default=None)
+    p.add_argument("--platform", choices=["cpu"], default=None)
+    args = p.parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from harp_tpu.utils.profiling import op_breakdown, trace
+    from harp_tpu.utils.roofline import annotate
+    from harp_tpu.utils.timing import HangWatchdog
+
+    sink = open(args.out, "a")
+    watchdog = HangWatchdog(on_fire=lambda what: (
+        sink.write(json.dumps({"config": what, "error": "hang"}) + "\n"),
+        sink.flush()))
+    watchdog.arm("backend init")
+    for name, (mod, kw) in profiled_configs(args.smoke).items():
+        if args.only and name not in args.only:
+            continue
+        watchdog.arm(name)
+        logdir = tempfile.mkdtemp(prefix=f"harp_prof_{name}_")
+        try:
+            mod.benchmark(**kw)  # warmup/compile OUTSIDE the trace
+            with trace(logdir):
+                result = mod.benchmark(**kw)
+            ops = op_breakdown(logdir, top=args.top)
+        except Exception as e:
+            rec = {"config": name, "error": f"{type(e).__name__}: {e}"}
+        else:
+            # an empty op table (relay died mid-trace, all spans filtered)
+            # is a per-config error, not a sweep-aborting ZeroDivision
+            traced = sum(t for _, t in ops) or 1.0
+            rec = {"config": name,
+                   **{k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in annotate(name, result).items()},
+                   "top_ops": [{"op": o, "sec": round(t, 5),
+                                "share_of_traced": round(t / traced, 3)}
+                               for o, t in ops]}
+        line = json.dumps(rec)
+        print(line, flush=True)
+        sink.write(line + "\n")
+        sink.flush()
+    watchdog.cancel()
+    sink.close()
+
+
+if __name__ == "__main__":
+    main()

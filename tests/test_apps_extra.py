@@ -97,6 +97,31 @@ def test_collective_bench_all_verbs_run(mesh):
         assert out["table_rows"] > out["requested_rows_per_worker"]
 
 
+def test_sparse_capacity_sweep_skew_contract(mesh):
+    """The pull_cap sizing table (VERDICT r2 item 5): drop rates are
+    monotone non-increasing in capacity, full capacity never drops, the
+    even spread reaches zero drops at cap = m/nw, and dedup strictly
+    beats the raw Zipf stream at every under-provisioned capacity."""
+    from harp_tpu import benchmark as B
+
+    recs = list(B.sweep_sparse_capacity(mesh, m=512, d=16, reps=1,
+                                        caps=(1 / 8, 1 / 4, 1.0)))
+    by = {}
+    for r in recs:
+        by.setdefault(r["dist"], []).append(r)
+    for dist, rows in by.items():
+        rates = [r["drop_rate"] for r in rows]
+        assert rates == sorted(rates, reverse=True), dist
+        assert rows[-1]["drop_rate"] == 0.0, dist  # cap = m never drops
+    # even: zero drops from cap >= m/nw (= m/8 here)
+    assert by["even"][0]["drop_rate"] == 0.0
+    # skew hurts: zipf drops where even doesn't; dedup <= raw throughout
+    assert by["zipf"][0]["drop_rate"] > 0.0
+    for dd, zz in zip(by["zipf_dedup"], by["zipf"]):
+        assert dd["drop_rate"] <= zz["drop_rate"]
+        assert dd["wire_mb"] == zz["wire_mb"]  # capacity defines wire
+
+
 def test_moments_large_mean_no_cancellation(mesh):
     rng = np.random.default_rng(4)
     from harp_tpu.models import stats as S

@@ -161,6 +161,53 @@ def test_gen_calibration_runs_end_to_end(mesh):
     assert (r["iters_per_sec_ex_gen"] is None) == ("gen_calibration" in r)
 
 
+def test_benchmark_ingest_memmap(mesh, tmp_path):
+    """Real-ingest harness (VERDICT r2 item 2): disk npy through the
+    instrumented fit_streaming — pipeline fields present and coherent."""
+    pts = _blobs(n=4096, d=16)
+    f = tmp_path / "pts.npy"
+    np.save(f, pts.astype(np.float16))  # the 100M-row disk dtype
+    mm = np.load(f, mmap_mode="r")
+    import os
+
+    r = KS.benchmark_ingest(mm, k=8, iters=2, chunk_points=1024,
+                            mesh=mesh, disk_bytes=os.path.getsize(f),
+                            compare_synthetic=True)
+    assert r["points_per_sec"] > 0
+    assert r["host_sec_per_epoch"] > 0 and r["host_gb_per_sec"] > 0
+    assert 0 < r["overlap_efficiency"] <= 1.0
+    assert 0 < r["ingest_bound_fraction"] <= 1.0
+    # host time is a lower bound on epoch wall, never above it
+    assert r["host_sec_per_epoch"] <= r["epoch_sec"] + 1e-9
+    assert r["synthetic_sec_per_epoch"] > 0
+    assert r["source"] == "memmap" and np.isfinite(r["inertia"])
+
+
+def test_benchmark_ingest_csv_source(mesh, tmp_path):
+    from harp_tpu.native.datasource import CSVPoints
+
+    pts = _blobs(n=1500, d=8)
+    f = tmp_path / "pts.csv"
+    np.savetxt(f, pts, fmt="%.5f", delimiter=",")
+    r = KS.benchmark_ingest(CSVPoints(str(f), chunk_rows=512), k=4,
+                            iters=2, chunk_points=512, mesh=mesh,
+                            disk_bytes=f.stat().st_size)
+    assert r["points_per_sec"] > 0 and r["source"] == "CSVPoints"
+    assert np.isfinite(r["inertia"])
+
+
+def test_instrument_hook_epoch_records(mesh):
+    inst: dict = {}
+    pts = _blobs(n=2048, d=8)
+    KS.fit_streaming(pts, k=4, iters=3, chunk_points=512, mesh=mesh,
+                     instrument=inst)
+    eps = inst["epochs"]
+    assert len(eps) == 3
+    for e in eps:
+        assert e["host_s"] > 0 and e["sync_s"] >= 0
+        assert e["epoch_s"] >= e["host_s"]
+
+
 def test_north_star_1b_program_lowers(mesh):
     """The REAL 1B×300 k=1000 program (3814-chunk scan × fori epochs)
     must trace and lower at its true shapes — proving the north-star

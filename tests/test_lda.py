@@ -139,12 +139,15 @@ def test_pushpull_small_pull_cap_still_valid_chain(mesh):
 
 def test_pushpull_drop_counter_surfaces_capacity_pressure(mesh):
     """All tokens share one word → every request targets one owner; a
-    tiny pull_cap must DROP most of them and say so via last_dropped."""
+    tiny pull_cap must DROP most of them and say so via last_dropped.
+    (dedup_pulls=False: the raw per-token wire is the one under pressure —
+    the companion dedup test shows the same corpus needs ONE slot.)"""
     n_tok_per_doc = 8
     d = np.repeat(np.arange(16, dtype=np.int32), n_tok_per_doc)
     w = np.zeros(16 * n_tok_per_doc, np.int32)  # one hot word
     model = L.LDA(16, 16, L.LDAConfig(n_topics=4, algo="pushpull",
-                                      chunk=16, pull_cap=1), mesh, seed=0)
+                                      chunk=16, pull_cap=1,
+                                      dedup_pulls=False), mesh, seed=0)
     model.set_tokens(d, w)
     model.sample_epoch()
     assert model.last_dropped > 0
@@ -152,6 +155,99 @@ def test_pushpull_drop_counter_surfaces_capacity_pressure(mesh):
     assert np.asarray(model.Ndk).sum() == model.n_tokens
     np.testing.assert_allclose(np.asarray(model.Nwk).sum(0),
                                np.asarray(model.Nk))
+
+
+def test_pushpull_dedup_serves_hot_word_in_one_slot(mesh):
+    """The Zipf mitigation (VERDICT r2 item 5): duplicates of a hot word
+    collapse to one request, so the corpus that chokes the raw wire at
+    pull_cap=1 samples with ZERO drops under dedup — and the exact
+    sizing helper says cap=1 suffices."""
+    n_tok_per_doc = 8
+    d = np.repeat(np.arange(16, dtype=np.int32), n_tok_per_doc)
+    w = np.zeros(16 * n_tok_per_doc, np.int32)  # one hot word
+    model = L.LDA(16, 16, L.LDAConfig(n_topics=4, algo="pushpull",
+                                      chunk=16, pull_cap=1), mesh, seed=0)
+    model.set_tokens(d, w)
+    assert model.suggest_pull_cap() == 1
+    model.sample_epoch()
+    assert model.last_dropped == 0
+    assert np.asarray(model.Ndk).sum() == model.n_tokens
+    np.testing.assert_allclose(np.asarray(model.Nwk).sum(0),
+                               np.asarray(model.Nk))
+
+
+def test_pushpull_dedup_bit_identical_at_zero_drops(mesh):
+    """dedup_pulls rearranges the wire, not the math: at the zero-drop
+    default cap the sampled chain is BIT-IDENTICAL to the raw exchange
+    (pulled rows are the same values; pushed deltas are exact ±1 integer
+    sums, so summation order cannot matter)."""
+    dw = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                            tokens_per_doc=50, seed=0)
+    tables = []
+    for dedup in (True, False):
+        model = L.LDA(96, 64, L.LDAConfig(n_topics=8, algo="pushpull",
+                                          chunk=64, dedup_pulls=dedup),
+                      mesh, seed=1)
+        model.set_tokens(*dw)
+        for _ in range(3):
+            model.sample_epoch()
+        assert model.last_dropped == 0
+        tables.append((model.doc_topic_table(), model.word_topic_table()))
+    np.testing.assert_array_equal(tables[0][0], tables[1][0])
+    np.testing.assert_array_equal(tables[0][1], tables[1][1])
+
+
+def test_pushpull_zipf_corpus_dedup_vs_raw_drops(mesh):
+    """A Zipf-1.1 corpus under a tight cap: the deduped wire must drop
+    strictly fewer tokens than the raw wire, and the suggest_pull_cap
+    rule must deliver ZERO drops when applied."""
+    rng = np.random.default_rng(0)
+    n_docs, vocab, tpd = 64, 256, 32
+    d = np.repeat(np.arange(n_docs, dtype=np.int32), tpd)
+    w = ((rng.zipf(1.1, size=n_docs * tpd) - 1) % vocab).astype(np.int32)
+    drops = {}
+    for dedup in (True, False):
+        model = L.LDA(n_docs, vocab,
+                      L.LDAConfig(n_topics=4, algo="pushpull", chunk=64,
+                                  pull_cap=8, dedup_pulls=dedup),
+                      mesh, seed=1)
+        model.set_tokens(d, w)
+        model.sample_epoch()
+        drops[dedup] = model.last_dropped
+        # drops never corrupt counts
+        assert np.asarray(model.Ndk).sum() == model.n_tokens
+    assert drops[True] < drops[False]
+
+    model = L.LDA(n_docs, vocab,
+                  L.LDAConfig(n_topics=4, algo="pushpull", chunk=64),
+                  mesh, seed=1)
+    model.set_tokens(d, w)
+    cap = model.suggest_pull_cap(apply=True)
+    assert model.cfg.pull_cap == cap < 64  # dedup: below the chunk size
+    model.sample_epoch()
+    assert model.last_dropped == 0
+
+
+def test_suggest_pull_cap_exact_small_case():
+    """Hand-checkable sizing: nw=2 workers, T_pad=8 each, chunk=4 → two
+    chunks per worker; vocab=8 → owner 0 owns words 0-3, owner 1 owns
+    4-7.  Per-(chunk, owner) loads, computed by hand:
+      worker0 chunk [0,0,0,1]: raw 4 → owner0, distinct {0,1} = 2
+      worker0 chunk [4,4,5,6]: raw 4 → owner1, distinct {4,5,6} = 3
+      worker1 chunk [3,3,3,3]: raw 4 → owner0, distinct {3} = 1
+      worker1 chunk [0,1,2,3]: raw 4 → owner0, distinct {0,1,2,3} = 4
+    """
+    w = np.array([0, 0, 0, 1,   4, 4, 5, 6,
+                  3, 3, 3, 3,   0, 1, 2, 3], np.int32)
+    m = np.ones(16, np.float32)
+    assert L.suggest_pull_cap(w, m, 2, 4, 8, dedup=False) == 4
+    assert L.suggest_pull_cap(w, m, 2, 4, 8, dedup=True) == 4
+    # masking out worker1's second chunk removes the distinct-4 load:
+    # the dedup max falls to worker0-chunk1's 3; raw stays 4
+    m2 = m.copy()
+    m2[12:] = 0.0
+    assert L.suggest_pull_cap(w, m2, 2, 4, 8, dedup=True) == 3
+    assert L.suggest_pull_cap(w, m2, 2, 4, 8, dedup=False) == 4
 
 
 @pytest.mark.parametrize("algo", ["dense", "scatter", "pushpull"])

@@ -1,0 +1,120 @@
+"""enwiki-1M graded-shape proofs (SURVEY.md §3.4 #3; VERDICT r2 item 3).
+
+The graded LDA corpus is 1M docs × 1k topics (~100M tokens).  Executing
+that needs TPU hours; what CAN be pinned on CPU, the way the 1B-point
+KMeans program was pinned (tests/test_kmeans_stream.py), is that the
+epoch programs TRACE AND LOWER at the true shapes — int16 doc-topic
+table, 8-way shard — via jax.ShapeDtypeStruct (zero host memory).
+
+``epoch_arg_shapes`` supplies the shapes; the first tests prove it
+mirrors the real partitioners exactly on corpora small enough to build.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from harp_tpu.models import lda as L
+
+
+def _even_corpus(n_docs, vocab, tokens_per_doc):
+    """Perfectly even corpus: every (worker, slice) block equally loaded,
+    so the even-fill model in epoch_arg_shapes is EXACT, not approximate."""
+    T = n_docs * tokens_per_doc
+    d = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
+    w = (np.arange(T, dtype=np.int32)) % vocab
+    return d, w
+
+
+def _actual_args(model):
+    return [model.Ndk, model.Nwk, model.Nk, model.z_grid,
+            *model._tokens, model._keys]
+
+
+def _check_shapes(model, predicted):
+    actual = _actual_args(model)
+    assert len(actual) == len(predicted)
+    for a, (shape, dt) in zip(actual, predicted):
+        assert tuple(a.shape) == tuple(shape), (a.shape, shape)
+        assert np.dtype(a.dtype) == np.dtype(dt), (a.dtype, dt)
+
+
+@pytest.mark.parametrize("chunk", [16, 2])
+def test_shape_model_matches_partitioner_pushpull(mesh, chunk):
+    n_docs, vocab, tpd = 64, 32, 4
+    cfg = L.LDAConfig(n_topics=6, algo="pushpull", chunk=chunk)
+    model = L.LDA(n_docs, vocab, cfg, mesh)
+    model.set_tokens(*_even_corpus(n_docs, vocab, tpd))
+    _check_shapes(model, L.epoch_arg_shapes(
+        8, n_docs, vocab, cfg, n_tokens=n_docs * tpd))
+
+
+@pytest.mark.parametrize("chunk", [16, 2])
+def test_shape_model_matches_partitioner_scatter(mesh, chunk):
+    # chunk=16 > bmax exercises the sublane-pad branch; chunk=2 the
+    # chunk-multiple branch — both must mirror partition_ratings' B rule
+    n_docs, vocab, tpd = 64, 32, 4
+    cfg = L.LDAConfig(n_topics=6, algo="scatter", chunk=chunk)
+    model = L.LDA(n_docs, vocab, cfg, mesh)
+    model.set_tokens(*_even_corpus(n_docs, vocab, tpd))
+    _check_shapes(model, L.epoch_arg_shapes(
+        8, n_docs, vocab, cfg, n_tokens=n_docs * tpd))
+
+
+def test_shape_model_matches_partitioner_dense(mesh):
+    # entry_cap small enough that the real partitioner's entry width C
+    # saturates at the cap (the regime the 1M model assumes); NE is
+    # corpus-dependent, so the real partitioner's NE is passed through
+    # and everything else must match
+    n_docs, vocab, tpd = 64, 32, 8
+    cfg = L.LDAConfig(n_topics=6, algo="dense", d_tile=4, w_tile=4,
+                      entry_cap=8, ndk_dtype="int16")
+    model = L.LDA(n_docs, vocab, cfg, mesh)
+    model.set_tokens(*_even_corpus(n_docs, vocab, tpd))
+    ne_real = model._tokens[0].shape[1]
+    assert model._tokens[0].shape[2] == cfg.entry_cap  # C hit the cap
+    _check_shapes(model, L.epoch_arg_shapes(
+        8, n_docs, vocab, cfg, n_tokens=n_docs * tpd,
+        entries_per_row=ne_real))
+    # the tight-packing default is a lower bound on the real NE
+    default_ne = L.epoch_arg_shapes(
+        8, n_docs, vocab, cfg, n_tokens=n_docs * tpd)[4][0][1]
+    assert default_ne <= ne_real
+
+
+def _sds(mesh, shapes):
+    return [jax.ShapeDtypeStruct(
+        shape, dt, sharding=(mesh.replicated() if i == 2
+                             else mesh.sharding(mesh.spec(0))))
+        for i, (shape, dt) in enumerate(shapes)]
+
+
+N_DOCS, VOCAB, K, N_TOK = 1_000_000, 50_000, 1000, 100_000_000
+
+
+@pytest.mark.parametrize("algo", ["pushpull", "dense"])
+def test_enwiki_1m_program_lowers(mesh, algo):
+    """The REAL graded-shape program — 1M docs × 1k topics, 100M token
+    slots, int16 Ndk, 8-way shard, 5 Gibbs sweeps in one scan — must
+    trace and lower without executing (execution needs the TPU)."""
+    cfg = L.LDAConfig(n_topics=K, algo=algo, ndk_dtype="int16")
+    shapes = L.epoch_arg_shapes(8, N_DOCS, VOCAB, cfg, n_tokens=N_TOK)
+
+    # the modeled layout really carries the corpus: >= 100M token slots
+    if algo == "pushpull":
+        slots = shapes[4][0][0]
+    else:
+        _, ne, c = shapes[4][0]
+        slots = 16 * 8 * ne * c
+    assert slots >= N_TOK
+
+    # int16 halves the Ndk footprint: the whole 1M-doc table is 2 GB
+    ndk_shape, ndk_dt = shapes[0]
+    ndk_gb = np.prod(ndk_shape) * np.dtype(ndk_dt).itemsize / 1e9
+    assert np.dtype(ndk_dt) == np.int16 and ndk_gb < 2.1
+
+    fn = L.make_multi_epoch_fn(mesh, cfg, VOCAB, epochs=5)
+    text = fn.lower(*_sds(mesh, shapes)).as_text()
+    assert "while" in text       # the chunk/entry scans lowered
+    assert "xi16" in text        # the int16 table is in the program
