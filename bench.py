@@ -48,7 +48,9 @@ BASELINES = {
 def _ingest_bench(smoke):
     """Real disk ingest through fit_streaming (VERDICT r2 item 2): full
     mode streams a reusable 20M×300 f16 npy from .bench_data/ — the
-    first run pays a ~4 min generation, later runs reuse the file."""
+    first run pays a ~4 min generation, later runs reuse the file.
+    Presets live in scripts/bench_ingest.py (run_smoke/run_full) so this
+    and measure_all can never drift apart."""
     import os
     import sys
 
@@ -56,11 +58,7 @@ def _ingest_bench(smoke):
         os.path.abspath(__file__)), "scripts"))
     import bench_ingest
 
-    if smoke:
-        return bench_ingest.run("npy", 20_000, 32, "float32", k=16,
-                                iters=2, chunk_points=4096, verbose=False)
-    return bench_ingest.run("npy", 20_000_000, 300, "float16", k=1000,
-                            iters=2, chunk_points=262_144, keep=True)
+    return bench_ingest.run_smoke() if smoke else bench_ingest.run_full()
 
 
 def _configs(smoke):
@@ -135,6 +133,10 @@ def main():
             "vs_baseline": (km.get("vs_baseline") if not smoke else None),
             "submetrics": {k: v for k, v in sub.items() if k != "kmeans"},
         }
+        for k in ("achieved_tflops", "achieved_gbs", "pct_peak_flops",
+                  "pct_peak_bw", "bound"):  # headline roofline context
+            if k in km:
+                rec[k] = km[k]
         if not kmeans_selected:
             rec["headline_skipped"] = True
         # a kmeans exception must surface on the headline, not vanish
@@ -169,9 +171,17 @@ def main():
             continue
         value = float(res[key])
         base = BASELINES[name]
+        # roofline context travels with the driver record (BENCH_r*.json),
+        # so a measured rate reads as %-of-datasheet-peak, not a bare number
+        from harp_tpu.utils.roofline import annotate
+
+        ann = annotate(name, res)
+        roof = {k: ann[k] for k in ("achieved_tflops", "achieved_gbs",
+                                    "pct_peak_flops", "pct_peak_bw",
+                                    "bound") if k in ann and k not in res}
         sub[name] = {"value": round(value, 2), "unit": unit,
                      "vs_baseline": (None if smoke or base is None else
-                                     round(value / base, 4))}
+                                     round(value / base, 4)), **roof}
     watchdog.cancel()
     done.set()
     print(json.dumps(record()), flush=True)

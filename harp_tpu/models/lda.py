@@ -183,43 +183,24 @@ def _sample_chunk_pushpull(Ndk, Nwk_shard, Nk, z, chunk, key,
     ids, same bucket order) carries nothing.
 
     With ``cfg.dedup_pulls`` duplicate word rows in the chunk collapse to
-    one request via the verbs' ``valid`` mask (sort → first-occurrence →
-    run-gather back; push deltas pre-summed per row with an exact integer
-    segment-sum) — the Zipf-skew mitigation: per-owner capacity need
-    becomes DISTINCT rows touched, not tokens.  The returned drop count
-    is TOKENS skipped this chunk (globally summed), identical in meaning
-    across both paths.
+    one request/push slot via :func:`harp_tpu.table.pull_rows_sparse_dedup`
+    / ``push_rows_sparse_dedup`` — the Zipf-skew mitigation: per-owner
+    capacity need becomes DISTINCT rows touched, not tokens (deltas are
+    ±1 integers, so the pre-summed push is bit-identical).  The returned
+    drop count is TOKENS skipped this chunk (globally summed), identical
+    in meaning across both paths.
     """
-    from harp_tpu.table import pull_rows_sparse, push_rows_sparse
+    from harp_tpu.table import (pull_rows_sparse, pull_rows_sparse_dedup,
+                                push_rows_sparse, push_rows_sparse_dedup)
 
     d, w, m = chunk  # worker-local doc rows, GLOBAL word ids, valid mask
     K = cfg.n_topics
     cap = cfg.pull_cap if cfg.pull_cap is not None else d.shape[0]
-    c = w.shape[0]
+    pull = pull_rows_sparse_dedup if cfg.dedup_pulls else pull_rows_sparse
+    push = push_rows_sparse_dedup if cfg.dedup_pulls else push_rows_sparse
 
-    if cfg.dedup_pulls:
-        big = jnp.int32(vocab_size)            # sorts padding last
-        keyed = jnp.where(m > 0, w, big)
-        order = jnp.argsort(keyed)
-        sw = jnp.take(keyed, order)
-        first = jnp.concatenate(
-            [jnp.ones((1,), bool), sw[1:] != sw[:-1]]) & (sw < big)
-        wire_ids = jnp.where(first, sw, 0)
-        pulled, ok_p, _ = pull_rows_sparse(Nwk_shard, wire_ids,
-                                           capacity=cap, valid=first)
-        idx = jnp.arange(c)
-        # run-representative position: cummax of first-occurrence indices
-        firstpos = lax.associative_scan(jnp.maximum,
-                                        jnp.where(first, idx, -1))
-        rows_sorted = jnp.take(pulled, jnp.maximum(firstpos, 0), axis=0)
-        ok_sorted = jnp.take(ok_p, jnp.maximum(firstpos, 0)) & (sw < big)
-        inv = jnp.argsort(order)               # unsort back to token order
-        rows = jnp.take(rows_sorted, inv, axis=0)
-        ok = jnp.take(ok_sorted, inv)
-    else:
-        # padding tokens (m == 0) issue no request, take no capacity slot
-        rows, ok, _ = pull_rows_sparse(Nwk_shard, w, capacity=cap,
-                                       valid=m > 0)
+    # padding tokens (m == 0) issue no request and take no capacity slot
+    rows, ok, _ = pull(Nwk_shard, w, capacity=cap, valid=m > 0)
     # tokens skipped this sweep (drop semantics identical across paths)
     tok_drop = C.allreduce(jnp.sum((m > 0) & ~ok).astype(jnp.int32))
 
@@ -234,18 +215,12 @@ def _sample_chunk_pushpull(Ndk, Nwk_shard, Nk, z, chunk, key,
     oh_new = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * mm[:, None]
     delta = oh_new - oh_old
     Ndk = Ndk.at[d].add(delta.astype(Ndk.dtype), mode="drop")
-    # push validity ⊆ pull ok, so push can never drop beyond the pull
-    if cfg.dedup_pulls:
-        run = jnp.cumsum(first) - 1            # run id per sorted position
-        delta_sorted = jnp.take(delta, order, axis=0)
-        summed = jax.ops.segment_sum(delta_sorted, run, num_segments=c,
-                                     indices_are_sorted=True)
-        delta_push = jnp.take(summed, run, axis=0) * first[:, None]
-        Nwk_shard, _ = push_rows_sparse(Nwk_shard, wire_ids, delta_push,
-                                        capacity=cap, valid=first)
-    else:
-        Nwk_shard, _ = push_rows_sparse(Nwk_shard, w, delta, capacity=cap,
-                                        valid=mm > 0)
+    # push with the SAME valid mask as the pull (m, not m·ok): the two
+    # dedup plans are then identical expressions XLA can CSE into one
+    # sort, and the difference is immaterial — a pull-dropped token's
+    # delta is zero, so its slot (dropped again, same plan) carries
+    # nothing either way
+    Nwk_shard, _ = push(Nwk_shard, w, delta, capacity=cap, valid=m > 0)
     dNk = delta.sum(0)
     return Ndk, Nwk_shard, dNk, z_new, tok_drop
 

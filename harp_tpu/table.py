@@ -587,6 +587,85 @@ def pull_rows_sparse(global_shard, row_ids, *, capacity: int,
     return out, keep, dropped
 
 
+def _dedup_plan(row_ids, valid):
+    """Shared dedup layout for the *_dedup verbs: stable-sort the ids
+    (padding forced last via an INT32_MAX sentinel — ids must be below
+    it, which any indexable row id is), mark first occurrences, and map
+    every position to its run's representative slot.  Returns
+    ``(order, inv, sorted_ids, first, run, firstpos)``; the wire then
+    carries ONE slot per distinct id (``valid=first``), the Zipf-skew
+    mitigation measured in benchmark.sweep_sparse_capacity."""
+    ids = row_ids.astype(jnp.int32)
+    sentinel = jnp.int32(jnp.iinfo(jnp.int32).max)
+    keyed = ids if valid is None else jnp.where(valid, ids, sentinel)
+    order = jnp.argsort(keyed)
+    sw = jnp.take(keyed, order)
+    first = jnp.concatenate([jnp.ones((1,), bool), sw[1:] != sw[:-1]]) \
+        & (sw < sentinel)
+    run = jnp.cumsum(first) - 1                 # run id per sorted position
+    idx = jnp.arange(ids.shape[0])
+    firstpos = jax.lax.associative_scan(jnp.maximum,
+                                        jnp.where(first, idx, -1))
+    inv = jnp.argsort(order)
+    return order, inv, jnp.where(first, sw, 0), first, run, firstpos
+
+
+def pull_rows_sparse_dedup(global_shard, row_ids, *, capacity: int,
+                           valid=None, axis: str = WORKER_AXIS):
+    """:func:`pull_rows_sparse` with duplicate ids sharing ONE wire slot.
+
+    Same contract and return shape — ``(rows [m, ...], ok [m], dropped)``
+    with every duplicate position receiving its row — but per-owner
+    capacity is consumed per DISTINCT id, so Zipf-skewed workloads (hot
+    rows requested many times per call) need far smaller capacities:
+    measured on the Zipf-1.1 sweep, zero drops at 1/4 the capacity the
+    raw wire needs (BASELINE.md, 2026-07-30).  ``dropped`` counts
+    distinct rows not served (capacity overflow + out-of-range ids, which
+    drop ONCE per distinct bad id here).  Bit-identical results to the
+    raw verb when nothing drops.
+    """
+    order, inv, wire_ids, first, run, firstpos = _dedup_plan(row_ids, valid)
+    pulled, ok_p, dropped = pull_rows_sparse(global_shard, wire_ids,
+                                             capacity=capacity,
+                                             valid=first, axis=axis)
+    safe = jnp.maximum(firstpos, 0)
+    rows = jnp.take(jnp.take(pulled, safe, axis=0), inv, axis=0)
+    ok = jnp.take(jnp.take(ok_p, safe) & (firstpos >= 0), inv)
+    if valid is not None:
+        ok = ok & valid
+    # contract parity with the raw verb: rows are ZEROS wherever ok is
+    # False (padding positions would otherwise echo a neighboring run)
+    rows = rows * ok.reshape(ok.shape + (1,) * (rows.ndim - 1)
+                             ).astype(rows.dtype)
+    return rows, ok, dropped
+
+
+def push_rows_sparse_dedup(global_shard, row_ids, deltas, *,
+                           capacity: int, valid=None,
+                           axis: str = WORKER_AXIS):
+    """:func:`push_rows_sparse` with duplicate ids sharing ONE wire slot:
+    deltas for the same row are pre-summed locally (an exact segment-sum
+    — note floats sum in sorted-run order, which can differ from the raw
+    verb's server-side order by rounding; integer-valued deltas are
+    bit-identical) and one slot per distinct id travels.  Same capacity
+    economics as :func:`pull_rows_sparse_dedup`; ``dropped`` counts
+    distinct rows.  Returns ``(new_shard, dropped)``.
+    """
+    order, inv, wire_ids, first, run, firstpos = _dedup_plan(row_ids, valid)
+    d_sorted = jnp.take(deltas, order, axis=0)
+    if valid is not None:
+        vz = jnp.take(valid, order)
+        d_sorted = d_sorted * vz.reshape(
+            vz.shape + (1,) * (d_sorted.ndim - 1)).astype(d_sorted.dtype)
+    summed = jax.ops.segment_sum(d_sorted, run,
+                                 num_segments=row_ids.shape[0],
+                                 indices_are_sorted=True)
+    d_push = jnp.take(summed, run, axis=0) * first.reshape(
+        first.shape + (1,) * (d_sorted.ndim - 1)).astype(d_sorted.dtype)
+    return push_rows_sparse(global_shard, wire_ids, d_push,
+                            capacity=capacity, valid=first, axis=axis)
+
+
 def push_rows_sparse(global_shard, row_ids, deltas, *, capacity: int,
                      valid=None, axis: str = WORKER_AXIS):
     """Scatter-add row deltas into a row-sharded global table, O(pushed) wire.

@@ -79,6 +79,7 @@ WORK_MODELS = {
     "mfsgd_scatter": _mfsgd_work,
     "lda": _lda_work,
     "lda_scale": _lda_work,
+    "lda_scale_1m": _lda_work,
     "lda_scatter": _lda_work,
     "mlp": _mlp_work,
 }

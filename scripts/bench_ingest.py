@@ -109,9 +109,16 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
 
     path, generated = ensure_dataset(fmt, rows, cols, disk_dtype,
                                      verbose=verbose)
+    cold = False
     try:
         if drop_caches:
-            os.system("sync; echo 3 > /proc/sys/vm/drop_caches")
+            # record cold_cache only if the drop actually happened — a
+            # non-root failure must not label a warm-cache rate as cold
+            cold = os.system(
+                "sync; echo 3 > /proc/sys/vm/drop_caches") == 0
+            if not cold:
+                print("drop_caches failed (need root) — measuring warm "
+                      "cache", file=sys.stderr)
         if fmt == "npy":
             pts = np.load(path, mmap_mode="r")
         else:
@@ -124,13 +131,32 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
                                compare_synthetic=compare_synthetic)
         res.update({"format": fmt, "disk_dtype":
                     (disk_dtype if fmt == "npy" else "text"),
-                    "cold_cache": bool(drop_caches)})
+                    "cold_cache": cold})
         return res
     finally:
         # delete only what this run created: a cached file another run
         # kept must survive a no-keep rerun that merely reused it
         if not keep and generated and os.path.exists(path):
             os.remove(path)
+
+
+def run_smoke() -> dict:
+    """The ONE smoke preset shared by bench.py and measure_all — tiny
+    npy, CPU-safe, regenerated per run."""
+    return run("npy", 20_000, 32, "float32", k=16, iters=2,
+               chunk_points=4096, verbose=False)
+
+
+def run_full(compare_synthetic: bool = False) -> dict:
+    """The ONE full preset shared by bench.py and measure_all: 20M×300
+    float16 (12 GB), kept in .bench_data/ for reuse across runs.
+    ``compare_synthetic`` adds the device-regenerated compute twin (a
+    second full-scale compile + timed run) — measure_all opts in; the
+    driver's bench.py skips it to stay well inside its per-config
+    watchdog."""
+    return run("npy", 20_000_000, 300, "float16", k=1000, iters=2,
+               chunk_points=262_144, keep=True,
+               compare_synthetic=compare_synthetic)
 
 
 def main(argv=None):

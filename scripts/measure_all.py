@@ -23,15 +23,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _bench_ingest(smoke: bool):
+    # shared presets (bench_ingest.run_smoke/run_full) keep this and
+    # bench.py's kmeans_ingest config measuring the same shapes; the
+    # synthetic compute twin is the sweep-only extra
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import bench_ingest
 
-    if smoke:
-        return bench_ingest.run("npy", 20_000, 32, "float32", k=16,
-                                iters=2, chunk_points=4096, verbose=False)
-    return bench_ingest.run("npy", 20_000_000, 300, "float16", k=1000,
-                            iters=2, chunk_points=262_144, keep=True,
-                            compare_synthetic=True)
+    return (bench_ingest.run_smoke() if smoke
+            else bench_ingest.run_full(compare_synthetic=True))
 
 
 def run_all(smoke: bool, only, watchdog=None):

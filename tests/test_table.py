@@ -17,8 +17,10 @@ from harp_tpu.table import (
     modulo_partitioner,
     pull_rows,
     pull_rows_sparse,
+    pull_rows_sparse_dedup,
     push_rows,
     push_rows_sparse,
+    push_rows_sparse_dedup,
 )
 
 N = 8
@@ -221,6 +223,96 @@ def test_push_then_pull_sparse_roundtrip(mesh):
     # each pushed row got +1 from each of its 2 duplicate pushes... from
     # every worker that owns the same id (ids differ per worker here)
     np.testing.assert_allclose(np.asarray(rows), 2.0)
+
+
+def test_pull_rows_sparse_dedup_matches_raw(mesh):
+    """Duplicates share one wire slot but every position still receives
+    its row — bit-identical to the raw verb at ample capacity, padding
+    honored, drop count zero."""
+    rng = np.random.default_rng(5)
+    rpw, d, m = 6, 3, 12
+    table = rng.normal(size=(N * rpw, d)).astype(np.float32)
+    # heavy duplication: only 4 distinct ids per worker
+    ids = rng.integers(0, N * rpw, size=(N, 4)).astype(np.int32)
+    ids = np.repeat(ids, 3, axis=1).reshape(-1)          # [N*m]
+    valid = (np.arange(N * m) % 5 != 0)                  # some padding
+
+    def prog(t, i, v):
+        raw = pull_rows_sparse(t, i, capacity=m, valid=v)
+        dd = pull_rows_sparse_dedup(t, i, capacity=m, valid=v)
+        return raw + dd
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0),) * 3,
+        out_specs=(mesh.spec(0), mesh.spec(0), P()) * 2))
+    r_rows, r_ok, r_drop, d_rows, d_ok, d_drop = fn(table, ids, valid)
+    assert int(r_drop) == 0 and int(d_drop) == 0
+    np.testing.assert_array_equal(np.asarray(r_ok), np.asarray(d_ok))
+    np.testing.assert_array_equal(np.asarray(r_rows), np.asarray(d_rows))
+
+
+def test_pull_rows_sparse_dedup_capacity_per_distinct(mesh):
+    """The point of dedup: m requests for ONE hot row need capacity 1
+    (the raw verb would drop m-1 of them)."""
+    rpw, d, m = 4, 2, 8
+    table = np.arange(N * rpw * d, dtype=np.float32).reshape(N * rpw, d)
+    ids = np.zeros(N * m, np.int32)  # every worker: m copies of row 0
+
+    def prog(t, i):
+        return (pull_rows_sparse_dedup(t, i, capacity=1)
+                + pull_rows_sparse(t, i, capacity=1))
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0),) * 2,
+        out_specs=(mesh.spec(0), mesh.spec(0), P()) * 2))
+    d_rows, d_ok, d_drop, r_rows, r_ok, r_drop = fn(table, ids)
+    assert int(d_drop) == 0 and np.asarray(d_ok).all()
+    np.testing.assert_allclose(np.asarray(d_rows),
+                               np.tile(table[0], (N * m, 1)))
+    assert int(r_drop) == N * (m - 1)  # raw: one slot serves, m-1 drop
+
+
+def test_push_rows_sparse_dedup_matches_dense(mesh):
+    """Pre-summed dedup push ≡ np scatter-add (integer deltas ⇒ exact),
+    with duplicate-heavy ids and a validity mask."""
+    rng = np.random.default_rng(6)
+    rpw, d, m = 5, 3, 12
+    table = np.zeros((N * rpw, d), np.float32)
+    ids = np.repeat(rng.integers(0, N * rpw, size=(N, 4)), 3,
+                    axis=1).reshape(-1).astype(np.int32)
+    deltas = rng.integers(-3, 4, size=(N * m, d)).astype(np.float32)
+    valid = (np.arange(N * m) % 4 != 1)
+
+    fn = jax.jit(mesh.shard_map(
+        lambda t, i, dv, v: push_rows_sparse_dedup(t, i, dv, capacity=m,
+                                                   valid=v),
+        in_specs=(mesh.spec(0),) * 4, out_specs=(mesh.spec(0), P())))
+    new_table, dropped = fn(table, ids, deltas, valid)
+    assert int(dropped) == 0
+    expect = table.copy()
+    np.add.at(expect, ids[valid], deltas[valid])
+    np.testing.assert_array_equal(np.asarray(new_table), expect)
+
+
+def test_dedup_verbs_out_of_range_ids_drop_once_per_distinct(mesh):
+    """Out-of-range ids stay counted drops (never served, never clamped)
+    — once per DISTINCT bad id under dedup."""
+    rpw, d = 4, 2
+    table = np.zeros((N * rpw, d), np.float32)
+    bad = N * rpw + 7
+    ids = np.tile(np.array([0, bad, bad, bad], np.int32), N)
+
+    def prog(t, i):
+        rows, ok, dropped = pull_rows_sparse_dedup(t, i, capacity=4)
+        return rows, ok, dropped
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0),) * 2,
+        out_specs=(mesh.spec(0), mesh.spec(0), P())))
+    rows, ok, dropped = fn(table, ids)
+    ok = np.asarray(ok).reshape(N, 4)
+    assert ok[:, 0].all() and not ok[:, 1:].any()
+    assert int(dropped) == N  # one distinct bad id per worker
 
 
 def test_regroup_by_key_routes_to_owner(mesh):
