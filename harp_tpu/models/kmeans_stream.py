@@ -120,7 +120,15 @@ def _make_finish_fn(mesh: WorkerMesh):
 
 def _init_centroids(points, n, k, seed, init):
     """Same seeding contract as kmeans.fit, but memmap-safe: only the
-    selected rows are ever materialized."""
+    selected rows are ever materialized.  ``init`` may also be an
+    explicit ``[k, d]`` array (warm start / cross-variant comparisons)."""
+    if not isinstance(init, str):  # explicit centroids
+        arr = np.asarray(init, np.float32)
+        if arr.ndim != 2 or arr.shape[0] != k or arr.shape[1] != points.shape[1]:
+            raise ValueError(
+                f"explicit init must be [k={k}, d={points.shape[1]}], "
+                f"got shape {arr.shape}")
+        return arr
     if init == "kmeans++":
         rng = np.random.default_rng(0 if seed is None else seed)
         idx = np.sort(rng.choice(n, size=min(n, 50_000), replace=False))
@@ -223,6 +231,26 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
         return (mesh.shard_array(blk.astype(np_dtype, copy=False), 0),
                 mesh.shard_array(m, 0))
 
+    if iters == 0:  # same contract as kmeans.fit(iters=0)
+        return (np.asarray(init_c, np.float32), 0.0, np.zeros(0, np.float32)
+                ) if return_history else (np.asarray(init_c, np.float32), 0.0)
+    offsets = list(range(0, n, chunk))
+    return _stream_train(mesh, cfg, lambda j: put_chunk(offsets[j]),
+                         len(offsets), centroids, iters, dtype,
+                         return_history, ckpt_dir, ckpt_every,
+                         max_restarts, fault, instrument)
+
+
+def _stream_train(mesh, cfg, put_chunk, n_chunks, centroids, iters, dtype,
+                  return_history, ckpt_dir, ckpt_every, max_restarts,
+                  fault, instrument):
+    """The shared blocked-epoch driver behind :func:`fit_streaming` and
+    :func:`fit_streaming_local`: double-buffered chunk loop, one
+    allreduce per epoch, checkpoint/resume, optional pipeline timing.
+    ``put_chunk(j)`` yields chunk j's device inputs for the epoch."""
+    nw = mesh.num_workers
+    k = cfg.k
+    d = int(centroids.shape[-1])
     accum_fn = _make_accum_fn(mesh, cfg)
     finish_fn = _make_finish_fn(mesh)
     zeros = lambda: (
@@ -230,10 +258,6 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
         jax.device_put(jnp.zeros((nw, k), jnp.float32), mesh.sharding(mesh.spec(0))),
         jax.device_put(jnp.zeros((nw,), jnp.float32), mesh.sharding(mesh.spec(0))),
     )
-    if iters == 0:  # same contract as kmeans.fit(iters=0)
-        return (np.asarray(init_c, np.float32), 0.0, np.zeros(0, np.float32)
-                ) if return_history else (np.asarray(init_c, np.float32), 0.0)
-    offsets = list(range(0, n, chunk))
     history: list = []
 
     def train_one():
@@ -242,13 +266,13 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
         host_s = 0.0
         sums, counts, inertia = zeros()
         t = time.perf_counter()
-        nxt = put_chunk(offsets[0])  # double buffer: transfer j+1 during j
+        nxt = put_chunk(0)  # double buffer: transfer j+1 during j
         host_s += time.perf_counter() - t
-        for j in range(len(offsets)):
+        for j in range(n_chunks):
             cur = nxt
-            if j + 1 < len(offsets):
+            if j + 1 < n_chunks:
                 t = time.perf_counter()
-                nxt = put_chunk(offsets[j + 1])
+                nxt = put_chunk(j + 1)
                 host_s += time.perf_counter() - t
             sums, counts, inertia = accum_fn(cur[0], cur[1], centroids,
                                              sums, counts, inertia)
@@ -292,6 +316,125 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
     if return_history:
         return c_host, float(final[-1]), final
     return c_host, float(final[-1])
+
+
+def fit_streaming_local(points_local, k=1000, iters=10,
+                        chunk_points=262_144, mesh: WorkerMesh | None = None,
+                        seed=0, dtype=jnp.float32, init="random",
+                        return_history=False, ckpt_dir=None, ckpt_every=5,
+                        max_restarts=3, fault=None, instrument=None):
+    """Multi-host blocked-epoch Lloyd where EACH PROCESS streams only its
+    own split — Harp's HDFS-split ingest (SURVEY.md §4.2 "load points
+    shard"): no host ever reads or materializes the whole dataset, so
+    the measured ~14 GB/s single-host ingest floor (BASELINE.md) divides
+    by the process count.
+
+    ``points_local``: this process's ``[n_local, d]`` slice (ndarray or
+    ``np.memmap``; a random-slicing source — the per-epoch access walks
+    each local worker's sub-slice, not one ascending scan, so
+    ``CSVPoints`` is not supported here).  The global row order is
+    process-major (process p's rows precede p+1's), each process's rows
+    block-partitioned over its local devices.  Semantics match
+    :func:`fit_streaming`: full-batch Lloyd, every point visited once
+    per epoch against epoch-start centroids — with an explicit ``init``
+    array the two produce the same clustering up to partial-sum rounding
+    (tested in tests/multiproc_worker.py).  Single-process it is simply
+    ``fit_streaming`` with a different chunk layout.
+
+    ``init``: "random" (each process contributes ⌈k/nproc⌉ seed rows,
+    allgathered, first k kept), "kmeans++" (D² seeding on an allgathered
+    ≤50k-row subsample, ⌈50k/nproc⌉ per process), or an explicit
+    ``[k, d]`` array.  ``quantize`` is not offered here (the int8 scale
+    pass is a global reduction left to the caller).  Other knobs —
+    checkpoint/resume, ``instrument`` — behave as in
+    :func:`fit_streaming`.
+    """
+    mesh = mesh or current_mesh()
+    nw = mesh.num_workers
+    nproc = jax.process_count()
+    if nw % nproc:
+        raise ValueError(f"{nw} workers do not divide over {nproc} processes")
+    ldev = nw // nproc               # workers (devices) on this process
+    n_local, d = points_local.shape
+    if n_local == 0:
+        raise ValueError("every process must hold at least one row "
+                         "(this one has an empty split)")
+    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype)
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    from jax.experimental import multihost_utils as mh
+
+    n_all = np.atleast_1d(np.asarray(
+        mh.process_allgather(np.int64(n_local))))          # [nproc]
+    npw = -(-n_local // ldev)        # rows per LOCAL worker (this process)
+    npw_all = -(-n_all // ldev)      # the same, per process
+    # chunk rows per worker: derived from GLOBAL info so every process
+    # builds the same static [nw*cl] chunk shape; per-process shortfall
+    # is padding (mask 0)
+    cl = max(1, min(-(-cfg.chunk_points // nw), int(npw_all.max())))
+    # every process loops the global max chunk count (late ones all-pad)
+    n_chunks = int((-(-npw_all // cl)).max())
+
+    def local_seed_rows(count, rng_seed):
+        """``count`` rows of this split (equal shape on every process for
+        the allgather).  A split shorter than ``count`` is topped up by
+        UNIFORM resampling — no positional bias, unlike a cyclic pad."""
+        rng = np.random.default_rng(0 if rng_seed is None else rng_seed)
+        if n_local >= count:
+            idx = (np.arange(count) if rng_seed is None
+                   else rng.choice(n_local, size=count, replace=False))
+        else:
+            idx = np.concatenate([np.arange(n_local),
+                                  rng.choice(n_local, count - n_local)])
+        return np.asarray(points_local[np.sort(idx)], np.float32)
+
+    if not isinstance(init, str):
+        init_c = _init_centroids(points_local, n_local, k, seed, init)
+    elif init == "random":
+        per = -(-k // nproc)
+        if n_local < per:
+            # resampled rows would be exact DUPLICATE centroids —
+            # permanently-empty clusters that silently degrade the fit
+            # (fit_streaming's n < k case raises too); seed explicitly
+            raise ValueError(
+                f"init='random' needs >= ceil(k/nproc) = {per} rows per "
+                f"process split, this one has {n_local}; pass an explicit "
+                "[k, d] init array instead")
+        mine = local_seed_rows(per, None if seed is None else seed)
+        init_c = np.asarray(mh.process_allgather(mine)).reshape(-1, d)[:k]
+    elif init == "kmeans++":
+        # subsample sized by the GLOBAL row count (matching fit_streaming's
+        # min(n, 50k) contract), split evenly across processes
+        per = -(-min(50_000, int(n_all.sum())) // nproc)
+        sub = np.asarray(mh.process_allgather(
+            local_seed_rows(per, 0 if seed is None else seed))).reshape(-1, d)
+        init_c = kmeanspp_init(sub, k, seed=0 if seed is None else seed)
+    else:
+        raise ValueError(f"init must be 'random', 'kmeans++' or a [k, d] "
+                         f"array, got {init!r}")
+    centroids = jax.device_put(jnp.asarray(init_c, dtype=dtype),
+                               mesh.replicated())
+
+    def put_chunk(j):
+        blk = np.zeros((ldev * cl, d), np_dtype)
+        msk = np.zeros(ldev * cl, np.float32)
+        for w in range(ldev):
+            w_end = min((w + 1) * npw, n_local)
+            lo = w * npw + j * cl
+            hi = min(lo + cl, w_end)
+            if hi > lo:
+                blk[w * cl: w * cl + hi - lo] = np.asarray(
+                    points_local[lo:hi]).astype(np_dtype, copy=False)
+                msk[w * cl: w * cl + hi - lo] = 1.0
+        return (mesh.shard_array_local(blk, nw * cl),
+                mesh.shard_array_local(msk, nw * cl))
+
+    if iters == 0:
+        return (np.asarray(init_c, np.float32), 0.0, np.zeros(0, np.float32)
+                ) if return_history else (np.asarray(init_c, np.float32), 0.0)
+    return _stream_train(mesh, cfg, put_chunk, n_chunks, centroids, iters,
+                         dtype, return_history, ckpt_dir, ckpt_every,
+                         max_restarts, fault, instrument)
 
 
 def _make_chunk_gen(key, rows: int, d: int, dtype):

@@ -138,9 +138,37 @@ class WorkerMesh:
         return NamedSharding(self.mesh, P())
 
     def shard_array(self, x, dim: int | None = 0):
-        """Place a host array on the mesh, split along ``dim`` (None = replicate)."""
+        """Place a host array on the mesh, split along ``dim`` (None = replicate).
+
+        Multi-host note: every process must pass the same GLOBAL ``x``;
+        each contributes its addressable shards.  When each host holds
+        only its own slice (sharded ingest), use
+        :meth:`shard_array_local` instead.
+        """
         spec = P() if dim is None else self.spec(dim, ndim=np.ndim(x))
         return jax.device_put(x, NamedSharding(self.mesh, spec))
+
+    def shard_array_local(self, x_local, global_rows: int | None = None):
+        """Assemble a dim-0-sharded global array from PER-PROCESS slices.
+
+        The multi-host ingest primitive (Harp parity: each mapper read
+        only its own HDFS split — SURVEY.md §4.2): process p passes only
+        the rows its local devices own (the contiguous block
+        ``[p * rows_per_process, (p+1) * rows_per_process)`` of the
+        global row order), so no host ever materializes — or reads — the
+        whole array.  ``global_rows`` defaults to ``local_rows *
+        process_count`` (equal splits; required: dim 0 must divide
+        evenly over processes).  Single-process: identical to
+        ``shard_array(x, 0)``.
+        """
+        x_local = np.asarray(x_local)
+        nproc = jax.process_count()
+        gshape = ((global_rows if global_rows is not None
+                   else x_local.shape[0] * nproc),) + x_local.shape[1:]
+        sh = NamedSharding(self.mesh, self.spec(0, ndim=x_local.ndim))
+        if nproc == 1:
+            return jax.device_put(x_local, sh)
+        return jax.make_array_from_process_local_data(sh, x_local, gshape)
 
     def shard_map(
         self,

@@ -160,6 +160,37 @@ np.testing.assert_allclose(Nk.sum(), lda.n_tokens)
 local_Nwk = np.asarray(lda.Nwk.addressable_shards[0].data)
 assert (local_Nwk >= 0).all() and np.isfinite(local_Nwk).all()
 
+# sharded ingest: each process streams ONLY its own split
+# (fit_streaming_local — Harp's HDFS-split model); the result must match
+# a straight-line numpy Lloyd on the concatenated dataset
+from harp_tpu.models.kmeans_stream import fit_streaming_local
+
+rng = np.random.RandomState(7)
+full = (rng.randn(64 * n_procs, 6).astype(np.float32)
+        + (np.arange(64 * n_procs)[:, None] % 4) * 5.0)
+mine_slice = full[proc_id * 64:(proc_id + 1) * 64]   # THIS process's split
+c0 = full[:4].copy()
+c_got, inertia_got = fit_streaming_local(mine_slice, k=4, iters=4,
+                                         chunk_points=40, mesh=mesh,
+                                         init=c0)
+
+
+def np_lloyd(pts, c, iters):
+    c = c.copy()
+    for _ in range(iters):
+        d2 = ((pts[:, None, :] - c[None]) ** 2).sum(-1)
+        a = d2.argmin(1)
+        last_inertia = float(d2[np.arange(len(pts)), a].sum())
+        for j in range(len(c)):
+            if (a == j).any():
+                c[j] = pts[a == j].mean(0)
+    return c, last_inertia
+
+
+c_ref, inertia_ref = np_lloyd(full, c0, 4)
+np.testing.assert_allclose(c_got, c_ref, rtol=1e-3, atol=1e-3)
+assert abs(inertia_got - inertia_ref) < 1e-3 * abs(inertia_ref)
+
 # pod-shaped only: one rotate step around the mixed ICI/DCN ring —
 # worker w's block must land on worker (w+1) % nw regardless of which
 # segments are intra- vs inter-process

@@ -208,6 +208,41 @@ def test_instrument_hook_epoch_records(mesh):
         assert e["epoch_s"] >= e["host_s"]
 
 
+def test_streaming_local_single_process_matches_global(mesh):
+    """fit_streaming_local is fit_streaming with a per-process chunk
+    layout: with the same explicit init the clusterings agree (the
+    chunk partitioning only regroups the f32 partial sums)."""
+    pts = _blobs(n=3100)  # not divisible by workers or chunks: pad paths
+    c0 = pts[:8].copy()
+    cg, ig = KS.fit_streaming(pts, k=8, iters=5, chunk_points=512,
+                              mesh=mesh, init=c0)
+    cl, il = KS.fit_streaming_local(pts, k=8, iters=5, chunk_points=512,
+                                    mesh=mesh, init=c0)
+    assert np.allclose(cg, cl, rtol=1e-4, atol=1e-4)
+    assert abs(ig - il) < 1e-3 * abs(ig)
+
+
+def test_streaming_local_seeding_modes(mesh):
+    pts = _blobs(n=2048)
+    for init in ("random", "kmeans++"):
+        c, inertia = KS.fit_streaming_local(pts, k=8, iters=3,
+                                            chunk_points=512, mesh=mesh,
+                                            seed=1, init=init)
+        assert np.isfinite(c).all() and np.isfinite(inertia)
+    with pytest.raises(ValueError, match="init must be"):
+        KS.fit_streaming_local(pts, k=8, iters=1, mesh=mesh, init="grid")
+    with pytest.raises(ValueError, match="explicit init"):
+        KS.fit_streaming_local(pts, k=8, iters=1, mesh=mesh,
+                               init=np.zeros((4, pts.shape[1])))
+    with pytest.raises(ValueError, match="at least one row"):
+        KS.fit_streaming_local(pts[:0], k=8, iters=1, mesh=mesh)
+    # a split too short to seed k distinct centroids fails LOUDLY: the
+    # resampled alternative would be duplicate seeds = dead clusters
+    with pytest.raises(ValueError, match="rows per"):
+        KS.fit_streaming_local(pts[:4], k=8, iters=1, mesh=mesh,
+                               init="random")
+
+
 def test_north_star_1b_program_lowers(mesh):
     """The REAL 1B×300 k=1000 program (3814-chunk scan × fori epochs)
     must trace and lower at its true shapes — proving the north-star
