@@ -118,17 +118,32 @@ def _make_finish_fn(mesh: WorkerMesh):
         finish, in_specs=(sh, sh, sh, P()), out_specs=(P(), P())))
 
 
+def _validate_explicit_init(init, k, d):
+    """The ONE explicit-``[k, d]``-init check, shared by every fit
+    variant — k AND the feature dim, so a mismatch fails here with a
+    plain message, not inside a jitted matmul."""
+    arr = np.asarray(init, np.float32)
+    if arr.ndim != 2 or arr.shape[0] != k or arr.shape[1] != d:
+        raise ValueError(f"explicit init must be [k={k}, d={d}], "
+                         f"got shape {arr.shape}")
+    return arr
+
+
+def _topup_rows(rows, count, rng):
+    """Pad ``rows`` to exactly ``count`` by UNIFORM resampling (equal
+    allgather shapes across processes; no positional bias)."""
+    if rows.shape[0] >= count:
+        return rows[:count]
+    extra = rng.choice(rows.shape[0], size=count - rows.shape[0])
+    return np.concatenate([rows, rows[np.sort(extra)]], 0)
+
+
 def _init_centroids(points, n, k, seed, init):
     """Same seeding contract as kmeans.fit, but memmap-safe: only the
     selected rows are ever materialized.  ``init`` may also be an
     explicit ``[k, d]`` array (warm start / cross-variant comparisons)."""
     if not isinstance(init, str):  # explicit centroids
-        arr = np.asarray(init, np.float32)
-        if arr.ndim != 2 or arr.shape[0] != k or arr.shape[1] != points.shape[1]:
-            raise ValueError(
-                f"explicit init must be [k={k}, d={points.shape[1]}], "
-                f"got shape {arr.shape}")
-        return arr
+        return _validate_explicit_init(init, k, points.shape[1])
     if init == "kmeans++":
         rng = np.random.default_rng(0 if seed is None else seed)
         idx = np.sort(rng.choice(n, size=min(n, 50_000), replace=False))
@@ -437,6 +452,135 @@ def fit_streaming_local(points_local, k=1000, iters=10,
                          max_restarts, fault, instrument)
 
 
+def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
+                        mesh: WorkerMesh | None = None, seed=0,
+                        dtype=jnp.float32, init="random",
+                        return_history=False, ckpt_dir=None, ckpt_every=5,
+                        max_restarts=3, fault=None, instrument=None,
+                        reader_chunk_rows=65_536):
+    """Blocked-epoch Lloyd over a DIRECTORY of file splits — Harp's real
+    input shape (SURVEY.md §4.2): files are dealt to workers by the
+    size-balanced ``multi_file_splits`` rule and each worker streams
+    ONLY its own files (npy memmap or text via the native
+    double-buffered parser), so in a multi-host job every file is read
+    by exactly one process and the host ingest floor divides by the
+    host count, file-granular like HDFS splits.
+
+    ``paths``: resolved file list (use ``harp_tpu.fileformat.list_files``
+    for a glob/dir; the list is sorted here for a deterministic
+    assignment).  Semantics are full-batch Lloyd, identical to
+    :func:`fit_streaming` on the same rows (the row ORDER differs —
+    worker-major over file assignments — which Lloyd does not see:
+    epochs are order-independent given the same init; tested).  Workers
+    may own zero files (more workers than files: their chunks are all
+    padding); a whole PROCESS with zero rows works with an explicit
+    ``init`` array (string seeding has nothing to sample there and
+    raises).  ``init`` as in :func:`fit_streaming_local`, seeded by
+    ``FileSplits.sample`` — random rows across this process's files.
+    """
+    from harp_tpu.native.datasource import FileSplits
+
+    mesh = mesh or current_mesh()
+    nw = mesh.num_workers
+    nproc = jax.process_count()
+    if nw % nproc:
+        raise ValueError(f"{nw} workers do not divide over {nproc} processes")
+    ldev = nw // nproc
+    pid = jax.process_index()
+    local_workers = range(pid * ldev, (pid + 1) * ldev)
+    fs = FileSplits(sorted(paths), nw, local_workers,
+                    chunk_rows=reader_chunk_rows)
+    try:
+        return _fit_streaming_files(fs, paths, k, iters, chunk_points,
+                                    mesh, nproc, ldev, pid, local_workers,
+                                    seed, dtype, init, return_history,
+                                    ckpt_dir, ckpt_every, max_restarts,
+                                    fault, instrument)
+    finally:
+        fs.close()  # also on iters==0 and validation raises: no fd leaks
+
+
+def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
+                         ldev, pid, local_workers, seed, dtype, init,
+                         return_history, ckpt_dir, ckpt_every,
+                         max_restarts, fault, instrument):
+    nw = mesh.num_workers
+    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype)
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+    from jax.experimental import multihost_utils as mh
+
+    n_per_worker = np.zeros(nw, np.int64)
+    for w in local_workers:
+        n_per_worker[w] = fs.rows(w)
+    n_per_worker = np.asarray(
+        mh.process_allgather(n_per_worker)).reshape(-1, nw).max(0)
+    n_total = int(n_per_worker.sum())
+    if n_total == 0:
+        raise ValueError(f"{len(paths)} input files contain no rows")
+    # feature dim must agree ACROSS processes too (each FileSplits only
+    # sees its own files); a process with no files adopts the global d
+    d_all = np.atleast_1d(np.asarray(
+        mh.process_allgather(np.int64(fs.cols))))
+    d = int(d_all.max())
+    if np.any((d_all != 0) & (d_all != d)):
+        raise ValueError(
+            f"input files disagree on column count across processes "
+            f"({sorted(set(int(v) for v in d_all if v))}) — a ragged mix "
+            "would silently misalign features")
+    rows_per_proc = n_per_worker.reshape(nproc, ldev).sum(1)
+    cl = max(1, min(-(-cfg.chunk_points // nw), int(n_per_worker.max())))
+    n_chunks = int((-(-n_per_worker // cl)).max())
+
+    if not isinstance(init, str):
+        init_c = _validate_explicit_init(init, k, d)
+    elif init in ("random", "kmeans++"):
+        if (rows_per_proc == 0).any():
+            raise ValueError(
+                f"process(es) {np.flatnonzero(rows_per_proc == 0).tolist()}"
+                " own no rows under the file assignment — string seeding "
+                "has nothing to sample there; pass an explicit [k, d] "
+                "init array (or use fewer workers)")
+        per = -(-(k if init == "random" else min(50_000, n_total)) // nproc)
+        rng = np.random.default_rng((0 if seed is None else seed, pid))
+        mine = fs.sample(per, rng=rng)
+        if init == "random" and mine.shape[0] < per:
+            raise ValueError(
+                f"init='random' needs >= ceil(k/nproc) = {per} rows in "
+                f"this process's files, they hold {mine.shape[0]}; pass "
+                "an explicit [k, d] init array instead")
+        mine = _topup_rows(mine, per, rng)
+        gathered = np.asarray(mh.process_allgather(mine)).reshape(-1, d)
+        init_c = (gathered[:k] if init == "random" else
+                  kmeanspp_init(gathered, k, seed=0 if seed is None else seed))
+    else:
+        raise ValueError(f"init must be 'random', 'kmeans++' or a [k, d] "
+                         f"array, got {init!r}")
+    centroids = jax.device_put(jnp.asarray(init_c, dtype=dtype),
+                               mesh.replicated())
+
+    def put_chunk(j):
+        if j == 0:  # epoch start: every worker rewinds to its first file
+            fs.reset()
+        blk = np.zeros((ldev * cl, d), np_dtype)
+        msk = np.zeros(ldev * cl, np.float32)
+        for li, w in enumerate(local_workers):
+            rows = fs.next_block(w, cl)
+            t = rows.shape[0]
+            if t:
+                blk[li * cl: li * cl + t] = rows.astype(np_dtype, copy=False)
+                msk[li * cl: li * cl + t] = 1.0
+        return (mesh.shard_array_local(blk, nw * cl),
+                mesh.shard_array_local(msk, nw * cl))
+
+    if iters == 0:
+        return (np.asarray(init_c, np.float32), 0.0, np.zeros(0, np.float32)
+                ) if return_history else (np.asarray(init_c, np.float32), 0.0)
+    return _stream_train(mesh, cfg, put_chunk, n_chunks, centroids, iters,
+                         dtype, return_history, ckpt_dir, ckpt_every,
+                         max_restarts, fault, instrument)
+
+
 def _make_chunk_gen(key, rows: int, d: int, dtype):
     """THE chunk generator — shared by the real synthetic program and its
     gen-only calibration twin so the two can never time different RNG
@@ -680,10 +824,13 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--chunk", type=int, default=262_144)
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
-    p.add_argument("--input", default=None, metavar="NPY_OR_CSV",
-                   help="stream a .npy file (np.memmap) or a CSV/text "
-                        "file (native prefetch-threaded reader, bounded "
-                        "memory) instead of the device-synthetic benchmark")
+    p.add_argument("--input", default=None, metavar="NPY_CSV_OR_GLOB",
+                   help="stream a .npy file (np.memmap), a CSV/text file "
+                        "(native prefetch-threaded reader, bounded "
+                        "memory), or a glob/directory of split files — "
+                        "dealt to workers size-balanced, each streaming "
+                        "only its own (the HDFS-split input shape) — "
+                        "instead of the device-synthetic benchmark")
     p.add_argument("--quantize", choices=["int8"], default=None)
     p.add_argument("--init", choices=["random", "kmeans++"], default="random")
     p.add_argument("--ckpt-dir", default=None,
@@ -694,19 +841,36 @@ def main(argv=None):
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
     if args.input:
-        if args.input.endswith(".npy"):
-            pts = np.load(args.input, mmap_mode="r")
-        else:  # text: native streaming reader, never materialized
-            from harp_tpu.native.datasource import CSVPoints
+        from harp_tpu.fileformat import list_files
 
-            pts = CSVPoints(args.input, chunk_rows=args.chunk)
-        c, inertia = fit_streaming(pts, args.k, args.iters, args.chunk,
-                                   dtype=dtype, quantize=args.quantize,
-                                   init=args.init, ckpt_dir=args.ckpt_dir,
-                                   ckpt_every=args.ckpt_every)
+        paths = list_files(args.input)
+        if not paths:
+            raise SystemExit(f"{args.input}: no input files matched")
+        if len(paths) > 1:  # split directory: per-worker file streams
+            if args.quantize:
+                raise SystemExit("--quantize is single-source only "
+                                 "(the int8 scale pass)")
+            c, inertia = fit_streaming_files(
+                paths, args.k, args.iters, args.chunk, dtype=dtype,
+                init=args.init, ckpt_dir=args.ckpt_dir,
+                ckpt_every=args.ckpt_every)
+            n_rows, d_cols = "split", "split"
+        else:
+            if paths[0].endswith(".npy"):
+                pts = np.load(paths[0], mmap_mode="r")
+            else:  # text: native streaming reader, never materialized
+                from harp_tpu.native.datasource import CSVPoints
+
+                pts = CSVPoints(paths[0], chunk_rows=args.chunk)
+            c, inertia = fit_streaming(pts, args.k, args.iters, args.chunk,
+                                       dtype=dtype, quantize=args.quantize,
+                                       init=args.init,
+                                       ckpt_dir=args.ckpt_dir,
+                                       ckpt_every=args.ckpt_every)
+            n_rows, d_cols = int(pts.shape[0]), int(pts.shape[1])
         # JSON, not dict repr: measure_on_relay.sh tees this into a .jsonl
         print(json.dumps({"k": args.k, "iters": args.iters,
-                          "n": int(pts.shape[0]), "d": int(pts.shape[1]),
+                          "n": n_rows, "d": d_cols, "files": len(paths),
                           "inertia": float(inertia)}))
     else:
         print(json.dumps(benchmark_streaming(args.n, args.d, args.k,

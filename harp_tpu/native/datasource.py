@@ -402,6 +402,141 @@ class CSVStream:
             pass
 
 
+class FileSplits:
+    """Size-balanced file→worker assignment with per-worker sequential
+    block reads — Harp's input shape (SURVEY.md §3.1 L4 input formats /
+    §4.2 "load points shard"): the dataset is a DIRECTORY of splits and
+    each worker streams only its own files, never the whole set.
+
+    ``paths`` (already-resolved list; sort for a deterministic
+    assignment) are dealt to workers by
+    :func:`harp_tpu.fileformat.multi_file_splits` — greedy size-balanced
+    by default (``by_size``), Harp's ``MultiFileInputFormat`` rule — and
+    only ``local_workers`` — the workers this process serves — are
+    opened, so a multi-host job touches each file exactly once across
+    the fleet.  ``.npy`` files open as memmaps; anything else goes
+    through :class:`CSVPoints` (native streaming parser, bounded
+    memory).  All files must agree on the column count.
+
+    Per worker: ``rows(w)`` (total), ``next_block(w, count)`` (the next
+    ≤count rows, crossing file boundaries), and :meth:`reset` rewinds
+    every stream for the next epoch.  ``head(count)`` serves seeding
+    (rows from this process's files in worker order) and resets after.
+    """
+
+    def __init__(self, paths, n_workers: int, local_workers,
+                 chunk_rows: int = 65_536, by_size: bool = True):
+        from harp_tpu.fileformat import multi_file_splits
+
+        if not paths:
+            raise ValueError("FileSplits needs at least one input file")
+        self.paths = list(paths)
+        self.n_workers = n_workers
+        self.local_workers = list(local_workers)
+        self._chunk_rows = chunk_rows
+        assign = multi_file_splits(self.paths, n_workers, by_size=by_size)
+        self._srcs: dict[int, list] = {}
+        cols = {}
+        for w in self.local_workers:
+            srcs = []
+            for p in assign[w]:
+                s = (np.load(p, mmap_mode="r") if p.endswith(".npy")
+                     else CSVPoints(p, chunk_rows))
+                if len(s.shape) != 2:
+                    raise ValueError(f"{p}: expected 2-D rows, got shape "
+                                     f"{s.shape}")
+                srcs.append(s)
+                cols[int(s.shape[1])] = p
+            self._srcs[w] = srcs
+        if len(cols) > 1:
+            raise ValueError(
+                f"input files disagree on column count {sorted(cols)} "
+                f"(e.g. {list(cols.values())[:2]}) — a ragged mix would "
+                "silently misalign features")
+        self.cols = next(iter(cols)) if cols else 0
+        self._pos = {w: [0, 0] for w in self.local_workers}  # [src, row]
+
+    def rows(self, w: int) -> int:
+        return int(sum(s.shape[0] for s in self._srcs[w]))
+
+    def reset(self) -> None:
+        self._pos = {w: [0, 0] for w in self.local_workers}
+
+    def next_block(self, w: int, count: int) -> np.ndarray:
+        out = []
+        si, off = self._pos[w]
+        srcs = self._srcs[w]
+        need = count
+        while need > 0 and si < len(srcs):
+            s = srcs[si]
+            take = min(need, int(s.shape[0]) - off)
+            if take > 0:
+                out.append(np.asarray(s[off:off + take], np.float32))
+                off += take
+                need -= take
+            if off >= s.shape[0]:
+                si += 1
+                off = 0
+        self._pos[w] = [si, off]
+        return (np.concatenate(out, 0) if out
+                else np.zeros((0, self.cols), np.float32))
+
+    def head(self, count: int) -> np.ndarray:
+        """First ``count`` rows across this process's workers (worker
+        order) — for shape probing; rewinds all streams afterwards."""
+        self.reset()
+        out = []
+        need = count
+        for w in self.local_workers:
+            if need <= 0:
+                break
+            blk = self.next_block(w, need)
+            out.append(blk)
+            need -= blk.shape[0]
+        self.reset()
+        return (np.concatenate(out, 0) if out
+                else np.zeros((0, self.cols), np.float32))
+
+    def sample(self, count: int, rng=0) -> np.ndarray:
+        """Up to ``count`` rows drawn RANDOMLY (without replacement per
+        file) across this process's files — centroid seeding that does
+        not collapse on sorted/cluster-grouped inputs the way a
+        first-rows head() would.  The draw spreads an even quota over
+        files (capped by file size; approximately, not exactly,
+        row-uniform), via sorted index gathers (memmap fancy-index; text
+        sources run one dedicated streaming pass).  Stream cursors are
+        untouched.  ``rng``: seed or ``np.random.Generator``."""
+        rng = (rng if isinstance(rng, np.random.Generator)
+               else np.random.default_rng(rng))
+        flat = [(w, i, int(s.shape[0]))
+                for w in self.local_workers
+                for i, s in enumerate(self._srcs[w])]
+        total = sum(z for _, _, z in flat)
+        remaining = min(count, total)
+        out = []
+        for j, (w, i, z) in enumerate(flat):
+            if remaining <= 0:
+                break
+            quota = min(z, -(-remaining // (len(flat) - j)))
+            idx = np.sort(rng.choice(z, size=quota, replace=False))
+            out.append(np.asarray(self._srcs[w][i][idx], np.float32))
+            remaining -= quota
+        return (np.concatenate(out, 0) if out
+                else np.zeros((0, self.cols), np.float32))
+
+    def close(self) -> None:
+        for srcs in self._srcs.values():
+            for s in srcs:
+                if hasattr(s, "close"):
+                    s.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class CSVPoints:
     """Sequential-access view of a CSV file shaped like an array —
     the ``points`` source contract of

@@ -39,6 +39,25 @@ def test_stats_all_algos_run(capsys):
             "naive", "naive_bayes") in capsys.readouterr().out
 
 
+def test_dispatch_kmeans_stream_split_glob(capsys, tmp_path):
+    """--input with a glob of split files runs the per-worker file-stream
+    path (the HDFS-split input shape) and prints one JSON line."""
+    import json
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        np.savetxt(tmp_path / f"part_{i}.csv",
+                   rng.normal(size=(50 + 20 * i, 4)).astype(np.float32),
+                   fmt="%.5f", delimiter=",")
+    rc = cli.main(["kmeans-stream", "--input", str(tmp_path / "part_*.csv"),
+                   "--k", "3", "--iters", "2", "--chunk", "32"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["files"] == 3 and np.isfinite(rec["inertia"])
+
+
 def test_dispatch_svm_libsvm_file(capsys, tmp_path):
     """The reference's native input format trains end-to-end via the CLI
     (sparse ELL path, labels mapped from arbitrary binary values)."""
