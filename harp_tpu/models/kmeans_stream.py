@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from typing import Any
 
@@ -457,7 +458,7 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
                         dtype=jnp.float32, init="random",
                         return_history=False, ckpt_dir=None, ckpt_every=5,
                         max_restarts=3, fault=None, instrument=None,
-                        reader_chunk_rows=65_536):
+                        reader_chunk_rows=65_536, info=None):
     """Blocked-epoch Lloyd over a DIRECTORY of file splits — Harp's real
     input shape (SURVEY.md §4.2): files are dealt to workers by the
     size-balanced ``multi_file_splits`` rule and each worker streams
@@ -468,7 +469,9 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
 
     ``paths``: resolved file list (use ``harp_tpu.fileformat.list_files``
     for a glob/dir; the list is sorted here for a deterministic
-    assignment).  Semantics are full-batch Lloyd, identical to
+    assignment).  ``info``: pass a dict to receive ``n_total`` / ``d``
+    (the CLI reports them; no other way to learn the global row count
+    without a second counting pass).  Semantics are full-batch Lloyd, identical to
     :func:`fit_streaming` on the same rows (the row ORDER differs —
     worker-major over file assignments — which Lloyd does not see:
     epochs are order-independent given the same init; tested).  Workers
@@ -495,7 +498,7 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
                                     mesh, nproc, ldev, pid, local_workers,
                                     seed, dtype, init, return_history,
                                     ckpt_dir, ckpt_every, max_restarts,
-                                    fault, instrument)
+                                    fault, instrument, info)
     finally:
         fs.close()  # also on iters==0 and validation raises: no fd leaks
 
@@ -503,7 +506,7 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
 def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
                          ldev, pid, local_workers, seed, dtype, init,
                          return_history, ckpt_dir, ckpt_every,
-                         max_restarts, fault, instrument):
+                         max_restarts, fault, instrument, info=None):
     nw = mesh.num_workers
     cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
@@ -531,6 +534,8 @@ def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
     rows_per_proc = n_per_worker.reshape(nproc, ldev).sum(1)
     cl = max(1, min(-(-cfg.chunk_points // nw), int(n_per_worker.max())))
     n_chunks = int((-(-n_per_worker // cl)).max())
+    if info is not None:
+        info.update({"n_total": n_total, "d": d})
 
     if not isinstance(init, str):
         init_c = _validate_explicit_init(init, k, d)
@@ -542,14 +547,17 @@ def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
                 "has nothing to sample there; pass an explicit [k, d] "
                 "init array (or use fewer workers)")
         per = -(-(k if init == "random" else min(50_000, n_total)) // nproc)
-        rng = np.random.default_rng((0 if seed is None else seed, pid))
-        mine = fs.sample(per, rng=rng)
-        if init == "random" and mine.shape[0] < per:
+        if init == "random" and (rows_per_proc < per).any():
+            # SYMMETRIC check (rows_per_proc is globally replicated): a
+            # one-sided raise would leave the other processes hanging in
+            # the allgather below
+            short = np.flatnonzero(rows_per_proc < per).tolist()
             raise ValueError(
-                f"init='random' needs >= ceil(k/nproc) = {per} rows in "
-                f"this process's files, they hold {mine.shape[0]}; pass "
-                "an explicit [k, d] init array instead")
-        mine = _topup_rows(mine, per, rng)
+                f"init='random' needs >= ceil(k/nproc) = {per} rows per "
+                f"process; process(es) {short} hold fewer — pass an "
+                "explicit [k, d] init array instead")
+        rng = np.random.default_rng((0 if seed is None else seed, pid))
+        mine = _topup_rows(fs.sample(per, rng=rng), per, rng)
         gathered = np.asarray(mh.process_allgather(mine)).reshape(-1, d)
         init_c = (gathered[:k] if init == "random" else
                   kmeanspp_init(gathered, k, seed=0 if seed is None else seed))
@@ -843,18 +851,22 @@ def main(argv=None):
     if args.input:
         from harp_tpu.fileformat import list_files
 
-        paths = list_files(args.input)
+        # a literal path wins over glob expansion: 'data[v2].npy' is a
+        # real file, not a character class
+        paths = ([args.input] if os.path.isfile(args.input)
+                 else list_files(args.input))
         if not paths:
             raise SystemExit(f"{args.input}: no input files matched")
         if len(paths) > 1:  # split directory: per-worker file streams
             if args.quantize:
                 raise SystemExit("--quantize is single-source only "
                                  "(the int8 scale pass)")
+            split_info: dict = {}
             c, inertia = fit_streaming_files(
                 paths, args.k, args.iters, args.chunk, dtype=dtype,
                 init=args.init, ckpt_dir=args.ckpt_dir,
-                ckpt_every=args.ckpt_every)
-            n_rows, d_cols = "split", "split"
+                ckpt_every=args.ckpt_every, info=split_info)
+            n_rows, d_cols = split_info["n_total"], split_info["d"]
         else:
             if paths[0].endswith(".npy"):
                 pts = np.load(paths[0], mmap_mode="r")

@@ -76,20 +76,27 @@ def _subtree_sizes(tpl):
 _FN_CACHE: dict = {}
 
 
-def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
+def make_colorful_count_fn(tpl, k, mesh: WorkerMesh,
+                           overflow_algo: str = "segment",
+                           row_tile: int = 512):
     """Compile the color-coding DP:
-    (nbr [n, deg], msk [n, deg], colors [trial_chunk, n]) → [trial_chunk]
-    colorful rooted counts — a chunk of trials per program (vmap over
-    colorings; the driver chunks, see SubgraphConfig.trial_chunk).
+    (nbr [n, deg], msk [n, deg], *overflow, colors [trial_chunk, n]) →
+    [trial_chunk] colorful rooted counts — a chunk of trials per program
+    (vmap over colorings; the driver chunks, see
+    SubgraphConfig.trial_chunk).  ``overflow_algo`` picks the exact tail
+    for past-max_degree adjacency (see SubgraphConfig): "segment" takes
+    the 3 flattened arrays of :func:`_partition_overflow`, "onehot" the
+    4 tiled arrays of :func:`_partition_overflow_tiles`.
 
     Counts maps φ: template→graph with all image colors distinct (hence
     injective), rooted at template vertex 0 — the quantity Harp's DP
     levels accumulate before unbiasing.  Compiled fns are cached per
-    (template, colors, mesh); jit re-specializes per trials count.
+    (template, colors, mesh, overflow formulation); jit re-specializes
+    per trials count.
     """
     # key on the underlying jax Mesh (hashable, identity-stable), not the
     # WorkerMesh wrapper, whose id could be reused after collection
-    cache_key = (tuple(tpl), k, mesh.mesh)
+    cache_key = (tuple(tpl), k, mesh.mesh, overflow_algo, row_tile)
     if cache_key in _FN_CACHE:
         return _FN_CACHE[cache_key]
     s = template_size(tpl)
@@ -97,26 +104,47 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
     sizes = _subtree_sizes(tpl)
     combos = _dp_subset_tables(tpl, k)
     n_subsets = 1 << k
+    n_ovf_args = 3 if overflow_algo == "segment" else 4
 
-    def spmv_gather(full_counts, nbr, msk, o_nbr, o_row, o_msk):
+    def spmv_gather(full_counts, nbr, msk, *ovf):
         # Σ_{u∈N(v)} counts[u, :]: padded CSR for the low-degree mass
-        # (dense gather, MXU-friendly) + an exact segment-sum over the
-        # overflow edge list for entries past max_degree — no adjacency
-        # is ever dropped (round-1 VERDICT weak #4: power-law hubs)
+        # (dense gather, MXU-friendly) + an EXACT tail for entries past
+        # max_degree — no adjacency is ever dropped (round-1 VERDICT
+        # weak #4: power-law hubs)
         g = jnp.take(full_counts, nbr, axis=0)      # [n_loc, deg, S]
         out = (g * msk[:, :, None]).sum(1)
-        og = jnp.take(full_counts, o_nbr, axis=0) * o_msk[:, None]
-        # _partition_overflow emits o_row ascending (padding id 0 first),
-        # so the sorted segment-sum lowering applies — the cheap mitigant
-        # for the v5e ~25 GB/s small-row scatter floor (CLAUDE.md).  If a
-        # TPU profile still shows this tail dominating at graded scale,
-        # the next step is the mfsgd/lda tiled one-hot MXU formulation
-        # (pending: relay outage 2026-07-30, BASELINE.md).
-        return out + jax.ops.segment_sum(og, o_row,
-                                         num_segments=out.shape[0],
-                                         indices_are_sorted=True)
+        if overflow_algo == "segment":
+            o_nbr, o_row, o_msk = ovf
+            og = jnp.take(full_counts, o_nbr, axis=0) * o_msk[:, None]
+            # _partition_overflow emits o_row ascending (padding id 0
+            # first), so the sorted segment-sum lowering applies — the
+            # cheap mitigant for the v5e ~25 GB/s small-row scatter
+            # floor (CLAUDE.md)
+            return out + jax.ops.segment_sum(og, o_row,
+                                             num_segments=out.shape[0],
+                                             indices_are_sorted=True)
+        # "onehot": no scatter at all — each (entry × row-window) tile is
+        # one one-hot MXU matmul into a dynamic-sliced block (the
+        # mfsgd/lda pattern); acc is padded by row_tile so the last
+        # window's slice stays in bounds
+        t_nbr, t_loc, t_msk, t_lo = ovf
+        acc = jnp.concatenate(
+            [out, jnp.zeros((row_tile, out.shape[1]), out.dtype)], 0)
 
-    def one_trial(nbr, msk, o_nbr, o_row, o_msk, colors_shard):
+        def body(a, tile):
+            nb, lc, mk, lo = tile
+            og = jnp.take(full_counts, nb, axis=0) * mk[:, None]  # [TE, S]
+            oh = jax.nn.one_hot(lc, row_tile, dtype=og.dtype)     # [TE, R]
+            contrib = jax.lax.dot_general(  # ohᵀ @ og → [R, S], MXU
+                oh, og, (((0,), (0,)), ((), ())))
+            blk = jax.lax.dynamic_slice_in_dim(a, lo, row_tile, 0)
+            return jax.lax.dynamic_update_slice_in_dim(
+                a, blk + contrib, lo, 0), None
+
+        acc, _ = jax.lax.scan(body, acc, (t_nbr, t_loc, t_msk, t_lo))
+        return acc[: out.shape[0]]
+
+    def one_trial(nbr, msk, ovf, colors_shard):
         base = jnp.zeros((colors_shard.shape[0], n_subsets), jnp.float32)
         singleton = base.at[
             jnp.arange(colors_shard.shape[0]), 1 << colors_shard
@@ -130,8 +158,7 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
             for c in ch[i]:
                 # partner table: child subtree aggregated over neighbors
                 child_full = C.allgather(tables[c])  # Harp allgather step
-                nbr_counts = spmv_gather(child_full, nbr, msk,
-                                         o_nbr, o_row, o_msk)
+                nbr_counts = spmv_gather(child_full, nbr, msk, *ovf)
                 triples = combos(acc_size, sizes[c])
                 S = jnp.asarray([t[0] for t in triples], jnp.int32)
                 S1 = jnp.asarray([t[1] for t in triples], jnp.int32)
@@ -148,20 +175,21 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh):
             rooted = tables[0][:, jnp.asarray(full_cols)].sum(-1)
         return rooted.sum()
 
-    def prog(nbr, msk, o_nbr, o_row, o_msk, colors_shard):
+    def prog(nbr, msk, *rest):
         # colors_shard [trial_chunk, n_loc]: a chunk of trials per program —
         # each dispatch+readback round trip costs ~20–150 ms (1× v5e relay,
         # 2026-07-30, BASELINE.md row 4), so a per-trial host loop would
         # dominate multi-trial estimates; chunking (not all-trials-vmap)
         # bounds the [chunk, n, 2^k] DP tables' HBM footprint
+        ovf, colors_shard = rest[:-1], rest[-1]
         rooted = jax.vmap(
-            lambda cs: one_trial(nbr, msk, o_nbr, o_row, o_msk, cs)
+            lambda cs: one_trial(nbr, msk, ovf, cs)
         )(colors_shard)
         return C.allreduce(rooted)  # [trial_chunk], replicated
 
     fn = jax.jit(mesh.shard_map(
         prog,
-        in_specs=(mesh.spec(0),) * 5 + (mesh.spec(1),),
+        in_specs=(mesh.spec(0),) * (2 + n_ovf_args) + (mesh.spec(1),),
         out_specs=P(),
     ))
     _FN_CACHE[cache_key] = fn
@@ -180,6 +208,25 @@ class SubgraphConfig:
     trial_chunk: int = 8
     max_degree: int = 64     # padded-CSR width
     seed: int = 0
+    # The exact tail for adjacency past max_degree, two formulations
+    # (bitwise-equal keeps per tile/segment ordering aside; tested):
+    # "segment" — sorted segment-sum over the overflow edge list (the
+    # shipped default; v5e scatters small rows at ~25 GB/s, the sorted
+    # lowering is the cheap mitigant);
+    # "onehot"  — the mfsgd/lda pattern: overflow entries grouped into
+    # (entry_tile × row_tile) tiles, each applied as ONE one-hot MXU
+    # matmul into a dynamic-sliced block (trades ~2·TE·R·S flops per
+    # tile for no scatter at all).  Which wins on TPU is the profile
+    # question queued since round 2 (BASELINE.md "Pallas headroom") —
+    # both are resident so the answer is one --overflow-algo flag away.
+    overflow_algo: str = "segment"
+    overflow_row_tile: int = 512    # onehot: rows per tile block
+    overflow_entry_tile: int = 2048  # onehot: max entries per tile
+
+    def __post_init__(self):
+        if self.overflow_algo not in ("segment", "onehot"):
+            raise ValueError(f"overflow_algo must be 'segment' or "
+                             f"'onehot', got {self.overflow_algo!r}")
 
 
 def pad_csr(edges, n_vertices, max_degree):
@@ -235,6 +282,54 @@ def _partition_overflow(overflow, n_pad, nw):
     return o_nbr.reshape(-1), o_row.reshape(-1), o_msk.reshape(-1)
 
 
+def _partition_overflow_tiles(overflow, n_pad, nw, row_tile, entry_tile):
+    """Overflow edges → per-worker (entry × row-window) tiles for the
+    one-hot MXU tail: each tile holds ≤ ``entry_tile`` entries whose
+    LOCAL rows all lie in one ``[lo, lo + row_tile)`` window (entries
+    arrive row-ascending, so tiles are contiguous windows).  Returns
+    ``(t_nbr [nw·NT, TE], t_loc [nw·NT, TE]`` — row offsets within the
+    window, ``row_tile`` for padding (one-hot maps it to a zero row),
+    ``t_msk [nw·NT, TE], t_lo [nw·NT])`` with NT the max per-worker tile
+    count (≥ 1) and TE ≤ entry_tile sublane-rounded to the max fill.
+    """
+    loc = n_pad // nw
+    rows, nbrs = overflow[:, 0], overflow[:, 1]
+    owner = rows // loc if len(rows) else np.zeros(0, np.int64)
+    per_w = []
+    for w in range(nw):
+        idx = np.flatnonzero(owner == w)
+        order = np.argsort(rows[idx], kind="stable")
+        r = (rows[idx][order] - w * loc).astype(np.int64)
+        nb = nbrs[idx][order].astype(np.int32)
+        tiles = []
+        i = 0
+        while i < len(r):
+            lo = int(r[i])
+            j = i
+            while j < len(r) and j - i < entry_tile and r[j] < lo + row_tile:
+                j += 1
+            tiles.append((lo, (r[i:j] - lo).astype(np.int32), nb[i:j]))
+            i = j
+        per_w.append(tiles)
+    NT = max(1, max((len(t) for t in per_w), default=1))
+    max_e = max((len(locs) for tiles in per_w for _, locs, _ in tiles),
+                default=0)
+    TE = min(entry_tile, max(8, -(-max_e // 8) * 8))
+    t_nbr = np.zeros((nw, NT, TE), np.int32)
+    t_loc = np.full((nw, NT, TE), row_tile, np.int32)
+    t_msk = np.zeros((nw, NT, TE), np.float32)
+    t_lo = np.zeros((nw, NT), np.int32)
+    for w, tiles in enumerate(per_w):
+        for t, (lo, locs, nb) in enumerate(tiles):
+            e = len(locs)
+            t_lo[w, t] = lo
+            t_nbr[w, t, :e] = nb
+            t_loc[w, t, :e] = locs
+            t_msk[w, t, :e] = 1.0
+    return (t_nbr.reshape(nw * NT, TE), t_loc.reshape(nw * NT, TE),
+            t_msk.reshape(nw * NT, TE), t_lo.reshape(nw * NT))
+
+
 def _dp_subset_tables(tpl, n_colors):
     """Static DP plan: for each template vertex i (post-order), the list of
     (S, S1, S2) bitmask triples combining the partial at i with a child
@@ -287,9 +382,15 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
 
     nbr_d = mesh.shard_array(nbr, 0)
     msk_d = mesh.shard_array(msk, 0)
-    o_nbr, o_row, o_msk = _partition_overflow(overflow, n_pad, nw)
-    ovf_d = tuple(mesh.shard_array(a, 0) for a in (o_nbr, o_row, o_msk))
-    fn = make_colorful_count_fn(tpl, k, mesh)
+    if cfg.overflow_algo == "onehot":
+        ovf = _partition_overflow_tiles(overflow, n_pad, nw,
+                                        cfg.overflow_row_tile,
+                                        cfg.overflow_entry_tile)
+    else:
+        ovf = _partition_overflow(overflow, n_pad, nw)
+    ovf_d = tuple(mesh.shard_array(a, 0) for a in ovf)
+    fn = make_colorful_count_fn(tpl, k, mesh, cfg.overflow_algo,
+                                cfg.overflow_row_tile)
 
     rng = np.random.default_rng(cfg.seed)
     p_colorful = math.factorial(s) / (s ** s) if k == s else (
@@ -349,7 +450,8 @@ def _count_automorphism_roots(tpl):
 
 
 def benchmark(n_vertices=100_000, avg_degree=16, template="u5-tree",
-              mesh=None, seed=0, max_degree=64, graph="uniform"):
+              mesh=None, seed=0, max_degree=64, graph="uniform",
+              overflow_algo="segment"):
     """Vertices/sec through one color-coding trial (graded config #5a).
 
     ``graph="powerlaw"`` draws edge sources zipf-1.3 (hub-heavy, the
@@ -371,7 +473,8 @@ def benchmark(n_vertices=100_000, avg_degree=16, template="u5-tree",
         ], 1)
     else:
         raise ValueError(f"graph must be 'uniform' or 'powerlaw', got {graph!r}")
-    cfg = SubgraphConfig(template=template, seed=seed, max_degree=max_degree)
+    cfg = SubgraphConfig(template=template, seed=seed, max_degree=max_degree,
+                         overflow_algo=overflow_algo)
     count_template(edges, n_vertices, cfg, mesh)  # warmup: compile + CSR
     t0 = time.perf_counter()
     est, trials, overflow = count_template(edges, n_vertices, cfg, mesh)
@@ -386,6 +489,7 @@ def benchmark(n_vertices=100_000, avg_degree=16, template="u5-tree",
         "template": template,
         "n_vertices": n_vertices,
         "graph": graph,
+        "overflow_algo": overflow_algo,
     }
 
 
@@ -397,9 +501,18 @@ def main(argv=None):
     p.add_argument("--avg-degree", type=int, default=16)
     p.add_argument("--template", default="u5-tree", choices=sorted(TEMPLATES))
     p.add_argument("--max-degree", type=int, default=64)
+    p.add_argument("--graph", choices=["uniform", "powerlaw"],
+                   default="uniform")
+    p.add_argument("--overflow-algo", choices=["segment", "onehot"],
+                   default="segment",
+                   help="exact tail for adjacency past max-degree: "
+                        "sorted segment-sum (default) or tiled one-hot "
+                        "MXU matmuls — same counts, different hardware "
+                        "path (profile on TPU to pick)")
     args = p.parse_args(argv)
     print(benchmark(args.vertices, args.avg_degree, args.template,
-                    max_degree=args.max_degree))
+                    max_degree=args.max_degree, graph=args.graph,
+                    overflow_algo=args.overflow_algo))
 
 
 if __name__ == "__main__":

@@ -34,4 +34,8 @@ echo "== real-ingest 100M×300 (writes+frees a 60 GB f16 npy; host-bound) =="
 python scripts/bench_ingest.py --iters 2 --compare-synthetic \
   | tee -a BENCH_local.jsonl
 
+echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
+python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
+  | tee -a BENCH_local.jsonl
+
 echo "done — update BASELINE.md from BENCH_local.jsonl and COMMIT NOW"

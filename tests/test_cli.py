@@ -56,6 +56,8 @@ def test_dispatch_kmeans_stream_split_glob(capsys, tmp_path):
     assert rc == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["files"] == 3 and np.isfinite(rec["inertia"])
+    # numeric schema even for split input (jsonl consumers do arithmetic)
+    assert rec["n"] == 50 + 70 + 90 and rec["d"] == 4
 
 
 def test_dispatch_svm_libsvm_file(capsys, tmp_path):

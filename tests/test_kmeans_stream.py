@@ -334,6 +334,27 @@ def test_streaming_files_more_workers_than_files(mesh, tmp_path):
     assert np.allclose(c, c0, rtol=1e-3, atol=1e-3)
 
 
+def test_streaming_files_checkpoint_recovery(mesh, tmp_path):
+    """The shared epoch driver's recovery contract holds for the
+    file-split source too: a crash mid-run resumes from the checkpoint
+    (streams rewound by put_chunk(0)) and equals the clean run."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    pts = _blobs(n=1024, d=6)
+    paths = _write_splits(tmp_path, pts, n_files=3, fmt="npy")
+    c0 = pts[:4].copy()
+    clean_c, _, clean_h = KS.fit_streaming_files(
+        paths, k=4, iters=6, chunk_points=256, mesh=mesh, init=c0,
+        return_history=True)
+    ck = str(tmp_path / "ckpt")
+    c, _, h = KS.fit_streaming_files(
+        paths, k=4, iters=6, chunk_points=256, mesh=mesh, init=c0,
+        return_history=True, ckpt_dir=ck, ckpt_every=2,
+        fault=FaultInjector(fail_at=(4,)))
+    np.testing.assert_allclose(c, clean_c, rtol=1e-6)
+    np.testing.assert_allclose(h, clean_h, rtol=1e-6)
+
+
 def test_north_star_1b_program_lowers(mesh):
     """The REAL 1B×300 k=1000 program (3814-chunk scan × fori epochs)
     must trace and lower at its true shapes — proving the north-star

@@ -147,3 +147,61 @@ def test_benchmark_powerlaw_graph(mesh):
     assert ru["overflow_share"] < r1["overflow_share"]
     with pytest.raises(ValueError, match="graph must be"):
         SG.benchmark(n_vertices=100, graph="smallworld", mesh=mesh)
+
+
+def test_overflow_onehot_matches_segment(mesh):
+    """The two exact overflow tails are the same math on different
+    hardware paths: per-trial counts must agree to f32 tolerance on a
+    hub-heavy graph where most adjacency rides the tail."""
+    rng = np.random.default_rng(9)
+    n = 64
+    hub_edges = [(0, i) for i in range(1, n)]       # degree-63 hub
+    hub2 = [(1, i) for i in range(2, 40)]           # second hub
+    rand = [(int(a), int(b)) for a, b in
+            zip(rng.integers(0, n, 120), rng.integers(0, n, 120))]
+    edges = hub_edges + hub2 + rand
+    res = {}
+    for algo in ("segment", "onehot"):
+        cfg = SG.SubgraphConfig(template="u5-tree", n_trials=4, seed=5,
+                                max_degree=4, overflow_algo=algo,
+                                overflow_row_tile=8,
+                                overflow_entry_tile=16)
+        est, trials, ovf = SG.count_template(edges, n, cfg, mesh)
+        assert ovf > 0  # the tail really carries mass
+        res[algo] = trials
+    np.testing.assert_allclose(res["onehot"], res["segment"], rtol=1e-5)
+
+
+def test_overflow_tiles_partitioner_exact():
+    """Host tiling invariants: every overflow entry lands in exactly one
+    tile slot, offsets stay inside the row window, padding is masked."""
+    rng = np.random.default_rng(3)
+    n_pad, nw, row_tile, entry_tile = 32, 4, 8, 4
+    m = 37
+    overflow = np.stack([rng.integers(0, n_pad, m),
+                         rng.integers(0, n_pad, m)], 1).astype(np.int64)
+    t_nbr, t_loc, t_msk, t_lo = SG._partition_overflow_tiles(
+        overflow, n_pad, nw, row_tile, entry_tile)
+    assert (t_msk.sum() == m)                     # every entry, once
+    live = t_msk.reshape(-1) > 0
+    assert (t_loc.reshape(-1)[live] < row_tile).all()
+    assert (t_loc.reshape(-1)[~live] == row_tile).all()  # pad → zero row
+    # reconstruct (local_row, nbr) multiset and compare with the input
+    loc_rows = n_pad // nw
+    NT = t_lo.shape[0] // nw
+    rec = []
+    for wt in range(nw * NT):
+        w = wt // NT
+        for e in range(t_nbr.shape[1]):
+            if t_msk[wt, e] > 0:
+                rec.append((w * loc_rows + t_lo[wt] + t_loc[wt, e],
+                            t_nbr[wt, e]))
+    want = sorted((int(r), int(c)) for r, c in overflow)
+    assert sorted(rec) == want
+
+
+def test_overflow_algo_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="overflow_algo"):
+        SG.SubgraphConfig(overflow_algo="scatter")

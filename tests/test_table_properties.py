@@ -172,6 +172,81 @@ def test_push_rows_sparse_property(mesh, ids, cap):
                                atol=1e-6)
 
 
+def _model_keep_dedup(ids, capacity):
+    """Exact dedup drop rule: per worker, DISTINCT ids request in
+    ASCENDING order (the sort inside _dedup_plan), capacity slots per
+    owner; every token of a kept id is ok.  Returns (token keep mask,
+    total distinct-id drops)."""
+    keep_tok = np.zeros(ids.shape, bool)
+    distinct_drops = 0
+    for w in range(_N):
+        chunk = ids[w * _M:(w + 1) * _M]
+        counts: dict = {}
+        kept = set()
+        for u in np.unique(chunk):          # ascending
+            dest = int(u) // _RPW
+            c = counts.get(dest, 0)
+            if c < capacity:
+                kept.add(int(u))
+            else:
+                distinct_drops += 1
+            counts[dest] = c + 1
+        keep_tok[w * _M:(w + 1) * _M] = [int(x) in kept for x in chunk]
+    return keep_tok, distinct_drops
+
+
+_dedup_cache: dict = {}
+
+
+def _dedup_fns(mesh, capacity):
+    from harp_tpu.table import pull_rows_sparse_dedup, push_rows_sparse_dedup
+
+    if capacity not in _dedup_cache:
+        pull = jax.jit(mesh.shard_map(
+            lambda t, i: pull_rows_sparse_dedup(t, i, capacity=capacity),
+            in_specs=(mesh.spec(0), mesh.spec(0)),
+            out_specs=(mesh.spec(0), mesh.spec(0), P())))
+        push = jax.jit(mesh.shard_map(
+            lambda t, i, dv: push_rows_sparse_dedup(t, i, dv,
+                                                    capacity=capacity),
+            in_specs=(mesh.spec(0),) * 3,
+            out_specs=(mesh.spec(0), P())))
+        _dedup_cache[capacity] = (pull, push)
+    return _dedup_cache[capacity]
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids=ids_st, cap=cap_st, tvals=table_st)
+def test_pull_rows_sparse_dedup_property(mesh, ids, cap, tvals):
+    ids = np.asarray(ids, np.int32)
+    table = np.asarray(tvals, np.float32).reshape(_N * _RPW, _D)
+    pull, _ = _dedup_fns(mesh, cap)
+    rows, ok, dropped = pull(table, ids)
+    keep, distinct_drops = _model_keep_dedup(ids, cap)
+    np.testing.assert_array_equal(np.asarray(ok), keep)
+    assert int(dropped) == distinct_drops   # counted per DISTINCT id
+    rows = np.asarray(rows)
+    np.testing.assert_allclose(rows[keep], table[ids[keep]])
+    np.testing.assert_allclose(rows[~keep], 0.0)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids=ids_st, cap=cap_st)
+def test_push_rows_sparse_dedup_property(mesh, ids, cap):
+    ids = np.asarray(ids, np.int32)
+    table = np.zeros((_N * _RPW, _D), np.float32)
+    # integer deltas: the pre-summed dedup push must be EXACTLY np.add.at
+    deltas = ((np.arange(_N * _M * _D) % 13) - 6).astype(
+        np.float32).reshape(_N * _M, _D)
+    _, push = _dedup_fns(mesh, cap)
+    new_table, dropped = push(table, ids, deltas)
+    keep, distinct_drops = _model_keep_dedup(ids, cap)
+    assert int(dropped) == distinct_drops
+    expect = np.zeros_like(table)
+    np.add.at(expect, ids[keep], deltas[keep])
+    np.testing.assert_array_equal(np.asarray(new_table), expect)
+
+
 # ---------------------------------------------------------------------------
 # Native CSV parser property: the hand-rolled C++ float scanner must
 # round-trip arbitrary f32 values written at full precision, agreeing
