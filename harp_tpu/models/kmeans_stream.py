@@ -160,14 +160,24 @@ def _init_centroids(points, n, k, seed, init):
     return np.asarray(points[idx], np.float32)
 
 
-def _int8_scales(points, n, chunk):
-    """Per-feature |max| over the source in one chunked host pass (a
+def _int8_amax(points, n, chunk):
+    """Per-feature |max| over a source in one chunked host pass (a
     memmap never loads more than one chunk)."""
     amax = np.zeros(points.shape[1], np.float32)
     for lo in range(0, n, chunk):
         blk = np.asarray(points[lo:lo + chunk], np.float32)
         np.maximum(amax, np.abs(blk).max(0), out=amax)
+    return amax
+
+
+def _amax_to_scales(amax):
+    """THE int8 scale rule — one place, so the single-source and
+    sharded-ingest paths can never disagree on it."""
     return np.maximum(amax, 1e-30) / 127.0
+
+
+def _int8_scales(points, n, chunk):
+    return _amax_to_scales(_int8_amax(points, n, chunk))
 
 
 def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
@@ -336,9 +346,10 @@ def _stream_train(mesh, cfg, put_chunk, n_chunks, centroids, iters, dtype,
 
 def fit_streaming_local(points_local, k=1000, iters=10,
                         chunk_points=262_144, mesh: WorkerMesh | None = None,
-                        seed=0, dtype=jnp.float32, init="random",
-                        return_history=False, ckpt_dir=None, ckpt_every=5,
-                        max_restarts=3, fault=None, instrument=None):
+                        seed=0, dtype=jnp.float32, quantize=None,
+                        init="random", return_history=False, ckpt_dir=None,
+                        ckpt_every=5, max_restarts=3, fault=None,
+                        instrument=None):
     """Multi-host blocked-epoch Lloyd where EACH PROCESS streams only its
     own split — Harp's HDFS-split ingest (SURVEY.md §4.2 "load points
     shard"): no host ever reads or materializes the whole dataset, so
@@ -360,8 +371,10 @@ def fit_streaming_local(points_local, k=1000, iters=10,
     ``init``: "random" (each process contributes ⌈k/nproc⌉ seed rows,
     allgathered, first k kept), "kmeans++" (D² seeding on an allgathered
     ≤50k-row subsample, ⌈50k/nproc⌉ per process), or an explicit
-    ``[k, d]`` array.  ``quantize`` is not offered here (the int8 scale
-    pass is a global reduction left to the caller).  Other knobs —
+    ``[k, d]`` array.  ``quantize="int8"`` works across hosts: each
+    process takes the per-feature |max| over ITS split (one chunked
+    pass) and the scales are the allgathered elementwise max — identical
+    to the single-source scales on the same global data.  Other knobs —
     checkpoint/resume, ``instrument`` — behave as in
     :func:`fit_streaming`.
     """
@@ -375,7 +388,8 @@ def fit_streaming_local(points_local, k=1000, iters=10,
     if n_local == 0:
         raise ValueError("every process must hold at least one row "
                          "(this one has an empty split)")
-    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype)
+    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype,
+                       quantize=quantize)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
     from jax.experimental import multihost_utils as mh
@@ -390,6 +404,20 @@ def fit_streaming_local(points_local, k=1000, iters=10,
     cl = max(1, min(-(-cfg.chunk_points // nw), int(npw_all.max())))
     # every process loops the global max chunk count (late ones all-pad)
     n_chunks = int((-(-npw_all // cl)).max())
+    scale_dev = scales = None
+    if quantize == "int8":
+        if cl > _INT8_SUM_ROW_LIMIT:
+            raise ValueError(
+                f"quantize='int8': {cl} chunk rows/worker exceeds the "
+                f"{_INT8_SUM_ROW_LIMIT} exact-int32 accumulation bound — "
+                "use a smaller chunk_points")
+        # global per-feature scales = allgathered max of LOCAL |max|es:
+        # same amax pass + scale rule as the single-source _int8_scales
+        amax = np.asarray(mh.process_allgather(
+            _int8_amax(points_local, n_local, ldev * cl))
+        ).reshape(-1, d).max(0)
+        scales = _amax_to_scales(amax)
+        scale_dev = jax.device_put(jnp.asarray(scales), mesh.replicated())
 
     def local_seed_rows(count, rng_seed):
         """``count`` rows of this split (equal shape on every process for
@@ -432,7 +460,8 @@ def fit_streaming_local(points_local, k=1000, iters=10,
                                mesh.replicated())
 
     def put_chunk(j):
-        blk = np.zeros((ldev * cl, d), np_dtype)
+        asm_dtype = np.float32 if quantize == "int8" else np_dtype
+        blk = np.zeros((ldev * cl, d), asm_dtype)
         msk = np.zeros(ldev * cl, np.float32)
         for w in range(ldev):
             w_end = min((w + 1) * npw, n_local)
@@ -440,8 +469,12 @@ def fit_streaming_local(points_local, k=1000, iters=10,
             hi = min(lo + cl, w_end)
             if hi > lo:
                 blk[w * cl: w * cl + hi - lo] = np.asarray(
-                    points_local[lo:hi]).astype(np_dtype, copy=False)
+                    points_local[lo:hi]).astype(asm_dtype, copy=False)
                 msk[w * cl: w * cl + hi - lo] = 1.0
+        if quantize == "int8":
+            q = np.clip(np.round(blk / scales), -127, 127).astype(np.int8)
+            return ((mesh.shard_array_local(q, nw * cl), scale_dev),
+                    mesh.shard_array_local(msk, nw * cl))
         return (mesh.shard_array_local(blk, nw * cl),
                 mesh.shard_array_local(msk, nw * cl))
 
@@ -859,8 +892,10 @@ def main(argv=None):
             raise SystemExit(f"{args.input}: no input files matched")
         if len(paths) > 1:  # split directory: per-worker file streams
             if args.quantize:
-                raise SystemExit("--quantize is single-source only "
-                                 "(the int8 scale pass)")
+                raise SystemExit(
+                    "--quantize with a split directory is not wired yet "
+                    "(fit_streaming / fit_streaming_local support int8; "
+                    "fit_streaming_files needs the per-file amax pass)")
             split_info: dict = {}
             c, inertia = fit_streaming_files(
                 paths, args.k, args.iters, args.chunk, dtype=dtype,

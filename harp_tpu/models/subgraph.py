@@ -95,8 +95,11 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh,
     per trials count.
     """
     # key on the underlying jax Mesh (hashable, identity-stable), not the
-    # WorkerMesh wrapper, whose id could be reused after collection
-    cache_key = (tuple(tpl), k, mesh.mesh, overflow_algo, row_tile)
+    # WorkerMesh wrapper, whose id could be reused after collection;
+    # row_tile only shapes the onehot trace — keying it under "segment"
+    # would cache duplicate byte-identical programs
+    cache_key = (tuple(tpl), k, mesh.mesh, overflow_algo,
+                 row_tile if overflow_algo == "onehot" else None)
     if cache_key in _FN_CACHE:
         return _FN_CACHE[cache_key]
     s = template_size(tpl)
