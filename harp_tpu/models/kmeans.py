@@ -118,6 +118,25 @@ def _partials_block(points, centroids, c2, mask=None):
 _INT8_SUM_ROW_LIMIT = (1 << 31) // 127
 
 
+def _clip_round_int8(values, scale):
+    """THE host int8 rounding rule — every quantized-points path (device
+    resident, streaming, sharded-ingest, file-split) shares this one
+    expression so the variants can never disagree on it."""
+    return np.clip(np.round(values / scale), -127, 127).astype(np.int8)
+
+
+def _check_int8_chunk_rows(rows_per_worker, limit=None):
+    """The shared exact-int32 accumulation guard for streamed chunks.
+    ``limit`` is passed by callers that resolve the module global at call
+    time (tests shrink it to exercise the guard)."""
+    limit = _INT8_SUM_ROW_LIMIT if limit is None else limit
+    if rows_per_worker > limit:
+        raise ValueError(
+            f"quantize='int8': {rows_per_worker} chunk rows/worker "
+            f"exceeds the {limit} exact-int32 accumulation "
+            "bound — use a smaller chunk_points")
+
+
 def quantize_points_int8(points):
     """Per-feature symmetric int8 quantization: (q int8 [n, d], scale [d]).
 
@@ -127,8 +146,7 @@ def quantize_points_int8(points):
     happens after, in ``fit``."""
     points = np.asarray(points, np.float32)
     scale = np.maximum(np.abs(points).max(0), 1e-30) / 127.0
-    q = np.clip(np.round(points / scale), -127, 127).astype(np.int8)
-    return q, scale.astype(np.float32)
+    return _clip_round_int8(points, scale), scale.astype(np.float32)
 
 
 def _partials_block_int8(pts_q, col_scale, centroids, c2, mask=None):

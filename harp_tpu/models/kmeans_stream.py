@@ -49,6 +49,8 @@ from harp_tpu.utils.timing import device_sync
 
 from harp_tpu.models.kmeans import (  # shared MXU partials formulation
     _INT8_SUM_ROW_LIMIT,
+    _check_int8_chunk_rows,
+    _clip_round_int8,
     _normalize_centroids,
     _partials_block,
     _partials_block_int8,
@@ -230,13 +232,11 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                                mesh.replicated())
     np_dtype = np.dtype(jnp.dtype(dtype).name)
     scale_dev = None
-    if quantize == "int8" and chunk // nw > _INT8_SUM_ROW_LIMIT:
+    if quantize == "int8":
         # same exact-int32 accumulation bound as kmeans.fit — here it
-        # applies PER CHUNK (cross-chunk accumulation is f32)
-        raise ValueError(
-            f"quantize='int8': {chunk // nw} chunk rows/worker exceeds the "
-            f"{_INT8_SUM_ROW_LIMIT} exact-int32 accumulation bound — "
-            "use a smaller chunk_points")
+        # applies PER CHUNK (cross-chunk accumulation is f32); the limit
+        # resolves at call time so tests can shrink it
+        _check_int8_chunk_rows(chunk // nw, _INT8_SUM_ROW_LIMIT)
     if quantize == "int8":
         scales = _int8_scales(points, n, chunk)
         scale_dev = jax.device_put(jnp.asarray(scales), mesh.replicated())
@@ -250,8 +250,7 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
             pad = np.zeros((chunk - (hi - lo), d), blk.dtype)
             blk = np.concatenate([blk, pad], 0)
         if quantize == "int8":
-            q = np.clip(np.round(blk.astype(np.float32) / scales),
-                        -127, 127).astype(np.int8)
+            q = _clip_round_int8(blk.astype(np.float32), scales)
             return ((mesh.shard_array(q, 0), scale_dev),
                     mesh.shard_array(m, 0))
         return (mesh.shard_array(blk.astype(np_dtype, copy=False), 0),
@@ -406,11 +405,7 @@ def fit_streaming_local(points_local, k=1000, iters=10,
     n_chunks = int((-(-npw_all // cl)).max())
     scale_dev = scales = None
     if quantize == "int8":
-        if cl > _INT8_SUM_ROW_LIMIT:
-            raise ValueError(
-                f"quantize='int8': {cl} chunk rows/worker exceeds the "
-                f"{_INT8_SUM_ROW_LIMIT} exact-int32 accumulation bound — "
-                "use a smaller chunk_points")
+        _check_int8_chunk_rows(cl, _INT8_SUM_ROW_LIMIT)
         # global per-feature scales = allgathered max of LOCAL |max|es:
         # same amax pass + scale rule as the single-source _int8_scales
         amax = np.asarray(mh.process_allgather(
@@ -472,7 +467,7 @@ def fit_streaming_local(points_local, k=1000, iters=10,
                     points_local[lo:hi]).astype(asm_dtype, copy=False)
                 msk[w * cl: w * cl + hi - lo] = 1.0
         if quantize == "int8":
-            q = np.clip(np.round(blk / scales), -127, 127).astype(np.int8)
+            q = _clip_round_int8(blk, scales)
             return ((mesh.shard_array_local(q, nw * cl), scale_dev),
                     mesh.shard_array_local(msk, nw * cl))
         return (mesh.shard_array_local(blk, nw * cl),
@@ -488,7 +483,7 @@ def fit_streaming_local(points_local, k=1000, iters=10,
 
 def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
                         mesh: WorkerMesh | None = None, seed=0,
-                        dtype=jnp.float32, init="random",
+                        dtype=jnp.float32, quantize=None, init="random",
                         return_history=False, ckpt_dir=None, ckpt_every=5,
                         max_restarts=3, fault=None, instrument=None,
                         reader_chunk_rows=65_536, info=None):
@@ -504,7 +499,10 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
     for a glob/dir; the list is sorted here for a deterministic
     assignment).  ``info``: pass a dict to receive ``n_total`` / ``d``
     (the CLI reports them; no other way to learn the global row count
-    without a second counting pass).  Semantics are full-batch Lloyd, identical to
+    without a second counting pass).  ``quantize="int8"`` streams int8
+    chunks with the shared scale rule — each process's
+    ``FileSplits.amax`` pass (one extra streaming sweep of its files)
+    feeds the allgathered global max.  Semantics are full-batch Lloyd, identical to
     :func:`fit_streaming` on the same rows (the row ORDER differs —
     worker-major over file assignments — which Lloyd does not see:
     epochs are order-independent given the same init; tested).  Workers
@@ -529,19 +527,20 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
     try:
         return _fit_streaming_files(fs, paths, k, iters, chunk_points,
                                     mesh, nproc, ldev, pid, local_workers,
-                                    seed, dtype, init, return_history,
-                                    ckpt_dir, ckpt_every, max_restarts,
-                                    fault, instrument, info)
+                                    seed, dtype, quantize, init,
+                                    return_history, ckpt_dir, ckpt_every,
+                                    max_restarts, fault, instrument, info)
     finally:
         fs.close()  # also on iters==0 and validation raises: no fd leaks
 
 
 def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
-                         ldev, pid, local_workers, seed, dtype, init,
-                         return_history, ckpt_dir, ckpt_every,
+                         ldev, pid, local_workers, seed, dtype, quantize,
+                         init, return_history, ckpt_dir, ckpt_every,
                          max_restarts, fault, instrument, info=None):
     nw = mesh.num_workers
-    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype)
+    cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype,
+                       quantize=quantize)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
 
     from jax.experimental import multihost_utils as mh
@@ -569,6 +568,16 @@ def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
     n_chunks = int((-(-n_per_worker // cl)).max())
     if info is not None:
         info.update({"n_total": n_total, "d": d})
+    scale_dev = scales = None
+    if quantize == "int8":
+        _check_int8_chunk_rows(cl, _INT8_SUM_ROW_LIMIT)
+        local_amax = fs.amax()
+        if local_amax.shape[0] != d:   # a no-file process: contribute 0s
+            local_amax = np.zeros(d, np.float32)
+        amax = np.asarray(mh.process_allgather(local_amax)
+                          ).reshape(-1, d).max(0)
+        scales = _amax_to_scales(amax)
+        scale_dev = jax.device_put(jnp.asarray(scales), mesh.replicated())
 
     if not isinstance(init, str):
         init_c = _validate_explicit_init(init, k, d)
@@ -603,14 +612,20 @@ def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
     def put_chunk(j):
         if j == 0:  # epoch start: every worker rewinds to its first file
             fs.reset()
-        blk = np.zeros((ldev * cl, d), np_dtype)
+        asm_dtype = np.float32 if quantize == "int8" else np_dtype
+        blk = np.zeros((ldev * cl, d), asm_dtype)
         msk = np.zeros(ldev * cl, np.float32)
         for li, w in enumerate(local_workers):
             rows = fs.next_block(w, cl)
             t = rows.shape[0]
             if t:
-                blk[li * cl: li * cl + t] = rows.astype(np_dtype, copy=False)
+                blk[li * cl: li * cl + t] = rows.astype(asm_dtype,
+                                                        copy=False)
                 msk[li * cl: li * cl + t] = 1.0
+        if quantize == "int8":
+            q = _clip_round_int8(blk, scales)
+            return ((mesh.shard_array_local(q, nw * cl), scale_dev),
+                    mesh.shard_array_local(msk, nw * cl))
         return (mesh.shard_array_local(blk, nw * cl),
                 mesh.shard_array_local(msk, nw * cl))
 
@@ -891,16 +906,12 @@ def main(argv=None):
         if not paths:
             raise SystemExit(f"{args.input}: no input files matched")
         if len(paths) > 1:  # split directory: per-worker file streams
-            if args.quantize:
-                raise SystemExit(
-                    "--quantize with a split directory is not wired yet "
-                    "(fit_streaming / fit_streaming_local support int8; "
-                    "fit_streaming_files needs the per-file amax pass)")
             split_info: dict = {}
             c, inertia = fit_streaming_files(
                 paths, args.k, args.iters, args.chunk, dtype=dtype,
-                init=args.init, ckpt_dir=args.ckpt_dir,
-                ckpt_every=args.ckpt_every, info=split_info)
+                quantize=args.quantize, init=args.init,
+                ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
+                info=split_info)
             n_rows, d_cols = split_info["n_total"], split_info["d"]
         else:
             if paths[0].endswith(".npy"):

@@ -524,6 +524,21 @@ class FileSplits:
         return (np.concatenate(out, 0) if out
                 else np.zeros((0, self.cols), np.float32))
 
+    def amax(self) -> np.ndarray:
+        """Per-feature |max| over ALL of this process's files (one
+        streaming pass in ``chunk_rows`` blocks; rewinds afterwards) —
+        the local half of the int8 scale reduction."""
+        out = np.zeros(self.cols, np.float32)
+        self.reset()
+        for w in self.local_workers:
+            while True:
+                blk = self.next_block(w, self._chunk_rows)
+                if blk.shape[0] == 0:
+                    break
+                np.maximum(out, np.abs(blk).max(0), out=out)
+        self.reset()
+        return out
+
     def close(self) -> None:
         for srcs in self._srcs.values():
             for s in srcs:

@@ -323,6 +323,10 @@ def test_filesplits_blocks_cover_every_row_once(tmp_path):
     assert np.isin(smp[:, 0], pts[:, 0]).all()
     assert fs.sample(100).shape == (23, 3)      # cap at total rows
     assert fs.next_block(0, 4).shape[0] > 0     # cursor still at start
+    # amax(): exact per-feature |max| over every file, cursors rewound
+    fs.reset()
+    np.testing.assert_allclose(fs.amax(), np.abs(pts).max(0), atol=1e-4)
+    assert fs.next_block(0, 4).shape[0] > 0
     fs.close()
 
 
@@ -351,6 +355,22 @@ def test_streaming_files_matches_single_source(mesh, tmp_path):
                                          init=c0)
         assert np.allclose(cg, cf, rtol=1e-3, atol=1e-3), fmt
         assert abs(ig - i_f) < 1e-3 * abs(ig), fmt
+
+
+def test_streaming_files_int8_matches_single_source_int8(mesh, tmp_path):
+    """File splits + int8: the per-file amax pass allgathers to the SAME
+    global scales as the single-source pass, so quantization is
+    identical and the chains agree to f32 partial-sum tolerance."""
+    pts = _blobs(n=1800, d=8)
+    paths = _write_splits(tmp_path, pts, n_files=4, fmt="npy")
+    c0 = pts[:5].copy()
+    cg, ig = KS.fit_streaming(pts, k=5, iters=3, chunk_points=300,
+                              mesh=mesh, init=c0, quantize="int8")
+    cf, i_f = KS.fit_streaming_files(paths, k=5, iters=3,
+                                     chunk_points=300, mesh=mesh,
+                                     init=c0, quantize="int8")
+    assert np.allclose(cg, cf, rtol=1e-3, atol=1e-3)
+    assert abs(ig - i_f) < 1e-3 * abs(ig)
 
 
 def test_streaming_files_more_workers_than_files(mesh, tmp_path):
