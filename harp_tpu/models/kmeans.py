@@ -125,11 +125,11 @@ def _clip_round_int8(values, scale):
     return np.clip(np.round(values / scale), -127, 127).astype(np.int8)
 
 
-def _check_int8_chunk_rows(rows_per_worker, limit=None):
+def _check_int8_chunk_rows(rows_per_worker, limit):
     """The shared exact-int32 accumulation guard for streamed chunks.
-    ``limit`` is passed by callers that resolve the module global at call
-    time (tests shrink it to exercise the guard)."""
-    limit = _INT8_SUM_ROW_LIMIT if limit is None else limit
+    ``limit`` is REQUIRED: callers resolve their module's
+    _INT8_SUM_ROW_LIMIT at call time (tests shrink it to exercise the
+    guard) — a default here would silently bypass that."""
     if rows_per_worker > limit:
         raise ValueError(
             f"quantize='int8': {rows_per_worker} chunk rows/worker "

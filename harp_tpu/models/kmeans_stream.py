@@ -237,7 +237,6 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
         # applies PER CHUNK (cross-chunk accumulation is f32); the limit
         # resolves at call time so tests can shrink it
         _check_int8_chunk_rows(chunk // nw, _INT8_SUM_ROW_LIMIT)
-    if quantize == "int8":
         scales = _int8_scales(points, n, chunk)
         scale_dev = jax.device_put(jnp.asarray(scales), mesh.replicated())
 

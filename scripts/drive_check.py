@@ -1,0 +1,250 @@
+"""End-to-end drive of the PUBLIC harp_tpu API, checked against numpy.
+
+The standing verification recipe (see .claude/skills/verify/SKILL.md):
+imports only the package surface, runs every major subsystem — the
+collective verbs with edge-case shifts/dtypes, Zipf LDA pushpull with
+exact capacity sizing, the real-ingest harness, the sparse capacity
+sweep, power-law subgraph with both overflow tails, the enwiki-1M and
+million-token lowering pins, sharded/file-split/int8 ingest — and
+checks results against straight-line numpy.  Grows a section per round;
+every "DRIVE OK round-N" line must print.
+
+Usage: python scripts/drive_check.py [cpu8|tpu]
+  cpu8 — 8 simulated CPU workers (no hardware needed; the default)
+  tpu  — whatever backend the axon site pin provides (probe the relay
+         with a 45 s timeout first; it can hang — CLAUDE.md)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "cpu8"
+if mode == "cpu8":
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+if mode == "cpu8":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+from harp_tpu import WorkerMesh
+from harp_tpu.parallel import collective as C
+from harp_tpu.parallel.mesh import set_mesh
+
+mesh = WorkerMesh()
+set_mesh(mesh)
+nw = mesh.num_workers
+print(f"mode={mode} backend={jax.default_backend()} workers={nw}")
+
+# 1. iterative program through shard_map + verbs vs numpy straight-line
+x = np.arange(nw * 4, dtype=np.float32).reshape(nw, 4)
+op = C.host_op(mesh, C.allreduce, in_dim=0, out_dim=0)
+got = np.asarray(op(x))
+np.testing.assert_allclose(got, np.tile(x.sum(0), (nw, 1)))
+
+# rotate shift 0 / negative / > nw
+for shift in (0, -1, nw + 1):
+    rot = C.host_op(mesh, lambda t, s=shift, **kw: C.rotate(t, s, **kw),
+                    in_dim=0, out_dim=0)
+    np.testing.assert_allclose(np.asarray(rot(x)),
+                               np.roll(x, shift % nw, axis=0),
+                               err_msg=f"shift={shift}")
+
+# bool through broadcast/reduce (psum promotes bool)
+b = np.zeros(nw, bool)
+b[0] = True
+bc = C.host_op(mesh, C.broadcast, in_dim=0, out_dim=0)
+assert np.asarray(bc(b)).any()
+
+# regroup divisibility: rows % nw != 0 must raise, not corrupt
+try:
+    bad = C.host_op(mesh, C.regroup, in_dim=0, out_dim=0)
+    bad(np.zeros((nw, 3), np.float32)) if nw > 3 else None
+    if nw > 3:
+        raise SystemExit("regroup divisibility did not raise")
+except ValueError:
+    pass
+except Exception as e:  # XLA's own divisibility error is fine too
+    assert "divisible" in str(e) or "divide" in str(e), e
+
+# 2. round-3: LDA pushpull dedup + exact cap sizing on a Zipf corpus
+from harp_tpu.models.lda import LDA, LDAConfig
+
+rng = np.random.default_rng(0)
+n_docs, vocab, tpd = 8 * nw, 128, 16
+d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tpd)
+w_ids = ((rng.zipf(1.1, size=n_docs * tpd) - 1) % vocab).astype(np.int32)
+model = LDA(n_docs, vocab, LDAConfig(n_topics=4, algo="pushpull", chunk=32),
+            mesh, seed=0)
+model.set_tokens(d_ids, w_ids)
+cap = model.suggest_pull_cap(apply=True)
+assert 1 <= cap <= 32, cap
+model.sample_epoch()
+assert model.last_dropped == 0, model.last_dropped
+assert np.asarray(model.Ndk).sum() == model.n_tokens
+print(f"lda pushpull dedup: cap={cap}, 0 drops, counts exact")
+
+# 3. round-3: real-ingest harness on a disk npy
+import tempfile
+
+from harp_tpu.models.kmeans_stream import benchmark_ingest
+
+tmp = tempfile.mkdtemp()
+pts = rng.normal(size=(4096, 16)).astype(np.float16)
+np.save(os.path.join(tmp, "p.npy"), pts)
+mm = np.load(os.path.join(tmp, "p.npy"), mmap_mode="r")
+r = benchmark_ingest(mm, k=8, iters=2, chunk_points=1024, mesh=mesh,
+                     disk_bytes=os.path.getsize(os.path.join(tmp, "p.npy")))
+assert r["points_per_sec"] > 0 and 0 < r["overlap_efficiency"] <= 1
+assert r["host_sec_per_epoch"] <= r["epoch_sec"]
+print(f"ingest: {r['points_per_sec']:.0f} pts/s, "
+      f"host {r['host_gb_per_sec']:.2f} GB/s, "
+      f"overlap {r['overlap_efficiency']:.2f}")
+
+# 4. round-3: capacity sweep contract under skew
+from harp_tpu import benchmark as B
+
+recs = list(B.sweep_sparse_capacity(mesh, m=256, d=8, reps=1,
+                                    caps=(1 / 4, 1.0)))
+by = {}
+for rec in recs:
+    by.setdefault(rec["dist"], []).append(rec)
+assert by["zipf_dedup"][0]["drop_rate"] <= by["zipf"][0]["drop_rate"]
+assert all(rows[-1]["drop_rate"] == 0.0 for rows in by.values())
+print("sparse capacity sweep: dedup<=raw, full cap never drops")
+
+# 5. round-3: subgraph power-law graph, exact overflow
+from harp_tpu.models.subgraph import benchmark as sg_bench
+
+sg = sg_bench(n_vertices=1000, avg_degree=4, template="u3-path",
+              max_degree=4, graph="powerlaw", mesh=mesh)
+assert sg["dropped_edges"] == 0 and sg["overflow_share"] > 0
+print(f"subgraph powerlaw: overflow {sg['overflow_share']:.0%}, 0 dropped")
+
+# 6. round-3: enwiki shape model + lowering of the true-shape program
+from harp_tpu.models import lda as L
+
+cfg = L.LDAConfig(n_topics=64, algo="pushpull", ndk_dtype="int16")
+shapes = L.epoch_arg_shapes(nw, 10_000, 2_000, cfg, n_tokens=200_000)
+sds = [jax.ShapeDtypeStruct(s, dt, sharding=(mesh.replicated() if i == 2
+                                             else mesh.sharding(mesh.spec(0))))
+       for i, (s, dt) in enumerate(shapes)]
+text = L.make_multi_epoch_fn(mesh, cfg, 2_000, epochs=2).lower(*sds).as_text()
+assert "while" in text and "xi16" in text
+print("epoch_arg_shapes lowering: ok")
+
+print(f"DRIVE OK ({mode})")
+
+# 7. public dedup verbs: one slot per distinct id, contract parity
+from harp_tpu.table import pull_rows_sparse_dedup, push_rows_sparse_dedup
+
+tb = np.arange(nw * 4 * 2, dtype=np.float32).reshape(nw * 4, 2)
+hot = np.zeros(nw * 6, np.int32)  # every worker: 6 copies of row 0
+
+def ddprog(t, i):
+    rows, ok, drop = pull_rows_sparse_dedup(t, i, capacity=1)
+    t2, pdrop = push_rows_sparse_dedup(
+        t, i, jnp.ones((i.shape[0], 2), jnp.float32), capacity=1)
+    return rows, ok, drop, t2, pdrop
+
+dd = jax.jit(mesh.shard_map(
+    ddprog, in_specs=(mesh.spec(0),) * 2,
+    out_specs=(mesh.spec(0), mesh.spec(0), None, mesh.spec(0), None)))
+try:
+    rows, ok, drop, t2, pdrop = dd(tb, hot)
+except Exception:
+    from jax.sharding import PartitionSpec as PS
+    dd = jax.jit(mesh.shard_map(
+        ddprog, in_specs=(mesh.spec(0),) * 2,
+        out_specs=(mesh.spec(0), mesh.spec(0), PS(), mesh.spec(0), PS())))
+    rows, ok, drop, t2, pdrop = dd(tb, hot)
+assert int(drop) == 0 and int(pdrop) == 0 and np.asarray(ok).all()
+np.testing.assert_allclose(np.asarray(rows), np.tile(tb[0], (nw * 6, 1)))
+exp = tb.copy(); exp[0] += 6 * nw  # 6 dups pre-summed, pushed by nw workers
+np.testing.assert_allclose(np.asarray(t2), exp)
+print("dedup verbs: cap=1 serves the hot row, push pre-sum exact")
+print(f"DRIVE OK round-2 ({mode})")
+
+# 8. sharded ingest: fit_streaming_local ≡ fit_streaming (explicit init)
+from harp_tpu.models.kmeans_stream import fit_streaming, fit_streaming_local
+
+pl = rng.normal(size=(3000, 12)).astype(np.float32) \
+    + (np.arange(3000)[:, None] % 3) * 6
+c0 = pl[:6].copy()
+cg, ig = fit_streaming(pl, k=6, iters=4, chunk_points=400, mesh=mesh, init=c0)
+cl_, il_ = fit_streaming_local(pl, k=6, iters=4, chunk_points=400,
+                               mesh=mesh, init=c0)
+assert np.allclose(cg, cl_, rtol=1e-4, atol=1e-4)
+print(f"sharded ingest: local≡global, inertia {ig:.1f} vs {il_:.1f}")
+print(f"DRIVE OK round-3 ({mode})")
+
+# 9. file-split ingest: directory of splits, per-worker file streams
+import glob as _glob
+
+from harp_tpu.models.kmeans_stream import fit_streaming_files
+
+sdir = tempfile.mkdtemp()
+fpts = rng.normal(size=(900, 10)).astype(np.float32) \
+    + (np.arange(900)[:, None] % 3) * 7
+for i in range(4):
+    np.savetxt(os.path.join(sdir, f"part_{i}.csv"),
+               fpts[i * 225:(i + 1) * 225], fmt="%.5f", delimiter=",")
+c0f = fpts[:5].copy()
+cf, inf = fit_streaming_files(sorted(_glob.glob(os.path.join(sdir, "*.csv"))),
+                              k=5, iters=3, chunk_points=200, mesh=mesh,
+                              init=c0f)
+cg2, ig2 = fit_streaming(fpts, k=5, iters=3, chunk_points=200, mesh=mesh,
+                         init=c0f)
+assert np.allclose(cf, cg2, rtol=1e-3, atol=1e-3)
+print(f"file-split ingest: 4 csv splits ≡ single source ({inf:.1f})")
+print(f"DRIVE OK round-4 ({mode})")
+
+# 10. subgraph overflow: both exact tails agree through the public API
+from harp_tpu.models import subgraph as SG
+
+hub_edges = [(0, i) for i in range(1, 48)] + \
+    [(int(a), int(b)) for a, b in zip(rng.integers(0, 48, 80),
+                                      rng.integers(0, 48, 80))]
+trials = {}
+for algo in ("segment", "onehot"):
+    cfgs = SG.SubgraphConfig(template="u3-path", n_trials=3, seed=2,
+                             max_degree=4, overflow_algo=algo,
+                             overflow_row_tile=8, overflow_entry_tile=16)
+    est, tr, ovf = SG.count_template(hub_edges, 48, cfgs, mesh)
+    assert ovf > 0
+    trials[algo] = tr
+np.testing.assert_allclose(trials["onehot"], trials["segment"], rtol=1e-5)
+print("subgraph overflow: onehot ≡ segment on a hub graph")
+print(f"DRIVE OK round-5 ({mode})")
+
+# 11. int8 sharded ingest + million-token attention lowering
+from harp_tpu.models.kmeans_stream import fit_streaming_local as fsl
+
+cq, iq = fsl(pl, k=6, iters=3, chunk_points=400, mesh=mesh, init=c0,
+             quantize="int8")
+assert np.isfinite(iq)
+from harp_tpu.ops.ring_attention import make_ring_attention_fn as mra
+
+sh_att = mesh.sharding(mesh.spec(1, ndim=4))
+sds_att = [jax.ShapeDtypeStruct((1, 1_048_576, 8, 128), jnp.bfloat16,
+                                sharding=sh_att) for _ in range(3)]
+t_att = mra(mesh, causal=True).lower(*sds_att).as_text()
+assert "collective_permute" in t_att and "131072" in t_att
+print("int8 sharded ingest + 1M-token ring attention lowering: ok")
+print(f"DRIVE OK round-6 ({mode})")
+
+# 12. int8 file-split ingest through the CLI surface
+from harp_tpu.models.kmeans_stream import fit_streaming_files as fsf
+
+cq2, iq2 = fsf(sorted(_glob.glob(os.path.join(sdir, "*.csv"))), k=5,
+               iters=2, chunk_points=200, mesh=mesh, init=c0f,
+               quantize="int8")
+assert np.isfinite(iq2)
+print(f"int8 file-split ingest: ok ({iq2:.1f})")
+print(f"DRIVE OK round-7 ({mode})")
