@@ -248,3 +248,29 @@ cq2, iq2 = fsf(sorted(_glob.glob(os.path.join(sdir, "*.csv"))), k=5,
 assert np.isfinite(iq2)
 print(f"int8 file-split ingest: ok ({iq2:.1f})")
 print(f"DRIVE OK round-7 ({mode})")
+
+# 13. roofline annotation math (this session: default-precision bf16 peak
+# + fused-kernel kmeans byte model, driven against hand-computed numpy)
+from harp_tpu.utils.roofline import V5E_PEAKS, annotate
+
+rec = {"n": 1_000_000, "d": 300, "k": 100, "iters_per_sec": 400.0,
+       "quantize": None, "num_workers": 1}
+ann = annotate("kmeans", rec)
+flops_s = 4.0 * rec["n"] * rec["d"] * rec["k"] * rec["iters_per_sec"]
+bytes_s = (rec["n"] * rec["d"] * 4 + 4.0 * rec["n"]) * rec["iters_per_sec"]
+np.testing.assert_allclose(ann["achieved_tflops"], round(flops_s / 1e12, 3))
+np.testing.assert_allclose(ann["achieved_gbs"], round(bytes_s / 1e9, 2))
+assert ann["roofline_peak"] == "bf16_flops"  # default-precision matmuls
+np.testing.assert_allclose(
+    ann["pct_peak_flops"],
+    round(100.0 * flops_s / V5E_PEAKS["bf16_flops"], 2))
+# the silicon fact that forced the fix: 131 TF/s measured ex-gen on
+# kmeans_stream must be REPRESENTABLE (< 100% of the chosen peak)
+fast = annotate("kmeans_stream", {"n": 99_876_864, "d": 300, "k": 1000,
+                                  "iters_per_sec": 0.53,
+                                  "iters_per_sec_ex_gen": 1.0934,
+                                  "quantize": None, "num_workers": 1})
+assert fast["pct_peak_flops"] < 100.0, fast
+assert fast["bound"] == "compute"
+print("roofline: bf16 peak + fused byte model vs numpy: ok")
+print(f"DRIVE OK round-8 ({mode})")
