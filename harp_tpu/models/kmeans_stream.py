@@ -95,7 +95,11 @@ def _make_accum_fn(mesh: WorkerMesh, cfg: StreamConfig):
             s, c, i = _partials_block_int8(pts_q, col_scale, centroids, c2,
                                            mask=mask)
         else:
-            s, c, i = _partials_block(pts, centroids, c2, mask=mask)
+            # chunks may arrive in a narrow wire dtype (f16 disk data
+            # ships as f16 — half the H2D bytes); the widening cast is
+            # exact, so this is bit-identical to casting on the host
+            s, c, i = _partials_block(pts.astype(cfg.dtype), centroids,
+                                      c2, mask=mask)
         return sums + s[None], counts + c[None], inertia + i[None]
 
     pts_spec = ((mesh.spec(0), P()) if cfg.quantize == "int8"
@@ -182,12 +186,63 @@ def _int8_scales(points, n, chunk):
     return _amax_to_scales(_int8_amax(points, n, chunk))
 
 
+# wire-dtype codes for the cross-process agreement allgather (0 = "ship
+# the compute dtype"); only narrow FLOAT formats are worth a code — int
+# sources upcast host-side as before
+_WIRE_CODES = {"float16": 1, "bfloat16": 2}
+_WIRE_FROM_CODE = {1: "float16", 2: "bfloat16"}
+
+
+def _resolve_wire_dtype(wire, np_dtype, src_dtype):
+    """H2D payload dtype for the float chunk-streaming paths.
+
+    ``wire="auto"`` (the default) ships the SOURCE dtype when it is a
+    narrower float than the compute dtype — f16 disk data crosses
+    host→device as f16 and widens on device, which is bit-identical to
+    the host-side cast (widening is exact) at half the transfer bytes;
+    the relay/PCIe link is the streaming bottleneck, not HBM
+    (BASELINE.md real-ingest rows).  Anything else — f32 sources, int
+    sources, mixed-file sets (``src_dtype=None``) — ships the compute
+    dtype unchanged.  An explicit dtype forces the wire format;
+    narrower than the source is a LOSSY opt-in compression (e.g.
+    ``wire_dtype=jnp.bfloat16`` on f32 data).  ``wire=None`` restores
+    the legacy ship-compute-dtype behavior.
+
+    Multi-host: every process must resolve the SAME wire dtype or the
+    per-host chunk programs compile differently and the job deadlocks —
+    "auto" allgathers a dtype code and falls back to the compute dtype
+    unless all processes agree.
+    """
+    if wire is None:
+        return np_dtype
+    if isinstance(wire, str) and wire == "auto":
+        name = np.dtype(src_dtype).name if src_dtype is not None else None
+        code = _WIRE_CODES.get(name, 0)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils as mh
+
+            codes = np.atleast_1d(np.asarray(
+                mh.process_allgather(np.int64(code))))
+            code = int(codes[0]) if (codes == codes[0]).all() else 0
+        wire_np = (np.dtype(_WIRE_FROM_CODE[code]) if code else np_dtype)
+        return wire_np if wire_np.itemsize < np_dtype.itemsize else np_dtype
+    w = np.dtype(jnp.dtype(wire).name)
+    if w.name not in ("float16", "bfloat16", "float32", "float64"):
+        raise ValueError(f"wire_dtype must be a float dtype, got {w.name}")
+    return w
+
+
 def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
                   mesh: WorkerMesh | None = None, seed=0,
                   dtype=jnp.float32, quantize=None, init="random",
                   return_history=False, ckpt_dir=None, ckpt_every=5,
-                  max_restarts=3, fault=None, instrument=None):
+                  max_restarts=3, fault=None, instrument=None,
+                  wire_dtype="auto"):
     """Blocked-epoch Lloyd over a source too large for HBM.
+
+    ``wire_dtype``: H2D payload format (:func:`_resolve_wire_dtype`) —
+    "auto" ships narrow-float sources (f16 disk) in their own dtype and
+    widens on device: bit-identical results, half the transfer bytes.
 
     ``points``: [n, d] numpy array, ``np.memmap``, or any sequential
     source honoring the slice contract (``harp_tpu.native.CSVPoints``).
@@ -231,6 +286,8 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
     centroids = jax.device_put(jnp.asarray(init_c, dtype=dtype),
                                mesh.replicated())
     np_dtype = np.dtype(jnp.dtype(dtype).name)
+    wire_np = _resolve_wire_dtype(wire_dtype, np_dtype,
+                                  getattr(points, "dtype", None))
     scale_dev = None
     if quantize == "int8":
         # same exact-int32 accumulation bound as kmeans.fit — here it
@@ -252,7 +309,7 @@ def fit_streaming(points, k=1000, iters=10, chunk_points=262_144,
             q = _clip_round_int8(blk.astype(np.float32), scales)
             return ((mesh.shard_array(q, 0), scale_dev),
                     mesh.shard_array(m, 0))
-        return (mesh.shard_array(blk.astype(np_dtype, copy=False), 0),
+        return (mesh.shard_array(blk.astype(wire_np, copy=False), 0),
                 mesh.shard_array(m, 0))
 
     if iters == 0:  # same contract as kmeans.fit(iters=0)
@@ -347,7 +404,7 @@ def fit_streaming_local(points_local, k=1000, iters=10,
                         seed=0, dtype=jnp.float32, quantize=None,
                         init="random", return_history=False, ckpt_dir=None,
                         ckpt_every=5, max_restarts=3, fault=None,
-                        instrument=None):
+                        instrument=None, wire_dtype="auto"):
     """Multi-host blocked-epoch Lloyd where EACH PROCESS streams only its
     own split — Harp's HDFS-split ingest (SURVEY.md §4.2 "load points
     shard"): no host ever reads or materializes the whole dataset, so
@@ -389,6 +446,10 @@ def fit_streaming_local(points_local, k=1000, iters=10,
     cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype,
                        quantize=quantize)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
+    # resolved BEFORE any other collective: "auto" allgathers a dtype
+    # code, and collective order must match across processes
+    wire_np = _resolve_wire_dtype(wire_dtype, np_dtype,
+                                  getattr(points_local, "dtype", None))
 
     from jax.experimental import multihost_utils as mh
 
@@ -454,7 +515,7 @@ def fit_streaming_local(points_local, k=1000, iters=10,
                                mesh.replicated())
 
     def put_chunk(j):
-        asm_dtype = np.float32 if quantize == "int8" else np_dtype
+        asm_dtype = np.float32 if quantize == "int8" else wire_np
         blk = np.zeros((ldev * cl, d), asm_dtype)
         msk = np.zeros(ldev * cl, np.float32)
         for w in range(ldev):
@@ -485,7 +546,8 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
                         dtype=jnp.float32, quantize=None, init="random",
                         return_history=False, ckpt_dir=None, ckpt_every=5,
                         max_restarts=3, fault=None, instrument=None,
-                        reader_chunk_rows=65_536, info=None):
+                        reader_chunk_rows=65_536, info=None,
+                        wire_dtype="auto"):
     """Blocked-epoch Lloyd over a DIRECTORY of file splits — Harp's real
     input shape (SURVEY.md §4.2): files are dealt to workers by the
     size-balanced ``multi_file_splits`` rule and each worker streams
@@ -528,7 +590,8 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
                                     mesh, nproc, ldev, pid, local_workers,
                                     seed, dtype, quantize, init,
                                     return_history, ckpt_dir, ckpt_every,
-                                    max_restarts, fault, instrument, info)
+                                    max_restarts, fault, instrument, info,
+                                    wire_dtype)
     finally:
         fs.close()  # also on iters==0 and validation raises: no fd leaks
 
@@ -536,11 +599,14 @@ def fit_streaming_files(paths, k=1000, iters=10, chunk_points=262_144,
 def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
                          ldev, pid, local_workers, seed, dtype, quantize,
                          init, return_history, ckpt_dir, ckpt_every,
-                         max_restarts, fault, instrument, info=None):
+                         max_restarts, fault, instrument, info=None,
+                         wire_dtype="auto"):
     nw = mesh.num_workers
     cfg = StreamConfig(k=k, chunk_points=chunk_points, dtype=dtype,
                        quantize=quantize)
     np_dtype = np.dtype(jnp.dtype(dtype).name)
+    # before the other allgathers: collective order must match per-process
+    wire_np = _resolve_wire_dtype(wire_dtype, np_dtype, fs.dtype)
 
     from jax.experimental import multihost_utils as mh
 
@@ -611,7 +677,7 @@ def _fit_streaming_files(fs, paths, k, iters, chunk_points, mesh, nproc,
     def put_chunk(j):
         if j == 0:  # epoch start: every worker rewinds to its first file
             fs.reset()
-        asm_dtype = np.float32 if quantize == "int8" else np_dtype
+        asm_dtype = np.float32 if quantize == "int8" else wire_np
         blk = np.zeros((ldev * cl, d), asm_dtype)
         msk = np.zeros(ldev * cl, np.float32)
         for li, w in enumerate(local_workers):
@@ -793,7 +859,8 @@ def _ex_gen_fields(dt: float, gen_dt: float, iters: int) -> dict:
 
 def benchmark_ingest(points, k=1000, iters=2, chunk_points=262_144,
                      mesh=None, dtype=jnp.float32, quantize=None, seed=0,
-                     disk_bytes=None, compare_synthetic=False):
+                     disk_bytes=None, compare_synthetic=False,
+                     wire_dtype="auto"):
     """End-to-end rate of :func:`fit_streaming` on a REAL disk source —
     the honest half of the 1B-point story (SURVEY.md §1 north-star, §4.2
     "load points shard" phase).  :func:`benchmark_streaming` measures the
@@ -830,12 +897,15 @@ def benchmark_ingest(points, k=1000, iters=2, chunk_points=262_144,
     """
     mesh = mesh or current_mesh()
     n, d = points.shape
+    np_dtype = np.dtype(jnp.dtype(dtype).name)
+    wire_np = _resolve_wire_dtype(wire_dtype, np_dtype,
+                                  getattr(points, "dtype", None))
     inst: dict = {}
     t0 = time.perf_counter()
     _, inertia = fit_streaming(points, k=k, iters=iters,
                                chunk_points=chunk_points, mesh=mesh,
                                seed=seed, dtype=dtype, quantize=quantize,
-                               instrument=inst)
+                               instrument=inst, wire_dtype=wire_dtype)
     wall = time.perf_counter() - t0
     eps = inst["epochs"]
     host = sum(e["host_s"] for e in eps) / len(eps)
@@ -856,6 +926,12 @@ def benchmark_ingest(points, k=1000, iters=2, chunk_points=262_144,
         "inertia": float(inertia),
         "n": n, "d": d, "k": k, "iters": iters,
         "chunk_points": chunk_points, "quantize": quantize,
+        # the H2D payload format + bytes actually crossing the link per
+        # epoch ("int8" when quantized): the wire, not the disk, is the
+        # relay/PCIe-bound half of the pipeline
+        "wire_dtype": "int8" if quantize == "int8" else wire_np.name,
+        "wire_gb_per_epoch": n * d * (1 if quantize == "int8"
+                                      else wire_np.itemsize) / 1e9,
         "num_workers": mesh.num_workers,
         "source": type(points).__name__,
     }
@@ -887,6 +963,15 @@ def main(argv=None):
                         "only its own (the HDFS-split input shape) — "
                         "instead of the device-synthetic benchmark")
     p.add_argument("--quantize", choices=["int8"], default=None)
+    p.add_argument("--wire-dtype", default="auto",
+                   choices=["auto", "none", "float16", "bfloat16",
+                            "float32"],
+                   help="H2D payload format for --input streaming: auto "
+                        "ships narrow-float sources as-is (f16 disk → "
+                        "half the transfer bytes, bit-identical); "
+                        "none = legacy ship-compute-dtype; an explicit "
+                        "dtype forces the wire (narrower than the "
+                        "source is lossy, opt-in)")
     p.add_argument("--init", choices=["random", "kmeans++"], default="random")
     p.add_argument("--ckpt-dir", default=None,
                    help="checkpoint/resume for long runs (rerunning with "
@@ -894,6 +979,8 @@ def main(argv=None):
     p.add_argument("--ckpt-every", type=int, default=5)
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    wire = {"auto": "auto", "none": None}.get(args.wire_dtype,
+                                              args.wire_dtype)
 
     if args.input:
         from harp_tpu.fileformat import list_files
@@ -910,7 +997,7 @@ def main(argv=None):
                 paths, args.k, args.iters, args.chunk, dtype=dtype,
                 quantize=args.quantize, init=args.init,
                 ckpt_dir=args.ckpt_dir, ckpt_every=args.ckpt_every,
-                info=split_info)
+                info=split_info, wire_dtype=wire)
             n_rows, d_cols = split_info["n_total"], split_info["d"]
         else:
             if paths[0].endswith(".npy"):
@@ -923,7 +1010,8 @@ def main(argv=None):
                                        dtype=dtype, quantize=args.quantize,
                                        init=args.init,
                                        ckpt_dir=args.ckpt_dir,
-                                       ckpt_every=args.ckpt_every)
+                                       ckpt_every=args.ckpt_every,
+                                       wire_dtype=wire)
             n_rows, d_cols = int(pts.shape[0]), int(pts.shape[1])
         # JSON, not dict repr: measure_on_relay.sh tees this into a .jsonl
         print(json.dumps({"k": args.k, "iters": args.iters,

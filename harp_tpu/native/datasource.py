@@ -456,6 +456,17 @@ class FileSplits:
         self.cols = next(iter(cols)) if cols else 0
         self._pos = {w: [0, 0] for w in self.local_workers}  # [src, row]
 
+    @property
+    def dtype(self):
+        """Common source dtype of this process's files, or None when they
+        mix (or it owns none) — feeds the streaming wire-dtype choice
+        (kmeans_stream._resolve_wire_dtype): a uniform f16 file set may
+        ship f16 over H2D; a mixed set must not.  CSV sources parse to
+        float32 and count as such."""
+        names = {np.dtype(getattr(s, "dtype", np.float32)).name
+                 for srcs in self._srcs.values() for s in srcs}
+        return np.dtype(next(iter(names))) if len(names) == 1 else None
+
     def rows(self, w: int) -> int:
         return int(sum(s.shape[0] for s in self._srcs[w]))
 

@@ -138,3 +138,110 @@ def kmeans_partials(points, centroids, *, interpret: bool = False):
         interpret=interpret,
     )(points, cpad)
     return sums[:k], counts[:k, 0], inertia[0, 0]
+
+
+def _kernel_int8(pts_ref, cq_ref, cscale_ref, c2_ref, sums_ref, counts_ref,
+                 best_ref, *, k: int):
+    """int8-points twin of :func:`_kernel` (round 3).
+
+    Same centroid-major single-pass layout; the point stream is int8 in
+    HBM (¼ the f32 bytes — the measured wall of the XLA int8 path is the
+    [n, k] intermediates it materializes, ~2 GB/iter at 1M×300 k=100,
+    which this kernel never writes).  Operands are cast int8→bf16 in
+    VMEM: |q| ≤ 127 is EXACT in bf16, products ≤ 127² and row sums
+    ≤ 127²·d < 2²⁴ are exact in the f32 MXU accumulator, so the dots and
+    one-hot sums equal the XLA path's int32 matmuls bit-for-bit; sums
+    accumulate across tiles as int32 (per-tile values ≤ 127·tn < 2²⁴
+    round-trip f32→int32 exactly).
+
+    Score/assignment math matches ``kmeans._partials_block_int8``:
+    ``scores = ||c||² − 2·(q·c_q)·c_scale`` with the same per-row
+    centroid requantization — assignments are identical by construction.
+    ``Σ‖x‖²`` is NOT computed here: it is iteration-invariant, so the
+    caller hoists it out of the Lloyd loop (the XLA path re-reads the
+    whole point stream for it every iteration).
+    """
+    kp = cq_ref.shape[0]
+    qb = pts_ref[:].astype(jnp.bfloat16)               # [tn, d], exact
+    cb = cq_ref[:].astype(jnp.bfloat16)                # [kp, d], exact
+    dots_q = jax.lax.dot_general(
+        cb, qb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [kp, tn], exact ints
+    dots = dots_q * cscale_ref[:]                      # [kp, 1] broadcast
+    row = jax.lax.broadcasted_iota(jnp.int32, dots.shape, 0)
+    scores = jnp.where(row >= k, jnp.inf, c2_ref[:] - 2.0 * dots)
+
+    best = scores.min(axis=0, keepdims=True)           # [1, tn]
+    assign = jnp.where(scores == best, row, kp).min(axis=0, keepdims=True)
+    onehot = (row == assign).astype(jnp.bfloat16)      # [kp, tn] 0/1
+
+    tile_sums = jax.lax.dot_general(
+        onehot, qb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [kp, d], exact ints
+    tile_counts = onehot.astype(jnp.float32).sum(axis=1, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        best_ref[:] = jnp.zeros_like(best_ref)
+
+    sums_ref[:] += tile_sums.astype(jnp.int32)
+    counts_ref[:] += tile_counts.astype(jnp.int32)
+    best_ref[:] += best.sum().reshape(1, 1)
+
+
+def kmeans_partials_int8(pts_q, c_q, c_scale, c2, col_scale, *,
+                         interpret: bool = False):
+    """Fused int8 per-shard partials → (sums [k, d] f32, counts [k] f32,
+    best_sum f32 scalar).
+
+    ``pts_q`` [n, d] int8 with per-feature ``col_scale`` [d]; ``c_q`` /
+    ``c_scale`` [k, d] int8 / [k] from the shared per-row centroid
+    requantization (``kmeans._quantize_centroids``); ``c2`` [k] the
+    ORIGINAL-space ‖c‖².  Returns dequantized sums (int32 accumulation ×
+    col_scale) and the Σ over points of the assigned score;
+    ``inertia = best_sum + Σ‖x‖²`` where the caller supplies the
+    iteration-invariant second term.  int32 exactness bound: a cluster
+    may absorb at most 2³¹/127 ≈ 16.9M local rows (same rule as the XLA
+    path's ``_INT8_SUM_ROW_LIMIT``)."""
+    n, d = pts_q.shape
+    k = c_q.shape[0]
+    tn = _tile_rows(n)
+    if tn is None:
+        raise ValueError(f"no supported tile size divides n={n}")
+    if 127 * 127 * d >= 1 << 24:  # d ≤ 1040
+        # beyond this the bf16-operand dot's f32 partial sums exceed the
+        # 2²⁴ exact-integer range and the bit-for-bit promise vs the XLA
+        # int32 path silently breaks — refuse loudly, like the row limit
+        raise ValueError(
+            f"fused int8 kernel: d={d} exceeds the exact-f32-accumulation "
+            f"bound (127²·d < 2²⁴ ⇒ d ≤ 1040); use the XLA int8 path")
+    kp = -(-k // _LANE) * _LANE
+    cq_pad = jnp.pad(c_q, ((0, kp - k), (0, 0)))
+    cs_pad = jnp.pad(c_scale.reshape(-1, 1), ((0, kp - k), (0, 0)))
+    c2_pad = jnp.pad(c2.reshape(-1, 1), ((0, kp - k), (0, 0)))
+
+    sums_i, counts_i, best_sum = pl.pallas_call(
+        functools.partial(_kernel_int8, k=k),
+        grid=(n // tn,),
+        in_specs=[
+            pl.BlockSpec((tn, d), lambda i: (i, 0)),
+            pl.BlockSpec((kp, d), lambda i: (0, 0)),
+            pl.BlockSpec((kp, 1), lambda i: (0, 0)),
+            pl.BlockSpec((kp, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((kp, d), lambda i: (0, 0)),
+            pl.BlockSpec((kp, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, d), jnp.int32),
+            jax.ShapeDtypeStruct((kp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pts_q, cq_pad, cs_pad, c2_pad)
+    sums = sums_i[:k].astype(jnp.float32) * col_scale[None, :]
+    return sums, counts_i[:k, 0].astype(jnp.float32), best_sum[0, 0]

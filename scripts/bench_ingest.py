@@ -178,6 +178,11 @@ def main(argv=None):
                    help="also time the device-regenerated formulation at "
                         "the same shapes (second compile + run)")
     p.add_argument("--drop-caches", action="store_true")
+    p.add_argument("--ensure-only", action="store_true",
+                   help="generate (or reuse) the dataset file and exit — "
+                        "run this OUTSIDE any benchmark watchdog: on this "
+                        "1-core host generation alone can eat most of a "
+                        "1200 s window (12 GB took 864 s on 2026-07-31)")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--platform", default=None, choices=["cpu"],
                    help="force the CPU backend (the axon relay can hang; "
@@ -194,6 +199,11 @@ def main(argv=None):
         rows = args.rows or (100_000_000 if args.format == "npy"
                              else 2_000_000)
         cols, k, chunk = args.cols, args.k, args.chunk
+    if args.ensure_only:
+        path, generated = ensure_dataset(args.format, rows, cols,
+                                         args.disk_dtype)
+        print(json.dumps({"ensured": path, "generated_now": generated}))
+        return
     res = run(args.format, rows, cols, args.disk_dtype, k, args.iters,
               chunk, keep=args.keep,
               compare_synthetic=args.compare_synthetic,

@@ -274,3 +274,28 @@ assert fast["pct_peak_flops"] < 100.0, fast
 assert fast["bound"] == "compute"
 print("roofline: bf16 peak + fused byte model vs numpy: ok")
 print(f"DRIVE OK round-8 ({mode})")
+
+# 14. wire-dtype streaming + fused int8 kernel (this session)
+import tempfile as _tf
+
+_wd = _tf.mkdtemp(prefix="drive_wire_")
+_pts16 = (rng.normal(size=(1500, 16)).astype(np.float32) * 3).astype(np.float16)
+_npy = os.path.join(_wd, "pts16.npy")
+np.save(_npy, _pts16)
+_mm = np.load(_npy, mmap_mode="r")
+from harp_tpu.models.kmeans_stream import fit_streaming as _fstr
+
+_c_auto, _i_auto = _fstr(_mm, k=6, iters=3, chunk_points=512, mesh=mesh,
+                         seed=11)
+_c_leg, _i_leg = _fstr(_mm, k=6, iters=3, chunk_points=512, mesh=mesh,
+                       seed=11, wire_dtype=None)
+np.testing.assert_array_equal(_c_auto, _c_leg)  # f16 wire is exact
+from harp_tpu.models.kmeans import fit as _kfit
+
+_pts_i8 = np.asarray(_pts16, np.float32)[:1024]
+_ca, _ia = _kfit(_pts_i8, k=4, iters=4, mesh=mesh, seed=5, quantize="int8")
+_cb, _ib = _kfit(_pts_i8, k=4, iters=4, mesh=mesh, seed=5, quantize="int8",
+                 use_pallas=True)
+np.testing.assert_allclose(_ca, _cb, rtol=1e-5, atol=1e-5)
+print(f"wire dtype exact + fused int8 kernel ≡ XLA int8 ({_ib:.1f})")
+print(f"DRIVE OK round-9 ({mode})")

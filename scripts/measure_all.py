@@ -57,11 +57,6 @@ def run_all(smoke: bool, only, watchdog=None):
                # scaffolding a real ingest wouldn't pay (ex-gen rate)
                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
                 "chunk_points": 262_144, "calibrate_gen": True})),
-        # the REAL-ingest half of the north-star (disk npy memmap through
-        # fit_streaming; VERDICT r2 item 2) — full mode keeps a 12 GB
-        # float16 file in .bench_data/ for reuse; the honest 100M-row run
-        # is scripts/bench_ingest.py directly (60 GB, host-bound)
-        "kmeans_ingest": lambda: _bench_ingest(smoke),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
@@ -115,6 +110,16 @@ def run_all(smoke: bool, only, watchdog=None):
         "rf": lambda: rf.benchmark(
             **({"n": 4096, "f": 16, "max_depth": 3,
                 "n_trees": 2 * jax.device_count()} if smoke else {})),
+        # the REAL-ingest half of the north-star (disk npy memmap through
+        # fit_streaming; VERDICT r2 item 2) — full mode keeps a 12 GB
+        # float16 file in .bench_data/ for reuse; the honest 100M-row run
+        # is scripts/bench_ingest.py directly (60 GB, host-bound).
+        # LAST deliberately: generating the file on this 1-core host took
+        # 864 s of the 1200 s watchdog window on 2026-07-31 and the
+        # watchdog exit then skipped every config after it — a slow
+        # ingest can only cost itself here (and measure_on_relay.sh
+        # pre-generates outside any watchdog)
+        "kmeans_ingest": lambda: _bench_ingest(smoke),
     }
     env = {
         "date": datetime.date.today().isoformat(),

@@ -20,6 +20,11 @@ if ! timeout 45 python -c "import jax; print(jax.devices())"; then
   exit 1
 fi
 
+echo "== pre-generate the ingest dataset OUTSIDE any watchdog =="
+# 12 GB took 864 s of the 1200 s per-config window on this 1-core host
+# (2026-07-31) — the sweep's kmeans_ingest config must only pay streaming
+python scripts/bench_ingest.py --rows 20000000 --ensure-only
+
 echo "== full graded sweep → BENCH_local.jsonl =="
 python scripts/measure_all.py --out BENCH_local.jsonl
 
@@ -30,9 +35,11 @@ echo "== 1B-point formulation (2 epochs, ~minutes) =="
 python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
   | tee -a BENCH_local.jsonl
 
-echo "== real-ingest 100M×300 (writes+frees a 60 GB f16 npy; host-bound) =="
-python scripts/bench_ingest.py --iters 2 --compare-synthetic \
-  | tee -a BENCH_local.jsonl
+echo "== real-ingest 100M×300 (writes a 60 GB f16 npy; host-bound) =="
+python scripts/bench_ingest.py --rows 100000000 --ensure-only
+python scripts/bench_ingest.py --rows 100000000 --iters 2 \
+  --compare-synthetic | tee -a BENCH_local.jsonl
+rm -f .bench_data/pts_100000000x300_float16.npy  # 60 GB: most of the disk
 
 echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
 python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \

@@ -184,7 +184,9 @@ def test_quantize_config_validation(mesh):
     with pytest.raises(ValueError, match="quantize must be"):
         KMeansConfig(quantize="fp4")
     with pytest.raises(ValueError, match="incompatible"):
-        KMeansConfig(quantize="int8", use_pallas=True)
+        KMeansConfig(quantize="int8", block_points=128)
+    # round 3: use_pallas + int8 is the FUSED kernel path, no longer an error
+    KMeansConfig(quantize="int8", use_pallas=True)
 
 
 def test_kmeanspp_init_rescues_degenerate_seeds(mesh):

@@ -68,3 +68,57 @@ def test_kernel_rejects_unsupported_n():
     c = jnp.zeros((2, 8), jnp.float32)
     with pytest.raises(ValueError, match="tile size"):
         kmeans_kernel.kmeans_partials(pts, c, interpret=True)
+
+
+# ---- fused int8 kernel (round 3) --------------------------------------
+
+def _quantized(pts):
+    from harp_tpu.models.kmeans import quantize_points_int8
+
+    q, scale = quantize_points_int8(pts)
+    return jnp.asarray(q), jnp.asarray(scale)
+
+
+def test_int8_kernel_matches_xla_int8_partials_exactly():
+    # same requantization, exact integer matmuls on both sides → the
+    # kernel must reproduce the XLA int8 path BITWISE (sums/counts) and
+    # to f32-order rounding on inertia (different summation trees)
+    from harp_tpu.models.kmeans import (_partials_block_int8,
+                                        _quantize_centroids)
+
+    pts, centers = _blobs(512, 40, 7)
+    q, scale = _quantized(pts)
+    c = jnp.asarray(centers)
+    c_q, c_scale, c2 = _quantize_centroids(c, scale)
+    s1, n1, best = kmeans_kernel.kmeans_partials_int8(
+        q, c_q, c_scale, c2, scale, interpret=True)
+    x2 = ((q.astype(jnp.float32) * scale[None, :]) ** 2).sum()
+    i1 = best + x2
+    s2, n2, i2 = _partials_block_int8(q, scale, c, c2)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_allclose(float(i1), float(i2), rtol=1e-5)
+
+
+def test_int8_kernel_k_not_lane_multiple():
+    # k=5 pads to a full 128 MXU tile; padded rows must absorb nothing
+    from harp_tpu.models.kmeans import _quantize_centroids
+
+    pts, centers = _blobs(256, 16, 5)
+    q, scale = _quantized(pts)
+    c_q, c_scale, c2 = _quantize_centroids(jnp.asarray(centers), scale)
+    s, n, _ = kmeans_kernel.kmeans_partials_int8(
+        q, c_q, c_scale, c2, scale, interpret=True)
+    assert s.shape == (5, 16) and n.shape == (5,)
+    assert float(n.sum()) == 256.0
+
+
+def test_int8_fit_pallas_matches_xla_int8_fit(mesh):
+    # end-to-end: fit(quantize='int8', use_pallas=True) ≡ the XLA int8
+    # fit — identical assignments → identical centroid chains
+    pts, _ = _blobs(1024, 24, 6, seed=3)
+    c_a, i_a = fit(pts, k=6, iters=5, mesh=mesh, seed=2, quantize="int8")
+    c_b, i_b = fit(pts, k=6, iters=5, mesh=mesh, seed=2, quantize="int8",
+                   use_pallas=True)
+    np.testing.assert_allclose(c_a, c_b, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(i_a, i_b, rtol=1e-4)

@@ -425,3 +425,89 @@ def test_north_star_1b_program_lowers(mesh):
         jax.ShapeDtypeStruct((), jnp.int32, sharding=mesh.replicated()))
     text = lowered.as_text()
     assert "while" in text  # the chunk scan is in the program
+
+
+# ---- wire dtype (H2D payload format; round 3) -------------------------
+
+def test_resolve_wire_dtype_rules():
+    f32 = np.dtype(np.float32)
+    # auto: narrow float sources ship as-is, everything else as compute
+    assert KS._resolve_wire_dtype("auto", f32, np.float16) == np.float16
+    assert KS._resolve_wire_dtype("auto", f32, np.dtype("bfloat16")).name \
+        == "bfloat16"
+    assert KS._resolve_wire_dtype("auto", f32, np.float32) == f32
+    assert KS._resolve_wire_dtype("auto", f32, np.int16) == f32
+    assert KS._resolve_wire_dtype("auto", f32, None) == f32  # mixed/unknown
+    # never ship WIDER than compute via auto
+    assert KS._resolve_wire_dtype("auto", np.dtype(np.float16),
+                                  np.float16) == np.float16
+    # None = legacy; explicit forces; non-float rejected
+    assert KS._resolve_wire_dtype(None, f32, np.float16) == f32
+    assert KS._resolve_wire_dtype(np.float16, f32, np.float32) == np.float16
+    with pytest.raises(ValueError, match="float"):
+        KS._resolve_wire_dtype(np.int8, f32, np.float32)
+
+
+def test_f16_source_wire_bit_identical_to_host_cast(mesh):
+    # an f16 disk source streamed with the f16 wire (auto) must equal the
+    # legacy path (host-cast to f32, f32 wire) BITWISE: widening is exact
+    pts16 = _blobs(n=1200, d=12).astype(np.float16)
+    c_auto, i_auto = KS.fit_streaming(pts16, k=5, iters=4, chunk_points=512,
+                                      mesh=mesh, seed=7)
+    c_legacy, i_legacy = KS.fit_streaming(pts16, k=5, iters=4,
+                                          chunk_points=512, mesh=mesh,
+                                          seed=7, wire_dtype=None)
+    np.testing.assert_array_equal(c_auto, c_legacy)
+    assert i_auto == i_legacy
+
+
+def test_f16_wire_program_receives_f16(mesh):
+    # the compiled chunk program must see an f16 operand (the wire win is
+    # real, not a host-side cast sneaking back in)
+    seen = []
+    orig = KS._make_accum_fn
+
+    def spy(m, cfg):
+        fn = orig(m, cfg)
+
+        def wrapped(pts, *rest):
+            seen.append(np.asarray(pts).dtype)
+            return fn(pts, *rest)
+        return wrapped
+
+    KS._make_accum_fn = spy
+    try:
+        pts16 = _blobs(n=600, d=8).astype(np.float16)
+        KS.fit_streaming(pts16, k=3, iters=1, chunk_points=256, mesh=mesh)
+    finally:
+        KS._make_accum_fn = orig
+    assert seen and all(d == np.float16 for d in seen), seen
+
+
+def test_streaming_files_f16_splits_use_f16_wire(mesh, tmp_path):
+    # uniform f16 .npy splits resolve the f16 wire and match the
+    # single-source result bitwise; a mixed f16+csv set falls back to f32
+    pts = _blobs(n=900, d=10).astype(np.float16)
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"s{i}.npy"
+        np.save(p, pts[i * 300:(i + 1) * 300])
+        paths.append(str(p))
+    init = pts[:4].astype(np.float32)
+    c_f, i_f = KS.fit_streaming_files(paths, k=4, iters=3, chunk_points=256,
+                                      mesh=mesh, init=init)
+    c_s, i_s = KS.fit_streaming(pts, k=4, iters=3, chunk_points=256,
+                                mesh=mesh, init=init)
+    assert np.allclose(c_f, c_s, rtol=1e-4, atol=1e-4)
+
+    from harp_tpu.native.datasource import FileSplits
+
+    fs = FileSplits(paths, mesh.num_workers,
+                    range(mesh.num_workers))
+    assert fs.dtype == np.float16
+    fs.close()
+    csv = tmp_path / "mix.csv"
+    np.savetxt(csv, pts[:8].astype(np.float32), delimiter=",")
+    fs2 = FileSplits([paths[0], str(csv)], 2, range(2))
+    assert fs2.dtype is None  # mixed → wire falls back to compute dtype
+    fs2.close()
