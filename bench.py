@@ -27,21 +27,19 @@ import threading
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
-# v0 regression baselines, 1× TPU v5e (BASELINE.md, 2026-07-29/30).
+# Regression baselines, 1× TPU v5e (BASELINE.md) — re-measured on
+# ROUND-3 code 2026-07-31 (every config, same day, same chip; the stale
+# round-1 values and the refactor caveat are retired).
 # None = no TPU number recorded yet (vs_baseline stays null until one is).
-# NOTE: the non-None values were measured on round-1 code; the round-2
-# refactors of the hot paths (mfsgd algo_kwargs/factor_state_io, lda shared
-# _cgs_resample, kmeans shared partials) have not been re-measured on TPU
-# (relay outage) — treat vs_baseline as approximate until re-measured.
 BASELINES = {
-    "kmeans": 400.0,        # iter/s, 1M×300 k=100 f32
-    "kmeans_stream": None,  # iter/s, 100M×300 k=1000 blocked-epoch (new)
+    "kmeans": 399.3,        # iter/s, 1M×300 k=100 f32
+    "kmeans_stream": 0.53,  # iter/s end-to-end, 100M×300 k=1000 (1.09 ex-gen)
     "kmeans_ingest": None,  # points/s, 20M×300 f16 disk npy (round 3)
-    "mfsgd": 96.4e6,        # updates/s/chip, ML-20M shapes, dense algo
-    "lda": 6.3e6,           # tokens/s/chip, 100k docs × 1k topics, dense
-    "mlp": 21.2e6,          # samples/s, MNIST shapes, device-resident
-    "subgraph": 83.6e3,     # vertices/s, u5-tree on 100k vertices
-    "rf": 7.07,             # trees/s, 32 trees depth 6 on 200k×64
+    "mfsgd": 92.7e6,        # updates/s/chip, ML-20M shapes, dense algo
+    "lda": 6.58e6,          # tokens/s/chip, 100k docs × 1k topics, dense
+    "mlp": 22.2e6,          # samples/s, MNIST shapes, device-resident
+    "subgraph": 93.8e3,     # vertices/s, u5-tree on 100k vertices
+    "rf": 7.92,             # trees/s, 32 trees depth 6 on 200k×64
 }
 
 
