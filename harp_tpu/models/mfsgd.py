@@ -66,6 +66,10 @@ class MFSGDConfig:
     # ML-20M graded config (rank 64, 1× v5e, 2026-07-30): dense 84–102M
     # updates/s/chip vs scatter 26.3M — TPU scatter of 256 B rows runs at
     # ~25 GB/s while the same permutation as matmuls rides the MXU.
+    # "pallas" fuses the dense entry update into one VMEM-resident kernel
+    # (ops/mfsgd_kernel.py) — same data layout and update order as "dense",
+    # minus the HBM round trips between XLA fusions; needs 128-multiple
+    # tiles and rank % 8 == 0 on TPU.
     algo: str = "dense"
     # dense tiling: 512×512 measured best on v5e (84–102M ups vs 60–80M at
     # 1024/2048 tiles — one-hot traffic grows with tile width and dominates
@@ -87,8 +91,9 @@ class MFSGDConfig:
     chunk: int = 32768
 
     def __post_init__(self):
-        if self.algo not in ("dense", "scatter"):
-            raise ValueError(f"algo must be 'dense' or 'scatter', got {self.algo!r}")
+        if self.algo not in ("dense", "scatter", "pallas"):
+            raise ValueError(
+                f"algo must be 'dense', 'scatter' or 'pallas', got {self.algo!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -343,7 +348,26 @@ def _tile_block_update(W, H, block, cfg: MFSGDConfig):
     return W, H, se, cnt
 
 
-_UPDATERS = {"dense": _tile_block_update, "scatter": _block_update}
+def _pallas_tile_block_update(W, H, block, cfg: MFSGDConfig):
+    """Fused-kernel twin of :func:`_tile_block_update` (same entries, same
+    order — see ops/mfsgd_kernel.py).  Factors transpose to rank-major at
+    the block boundary; ~0.3 ms/epoch of HBM traffic at ML-20M scale."""
+    from harp_tpu.ops.mfsgd_kernel import sgd_tile_update
+
+    eu, ei, ev, ou, oi = block
+    Wt, Ht, se, cnt = sgd_tile_update(
+        W.T, H.T, eu, ei, ev, ou, oi,
+        lr=cfg.lr, reg=cfg.reg, u_tile=cfg.u_tile, i_tile=cfg.i_tile,
+        compute_dtype=cfg.compute_dtype,
+        interpret=jax.default_backend() != "tpu")
+    return Wt.T, Ht.T, se, cnt
+
+
+_UPDATERS = {"dense": _tile_block_update, "scatter": _block_update,
+             "pallas": _pallas_tile_block_update}
+
+#: algos that consume the dense (u_tile × i_tile) entry layout
+_DENSE_ALGOS = ("dense", "pallas")
 
 
 def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
@@ -398,7 +422,7 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
 
 
 def _n_block_args(cfg: MFSGDConfig) -> int:
-    return 5 if cfg.algo == "dense" else 4
+    return 5 if cfg.algo in _DENSE_ALGOS else 4
 
 
 def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
@@ -451,7 +475,7 @@ class MFSGD:
         self.cfg = cfg or MFSGDConfig()
         self.n_users, self.n_items = n_users, n_items
         n = self.mesh.num_workers
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _DENSE_ALGOS:
             self.u_own, self.i_own, self.u_bound, ib2 = _dense_bounds(
                 n_users, n_items, n, 2 * n, self.cfg.u_tile, self.cfg.i_tile)
             self.i_bound = 2 * ib2
@@ -474,12 +498,17 @@ class MFSGD:
 
     def set_ratings(self, users, items, vals):
         n = self.mesh.num_workers
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _DENSE_ALGOS:
             eu, ei, ev, ou, oi, uo, io, ub, ib2 = partition_ratings_tiles(
                 users, items, vals, self.n_users, self.n_items, n,
                 self.cfg.u_tile, self.cfg.i_tile, self.cfg.entry_cap,
             )
             assert (uo, io) == (self.u_own, self.i_own)
+            if self.cfg.algo == "pallas":
+                from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
+
+                eu, ei, ev, ou, oi = insert_coverage_entries(
+                    eu, ei, ev, ou, oi, ub, self.cfg.u_tile)
             blocks = (eu, ei, ev, ou, oi)
         else:
             bu, bi, bv, bm, ub, ib2 = partition_ratings(
@@ -565,7 +594,7 @@ class MFSGD:
         n = self.mesh.num_workers
         W = np.asarray(self.W)
         H = np.asarray(self.H)
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _DENSE_ALGOS:
             r = W.shape[-1]
             W = W.reshape(n, self.u_bound, r)[:, : self.u_own].reshape(-1, r)
             ib2 = self.i_bound // 2
@@ -620,7 +649,8 @@ def _make_config(rank: int, chunk: int | None, algo: str = "dense",
                  entry_cap: int | None = None) -> MFSGDConfig:
     return MFSGDConfig(rank=rank, **algo_kwargs(algo, {
         "scatter": {"chunk": chunk},
-        "dense": {"u_tile": u_tile, "i_tile": i_tile, "entry_cap": entry_cap},
+        _DENSE_ALGOS: {"u_tile": u_tile, "i_tile": i_tile,
+                       "entry_cap": entry_cap},
     }))
 
 
@@ -677,19 +707,21 @@ def main(argv=None):
     p.add_argument("--nnz", type=int, default=20_000_000)
     p.add_argument("--rank", type=int, default=64)
     p.add_argument("--epochs", type=int, default=3)
-    p.add_argument("--algo", choices=["dense", "scatter"], default="dense",
-                   help="dense: one-hot MXU tiles (fastest, default); "
-                        "scatter: direct gather/scatter-add reference")
+    p.add_argument("--algo", choices=["dense", "scatter", "pallas"],
+                   default="dense",
+                   help="dense: one-hot MXU tiles (default); pallas: the "
+                        "same update fused into one VMEM kernel; scatter: "
+                        "direct gather/scatter-add reference")
     p.add_argument("--chunk", type=int, default=None,
                    help="scatter-only: minibatch size (default: tuned 32768); "
                         "errors under --algo dense instead of silently "
                         "doing nothing")
     p.add_argument("--u-tile", type=int, default=None,
-                   help="dense-only: W tile rows (default 512)")
+                   help="dense/pallas: W tile rows (default 512)")
     p.add_argument("--i-tile", type=int, default=None,
-                   help="dense-only: H tile rows (default 512)")
+                   help="dense/pallas: H tile rows (default 512)")
     p.add_argument("--entry-cap", type=int, default=None,
-                   help="dense-only: max ratings per tile entry (default 2048)")
+                   help="dense/pallas: max ratings per tile entry (default 2048)")
     p.add_argument("--ckpt-dir", default=None,
                    help="train with checkpoint/resume instead of benchmarking; "
                         "rerunning with the same dir resumes from the latest "

@@ -299,3 +299,28 @@ _cb, _ib = _kfit(_pts_i8, k=4, iters=4, mesh=mesh, seed=5, quantize="int8",
 np.testing.assert_allclose(_ca, _cb, rtol=1e-5, atol=1e-5)
 print(f"wire dtype exact + fused int8 kernel ≡ XLA int8 ({_ib:.1f})")
 print(f"DRIVE OK round-9 ({mode})")
+
+# 15. fused Pallas MF-SGD (this session): algo="pallas" through the public
+# MFSGD driver must reproduce algo="dense" (same entries, same order) and
+# leave ratings-free W blocks untouched.
+from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings
+
+_u, _i, _v = synthetic_ratings(96, 64, 3000, rank=4, noise=0.05, seed=2)
+_factors = {}
+for _algo in ("dense", "pallas"):
+    _cfg = MFSGDConfig(rank=8, algo=_algo, u_tile=8, i_tile=8, entry_cap=32,
+                       compute_dtype=jnp.float32, lr=0.03, reg=0.01)
+    _m = MFSGD(96, 64, _cfg, mesh, seed=4)
+    _m.set_ratings(_u, _i, _v)
+    _rm = [_m.train_epoch() for _ in range(2)]
+    _factors[_algo] = (_m.factors(), _rm)
+np.testing.assert_allclose(_factors["pallas"][0][0], _factors["dense"][0][0],
+                           rtol=1e-4, atol=1e-5)
+np.testing.assert_allclose(_factors["pallas"][0][1], _factors["dense"][0][1],
+                           rtol=1e-4, atol=1e-5)
+np.testing.assert_allclose(_factors["pallas"][1], _factors["dense"][1],
+                           rtol=1e-5)
+assert _factors["pallas"][1][1] < _factors["pallas"][1][0]  # converging
+print(f"pallas MF-SGD ≡ dense through public driver "
+      f"(rmse {_factors['pallas'][1][-1]:.4f})")
+print(f"DRIVE OK round-10 ({mode})")

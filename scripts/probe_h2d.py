@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Measure raw host->device transfer bandwidth over the current backend.
+
+Diagnostic for the kmeans_ingest hang (2026-07-31): the axon relay
+tunnels H2D over HTTP, so `jax.device_put` of streaming chunks may run
+orders of magnitude below a real TPU-VM's PCIe/DMA path.  This probe
+times device_put (H2D) and np.asarray readback (D2H) at a few sizes and
+prints one JSON line.  Run bounded (`timeout 300 ...`) — the relay can
+hang (CLAUDE.md gotchas).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    out = {"device": str(dev), "probes": []}
+    for mb in (1, 16, 64, 157):
+        arr = np.random.default_rng(0).standard_normal(
+            (mb * 1 << 20) // 2).astype(np.float16)
+        # warm one tiny transfer to exclude connection setup from the 1st row
+        jax.device_put(np.ones(8, np.float16), dev).block_until_ready()
+        t0 = time.perf_counter()
+        x = jax.device_put(arr, dev)
+        x.block_until_ready()
+        h2d = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        back = np.asarray(x)
+        d2h = time.perf_counter() - t0
+        assert back[0] == arr[0]
+        out["probes"].append({"mb": mb, "h2d_s": round(h2d, 3),
+                              "h2d_mb_s": round(mb / h2d, 1),
+                              "d2h_s": round(d2h, 3),
+                              "d2h_mb_s": round(mb / d2h, 1)})
+        print(json.dumps(out["probes"][-1]), file=sys.stderr, flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
