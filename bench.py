@@ -36,6 +36,7 @@ BASELINES = {
     "kmeans_stream": 0.53,  # iter/s end-to-end, 100M×300 k=1000 (1.09 ex-gen)
     "kmeans_ingest": None,  # points/s, 20M×300 f16 disk npy (round 3)
     "mfsgd": 92.7e6,        # updates/s/chip, ML-20M shapes, dense algo
+    "mfsgd_pallas": None,   # fused-kernel algo (round 3; no TPU number yet)
     "lda": 6.58e6,          # tokens/s/chip, 100k docs × 1k topics, dense
     "mlp": 22.2e6,          # samples/s, MNIST shapes, device-resident
     "subgraph": 93.8e3,     # vertices/s, u5-tree on 100k vertices
@@ -85,6 +86,13 @@ def _configs(smoke):
              **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                  "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
                 if smoke else {}))),
+        ("mfsgd_pallas", "updates/s/chip", "updates_per_sec_per_chip",
+         lambda: mfsgd.benchmark(
+             algo="pallas",
+             # smoke tiles must pass the kernel's TPU gate (128-multiples)
+             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                 "epochs": 2, "u_tile": 128, "i_tile": 128,
+                 "entry_cap": 256} if smoke else {}))),
         ("lda", "tokens/s/chip", "tokens_per_sec_per_chip",
          lambda: lda.benchmark(
              **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,

@@ -99,6 +99,7 @@ WORK_MODELS = {
     "kmeans_stream": _kmeans_work,
     "mfsgd": _mfsgd_work,
     "mfsgd_scatter": _mfsgd_work,
+    "mfsgd_pallas": _mfsgd_work,
     "lda": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,

@@ -324,3 +324,24 @@ assert _factors["pallas"][1][1] < _factors["pallas"][1][0]  # converging
 print(f"pallas MF-SGD ≡ dense through public driver "
       f"(rmse {_factors['pallas'][1][-1]:.4f})")
 print(f"DRIVE OK round-10 ({mode})")
+
+# 16. self-time op_breakdown (this session): trace a real jitted run and
+# check the table is flame-graph-consistent — parent/aggregate spans must
+# not outweigh the whole capture (they triple-counted before the fix).
+import tempfile as _tf2
+
+from harp_tpu.utils.profiling import op_breakdown, trace
+
+_x = jnp.ones((256, 256))
+_g = jax.jit(lambda a: (a @ a).sum())
+float(_g(_x))  # compile outside
+with trace(_tf2.mkdtemp(prefix="drive_prof_")) as _td:
+    float(_g(_x))
+_rows = op_breakdown(_td, top=50)
+assert _rows and all(s >= 0 for _, s in _rows)
+_raw = op_breakdown(_td, top=50, self_time=False)
+# self-time never exceeds raw for any op, and the self-time total is ≤ raw
+assert sum(s for _, s in _rows) <= sum(s for _, s in _raw) + 1e-9
+print(f"self-time op_breakdown: {len(_rows)} ops, "
+      f"{sum(s for _, s in _rows) * 1e3:.2f} ms traced")
+print(f"DRIVE OK round-11 ({mode})")

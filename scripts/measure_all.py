@@ -65,6 +65,14 @@ def run_all(smoke: bool, only, watchdog=None):
             algo="scatter",
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "chunk": 1024} if smoke else {})),
+        # round 3: the dense update fused into one VMEM Pallas kernel
+        # (ops/mfsgd_kernel.py) — candidate new default if it wins on TPU
+        "mfsgd_pallas": lambda: mfsgd.benchmark(
+            algo="pallas",
+            # smoke tiles must pass the kernel's TPU gate (128-multiples)
+            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                "epochs": 2, "u_tile": 128, "i_tile": 128,
+                "entry_cap": 256} if smoke else {})),
         "lda": lambda: lda.benchmark(
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
@@ -155,9 +163,9 @@ def main(argv=None):
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
                    choices=["kmeans", "kmeans_int8", "kmeans_stream",
                             "kmeans_ingest", "mfsgd", "mfsgd_scatter",
-                            "lda", "lda_scale", "lda_scale_1m",
-                            "lda_scatter", "mlp", "subgraph",
-                            "subgraph_1m", "rf"],
+                            "mfsgd_pallas", "lda", "lda_scale",
+                            "lda_scale_1m", "lda_scatter", "mlp",
+                            "subgraph", "subgraph_1m", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     p.add_argument("--platform", choices=["cpu"], default=None,

@@ -260,3 +260,51 @@ def test_profiling_op_breakdown(mesh, tmp_path):
 
     with pytest.raises(FileNotFoundError, match="trace.json.gz"):
         op_breakdown(str(tmp_path / "nope"))
+
+
+def test_op_breakdown_self_time_unnests_parent_spans(tmp_path):
+    """TPU device tracks nest (jit module ⊃ while ⊃ fusions); the table
+    must charge parents only their uncovered time or shares triple-count
+    (the 2026-07-31 kmeans capture read jit_run at 28% this way)."""
+    import gzip
+    import json
+
+    from harp_tpu.utils.profiling import op_breakdown
+
+    #            0         10        20        30        40
+    # jit_run    [----------------------------------------]   40 us
+    #   while.1      [------------------]                      20 us
+    #     fusion.1     [------]  [------]                      8+8 us
+    #   fusion.2                              [------]         8 us
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "jit_run", "ts": 0,
+         "dur": 40},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "while.1", "ts": 4,
+         "dur": 20},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 5,
+         "dur": 8},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.1", "ts": 14,
+         "dur": 8},
+        {"ph": "X", "pid": 7, "tid": 1, "name": "fusion.2", "ts": 30,
+         "dur": 8},
+        # host-track span must stay filtered out
+        {"ph": "X", "pid": 1, "tid": 1, "name": "host_thing", "ts": 0,
+         "dur": 999},
+    ]
+    d = tmp_path / "fake"
+    d.mkdir()
+    with gzip.open(d / "x.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    got = dict(op_breakdown(str(d)))
+    assert "host_thing" not in got
+    assert abs(got["fusion.1"] - 16e-6) < 1e-12
+    assert abs(got["fusion.2"] - 8e-6) < 1e-12
+    assert abs(got["while.1"] - 4e-6) < 1e-12   # 20 − 16 covered
+    assert abs(got["jit_run"] - 12e-6) < 1e-12  # 40 − 20 − 8 covered
+    assert abs(sum(got.values()) - 40e-6) < 1e-12  # shares sum to wall
+
+    raw = dict(op_breakdown(str(d), self_time=False))
+    assert abs(raw["jit_run"] - 40e-6) < 1e-12  # old behavior, opt-in

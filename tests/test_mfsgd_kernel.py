@@ -58,6 +58,35 @@ def test_pallas_multi_epoch_program_matches_dense(mesh):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_pallas_multi_chunk_entries_match_dense(mesh):
+    """C > chunk_c=512 drives the kernel's inner fori_loop through
+    multiple chunks — the path the full-scale ML-20M config (C=2048)
+    runs; a chunk-slicing bug passes the small-entry tests but corrupts
+    factors only at scale."""
+    rng = np.random.default_rng(11)
+    # all ratings in ONE (worker, slice, tile) cell (n_items=128 → 8 items
+    # per half-slice, so i<8 is slice 0 / tile 0) → one entry holding 600
+    # ratings, padded to C=1024 by insert_coverage_entries → 2 chunks
+    n_users, n_items, nnz = 8 * 8, 128, 600
+    u = rng.integers(0, 8, nnz).astype(np.int32)  # worker 0, tile 0
+    i = rng.integers(0, 8, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+
+    kw = dict(entry_cap=1024)
+    Wd, Hd, rd = _run_epochs(mesh, "dense", u, i, v, n_users, n_items, **kw)
+    Wp, Hp, rp = _run_epochs(mesh, "pallas", u, i, v, n_users, n_items, **kw)
+    # the prep must actually have produced a multi-chunk entry
+    from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
+
+    eu, ei, ev, ou, oi, *_ = MF.partition_ratings_tiles(
+        u, i, v, n_users, n_items, N, 8, 8, 1024)
+    assert insert_coverage_entries(eu, ei, ev, ou, oi, 8, 8)[0].shape[-1] \
+        > 512
+    np.testing.assert_allclose(Wp, Wd, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(Hp, Hd, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(rp, rd, rtol=1e-5)
+
+
 def test_pallas_unvisited_w_blocks_pass_through(mesh):
     """W blocks with zero ratings must come out bit-identical, not garbage
     (the kernel writes every output block only because host prep inserts
