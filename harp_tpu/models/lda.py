@@ -124,6 +124,16 @@ class LDAConfig:
     # chain statistics, different random stream.  Kept opt-in until a
     # TPU measurement picks the default (CLAUDE.md perf discipline).
     sampler: str = "gumbel"
+    # Random-bit source for the per-[token, K] draws.  "threefry"
+    # (default): JAX's counter-based PRNG — splittable, reproducible
+    # across backends, but ~15 VPU ops per element; at 1k topics the
+    # noise tensor is K× the token count, so bit generation is a real
+    # share of the epoch.  "rbg": XLA's RngBitGenerator — the TPU
+    # hardware generator, near-free, still deterministic per key but a
+    # different (backend-dependent) stream.  Chain statistics unaffected
+    # (any iid uniform source is a valid Gibbs draw).  Opt-in until a
+    # TPU measurement picks the default (CLAUDE.md perf discipline).
+    rng_impl: str = "threefry"
 
     def __post_init__(self):
         if self.ndk_dtype not in ("float32", "int16"):
@@ -136,6 +146,9 @@ class LDAConfig:
         if self.sampler not in ("gumbel", "exprace"):
             raise ValueError(
                 f"sampler must be 'gumbel' or 'exprace', got {self.sampler!r}")
+        if self.rng_impl not in ("threefry", "rbg"):
+            raise ValueError(
+                f"rng_impl must be 'threefry' or 'rbg', got {self.rng_impl!r}")
         if self.pull_cap is not None and self.algo != "pushpull":
             raise ValueError("pull_cap only applies to algo='pushpull'")
         if self.pull_cap is not None and self.pull_cap < 1:
@@ -151,6 +164,13 @@ def _cgs_resample(ndk, nwk, nk, z, mask, key, cfg: LDAConfig, vocab_size):
     a = jnp.maximum(ndk + cfg.alpha, 1e-10)
     b = jnp.maximum(nwk + cfg.beta, 1e-10)
     c = jnp.maximum(nk + vocab_size * cfg.beta, 1e-10)
+    if cfg.rng_impl == "rbg":
+        # rebuild the (split-derived, chunk-unique) threefry key as an RBG
+        # key: bits then come from the TPU hardware generator instead of
+        # ~15 VPU ops/element of counter hashing (see LDAConfig.rng_impl)
+        kd = key if key.dtype == jnp.uint32 else jax.random.key_data(key)
+        key = jax.random.wrap_key_data(jnp.concatenate([kd, kd]),
+                                       impl="rbg")
     if cfg.sampler == "exprace":
         # competing exponentials: argmin_k E_k/p_k lands on k with
         # probability p_k/Σp — the same draw as Gumbel-argmax at ~1/5th
@@ -887,10 +907,11 @@ def synthetic_corpus(n_docs, vocab_size, n_topics_true, tokens_per_doc, seed=0):
 
 def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
               entry_cap=None, pull_cap=None, ndk_dtype="float32",
-              dedup_pulls=None, sampler="gumbel"):
+              dedup_pulls=None, sampler="gumbel", rng_impl="threefry"):
     """None inherits LDAConfig's defaults; algo-specific knobs raise when
     combined with a non-owning algo (shared contract: mfsgd.algo_kwargs)."""
     return LDAConfig(n_topics=n_topics, ndk_dtype=ndk_dtype, sampler=sampler,
+                     rng_impl=rng_impl,
                      **algo_kwargs(algo, {
         ("scatter", "pushpull"): {"chunk": chunk},
         "dense": {"d_tile": d_tile, "w_tile": w_tile, "entry_cap": entry_cap},
@@ -902,7 +923,7 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
               tokens_per_doc=100, epochs=2, mesh=None, chunk=None, seed=0,
               algo="dense", d_tile=None, w_tile=None, entry_cap=None,
               pull_cap=None, ndk_dtype="float32", dedup_pulls=None,
-              sampler="gumbel"):
+              sampler="gumbel", rng_impl="threefry"):
     """Tokens/sec/chip on an enwiki-1M-scaled config (graded config #3).
 
     (Full enwiki-1M docs needs a multi-chip pod for the 1M×1k doc-topic
@@ -910,7 +931,7 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
     """
     mesh = mesh or current_mesh()
     cfg = _make_cfg(n_topics, algo, chunk, d_tile, w_tile, entry_cap,
-                    pull_cap, ndk_dtype, dedup_pulls, sampler)
+                    pull_cap, ndk_dtype, dedup_pulls, sampler, rng_impl)
     model = LDA(n_docs, vocab_size, cfg, mesh, seed)
     rng = np.random.default_rng(seed)
     n_tok = n_docs * tokens_per_doc
@@ -974,6 +995,12 @@ def main(argv=None):
                         "argmax, default) or exprace (exponential race — "
                         "identical distribution, ~5x fewer VPU "
                         "transcendentals; opt-in until TPU-measured)")
+    p.add_argument("--rng-impl", choices=["threefry", "rbg"],
+                   default="threefry",
+                   help="random bits for the [token, K] draws: threefry "
+                        "(default, splittable counter PRNG) or rbg (TPU "
+                        "hardware generator, near-free; opt-in until "
+                        "TPU-measured)")
     p.add_argument("--ndk-dtype", choices=["float32", "int16"],
                    default="float32",
                    help="doc-topic table dtype: int16 halves its HBM "
@@ -1027,7 +1054,7 @@ def main(argv=None):
                               args.d_tile, args.w_tile, args.entry_cap,
                               args.pull_cap, args.ndk_dtype,
                               False if args.no_dedup_pulls else None,
-                              args.sampler))
+                              args.sampler, args.rng_impl))
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
@@ -1039,7 +1066,8 @@ def main(argv=None):
                         w_tile=args.w_tile, entry_cap=args.entry_cap,
                         pull_cap=args.pull_cap, ndk_dtype=args.ndk_dtype,
                         dedup_pulls=(False if args.no_dedup_pulls
-                                     else None), sampler=args.sampler))
+                                     else None), sampler=args.sampler,
+                        rng_impl=args.rng_impl))
 
 
 if __name__ == "__main__":

@@ -104,6 +104,14 @@ def run_all(smoke: bool, only, watchdog=None):
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
                 "w_tile": 16, "entry_cap": 64} if smoke else {})),
+        # round 3: exprace + hardware RNG together — the candidate new
+        # default sampling stack; vs lda/lda_exprace it attributes the
+        # win between sampler math and bit generation
+        "lda_fast": lambda: lda.benchmark(
+            sampler="exprace", rng_impl="rbg",
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64} if smoke else {})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -171,8 +179,9 @@ def main(argv=None):
                    choices=["kmeans", "kmeans_int8", "kmeans_stream",
                             "kmeans_ingest", "mfsgd", "mfsgd_scatter",
                             "mfsgd_pallas", "lda", "lda_exprace",
-                            "lda_scale", "lda_scale_1m", "lda_scatter",
-                            "mlp", "subgraph", "subgraph_1m", "rf"],
+                            "lda_fast", "lda_scale", "lda_scale_1m",
+                            "lda_scatter", "mlp", "subgraph",
+                            "subgraph_1m", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     p.add_argument("--platform", choices=["cpu"], default=None,

@@ -345,3 +345,29 @@ def test_exprace_full_chain_converges(mesh):
     assert lls[-1] > lls[0]
     Ndk = np.asarray(model.Ndk)
     assert Ndk.sum() == model.n_tokens and (Ndk >= 0).all()
+
+
+@pytest.mark.parametrize("sampler", ["gumbel", "exprace"])
+def test_rbg_rng_full_chain_converges(mesh, sampler):
+    """Hardware-RNG bits (rng_impl='rbg') keep the chain valid under BOTH
+    samplers: counts invariant, likelihood ascends."""
+    cfg = L.LDAConfig(n_topics=8, algo="dense", d_tile=16, w_tile=16,
+                      entry_cap=64, alpha=0.5, beta=0.1,
+                      sampler=sampler, rng_impl="rbg")
+    d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                              tokens_per_doc=50, seed=0)
+    model = L.LDA(96, 64, cfg, mesh, seed=1)
+    model.set_tokens(d, w)
+    ll0 = model.log_likelihood()
+    for _ in range(6):
+        model.sample_epoch()
+    assert model.log_likelihood() > ll0
+    Ndk = np.asarray(model.Ndk)
+    Nwk = np.asarray(model.Nwk)
+    assert Ndk.sum() == model.n_tokens and (Ndk >= 0).all()
+    assert Nwk.sum() == model.n_tokens and (Nwk >= 0).all()
+
+
+def test_rng_impl_validation():
+    with pytest.raises(ValueError, match="rng_impl"):
+        L.LDAConfig(n_topics=4, rng_impl="philox")
