@@ -139,10 +139,18 @@ class LDAConfig:
         if self.ndk_dtype not in ("float32", "int16"):
             raise ValueError(
                 f"ndk_dtype must be 'float32' or 'int16', got {self.ndk_dtype!r}")
-        if self.algo not in ("dense", "scatter", "pushpull"):
+        if self.algo not in ("dense", "scatter", "pushpull", "pallas"):
             raise ValueError(
-                f"algo must be 'dense', 'scatter' or 'pushpull', "
-                f"got {self.algo!r}")
+                f"algo must be 'dense', 'scatter', 'pushpull' or "
+                f"'pallas', got {self.algo!r}")
+        if self.algo == "pallas" and (self.sampler != "exprace"
+                                      or self.rng_impl != "rbg"):
+            # the fused kernel IS the exprace + hardware-bits stack (see
+            # ops/lda_kernel.py) — require the matching knobs so a config
+            # never claims a sampler the kernel doesn't run
+            raise ValueError(
+                "algo='pallas' fuses the exprace draw over hardware "
+                "random bits; pass sampler='exprace', rng_impl='rbg'")
         if self.sampler not in ("gumbel", "exprace"):
             raise ValueError(
                 f"sampler must be 'gumbel' or 'exprace', got {self.sampler!r}")
@@ -307,6 +315,34 @@ def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
     return Ndk, Nwk, dNk, z_new
 
 
+def _sample_entry_pallas(NdkT, NwkT, nk, z, entry, key2, cfg: LDAConfig,
+                         vocab_size):
+    """Fused-kernel twin of :func:`_sample_entry` on TOPIC-MAJOR tables
+    (ops/lda_kernel.py): tiles slice along lanes, the whole [C, K] chain
+    stays in VMEM.  Chunk-granular snapshots (fresher than the XLA
+    entry snapshot); exprace draw over hardware bits by construction."""
+    from harp_tpu.ops.lda_kernel import cgs_entry_update
+
+    cd, cw, od, ow = entry
+    DR, WR = cfg.d_tile, cfg.w_tile
+    DbT = lax.dynamic_slice_in_dim(NdkT, od, DR, 1)
+    WbT = lax.dynamic_slice_in_dim(NwkT, ow, WR, 1)
+    DbT, WbT, z_new, dNk = cgs_entry_update(
+        DbT, WbT, nk, z, cd, cw, key2,
+        alpha=cfg.alpha, beta=cfg.beta, vbeta=vocab_size * cfg.beta,
+        interpret=jax.default_backend() != "tpu")
+    NdkT = lax.dynamic_update_slice_in_dim(NdkT, DbT, od, 1)
+    NwkT = lax.dynamic_update_slice_in_dim(NwkT, WbT, ow, 1)
+    return NdkT, NwkT, dNk, z_new
+
+
+#: algos that consume the dense (d_tile × w_tile) entry layout
+_TILED_ALGOS = ("dense", "pallas")
+
+#: pallas prep: entry width must be a multiple of the kernel chunk
+_PALLAS_C = 256
+
+
 def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
     """Device-view epoch body: every token resampled once.
 
@@ -317,13 +353,18 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
     chunks (see :func:`_sample_entry` / :func:`_sample_chunk`).
     """
     two_n = 2 * mesh.num_workers
-    dense = cfg.algo == "dense"
+    tiled = cfg.algo in _TILED_ALGOS
+    pallas = cfg.algo == "pallas"
 
     def epoch(Ndk, Nwk_slice, Nk, z_grid, *token_args):
         key = token_args[-1][0]
         tokens = token_args[:-1]
         ib2 = Nwk_slice.shape[0] // 2
         computing, inflight = Nwk_slice[:ib2], Nwk_slice[ib2:]
+        if pallas:
+            # the fused kernel is topic-major: transpose once per epoch
+            # (~10 GB/epoch of HBM at enwiki scale — noise vs the epoch)
+            Ndk, computing, inflight = Ndk.T, computing.T, inflight.T
 
         def body(carry, t):
             Ndk, computing, inflight, Nk, z_grid, key = carry
@@ -333,14 +374,18 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
             z_blk = z_grid[half_idx]
             key, sub = jax.random.split(key)
 
-            if dense:
+            if tiled:
                 ed, ew, od, ow = blk  # [NE, C], [NE]
                 entry_keys = jax.random.split(sub, ed.shape[0])
+                if pallas:
+                    entry_keys = lax.bitcast_convert_type(
+                        entry_keys, jnp.int32)
+                sample = _sample_entry_pallas if pallas else _sample_entry
 
                 def entry_body(st, inp):
                     Ndk, Nwk, dNk_acc = st
                     cd, cw, zc, eo, wo, k = inp
-                    Ndk, Nwk, dNk, z_new = _sample_entry(
+                    Ndk, Nwk, dNk, z_new = sample(
                         Ndk, Nwk, Nk + dNk_acc, zc, (cd, cw, eo, wo), k,
                         cfg, vocab_size)
                     return (Ndk, Nwk, dNk_acc + dNk), z_new
@@ -381,6 +426,8 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
             body, (Ndk, computing, inflight, Nk, z_grid, key),
             jnp.arange(two_n),
         )
+        if pallas:
+            Ndk, computing, inflight = Ndk.T, computing.T, inflight.T
         Nwk_slice = jnp.concatenate([computing, inflight], axis=0)
         return Ndk, Nwk_slice, Nk, z_grid
 
@@ -426,7 +473,7 @@ def _device_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
 
 
 def _n_token_args(cfg: LDAConfig) -> int:
-    return 5 if cfg.algo == "dense" else 4  # (+ keys)
+    return 5 if cfg.algo in _TILED_ALGOS else 4  # (+ keys)
 
 
 def _epoch_out_specs(mesh, cfg):
@@ -593,12 +640,16 @@ def epoch_arg_shapes(n_workers, n_docs, vocab_size, cfg: LDAConfig,
         flat = ((n * T_pad,), i32)
         return [((d_bound * n, K), ndk_dt), ((w_own * n, K), f32), nk,
                 flat, flat, flat, ((n * T_pad,), f32), keys]
-    if cfg.algo == "dense":
+    if cfg.algo in _TILED_ALGOS:
         d_own, w_own, d_bound, ib2 = _dense_bounds(
             n_docs, vocab_size, n, ns, cfg.d_tile, cfg.w_tile)
         C = entry_width or cfg.entry_cap
+        # NE comes from the REAL entry capacity — pallas C-padding adds
+        # masked slots, not token capacity (set_tokens pads after packing)
         NE = entries_per_row or max(1, _ceil_div(_ceil_div(n_tokens, n * ns),
                                                  C))
+        if cfg.algo == "pallas":
+            C = _PALLAS_C * _ceil_div(C, _PALLAS_C)
         ec, eo = ((n * ns, NE, C), i32), ((n * ns, NE), i32)
         return [((d_bound * n, K), ndk_dt), ((2 * ib2 * n, K), f32), nk,
                 ec, ec, ec, eo, eo, keys]
@@ -624,7 +675,7 @@ class LDA:
         self.cfg = cfg or LDAConfig()
         self.n_docs, self.vocab_size = n_docs, vocab_size
         n = self.mesh.num_workers
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _TILED_ALGOS:
             self.d_own, self.w_own, self.d_bound, wb2 = _dense_bounds(
                 n_docs, vocab_size, n, 2 * n, self.cfg.d_tile, self.cfg.w_tile)
             self.w_bound = 2 * wb2
@@ -684,13 +735,23 @@ class LDA:
         # reuse the MF-SGD grid partitioners: "rating value" carries the
         # initial topic assignment
         z0 = rng.integers(0, K, len(doc_ids)).astype(np.float32)
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _TILED_ALGOS:
             ed, ew, ez, od, ow, do, wo, db, wb2 = partition_ratings_tiles(
                 doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
                 self.cfg.d_tile, self.cfg.w_tile, self.cfg.entry_cap,
             )
             assert (do, wo, db, 2 * wb2) == (
                 self.d_own, self.w_own, self.d_bound, self.w_bound)
+            if self.cfg.algo == "pallas":
+                # kernel chunks C in _PALLAS_C slices: pad entry width up
+                # (pad slots: d id = tile width -> masked out in-kernel)
+                Cw = ed.shape[-1]
+                Cp = _PALLAS_C * _ceil_div(Cw, _PALLAS_C)
+                if Cp != Cw:
+                    pad = ((0, 0), (0, 0), (0, Cp - Cw))
+                    ed = np.pad(ed, pad, constant_values=self.cfg.d_tile)
+                    ew = np.pad(ew, pad, constant_values=self.cfg.w_tile)
+                    ez = np.pad(ez, pad, constant_values=0.0)
             z_grid = ez.astype(np.int32)
             tokens = (ed, ew, od, ow)
         elif self.cfg.algo == "pushpull":
@@ -745,7 +806,7 @@ class LDA:
             return gd, pw, pm > 0  # word ids are already global
         db, wb2 = self.d_bound, self.w_bound // 2
         rows = np.arange(n * 2 * n)
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _TILED_ALGOS:
             ed, ew, od, ow = (np.asarray(a) for a in tokens)
             gm = (ed < self.cfg.d_tile).reshape(-1)
             ld = np.minimum(ed, self.cfg.d_tile - 1) + od[:, :, None]
@@ -763,7 +824,7 @@ class LDA:
         """[n_docs, K] doc-topic counts with storage padding stripped."""
         n = self.mesh.num_workers
         Ndk = np.asarray(self.Ndk)
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _TILED_ALGOS:
             K = Ndk.shape[-1]
             Ndk = Ndk.reshape(n, self.d_bound, K)[:, : self.d_own].reshape(-1, K)
         return Ndk[: self.n_docs]
@@ -772,7 +833,7 @@ class LDA:
         """[vocab_size, K] word-topic counts with storage padding stripped."""
         n = self.mesh.num_workers
         Nwk = np.asarray(self.Nwk)
-        if self.cfg.algo == "dense":
+        if self.cfg.algo in _TILED_ALGOS:
             K = Nwk.shape[-1]
             wb2 = self.w_bound // 2
             Nwk = Nwk.reshape(2 * n, wb2, K)[:, : self.w_own].reshape(-1, K)
@@ -907,14 +968,23 @@ def synthetic_corpus(n_docs, vocab_size, n_topics_true, tokens_per_doc, seed=0):
 
 def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
               entry_cap=None, pull_cap=None, ndk_dtype="float32",
-              dedup_pulls=None, sampler="gumbel", rng_impl="threefry"):
+              dedup_pulls=None, sampler=None, rng_impl=None):
     """None inherits LDAConfig's defaults; algo-specific knobs raise when
     combined with a non-owning algo (shared contract: mfsgd.algo_kwargs)."""
+    # None = "caller didn't say": resolves to the LDAConfig defaults,
+    # except algo="pallas" whose fused kernel IS the exprace +
+    # hardware-bits stack (an EXPLICIT gumbel/threefry request passes
+    # through and errors in LDAConfig's validation)
+    if sampler is None:
+        sampler = "exprace" if algo == "pallas" else "gumbel"
+    if rng_impl is None:
+        rng_impl = "rbg" if algo == "pallas" else "threefry"
     return LDAConfig(n_topics=n_topics, ndk_dtype=ndk_dtype, sampler=sampler,
                      rng_impl=rng_impl,
                      **algo_kwargs(algo, {
         ("scatter", "pushpull"): {"chunk": chunk},
-        "dense": {"d_tile": d_tile, "w_tile": w_tile, "entry_cap": entry_cap},
+        _TILED_ALGOS: {"d_tile": d_tile, "w_tile": w_tile,
+                       "entry_cap": entry_cap},
         "pushpull": {"pull_cap": pull_cap, "dedup_pulls": dedup_pulls},
     }))
 
@@ -923,7 +993,7 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
               tokens_per_doc=100, epochs=2, mesh=None, chunk=None, seed=0,
               algo="dense", d_tile=None, w_tile=None, entry_cap=None,
               pull_cap=None, ndk_dtype="float32", dedup_pulls=None,
-              sampler="gumbel", rng_impl="threefry"):
+              sampler=None, rng_impl=None):
     """Tokens/sec/chip on an enwiki-1M-scaled config (graded config #3).
 
     (Full enwiki-1M docs needs a multi-chip pod for the 1M×1k doc-topic
@@ -969,7 +1039,8 @@ def main(argv=None):
     p.add_argument("--topics", type=int, default=1000)
     p.add_argument("--tokens-per-doc", type=int, default=100)
     p.add_argument("--epochs", type=int, default=2)
-    p.add_argument("--algo", choices=["dense", "scatter", "pushpull"],
+    p.add_argument("--algo",
+                   choices=["dense", "scatter", "pushpull", "pallas"],
                    default="dense",
                    help="dense: one-hot MXU count updates (fastest, "
                         "default); scatter: direct scatter-add reference; "
@@ -990,13 +1061,13 @@ def main(argv=None):
                         "on by default — Zipf corpora need far smaller "
                         "pull_cap with it)")
     p.add_argument("--sampler", choices=["gumbel", "exprace"],
-                   default="gumbel",
+                   default=None,
                    help="topic draw: gumbel (log-posterior + Gumbel "
                         "argmax, default) or exprace (exponential race — "
                         "identical distribution, ~5x fewer VPU "
                         "transcendentals; opt-in until TPU-measured)")
     p.add_argument("--rng-impl", choices=["threefry", "rbg"],
-                   default="threefry",
+                   default=None,
                    help="random bits for the [token, K] draws: threefry "
                         "(default, splittable counter PRNG) or rbg (TPU "
                         "hardware generator, near-free; opt-in until "

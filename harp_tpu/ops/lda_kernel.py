@@ -1,0 +1,208 @@
+"""Fused LDA-CGS entry resample — Pallas TPU kernel.
+
+Reference parity: the CGS inner loop Harp ran in ``edu.iu.lda``'s
+sampler threads (SURVEY.md §3.4 #3, §4.4).  The XLA ``algo="dense"``
+path (`models/lda.py:_sample_entry`) materializes six-plus [C, K]
+intermediates per tile entry in HBM (gathered count rows, the removed
+self-assignment, posterior, noise) — ~30 MB per 2048-token entry at the
+graded 1k topics.  This kernel runs the whole entry — count-row
+gathers, posterior, topic draw, count-delta scatters — inside VMEM, so
+HBM sees only the two count tiles in and out plus the token stream.
+
+Layout (the kmeans/mfsgd kernels' lane rules): everything is
+**topic-major** — count tiles arrive transposed ([K, d_tile]/[K, w_tile],
+the epoch transposes the tables once), token ids/assignments ride rows
+[1, C], all one-hots are built in [tile, C] orientation, and every
+matmul contracts over lanes or A-lane×B-sublane.
+
+Sampling stack (fixed, by construction — the kernel exists because of
+it): exponential-race draw (``LDAConfig.sampler="exprace"`` — identical
+distribution to Gumbel-argmax) over hardware random bits
+(``pltpu.prng_random_bits`` — the ``rng_impl="rbg"`` analogue), seeded
+per entry+chunk so runs are deterministic per backend.
+
+Numerics — read before trusting counts:
+- Count GATHERS run as single bf16 one-hot dots: counts ≤ 256 are exact;
+  larger counts round to bf16 (≤ 0.4% relative error) *in the posterior
+  only*.  The posterior a hot word sees is already ~that stale from
+  parallel chunk sampling, so this perturbs the draw less than the
+  blocked-Gibbs approximation the reference itself makes.  (An exact
+  alternative — hi/lo bf16 plane splitting — doubles the gather dots;
+  revisit if a likelihood regression ever shows.)
+- Count UPDATES stay exact: deltas are 0/±1 (bf16-exact), scatter dots
+  accumulate in f32, int16 tables round-trip exactly.  Tables remain
+  integer-valued — the invariant the tests pin.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+
+
+def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
+            alpha, beta, vbeta, has_noise):
+    if has_noise:
+        # CPU/interpret test path: pltpu.prng_random_bits is stubbed to
+        # zeros off-TPU, so uniforms arrive as a sliced input instead
+        noise_in, db_out, wb_out, z_out, dnk_out = rest
+    else:
+        db_out, wb_out, z_out, dnk_out = rest
+    K, DR = db_in.shape
+    _, WR = wb_in.shape
+    cc = z_in.shape[1]
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        db_out[...] = db_in[...]
+        wb_out[...] = wb_in[...]
+        dnk_out[...] = jnp.zeros_like(dnk_out)
+
+    cd = cd_in[...]                                      # [1, cc] i32
+    cw = cw_in[...]
+    z = z_in[...]
+    m = (cd < DR).astype(jnp.float32)                    # pad slots drop out
+
+    ohd = (lax.broadcasted_iota(jnp.int32, (DR, cc), 0) == cd
+           ).astype(jnp.bfloat16)                        # [DR, cc]
+    ohw = (lax.broadcasted_iota(jnp.int32, (WR, cc), 0) == cw
+           ).astype(jnp.bfloat16)
+    rows_k = lax.broadcasted_iota(jnp.int32, (K, cc), 0)
+    oh_old = (rows_k == z).astype(jnp.float32) * m       # [K, cc]
+
+    dot = functools.partial(lax.dot_general,
+                            preferred_element_type=jnp.float32)
+    # snapshot gathers (bf16-rounded for counts > 256 — see module doc)
+    ndkT = dot(db_out[...].astype(jnp.bfloat16), ohd,
+               (((1,), (0,)), ((), ()))) - oh_old        # [K, cc]
+    nwkT = dot(wb_out[...].astype(jnp.bfloat16), ohw,
+               (((1,), (0,)), ((), ()))) - oh_old
+    nkT = (nk_in[...] + dnk_out[...]) - oh_old           # [K, 1] bcast
+
+    a = jnp.maximum(ndkT + alpha, 1e-10)
+    b = jnp.maximum(nwkT + beta, 1e-10)
+    c = jnp.maximum(nkT + vbeta, 1e-10)
+    # exponential race: argmin E/p, E ~ Exp(1), p ∝ a·b/c
+    if has_noise:
+        u = noise_in[...]                                # [K, cc] in (0,1)
+    else:
+        # distinct stream per (entry, chunk): entry-key words + chunk id
+        pltpu.prng_seed(seed_ref[0], seed_ref[1], j)
+        bits = pltpu.prng_random_bits((K, cc))
+        u = (bits.astype(jnp.uint32) >> 8).astype(jnp.float32) \
+            * (2.0 ** -24) + 2.0 ** -25                  # (0, 1)
+    ratio = -jnp.log(u) * c / (a * b)                    # [K, cc]
+
+    best = ratio.min(axis=0, keepdims=True)              # [1, cc]
+    z_new = jnp.where(ratio == best, rows_k, K).min(axis=0, keepdims=True)
+    z_new = jnp.where(m > 0, z_new, z)
+    z_out[...] = z_new
+
+    oh_new = (rows_k == z_new).astype(jnp.float32) * m
+    delta = (oh_new - oh_old).astype(jnp.bfloat16)       # 0/±1: exact
+    dDb = dot(delta, ohd, (((1,), (1,)), ((), ())))      # [K, DR] exact f32
+    dWb = dot(delta, ohw, (((1,), (1,)), ((), ())))
+    db_out[...] = (db_out[...].astype(jnp.float32) + dDb
+                   ).astype(db_out.dtype)
+    wb_out[...] = wb_out[...] + dWb
+    dnk_out[...] += delta.astype(jnp.float32).sum(axis=1, keepdims=True)
+
+
+def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
+                     chunk_c: int = 256, interpret: bool = False):
+    """Resample one dense tile entry's tokens; return updated tiles.
+
+    ``DbT`` [K, d_tile] (float32 or int16), ``WbT`` [K, w_tile] float32 —
+    topic-major count tiles; ``nk`` [K] topic totals the entry should
+    sample against; ``z/cd/cw`` [C] current topics + tile-local ids (pad
+    id = tile width); ``seed2`` [2] int32.  Returns
+    ``(DbT', WbT', z_new [C], dnk [K])``.
+
+    Blocked-Gibbs granularity is ``chunk_c`` tokens, FINER than the XLA
+    path's whole-entry snapshot: tiles and dnk accumulate in VMEM across
+    the chunk grid, so chunk j samples against counts that already
+    include chunks < j — strictly fresher than ``lda._sample_entry``
+    (same approximation family the reference's timer-bounded scheduler
+    sets; convergence tests cover it).
+    """
+    K, DR = DbT.shape
+    _, WR = WbT.shape
+    C = z.shape[0]
+
+    def est(cc):
+        # tiles in+out (+4: f32 out even for int16 in) + ~6 live [K, cc]
+        return ((DbT.dtype.itemsize + 4) * K * DR + 8 * K * WR
+                + 6 * 4 * K * cc)
+
+    # shrink the chunk before refusing: halving cc trades grid steps for
+    # VMEM and keeps C % cc == 0 (C is padded to a 256-multiple)
+    cc = min(C, chunk_c)
+    while est(cc) > 14 << 20 and cc > _LANE and cc % 2 == 0:
+        cc //= 2
+    if C % cc:
+        raise ValueError(f"C={C} must be a multiple of chunk_c={cc} "
+                         f"(pad entries with DR/WR ids)")
+    if not interpret:
+        for name, v, mlt in (("d_tile", DR, _LANE), ("w_tile", WR, _LANE),
+                             ("chunk", cc, _LANE), ("n_topics", K, 8)):
+            if v % mlt:
+                raise ValueError(
+                    f"pallas lda: {name}={v} must be a multiple of {mlt} "
+                    f"on TPU (use algo='dense' for odd shapes)")
+    if est(cc) > 14 << 20:
+        raise ValueError(
+            f"pallas lda: ~{est(cc) >> 20} MB VMEM estimate exceeds the "
+            f"14 MB budget even at chunk {cc}; lower d_tile/w_tile or "
+            f"use algo='dense'")
+
+    in_specs = [
+        pl.BlockSpec((K, DR), lambda j, s: (0, 0)),
+        pl.BlockSpec((K, WR), lambda j, s: (0, 0)),
+        pl.BlockSpec((K, 1), lambda j, s: (0, 0)),
+        pl.BlockSpec((1, cc), lambda j, s: (0, j)),
+        pl.BlockSpec((1, cc), lambda j, s: (0, j)),
+        pl.BlockSpec((1, cc), lambda j, s: (0, j)),
+    ]
+    operands = [DbT, WbT, nk.reshape(K, 1), z.reshape(1, C),
+                cd.reshape(1, C), cw.reshape(1, C)]
+    if interpret:
+        # off-TPU the hardware PRNG is unavailable (pltpu.prng_random_bits
+        # stubs to zeros in interpret mode) — draw the uniforms outside
+        # and stream them in per chunk; the TPU path never pays this HBM
+        key = jax.random.wrap_key_data(seed2.astype(jnp.uint32)[:2])
+        u_all = jax.random.uniform(key, (K, C), jnp.float32,
+                                   minval=2.0 ** -25, maxval=1.0)
+        in_specs.append(pl.BlockSpec((K, cc), lambda j, s: (0, j)))
+        operands.append(u_all)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # seed2
+        grid=(C // cc,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((K, DR), lambda j, s: (0, 0)),
+            pl.BlockSpec((K, WR), lambda j, s: (0, 0)),
+            pl.BlockSpec((1, cc), lambda j, s: (0, j)),
+            pl.BlockSpec((K, 1), lambda j, s: (0, 0)),
+        ],
+    )
+    Db2, Wb2, z_new, dnk = pl.pallas_call(
+        functools.partial(_kernel, alpha=alpha, beta=beta, vbeta=vbeta,
+                          has_noise=bool(interpret)),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((K, DR), DbT.dtype),
+            jax.ShapeDtypeStruct((K, WR), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.int32),
+            jax.ShapeDtypeStruct((K, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed2.astype(jnp.int32), *operands)
+    return Db2, Wb2, z_new.reshape(C), dnk.reshape(K)

@@ -103,6 +103,7 @@ WORK_MODELS = {
     "lda": _lda_work,
     "lda_exprace": _lda_work,
     "lda_fast": _lda_work,
+    "lda_pallas": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
     "lda_scatter": _lda_work,

@@ -307,9 +307,11 @@ from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings
 
 _u, _i, _v = synthetic_ratings(96, 64, 3000, rank=4, noise=0.05, seed=2)
 _factors = {}
+_mt = 128 if mode == "tpu" else 8  # kernel gates 128-multiples on TPU
 for _algo in ("dense", "pallas"):
-    _cfg = MFSGDConfig(rank=8, algo=_algo, u_tile=8, i_tile=8, entry_cap=32,
-                       compute_dtype=jnp.float32, lr=0.03, reg=0.01)
+    _cfg = MFSGDConfig(rank=8, algo=_algo, u_tile=_mt, i_tile=_mt,
+                       entry_cap=32, compute_dtype=jnp.float32,
+                       lr=0.03, reg=0.01)
     _m = MFSGD(96, 64, _cfg, mesh, seed=4)
     _m.set_ratings(_u, _i, _v)
     _rm = [_m.train_epoch() for _ in range(2)]
@@ -374,3 +376,27 @@ for _k, _v in _lls.items():
     assert abs(_v - _base) / abs(_base) < 0.25, _lls
 print(f"sampler/rng variants ≡ gumbel chain quality ({_lls})")
 print(f"DRIVE OK round-12 ({mode})")
+
+# 18. fused Pallas LDA entry resample (this session): algo="pallas"
+# through the public driver — chain ascends, counts stay exact integers.
+# TPU-legal tiles when driving real hardware (the kernel gates 128-
+# multiples there); the CPU sim keeps the fast small-tile shapes
+_pt = 128 if mode == "tpu" else 16
+_pcfg = LDAConfig(n_topics=8, algo="pallas", d_tile=_pt, w_tile=_pt,
+                  entry_cap=64, alpha=0.5, beta=0.1,
+                  sampler="exprace", rng_impl="rbg")
+_pm = LDA(64, 32, _pcfg, mesh, seed=1)
+_pm.set_tokens(_d, _w)
+_pll0 = _pm.log_likelihood()
+for _ in range(6):
+    _pm.sample_epoch()
+_pndk = np.asarray(_pm.Ndk)
+_pnwk = np.asarray(_pm.Nwk)
+assert _pndk.sum() == _pm.n_tokens and (_pndk >= 0).all()
+assert (_pnwk == np.round(_pnwk)).all()  # integer counts survive bf16 gathers
+np.testing.assert_allclose(_pnwk.sum(0), np.asarray(_pm.Nk))
+assert _pm.log_likelihood() > _pll0
+_pbase = _lls["gumbel/threefry"]
+assert abs(_pm.log_likelihood() - _pbase) / abs(_pbase) < 0.25
+print(f"pallas LDA chain ok (ll {_pll0:.2f} -> {_pm.log_likelihood():.2f})")
+print(f"DRIVE OK round-13 ({mode})")

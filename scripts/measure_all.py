@@ -112,6 +112,13 @@ def run_all(smoke: bool, only, watchdog=None):
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
                 "w_tile": 16, "entry_cap": 64} if smoke else {})),
+        # round 3: the whole entry fused into one VMEM kernel
+        # (ops/lda_kernel.py) — candidate new default if it wins on TPU
+        "lda_pallas": lambda: lda.benchmark(
+            algo="pallas",
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 128,
+                "w_tile": 128, "entry_cap": 64} if smoke else {})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
@@ -179,9 +186,9 @@ def main(argv=None):
                    choices=["kmeans", "kmeans_int8", "kmeans_stream",
                             "kmeans_ingest", "mfsgd", "mfsgd_scatter",
                             "mfsgd_pallas", "lda", "lda_exprace",
-                            "lda_fast", "lda_scale", "lda_scale_1m",
-                            "lda_scatter", "mlp", "subgraph",
-                            "subgraph_1m", "rf"],
+                            "lda_fast", "lda_pallas", "lda_scale",
+                            "lda_scale_1m", "lda_scatter", "mlp",
+                            "subgraph", "subgraph_1m", "rf"],
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
     p.add_argument("--platform", choices=["cpu"], default=None,

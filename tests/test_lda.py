@@ -297,7 +297,7 @@ def test_int16_rejects_overlong_document(mesh, monkeypatch):
 def test_pushpull_rejects_dense_knobs():
     with pytest.raises(ValueError, match="pull_cap only applies"):
         L.LDAConfig(algo="dense", pull_cap=8)
-    with pytest.raises(ValueError, match="dense-only"):
+    with pytest.raises(ValueError, match="dense.pallas-only"):
         L._make_cfg(4, algo="pushpull", d_tile=8)
     with pytest.raises(ValueError, match="pushpull-only"):
         L._make_cfg(4, algo="scatter", chunk=16, pull_cap=8)

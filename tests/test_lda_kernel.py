@@ -1,0 +1,134 @@
+"""Fused Pallas LDA-CGS kernel (ops/lda_kernel.py) + algo="pallas".
+
+Interpret mode streams externally-drawn uniforms (the TPU hardware PRNG
+is unavailable off-TPU), so the distributional tests exercise the exact
+posterior/race math the TPU path runs — only the bit source differs.
+"""
+
+import numpy as np
+import pytest
+
+from harp_tpu.models import lda as L
+
+N = 8
+
+
+def _pallas_cfg(**kw):
+    base = dict(n_topics=8, algo="pallas", d_tile=16, w_tile=16,
+                entry_cap=64, alpha=0.5, beta=0.1,
+                sampler="exprace", rng_impl="rbg")
+    base.update(kw)
+    return L.LDAConfig(**base)
+
+
+def test_kernel_draws_from_posterior():
+    """Direct kernel calls on a flat tile: frequencies must match
+    p ∝ (ndk+α)(nwk+β)/(nk+Vβ).
+
+    One 256-token chunk per call (all tokens score against the entry
+    snapshot — no within-call drift), repeated over fresh seeds from the
+    SAME initial counts; counts are large so the bf16-rounded gathers
+    (module doc) shift p well under the statistical window."""
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.lda_kernel import cgs_entry_update
+
+    K, DR, WR, C = 8, 8, 8, 256
+    av = np.array([1.0, 2, 3, 4, 1, 1, 1, 3]) * 10_000
+    bv = np.array([4.0, 1, 2, 1, 1, 2, 1, 1]) * 10_000
+    DbT = jnp.zeros((K, DR), jnp.float32).at[:, 0].set(jnp.asarray(av))
+    WbT = jnp.zeros((K, WR), jnp.float32).at[:, 0].set(jnp.asarray(bv))
+    nk = jnp.full((K,), 1e6)
+    z = jnp.zeros(C, jnp.int32)  # current topic 0 (consistent: av[0] ≫ C)
+    cd = jnp.zeros(C, jnp.int32)
+    cw = jnp.zeros(C, jnp.int32)
+
+    # remove-current: topic 0 scores (a0−1)(b0−1)/(c0−1)
+    a, b, c = av.copy(), bv.copy(), np.full(K, 1e6)
+    a[0] -= 1; b[0] -= 1; c[0] -= 1
+    p = (a * b) / c
+    p /= p.sum()
+
+    reps = 24
+    counts = np.zeros(K)
+    for r in range(reps):
+        _, _, z_new, dnk = cgs_entry_update(
+            DbT, WbT, nk, z, cd, cw, jnp.array([3, 100 + r], jnp.int32),
+            alpha=0.0, beta=0.0, vbeta=0.0, interpret=True)
+        zn = np.asarray(z_new)
+        counts += np.bincount(zn, minlength=K)
+        # count bookkeeping: dnk ≡ assignment histogram delta, every call
+        np.testing.assert_allclose(
+            np.asarray(dnk),
+            np.bincount(zn, minlength=K) - np.array([C] + [0] * (K - 1)))
+    freq = counts / (reps * C)
+    se = np.sqrt(p * (1 - p) / (reps * C)).max()
+    np.testing.assert_allclose(freq, p, atol=5 * se + 0.005)
+
+
+@pytest.mark.parametrize("ndk_dtype", ["float32", "int16"])
+def test_pallas_chain_converges_counts_exact(mesh, ndk_dtype):
+    cfg = _pallas_cfg(ndk_dtype=ndk_dtype)
+    d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
+                              tokens_per_doc=50, seed=0)
+    model = L.LDA(96, 64, cfg, mesh, seed=1)
+    model.set_tokens(d, w)
+    ll0 = model.log_likelihood()
+    for _ in range(6):
+        model.sample_epoch()
+    assert model.log_likelihood() > ll0
+    Ndk = np.asarray(model.Ndk)
+    Nwk = np.asarray(model.Nwk)
+    Nk = np.asarray(model.Nk)
+    # the scatter side is exact: tables stay integer-valued invariants
+    assert Ndk.sum() == model.n_tokens
+    assert Nwk.sum() == model.n_tokens
+    np.testing.assert_allclose(Nwk.sum(0), Nk)
+    np.testing.assert_array_equal(Nwk, np.round(Nwk))
+    assert (Ndk >= 0).all() and (Nwk >= 0).all()
+
+
+def test_pallas_multi_epoch_program(mesh):
+    """sample_epochs (one scanned device program) through the kernel."""
+    cfg = _pallas_cfg()
+    d, w = L.synthetic_corpus(n_docs=64, vocab_size=32, n_topics_true=4,
+                              tokens_per_doc=40, seed=2)
+    model = L.LDA(64, 32, cfg, mesh, seed=3)
+    model.set_tokens(d, w)
+    model.sample_epochs(3)
+    Ndk = np.asarray(model.Ndk)
+    assert Ndk.sum() == model.n_tokens and (Ndk >= 0).all()
+
+
+def test_pallas_requires_fused_sampling_stack():
+    with pytest.raises(ValueError, match="exprace"):
+        L.LDAConfig(n_topics=8, algo="pallas")  # default gumbel/threefry
+
+
+def test_pallas_benchmark_defaults_upgrade(mesh):
+    """benchmark(algo='pallas') silently upgrades the DEFAULT sampler
+    knobs (an explicit gumbel request still errors)."""
+    out = L.benchmark(n_docs=64, vocab_size=32, n_topics=8,
+                      tokens_per_doc=8, epochs=1, mesh=mesh,
+                      algo="pallas", d_tile=16, w_tile=16, entry_cap=64)
+    assert out["tokens_per_sec_per_chip"] > 0
+    with pytest.raises(ValueError, match="exprace"):
+        L.benchmark(n_docs=64, vocab_size=32, n_topics=8,
+                    tokens_per_doc=8, epochs=1, mesh=mesh,
+                    algo="pallas", sampler="gumbel")
+
+
+def test_kernel_vmem_gate():
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.lda_kernel import cgs_entry_update
+
+    K = 4096
+    DbT = jnp.zeros((K, 512), jnp.float32)
+    WbT = jnp.zeros((K, 512), jnp.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        cgs_entry_update(DbT, WbT, jnp.zeros(K), jnp.zeros(256, jnp.int32),
+                         jnp.zeros(256, jnp.int32),
+                         jnp.zeros(256, jnp.int32),
+                         jnp.zeros(2, jnp.int32), alpha=0.1, beta=0.1,
+                         vbeta=1.0, interpret=True)
