@@ -38,6 +38,7 @@ BASELINES = {
     "mfsgd": 92.7e6,        # updates/s/chip, ML-20M shapes, dense algo
     "mfsgd_pallas": None,   # fused-kernel algo (round 3; no TPU number yet)
     "lda": 6.58e6,          # tokens/s/chip, 100k docs × 1k topics, dense
+    "lda_pallas": None,     # fused-kernel algo (round 3; no TPU number yet)
     "mlp": 22.2e6,          # samples/s, MNIST shapes, device-resident
     "subgraph": 93.8e3,     # vertices/s, u5-tree on 100k vertices
     "rf": 7.92,             # trees/s, 32 trees depth 6 on 200k×64
@@ -98,6 +99,13 @@ def _configs(smoke):
              **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                  "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
                  "w_tile": 16, "entry_cap": 64} if smoke else {}))),
+        ("lda_pallas", "tokens/s/chip", "tokens_per_sec_per_chip",
+         lambda: lda.benchmark(
+             algo="pallas",
+             # smoke tiles must pass the kernel's TPU gate (128-multiples)
+             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 128,
+                 "w_tile": 128, "entry_cap": 64} if smoke else {}))),
         ("mlp", "samples/s", "samples_per_sec", lambda: mlp.benchmark(
             **({"n": 4096, "batch": 512, "steps": 5} if smoke else {}))),
         ("subgraph", "vertices/s", "vertices_per_sec",
