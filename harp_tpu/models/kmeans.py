@@ -119,11 +119,12 @@ def _partials_block(points, centroids, c2, mask=None):
 _INT8_SUM_ROW_LIMIT = (1 << 31) // 127
 
 
-def _clip_round_int8(values, scale):
-    """THE host int8 rounding rule — every quantized-points path (device
-    resident, streaming, sharded-ingest, file-split) shares this one
-    expression so the variants can never disagree on it."""
-    return np.clip(np.round(values / scale), -127, 127).astype(np.int8)
+def _clip_round_int8(values, scale, xp=np):
+    """THE int8 rounding rule — every quantized-points path (device
+    resident, streaming, sharded-ingest, file-split, and the traced
+    synthetic twin via ``xp=jnp``) shares this one expression so the
+    variants can never disagree on it."""
+    return xp.clip(xp.round(values / scale), -127, 127).astype(xp.int8)
 
 
 def _check_int8_chunk_rows(rows_per_worker, limit):

@@ -97,6 +97,7 @@ WORK_MODELS = {
     "kmeans": _kmeans_work,
     "kmeans_int8": _kmeans_work,
     "kmeans_stream": _kmeans_work,
+    "kmeans_stream_int8": _kmeans_work,
     "mfsgd": _mfsgd_work,
     "mfsgd_scatter": _mfsgd_work,
     "mfsgd_pallas": _mfsgd_work,

@@ -400,3 +400,17 @@ _pbase = _lls["gumbel/threefry"]
 assert abs(_pm.log_likelihood() - _pbase) / abs(_pbase) < 0.25
 print(f"pallas LDA chain ok (ll {_pll0:.2f} -> {_pm.log_likelihood():.2f})")
 print(f"DRIVE OK round-13 ({mode})")
+
+# 19. int8 synthetic streaming formulation (this session): the north-star
+# compute twin on the int8 MXU — same keys as f32, inertia within the
+# quantization tolerance and descending.
+from harp_tpu.models.kmeans_stream import benchmark_streaming as _bstr
+
+_bkw = dict(n=32768, d=16, k=8, chunk_points=4096, mesh=mesh, warmup=1)
+_bf = _bstr(iters=2, **_bkw)
+_bq = _bstr(iters=2, quantize="int8", **_bkw)
+assert _bq["quantize"] == "int8"
+assert abs(_bq["inertia"] - _bf["inertia"]) / _bf["inertia"] < 0.05
+print(f"int8 streaming formulation ≡ f32 within tolerance "
+      f"({_bq['inertia']:.0f} vs {_bf['inertia']:.0f})")
+print(f"DRIVE OK round-14 ({mode})")

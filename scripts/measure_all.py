@@ -57,6 +57,14 @@ def run_all(smoke: bool, only, watchdog=None):
                # scaffolding a real ingest wouldn't pay (ex-gen rate)
                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
                 "chunk_points": 262_144, "calibrate_gen": True})),
+        # round 3: the same compute formulation on the int8 MXU (2× the
+        # bf16 rate on v5e) — device-quantized chunks, static 5σ scale
+        "kmeans_stream_int8": lambda: kmeans_stream.benchmark_streaming(
+            quantize="int8",
+            **({"n": 65536, "d": 16, "k": 16, "iters": 2,
+                "chunk_points": 8192} if smoke else
+               {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
+                "chunk_points": 262_144, "calibrate_gen": True})),
         "mfsgd": lambda: mfsgd.benchmark(
             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
@@ -184,7 +192,8 @@ def main(argv=None):
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
                    choices=["kmeans", "kmeans_int8", "kmeans_stream",
-                            "kmeans_ingest", "mfsgd", "mfsgd_scatter",
+                            "kmeans_stream_int8", "kmeans_ingest",
+                            "mfsgd", "mfsgd_scatter",
                             "mfsgd_pallas", "lda", "lda_exprace",
                             "lda_fast", "lda_pallas", "lda_scale",
                             "lda_scale_1m", "lda_scatter", "mlp",

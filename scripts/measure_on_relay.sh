@@ -4,6 +4,7 @@
 # Probes the relay (bounded, per CLAUDE.md: never block on it), then runs
 # the full measurement checklist from BASELINE.md's outage list:
 #   1. scripts/measure_all.py  → BENCH_local.jsonl (all graded configs +
+#      round-3 candidates: mfsgd_pallas, lda_exprace/lda_fast/lda_pallas;
 #      roofline annotations; per-config watchdog)
 #   2. bench.py                → one driver-contract JSON line
 # Each step is watchdogged (HARP_BENCH_TIMEOUT, default 1200 s/config), so
@@ -20,6 +21,9 @@ if ! timeout 45 python -c "import jax; print(jax.devices())"; then
   exit 1
 fi
 
+echo "== raw H2D/D2H bandwidth over the relay (kmeans_ingest diagnosis) =="
+timeout 600 python scripts/probe_h2d.py | tee -a BENCH_local.jsonl
+
 echo "== pre-generate the ingest dataset OUTSIDE any watchdog =="
 # 12 GB took 864 s of the 1200 s per-config window on this 1-core host
 # (2026-07-31) — the sweep's kmeans_ingest config must only pay streaming
@@ -35,11 +39,15 @@ echo "== 1B-point formulation (2 epochs, ~minutes) =="
 python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
   | tee -a BENCH_local.jsonl
 
-echo "== real-ingest 100M×300 (writes a 60 GB f16 npy; host-bound) =="
-python scripts/bench_ingest.py --rows 100000000 --ensure-only
-python scripts/bench_ingest.py --rows 100000000 --iters 2 \
-  --compare-synthetic | tee -a BENCH_local.jsonl
-rm -f .bench_data/pts_100000000x300_float16.npy  # 60 GB: most of the disk
+echo "== subgraph overflow-tail decision: segment vs onehot (r2 item 7) =="
+python -m harp_tpu subgraph --graph powerlaw --vertices 100000 \
+  --overflow-algo segment | tee -a BENCH_local.jsonl
+python -m harp_tpu subgraph --graph powerlaw --vertices 100000 \
+  --overflow-algo onehot | tee -a BENCH_local.jsonl
+
+echo "== per-config op-breakdown traces (self-time; fast configs only) =="
+timeout 2400 python scripts/profile_on_relay.py --out PROFILE_local.jsonl \
+  || echo "profile pass died (relay?) — partial PROFILE_local.jsonl kept"
 
 echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
 python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \

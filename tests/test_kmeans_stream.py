@@ -427,6 +427,25 @@ def test_north_star_1b_program_lowers(mesh):
     assert "while" in text  # the chunk scan is in the program
 
 
+def test_north_star_1b_int8_program_lowers(mesh):
+    """The int8 twin of the 1B program (device-quantized chunks on the
+    int8 MXU) lowers at true shapes too — same proof, quantized path."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = KS.StreamConfig(k=1000, chunk_points=262_144, quantize="int8")
+    n_chunks = 1_000_000_000 // cfg.chunk_points
+    fn = KS.make_synthetic_run_fn(mesh, cfg, d=300, n_chunks=n_chunks)
+    keys = jax.random.split(jax.random.key(0), mesh.num_workers)
+    lowered = fn.lower(
+        jax.ShapeDtypeStruct(keys.shape, keys.dtype,
+                             sharding=mesh.sharding(mesh.spec(0))),
+        jax.ShapeDtypeStruct((1000, 300), jnp.float32,
+                             sharding=mesh.replicated()),
+        jax.ShapeDtypeStruct((), jnp.int32, sharding=mesh.replicated()))
+    assert "i8" in lowered.as_text()  # the int8 stream is in the program
+
+
 # ---- wire dtype (H2D payload format; round 3) -------------------------
 
 def test_resolve_wire_dtype_rules():
@@ -511,3 +530,19 @@ def test_streaming_files_f16_splits_use_f16_wire(mesh, tmp_path):
     fs2 = FileSplits([paths[0], str(csv)], 2, range(2))
     assert fs2.dtype is None  # mixed → wire falls back to compute dtype
     fs2.close()
+
+
+def test_synthetic_int8_formulation_matches_f32_clustering(mesh):
+    """quantize='int8' on the device-regenerated formulation: same data
+    (same keys), quantized on device with the static 5σ scale — inertia
+    must land within the quantization tolerance of the f32 run and
+    descend with more iters."""
+    kw = dict(n=65536, d=16, k=16, chunk_points=8192, mesh=mesh, warmup=1)
+    f1 = KS.benchmark_streaming(iters=1, **kw)
+    q1 = KS.benchmark_streaming(iters=1, quantize="int8", **kw)
+    q6 = KS.benchmark_streaming(iters=6, quantize="int8", **kw)
+    assert q1["quantize"] == "int8"
+    # int8 rounding perturbs assignments slightly; 5% matches the
+    # non-streaming int8 quality bound (tests/test_kmeans.py)
+    assert abs(q1["inertia"] - f1["inertia"]) / f1["inertia"] < 0.05
+    assert q6["inertia"] < q1["inertia"]
