@@ -97,8 +97,10 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
         # distinct stream per (entry, chunk): entry-key words + chunk id
         pltpu.prng_seed(seed_ref[0], seed_ref[1], j)
         bits = pltpu.prng_random_bits((K, cc))
-        u = (bits.astype(jnp.uint32) >> 8).astype(jnp.float32) \
-            * (2.0 ** -24) + 2.0 ** -25                  # (0, 1)
+        # logical shift keeps int32 (Mosaic has no uint32->f32 cast):
+        # 24 uniform bits -> (0, 1)
+        u = lax.shift_right_logical(bits, 8).astype(jnp.float32) \
+            * (2.0 ** -24) + 2.0 ** -25
     ratio = -jnp.log(u) * c / (a * b)                    # [K, cc]
 
     best = ratio.min(axis=0, keepdims=True)              # [1, cc]

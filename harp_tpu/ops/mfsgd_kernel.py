@@ -55,17 +55,19 @@ _LANE = 128
 
 
 def _kernel(ou_blk_ref, oi_ref, w_in, h_in, cu_ref, ci_ref, cv_ref,
-            w_out, h_out, se_ref, cnt_ref, *, lr, reg, i_tile, cc,
-            compute_dtype):
+            w_out, h_out, se_ref, cnt_ref, wsnap, hsnap, gw_acc, gh_acc,
+            *, lr, reg, i_tile, compute_dtype):
     R, UR = w_in.shape
     IR = i_tile
-    C = cu_ref.shape[1]
-    e = pl.program_id(0)
+    cc = cu_ref.shape[-1]
+    e = pl.program_id(0)   # entry
+    j = pl.program_id(1)   # chunk within entry
+    nc = pl.num_programs(1)
 
     blk = ou_blk_ref[e]
     prev = ou_blk_ref[jnp.maximum(e - 1, 0)]
 
-    @pl.when(e == 0)
+    @pl.when((e == 0) & (j == 0))
     def _init():
         h_out[...] = h_in[...]
         se_ref[...] = jnp.zeros_like(se_ref)
@@ -74,47 +76,53 @@ def _kernel(ou_blk_ref, oi_ref, w_in, h_in, cu_ref, ci_ref, cv_ref,
     # First entry of this W block's contiguous run: seed the output buffer
     # from the pristine input block.  Later entries of the run read back
     # their predecessors' updates from the (still-resident) output buffer.
-    @pl.when((e == 0) | (blk != prev))
+    @pl.when(((e == 0) | (blk != prev)) & (j == 0))
     def _start_run():
         w_out[...] = w_in[...]
 
     toi = pl.multiple_of(oi_ref[e], IR)
-    WbT = w_out[...]                                   # [R, UR] f32
-    Hb = h_out[:, pl.ds(toi, IR)]                      # [R, IR] f32
+
+    # Entry start: snapshot the tiles (all chunks score against the
+    # entry-start factors, matching the XLA dense path's whole-entry
+    # snapshot) and zero the gradient accumulators.  Scratch persists
+    # across the sequential grid, so the state survives the chunk steps.
+    @pl.when(j == 0)
+    def _start_entry():
+        wsnap[...] = w_out[...]
+        hsnap[...] = h_out[:, pl.ds(toi, IR)]
+        gw_acc[...] = jnp.zeros_like(gw_acc)
+        gh_acc[...] = jnp.zeros_like(gh_acc)
+
     cd = compute_dtype
     dot = functools.partial(lax.dot_general,
                             preferred_element_type=jnp.float32)
-    Wb_c, Hb_c = WbT.astype(cd), Hb.astype(cd)
+    Wb_c = wsnap[...].astype(cd)
+    Hb_c = hsnap[...].astype(cd)
+    cu = cu_ref[...].reshape(1, cc)                    # [1, cc] i32
+    ci = ci_ref[...].reshape(1, cc)
+    cv = cv_ref[...].reshape(1, cc)                    # [1, cc] f32
 
-    def chunk(j, acc):
-        gW, gH, se, cnt = acc
-        sl = pl.ds(j * cc, cc)
-        cu = cu_ref[:, sl]                             # [1, cc] i32
-        ci = ci_ref[:, sl]
-        cv = cv_ref[:, sl]                             # [1, cc] f32
-        ohu = (lax.broadcasted_iota(jnp.int32, (UR, cc), 0) == cu
-               ).astype(cd)                            # [UR, cc]
-        ohi = (lax.broadcasted_iota(jnp.int32, (IR, cc), 0) == ci
-               ).astype(cd)                            # [IR, cc]
-        wuT = dot(Wb_c, ohu, (((1,), (0,)), ((), ())))  # [R, cc] gather
-        hiT = dot(Hb_c, ohi, (((1,), (0,)), ((), ())))
-        cm = (cu < UR).astype(jnp.float32)             # pad slots drop out
-        err = cm * (cv - (wuT * hiT).sum(0, keepdims=True))
-        gwT = (err * hiT - reg * cm * wuT).astype(cd)  # [R, cc]
-        ghT = (err * wuT - reg * cm * hiT).astype(cd)
-        gW = gW + dot(gwT, ohu, (((1,), (1,)), ((), ())))  # [R, UR] scatter
-        gH = gH + dot(ghT, ohi, (((1,), (1,)), ((), ())))
-        return (gW, gH, se + (err * err).sum(), cnt + cm.sum())
+    ohu = (lax.broadcasted_iota(jnp.int32, (UR, cc), 0) == cu
+           ).astype(cd)                                # [UR, cc]
+    ohi = (lax.broadcasted_iota(jnp.int32, (IR, cc), 0) == ci
+           ).astype(cd)                                # [IR, cc]
+    wuT = dot(Wb_c, ohu, (((1,), (0,)), ((), ())))     # [R, cc] gather
+    hiT = dot(Hb_c, ohi, (((1,), (0,)), ((), ())))
+    cm = (cu < UR).astype(jnp.float32)                 # pad slots drop out
+    err = cm * (cv - (wuT * hiT).sum(0, keepdims=True))
+    gwT = (err * hiT - reg * cm * wuT).astype(cd)      # [R, cc]
+    ghT = (err * wuT - reg * cm * hiT).astype(cd)
+    gw_acc[...] += dot(gwT, ohu, (((1,), (1,)), ((), ())))  # [R, UR]
+    gh_acc[...] += dot(ghT, ohi, (((1,), (1,)), ((), ())))
+    se_ref[...] += (err * err).sum().reshape(1, 1)
+    cnt_ref[...] += cm.sum().reshape(1, 1)
 
-    gW0 = jnp.zeros((R, UR), jnp.float32)
-    gH0 = jnp.zeros((R, IR), jnp.float32)
-    gW, gH, se, cnt = lax.fori_loop(
-        0, C // cc, chunk, (gW0, gH0, jnp.float32(0.0), jnp.float32(0.0)))
-
-    w_out[...] = WbT + lr * gW
-    h_out[:, pl.ds(toi, IR)] = Hb + lr * gH
-    se_ref[...] += se.reshape(1, 1)
-    cnt_ref[...] += cnt.reshape(1, 1)
+    # Entry end: one apply per entry, from the snapshot — identical update
+    # order to the XLA dense path.
+    @pl.when(j == nc - 1)
+    def _end_entry():
+        w_out[...] = wsnap[...] + lr * gw_acc[...]
+        h_out[:, pl.ds(toi, IR)] = hsnap[...] + lr * gh_acc[...]
 
 
 def sgd_tile_update(Wt, Ht, eu, ei, ev, ou, oi, *, lr, reg, u_tile, i_tile,
@@ -152,26 +160,39 @@ def sgd_tile_update(Wt, Ht, eu, ei, ev, ou, oi, *, lr, reg, u_tile, i_tile,
             f" MB ×2 VMEM copies > 10 MB VMEM budget; shard over more "
             f"workers or use algo='dense'")
 
+    # 2-D grid: entries × chunks.  Chunking rides the grid (not an
+    # in-kernel loop — Mosaic supports neither value-level dynamic_slice
+    # nor mixed int+ds ref reads); entry-snapshot state lives in scratch,
+    # which persists across the sequential grid steps.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(NE,),
+        grid=(NE, C // cc),
         in_specs=[
-            pl.BlockSpec((R, u_tile), lambda e, ob, oo: (0, ob[e])),
-            pl.BlockSpec((R, IB), lambda e, ob, oo: (0, 0)),
-            pl.BlockSpec((1, C), lambda e, ob, oo: (e, 0)),
-            pl.BlockSpec((1, C), lambda e, ob, oo: (e, 0)),
-            pl.BlockSpec((1, C), lambda e, ob, oo: (e, 0)),
+            pl.BlockSpec((R, u_tile), lambda e, j, ob, oo: (0, ob[e])),
+            pl.BlockSpec((R, IB), lambda e, j, ob, oo: (0, 0)),
+            # entry streams ride [NE, 1, C]: Mosaic requires block dim -2
+            # to divide 8 or equal the array dim — (1, cc) over [NE, C]
+            # is illegal, (1, 1, cc) over [NE, 1, C] is exact in dim -2
+            pl.BlockSpec((1, 1, cc), lambda e, j, ob, oo: (e, 0, j)),
+            pl.BlockSpec((1, 1, cc), lambda e, j, ob, oo: (e, 0, j)),
+            pl.BlockSpec((1, 1, cc), lambda e, j, ob, oo: (e, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((R, u_tile), lambda e, ob, oo: (0, ob[e])),
-            pl.BlockSpec((R, IB), lambda e, ob, oo: (0, 0)),
-            pl.BlockSpec((1, 1), lambda e, ob, oo: (0, 0)),
-            pl.BlockSpec((1, 1), lambda e, ob, oo: (0, 0)),
+            pl.BlockSpec((R, u_tile), lambda e, j, ob, oo: (0, ob[e])),
+            pl.BlockSpec((R, IB), lambda e, j, ob, oo: (0, 0)),
+            pl.BlockSpec((1, 1), lambda e, j, ob, oo: (0, 0)),
+            pl.BlockSpec((1, 1), lambda e, j, ob, oo: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((R, u_tile), jnp.float32),  # W snapshot
+            pltpu.VMEM((R, i_tile), jnp.float32),  # H snapshot
+            pltpu.VMEM((R, u_tile), jnp.float32),  # gW accumulator
+            pltpu.VMEM((R, i_tile), jnp.float32),  # gH accumulator
         ],
     )
     ou_blk = (ou // u_tile).astype(jnp.int32)
     Wt2, Ht2, se, cnt = pl.pallas_call(
-        functools.partial(_kernel, lr=lr, reg=reg, i_tile=i_tile, cc=cc,
+        functools.partial(_kernel, lr=lr, reg=reg, i_tile=i_tile,
                           compute_dtype=compute_dtype),
         grid_spec=grid_spec,
         out_shape=[
@@ -182,7 +203,8 @@ def sgd_tile_update(Wt, Ht, eu, ei, ev, ou, oi, *, lr, reg, u_tile, i_tile,
         ],
         interpret=interpret,
     )(ou_blk, oi.astype(jnp.int32),
-      Wt, Ht, eu.reshape(NE, C), ei.reshape(NE, C), ev.reshape(NE, C))
+      Wt, Ht, eu.reshape(NE, 1, C), ei.reshape(NE, 1, C),
+      ev.reshape(NE, 1, C))
     return Wt2, Ht2, se[0, 0], cnt[0, 0]
 
 

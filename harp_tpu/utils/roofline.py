@@ -96,6 +96,7 @@ def _mlp_work(r):
 WORK_MODELS = {
     "kmeans": _kmeans_work,
     "kmeans_int8": _kmeans_work,
+    "kmeans_int8_fused": _kmeans_work,
     "kmeans_stream": _kmeans_work,
     "kmeans_stream_int8": _kmeans_work,
     "mfsgd": _mfsgd_work,

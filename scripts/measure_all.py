@@ -48,6 +48,13 @@ def run_all(smoke: bool, only, watchdog=None):
             quantize="int8",
             **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        # round 3: the FUSED int8 kernel (ops/kmeans_kernel.py) — the XLA
+        # int8 path's wall is the ~2 GB/iter [n, k] intermediates it
+        # materializes; the kernel never writes them (single HBM pass)
+        "kmeans_int8_fused": lambda: kmeans.benchmark(
+            quantize="int8", use_pallas=True,
+            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         # north-star shape (SURVEY.md §1): blocked-epoch streaming at
         # 100M×300 k=1000 (full 1B runs via --n on the app CLI)
         "kmeans_stream": lambda: kmeans_stream.benchmark_streaming(
@@ -191,9 +198,9 @@ def main(argv=None):
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "kmeans_int8", "kmeans_stream",
-                            "kmeans_stream_int8", "kmeans_ingest",
-                            "mfsgd", "mfsgd_scatter",
+                   choices=["kmeans", "kmeans_int8", "kmeans_int8_fused",
+                            "kmeans_stream", "kmeans_stream_int8",
+                            "kmeans_ingest", "mfsgd", "mfsgd_scatter",
                             "mfsgd_pallas", "lda", "lda_exprace",
                             "lda_fast", "lda_pallas", "lda_scale",
                             "lda_scale_1m", "lda_scatter", "mlp",

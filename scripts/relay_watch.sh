@@ -14,10 +14,15 @@ POLL_S="${POLL_S:-600}"
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[relay_watch] relay ANSWERED at $(date -u +%FT%TZ) — sprinting"
-    ./scripts/measure_on_relay.sh
-    echo "[relay_watch] sprint done at $(date -u +%FT%TZ) — COMMIT the results"
-    exit 0
+    if ./scripts/measure_on_relay.sh; then
+      echo "[relay_watch] sprint done at $(date -u +%FT%TZ) — COMMIT the results"
+      exit 0
+    fi
+    # the documented flapping mode: answered the probe, hung again before
+    # the sprint — keep watching, partial records (if any) are appended
+    echo "[relay_watch] sprint FAILED at $(date -u +%FT%TZ) — still watching"
+  else
+    echo "[relay_watch] $(date -u +%FT%TZ) relay still hung; sleeping ${POLL_S}s"
   fi
-  echo "[relay_watch] $(date -u +%FT%TZ) relay still hung; sleeping ${POLL_S}s"
   sleep "$POLL_S"
 done

@@ -132,3 +132,25 @@ def test_kernel_vmem_gate():
                          jnp.zeros(256, jnp.int32),
                          jnp.zeros(2, jnp.int32), alpha=0.1, beta=0.1,
                          vbeta=1.0, interpret=True)
+
+
+@pytest.mark.parametrize("ndk_dtype", ["float32", "int16"])
+def test_kernel_lowers_for_tpu(ndk_dtype):
+    """Pallas->Mosaic verification at the graded tile shapes, no hardware
+    (caught the uint32->f32 cast Mosaic rejects, pre-relay)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.lda_kernel import cgs_entry_update
+
+    K, DR, WR, C = 1000, 512, 512, 2048
+    f = functools.partial(cgs_entry_update, alpha=0.1, beta=0.01,
+                          vbeta=500.0, interpret=False)
+    lowered = jax.jit(f).trace(
+        jnp.zeros((K, DR), jnp.dtype(ndk_dtype)), jnp.zeros((K, WR)),
+        jnp.zeros((K,)), jnp.zeros(C, jnp.int32), jnp.zeros(C, jnp.int32),
+        jnp.zeros(C, jnp.int32),
+        jnp.zeros(2, jnp.int32)).lower(lowering_platforms=("tpu",))
+    assert "tpu_custom_call" in lowered.as_text()

@@ -161,3 +161,25 @@ def test_pallas_rejects_oversized_resident_h():
                         jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
                         lr=0.1, reg=0.0, u_tile=128, i_tile=128,
                         interpret=True)
+
+
+def test_kernel_lowers_for_tpu():
+    """Cross-platform lowering runs the Pallas->Mosaic verification
+    (layouts, block shapes, casts) without hardware — the check that
+    caught the [1, C]-block constraint before any relay time was spent."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.mfsgd_kernel import sgd_tile_update
+
+    R, UB, IB, NE, C = 64, 2048, 13440, 8, 2048
+    f = functools.partial(sgd_tile_update, lr=0.01, reg=0.05, u_tile=512,
+                          i_tile=512, interpret=False)
+    lowered = jax.jit(f).trace(
+        jnp.zeros((R, UB)), jnp.zeros((R, IB)),
+        jnp.zeros((NE, C), jnp.int32), jnp.zeros((NE, C), jnp.int32),
+        jnp.zeros((NE, C)), jnp.zeros(NE, jnp.int32),
+        jnp.zeros(NE, jnp.int32)).lower(lowering_platforms=("tpu",))
+    assert "tpu_custom_call" in lowered.as_text()
