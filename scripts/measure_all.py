@@ -20,6 +20,7 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_common
 
 
 def _bench_ingest(smoke: bool):
@@ -36,30 +37,30 @@ def _bench_ingest(smoke: bool):
 def run_all(smoke: bool, only, watchdog=None):
     import jax
 
+    from bench_common import SMOKE
     from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp, rf,
                                  subgraph)
 
     # (name, callable) — each returns the model module's benchmark dict
     configs = {
         "kmeans": lambda: kmeans.benchmark(
-            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+            **(SMOKE["kmeans"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         "kmeans_int8": lambda: kmeans.benchmark(
             quantize="int8",
-            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+            **(SMOKE["kmeans"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         # round 3: the FUSED int8 kernel (ops/kmeans_kernel.py) — the XLA
         # int8 path's wall is the ~2 GB/iter [n, k] intermediates it
         # materializes; the kernel never writes them (single HBM pass)
         "kmeans_int8_fused": lambda: kmeans.benchmark(
             quantize="int8", use_pallas=True,
-            **({"n": 8192, "d": 32, "k": 16, "iters": 10} if smoke else
+            **(SMOKE["kmeans"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         # north-star shape (SURVEY.md §1): blocked-epoch streaming at
         # 100M×300 k=1000 (full 1B runs via --n on the app CLI)
         "kmeans_stream": lambda: kmeans_stream.benchmark_streaming(
-            **({"n": 65536, "d": 16, "k": 16, "iters": 2,
-                "chunk_points": 8192} if smoke else
+            **(SMOKE["kmeans_stream"] if smoke else
                # calibrate_gen: one extra compile+run isolating the RNG
                # scaffolding a real ingest wouldn't pay (ex-gen rate)
                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
@@ -68,30 +69,23 @@ def run_all(smoke: bool, only, watchdog=None):
         # bf16 rate on v5e) — device-quantized chunks, static 5σ scale
         "kmeans_stream_int8": lambda: kmeans_stream.benchmark_streaming(
             quantize="int8",
-            **({"n": 65536, "d": 16, "k": 16, "iters": 2,
-                "chunk_points": 8192} if smoke else
+            **(SMOKE["kmeans_stream"] if smoke else
                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
                 "chunk_points": 262_144, "calibrate_gen": True})),
         "mfsgd": lambda: mfsgd.benchmark(
-            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
-                "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
+            **(SMOKE["mfsgd"]
                if smoke else {})),
         "mfsgd_scatter": lambda: mfsgd.benchmark(
             algo="scatter",
-            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
-                "epochs": 2, "chunk": 1024} if smoke else {})),
+            **(SMOKE["mfsgd_scatter"] if smoke else {})),
         # round 3: the dense update fused into one VMEM Pallas kernel
         # (ops/mfsgd_kernel.py) — candidate new default if it wins on TPU
         "mfsgd_pallas": lambda: mfsgd.benchmark(
             algo="pallas",
             # smoke tiles must pass the kernel's TPU gate (128-multiples)
-            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
-                "epochs": 2, "u_tile": 128, "i_tile": 128,
-                "entry_cap": 256} if smoke else {})),
+            **(SMOKE["mfsgd_pallas"] if smoke else {})),
         "lda": lambda: lda.benchmark(
-            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                "w_tile": 16, "entry_cap": 64} if smoke else {})),
+            **(SMOKE["lda"] if smoke else {})),
         # graded-scale ladder (VERDICT r1 item 5): 500k docs × 1k topics
         # with the int16 doc-topic table (2 GB instead of 4 GB at 1M docs)
         "lda_scale": lambda: lda.benchmark(
@@ -116,33 +110,26 @@ def run_all(smoke: bool, only, watchdog=None):
         # ~5× fewer VPU transcendentals) — candidate default if it wins
         "lda_exprace": lambda: lda.benchmark(
             sampler="exprace",
-            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                "w_tile": 16, "entry_cap": 64} if smoke else {})),
+            **(SMOKE["lda"] if smoke else {})),
         # round 3: exprace + hardware RNG together — the candidate new
         # default sampling stack; vs lda/lda_exprace it attributes the
         # win between sampler math and bit generation
         "lda_fast": lambda: lda.benchmark(
             sampler="exprace", rng_impl="rbg",
-            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                "w_tile": 16, "entry_cap": 64} if smoke else {})),
+            **(SMOKE["lda"] if smoke else {})),
         # round 3: the whole entry fused into one VMEM kernel
         # (ops/lda_kernel.py) — candidate new default if it wins on TPU
         "lda_pallas": lambda: lda.benchmark(
             algo="pallas",
-            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 128,
-                "w_tile": 128, "entry_cap": 64} if smoke else {})),
+            **(SMOKE["lda_pallas"] if smoke else {})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
-            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "chunk": 256} if smoke
+            **(SMOKE["lda_scatter"] if smoke
                else {})),
         "mlp": lambda: mlp.benchmark(
-            **({"n": 4096, "batch": 512, "steps": 5} if smoke else {})),
+            **(SMOKE["mlp"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
-            **({"n_vertices": 2000, "avg_degree": 4} if smoke else {})),
+            **(SMOKE["subgraph"] if smoke else {})),
         # the graded template at graded scale (VERDICT r2 item 4): u5-tree
         # on a 1M-vertex power-law graph — hub mass rides the exact
         # overflow segment-sum path (overflow_share reported; 0 dropped)

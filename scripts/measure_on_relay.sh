@@ -14,6 +14,7 @@
 
 set -u
 cd "$(dirname "$0")/.."
+start_lines=$(wc -l < BENCH_local.jsonl 2>/dev/null || echo 0)
 
 echo "== probing relay (45 s bound) =="
 if ! timeout 45 python -c "import jax; print(jax.devices())"; then
@@ -53,4 +54,18 @@ echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
 python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
   | tee -a BENCH_local.jsonl
 
+# Success = the sweep actually produced records AND the relay still
+# answers (per-config watchdogs os._exit the python steps on a hang but
+# this shell keeps going — without these checks a mid-sprint hang would
+# report success with an empty BENCH_local.jsonl, and relay_watch.sh
+# would stop watching).
+new_lines=$(( $(wc -l < BENCH_local.jsonl 2>/dev/null || echo 0) - start_lines ))
+if [ "$new_lines" -lt 5 ]; then
+  echo "sprint FAILED: only ${new_lines} new records in BENCH_local.jsonl" >&2
+  exit 1
+fi
+if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  echo "sprint DEGRADED: relay stopped answering before the end" >&2
+  exit 1
+fi
 echo "done — update BASELINE.md from BENCH_local.jsonl and COMMIT NOW"

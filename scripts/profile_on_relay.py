@@ -29,22 +29,16 @@ import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))  # bench_common
 
 
 def profiled_configs(smoke: bool):
     """Short-running variants: one trace needs seconds, not minutes."""
+    from bench_common import SMOKE
     from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
 
-    small = {"kmeans": {"n": 8192, "d": 32, "k": 16, "iters": 10},
-             "mfsgd": {"n_users": 512, "n_items": 256, "nnz": 20_000,
-                       "rank": 8, "epochs": 2, "u_tile": 16, "i_tile": 16,
-                       "entry_cap": 256},
-             "lda": {"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                     "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                     "w_tile": 16, "entry_cap": 64},
-             "mlp": {"n": 4096, "batch": 512, "steps": 5},
-             "subgraph": {"n_vertices": 2000, "avg_degree": 4},
-             "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2}}
+    small = {name: SMOKE[name]
+             for name in ("kmeans", "mfsgd", "lda", "mlp", "subgraph", "rf")}
     full = {"kmeans": {"n": 1_000_000, "d": 300, "k": 100, "iters": 10},
             "mfsgd": {"epochs": 2},
             "lda": {"epochs": 1},
@@ -54,7 +48,18 @@ def profiled_configs(smoke: bool):
     mods = {"kmeans": kmeans, "mfsgd": mfsgd, "lda": lda, "mlp": mlp,
             "subgraph": subgraph, "rf": rf}
     kw = small if smoke else full
-    return {name: (mods[name], kw[name]) for name in mods}
+    configs = {name: (mods[name], kw[name]) for name in mods}
+    # round-3 candidates: trace the fused/sampler variants next to their
+    # baselines so the op tables attribute the wins
+    configs["mfsgd_pallas"] = (
+        mfsgd, {"algo": "pallas",
+                **(SMOKE["mfsgd_pallas"] if smoke else kw["mfsgd"])})
+    configs["lda_fast"] = (lda, {**kw["lda"], "sampler": "exprace",
+                                 "rng_impl": "rbg"})
+    configs["lda_pallas"] = (
+        lda, {"algo": "pallas",
+              **(SMOKE["lda_pallas"] if smoke else kw["lda"])})
+    return configs
 
 
 def main(argv=None):
