@@ -27,7 +27,6 @@ def _bench_ingest(smoke: bool):
     # shared presets (bench_ingest.run_smoke/run_full) keep this and
     # bench.py's kmeans_ingest config measuring the same shapes; the
     # synthetic compute twin is the sweep-only extra
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import bench_ingest
 
     return (bench_ingest.run_smoke() if smoke
@@ -135,13 +134,13 @@ def run_all(smoke: bool, only, watchdog=None):
         # overflow segment-sum path (overflow_share reported; 0 dropped)
         "subgraph_1m": lambda: subgraph.benchmark(
             graph="powerlaw",
-            **({"n_vertices": 2000, "avg_degree": 4, "max_degree": 8}
+            **({**SMOKE["subgraph"], "max_degree": 8}
                if smoke else
                {"n_vertices": 1_000_000, "avg_degree": 8,
                 "max_degree": 16, "template": "u5-tree"})),
         "rf": lambda: rf.benchmark(
-            **({"n": 4096, "f": 16, "max_depth": 3,
-                "n_trees": 2 * jax.device_count()} if smoke else {})),
+            **({**SMOKE["rf"], "n_trees": 2 * jax.device_count()}
+               if smoke else {})),
         # the REAL-ingest half of the north-star (disk npy memmap through
         # fit_streaming; VERDICT r2 item 2) — full mode keeps a 12 GB
         # float16 file in .bench_data/ for reuse; the honest 100M-row run

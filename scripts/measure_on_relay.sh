@@ -14,7 +14,7 @@
 
 set -u
 cd "$(dirname "$0")/.."
-start_lines=$(wc -l < BENCH_local.jsonl 2>/dev/null || echo 0)
+start_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null || echo 0)
 
 echo "== probing relay (45 s bound) =="
 if ! timeout 45 python -c "import jax; print(jax.devices())"; then
@@ -59,9 +59,12 @@ python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
 # this shell keeps going — without these checks a mid-sprint hang would
 # report success with an empty BENCH_local.jsonl, and relay_watch.sh
 # would stop watching).
-new_lines=$(( $(wc -l < BENCH_local.jsonl 2>/dev/null || echo 0) - start_lines ))
-if [ "$new_lines" -lt 5 ]; then
-  echo "sprint FAILED: only ${new_lines} new records in BENCH_local.jsonl" >&2
+# count only REAL measurements: watchdogged steps append {"error": ...}
+# records, which must not satisfy the success gate
+total_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null || echo 0)
+new_ok=$(( total_ok - start_ok ))
+if [ "$new_ok" -lt 5 ]; then
+  echo "sprint FAILED: only ${new_ok} new error-free records in BENCH_local.jsonl" >&2
   exit 1
 fi
 if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then

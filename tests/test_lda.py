@@ -8,14 +8,16 @@ from harp_tpu.models import lda as L
 N = 8
 
 
-@pytest.fixture(params=["dense", "scatter", "pushpull"])
+@pytest.fixture(params=["dense", "scatter", "pushpull", "pallas"])
 def small_model(mesh, request):
-    """Fresh model per test (all three count-update algos — dense/scatter
-    rotation and the pull/push variant): shared state would make
-    assertions depend on test execution order."""
+    """Fresh model per test (all four count-update algos — dense/scatter
+    rotation, the pull/push variant, and the fused kernel): shared state
+    would make assertions depend on test execution order."""
+    extra = ({"sampler": "exprace", "rng_impl": "rbg"}
+             if request.param == "pallas" else {})
     cfg = L.LDAConfig(n_topics=8, algo=request.param, chunk=64,
                       d_tile=16, w_tile=16, entry_cap=64,
-                      alpha=0.5, beta=0.1)
+                      alpha=0.5, beta=0.1, **extra)
     d, w = L.synthetic_corpus(n_docs=96, vocab_size=64, n_topics_true=4,
                               tokens_per_doc=50, seed=0)
     model = L.LDA(96, 64, cfg, mesh, seed=1)
