@@ -44,6 +44,24 @@ class MLPConfig:
     # halves/quarters ICI/DCN gradient bytes on real pods; loss/acc metrics
     # always reduce exactly
     grad_wire: str = "f32"
+    # ZeRO-1 optimizer-state sharding (beyond-reference, like TP/PP/EP):
+    # instead of allreduce(grads) + a replicated optax update, the step
+    # PUSHes gradient shards to their owners (psum_scatter — Harp's push
+    # verb applied to the optimizer), updates only the local 1/nw slice of
+    # the optimizer state, and PULLs the updated parameter shards back
+    # (all_gather — Harp's pull).  Optimizer memory per chip drops nw×
+    # (adam: 2× params replicated → 2×/nw), comm volume stays 2×params/
+    # step like allreduce (reduce_scatter + all_gather IS ring allreduce).
+    # Identical math for elementwise optimizers (sgd/momentum/adam) —
+    # tests pin step-for-step equality with the replicated path.
+    zero1: bool = False
+
+    def __post_init__(self):
+        if self.zero1 and self.grad_wire != "f32":
+            raise ValueError(
+                "zero1 shards the gradient exchange through push/pull; "
+                "the quantized allreduce wire does not apply — use "
+                "grad_wire='f32' (quantized reduce_scatter is future work)")
 
 
 def init_params(cfg: MLPConfig, key):
@@ -127,22 +145,97 @@ def _grad_combine(cfg: MLPConfig):
     return combine
 
 
-def make_train_step(mesh: WorkerMesh, cfg: MLPConfig):
+def param_count(cfg: MLPConfig) -> int:
+    return sum(fi * fo + fo for fi, fo in zip(cfg.sizes[:-1], cfg.sizes[1:]))
+
+
+def zero1_shard_len(cfg: MLPConfig, n_workers: int) -> int:
+    """Per-worker slice of the flattened parameter vector (ceil-padded)."""
+    return -(-param_count(cfg) // n_workers)
+
+
+def _zero1_step_body(tx, cfg: MLPConfig, nw: int):
+    """ZeRO-1 twin of :func:`_step_body`: same (params, opt_state, x, y)
+    → (params, opt_state, loss, acc) contract, but ``opt_state`` is this
+    worker's 1/nw shard over the flattened parameter vector.  The
+    gradient exchange is push (psum_scatter) + pull (all_gather) — the
+    same bytes as allreduce, with the optax update sharded between them.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    def step(params, opt_state, x, y):
+        (loss, logits), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, x, y, cfg), has_aux=True
+        )(params)
+        acc = (jnp.argmax(logits, -1) == y).mean()
+        loss, acc = C.allreduce((loss, acc), C.Combiner.AVG)
+
+        flat_p, unravel = ravel_pytree(params)
+        flat_g, _ = ravel_pytree(grads)
+        total = flat_p.shape[0]
+        L = -(-total // nw)
+        pad = nw * L - total
+        gsh = C.push(jnp.pad(flat_g, (0, pad)), C.Combiner.AVG)  # [L]
+        w = lax.axis_index(C.WORKER_AXIS)
+        psh = lax.dynamic_slice_in_dim(jnp.pad(flat_p, (0, pad)), w * L, L)
+        updates, opt_state = tx.update(gsh, opt_state, psh)
+        psh = optax.apply_updates(psh, updates)
+        params = unravel(C.pull(psh)[:total])                    # [nw·L]
+        return params, opt_state, loss, acc
+
+    return step
+
+
+def _opt_state_setup(mesh: WorkerMesh, cfg: MLPConfig, tx, params):
+    """(initial opt_state, its shard_map spec tree) for either layout.
+
+    Replicated (default): optax state over the full param pytree, P().
+    zero1: state over a [L]-vector per worker — vector leaves live as
+    [nw·L] arrays sharded on dim 0, scalar leaves (adam's count)
+    replicated.
+    """
+    if not cfg.zero1:
+        state = jax.device_put(tx.init(params), mesh.replicated())
+        return state, P()
+    nw = mesh.num_workers
+    L = zero1_shard_len(cfg, nw)
+    local = tx.init(jnp.zeros((L,), jnp.float32))
+
+    def globalize(leaf):
+        if leaf.ndim == 0:
+            return jax.device_put(leaf, mesh.replicated())
+        assert not leaf.any(), "zero1 init expects zero-initialized state"
+        return mesh.shard_array(
+            np.zeros((nw * L,) + leaf.shape[1:], leaf.dtype), 0)
+
+    state = jax.tree.map(globalize, local)
+    specs = jax.tree.map(lambda a: P() if a.ndim == 0 else mesh.spec(0),
+                         local)
+    return state, specs
+
+
+def _pick_step_body(mesh: WorkerMesh, cfg: MLPConfig, tx):
+    if cfg.zero1:
+        return _zero1_step_body(tx, cfg, mesh.num_workers)
+    # the graded pattern: gradient allreduce through the app-level verb
+    return _step_body(tx, cfg, _grad_combine(cfg))
+
+
+def make_train_step(mesh: WorkerMesh, cfg: MLPConfig, opt_specs=P()):
     """Compile the data-parallel training step (the daal_nn hot loop)."""
     tx = make_optimizer(cfg)
-    # the graded pattern: gradient allreduce through the app-level verb
-    step = _step_body(tx, cfg, _grad_combine(cfg))
+    step = _pick_step_body(mesh, cfg, tx)
     return jax.jit(
         mesh.shard_map(
             step,
-            in_specs=(P(), P(), mesh.spec(0), mesh.spec(0)),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(P(), opt_specs, mesh.spec(0), mesh.spec(0)),
+            out_specs=(P(), opt_specs, P(), P()),
         )
     ), tx
 
 
 def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
-                  n_batches: int, epochs: int = 1):
+                  n_batches: int, epochs: int = 1, opt_specs=P()):
     """Compile ``epochs`` epochs over a device-RESIDENT shard as ONE program.
 
     Harp-DAAL NN iterates minibatches of an in-memory NumericTable; the
@@ -157,7 +250,7 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
     Returns per-epoch (last-batch loss, acc) arrays.
     """
     tx = make_optimizer(cfg)
-    step = _step_body(tx, cfg, _grad_combine(cfg))
+    step = _pick_step_body(mesh, cfg, tx)
 
     def run(params, opt_state, xs, ys, key):
         base = jax.random.wrap_key_data(key)
@@ -187,8 +280,8 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
     return jax.jit(
         mesh.shard_map(
             run,
-            in_specs=(P(), P(), mesh.spec(0), mesh.spec(0), P()),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(P(), opt_specs, mesh.spec(0), mesh.spec(0), P()),
+            out_specs=(P(), opt_specs, P(), P()),
         )
     ), tx
 
@@ -211,10 +304,11 @@ class MLPTrainer:
         self.params = jax.device_put(
             init_params(self.cfg, jax.random.key(seed)), self.mesh.replicated()
         )
-        self._step, tx = make_train_step(self.mesh, self.cfg)
-        self.opt_state = jax.device_put(
-            tx.init(self.params), self.mesh.replicated()
-        )
+        tx = make_optimizer(self.cfg)
+        self.opt_state, self._opt_specs = _opt_state_setup(
+            self.mesh, self.cfg, tx, self.params)
+        self._step, _ = make_train_step(self.mesh, self.cfg,
+                                        opt_specs=self._opt_specs)
         self._forward = jax.jit(lambda p, v: forward(p, v, self.cfg))
         self._epoch_fns: dict = {}
         self._shuffle_counter = 0
@@ -260,7 +354,8 @@ class MLPTrainer:
         xs, ys, bpw, nb = self._resident
         fn = self._epoch_fns.get((bpw, nb, epochs))
         if fn is None:
-            fn, _ = make_epoch_fn(self.mesh, self.cfg, bpw, nb, epochs)
+            fn, _ = make_epoch_fn(self.mesh, self.cfg, bpw, nb, epochs,
+                                  opt_specs=self._opt_specs)
             self._epoch_fns[(bpw, nb, epochs)] = fn
         # raw threefry key bits built on host: jax.random.PRNGKey(int)
         # specializes on the Python int, so distinct seeds would each
@@ -301,14 +396,27 @@ class MLPTrainer:
             if not isinstance(jax.tree.leaves(state["params"])[0], jax.Array):
                 # a checkpoint restore yields plain containers; rebuild on
                 # the LIVE treedefs so optax's named-tuple states survive
-                def put_like(template, restored):
+                def put_like(template, restored, spec_tree=None):
                     leaves = [np.asarray(v) for v in jax.tree.leaves(restored)]
-                    return jax.device_put(
-                        jax.tree.unflatten(jax.tree.structure(template), leaves),
-                        self.mesh.replicated())
+                    tdef = jax.tree.structure(template)
+                    if spec_tree is None:
+                        return jax.device_put(jax.tree.unflatten(tdef, leaves),
+                                              self.mesh.replicated())
+                    # zero1: restore each leaf to ITS sharding — replicating
+                    # the [nw·L] state on every chip would transiently cost
+                    # the nw× memory zero1 exists to avoid (the spec tree is
+                    # leaf-aligned with the state by construction)
+                    specs = jax.tree.leaves(
+                        spec_tree, is_leaf=lambda s: isinstance(s, P))
+                    assert len(specs) == len(leaves), (specs, len(leaves))
+                    placed = [jax.device_put(l, self.mesh.sharding(sp))
+                              for l, sp in zip(leaves, specs)]
+                    return jax.tree.unflatten(tdef, placed)
 
                 self.params = put_like(self.params, state["params"])
-                self.opt_state = put_like(self.opt_state, state["opt_state"])
+                self.opt_state = put_like(
+                    self.opt_state, state["opt_state"],
+                    None if self._opt_specs == P() else self._opt_specs)
             else:
                 self.params = state["params"]
                 self.opt_state = state["opt_state"]
@@ -365,6 +473,11 @@ class TPMLPTrainer:
         from harp_tpu.parallel.mesh import mesh_2d
 
         self.cfg = cfg or MLPConfig()
+        if self.cfg.zero1:
+            raise ValueError(
+                "zero1 is DP-only: the TP trainer's optimizer state follows "
+                "the GSPMD param shardings; silently replicating it would "
+                "betray the memory contract zero1 promises")
         if self.cfg.grad_wire != "f32":
             raise ValueError(
                 f"grad_wire={self.cfg.grad_wire!r} is DP-only: under GSPMD "

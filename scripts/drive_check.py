@@ -414,3 +414,22 @@ assert abs(_bq["inertia"] - _bf["inertia"]) / _bf["inertia"] < 0.05
 print(f"int8 streaming formulation ≡ f32 within tolerance "
       f"({_bq['inertia']:.0f} vs {_bf['inertia']:.0f})")
 print(f"DRIVE OK round-14 ({mode})")
+
+# 20. ZeRO-1 sharded optimizer (this session): the optax update through
+# push/pull must equal the replicated step, and the state must actually
+# shard.
+from harp_tpu.models.mlp import MLPConfig, MLPTrainer, synthetic_mnist
+
+_zx, _zy = synthetic_mnist(n=256, d=32, classes=4, seed=0)
+_zout = {}
+for _z in (False, True):
+    _zt = MLPTrainer(MLPConfig(sizes=(32, 48, 4), optimizer="adam",
+                               zero1=_z), mesh, seed=0)
+    _zl = [_zt.train_batch(_zx, _zy)[0] for _ in range(3)]
+    _zout[_z] = (_zl, np.concatenate(
+        [np.asarray(p).ravel() for p in jax.tree.leaves(_zt.params)]))
+np.testing.assert_allclose(_zout[True][0], _zout[False][0], rtol=1e-5)
+np.testing.assert_allclose(_zout[True][1], _zout[False][1],
+                           rtol=2e-5, atol=2e-6)
+print(f"zero1 ≡ replicated adam over 3 steps (loss {_zout[True][0][-1]:.4f})")
+print(f"DRIVE OK round-15 ({mode})")

@@ -14,7 +14,10 @@
 
 set -u
 cd "$(dirname "$0")/.."
-start_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null || echo 0)
+# NB: grep -vc prints the 0 AND exits 1 on zero matches — no `|| echo 0`
+# (that would yield "0\n0" and break the arithmetic below)
+start_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null)
+start_ok=${start_ok:-0}
 
 echo "== probing relay (45 s bound) =="
 if ! timeout 45 python -c "import jax; print(jax.devices())"; then
@@ -61,7 +64,8 @@ python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
 # would stop watching).
 # count only REAL measurements: watchdogged steps append {"error": ...}
 # records, which must not satisfy the success gate
-total_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null || echo 0)
+total_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null)
+total_ok=${total_ok:-0}
 new_ok=$(( total_ok - start_ok ))
 if [ "$new_ok" -lt 5 ]; then
   echo "sprint FAILED: only ${new_ok} new error-free records in BENCH_local.jsonl" >&2

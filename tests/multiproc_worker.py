@@ -140,6 +140,23 @@ r1 = model.train_epoch()
 rs = model.train_epochs(3)
 assert np.isfinite(r1) and rs[-1] < r1, (r1, rs)
 
+# the fused-kernel algo (interpret-mode pallas off-TPU) through the same
+# cross-process rotation: scalar-prefetch grids + scratch under
+# shard_map with a process-boundary mesh must match the dense result
+model_p = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, algo="pallas", u_tile=8,
+                                          i_tile=8, entry_cap=32, lr=0.05,
+                                          compute_dtype=jnp.float32),
+                   mesh, seed=0)
+model_p.set_ratings(u_ids, i_ids, vals)
+rp = model_p.train_epoch()
+model_d = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, u_tile=8, i_tile=8,
+                                          entry_cap=32, lr=0.05,
+                                          compute_dtype=jnp.float32),
+                   mesh, seed=0)
+model_d.set_ratings(u_ids, i_ids, vals)
+rd = model_d.train_epoch()
+assert abs(rp - rd) < 1e-5, (rp, rd)
+
 # LDA pull/push epoch across the boundary: the word-topic table is
 # row-sharded over the WHOLE mesh, so chunk pull/push request/serve
 # round trips cross both intra- and inter-process links
