@@ -57,11 +57,9 @@ class MLPConfig:
     zero1: bool = False
 
     def __post_init__(self):
-        if self.zero1 and self.grad_wire != "f32":
+        if self.grad_wire not in ("f32", "bf16", "int8"):
             raise ValueError(
-                "zero1 shards the gradient exchange through push/pull; "
-                "the quantized allreduce wire does not apply — use "
-                "grad_wire='f32' (quantized reduce_scatter is future work)")
+                f"grad_wire must be f32|bf16|int8, got {self.grad_wire!r}")
 
 
 def init_params(cfg: MLPConfig, key):
@@ -130,9 +128,8 @@ def _grad_combine(cfg: MLPConfig):
     """
     if cfg.grad_wire == "f32":
         return lambda t: C.allreduce(t, C.Combiner.AVG)
-    wire = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(cfg.grad_wire)
-    if wire is None:
-        raise ValueError(f"grad_wire must be f32|bf16|int8, got {cfg.grad_wire!r}")
+    # unknown values already rejected by MLPConfig.__post_init__
+    wire = {"bf16": jnp.bfloat16, "int8": jnp.int8}[cfg.grad_wire]
 
     def combine(tree):
         grads, loss, acc = tree
@@ -154,6 +151,45 @@ def zero1_shard_len(cfg: MLPConfig, n_workers: int) -> int:
     return -(-param_count(cfg) // n_workers)
 
 
+def _zero1_grad_shard(grads, cfg: MLPConfig, nw: int, pad: int):
+    """Average-reduce the gradient pytree to this worker's flat [L] slice.
+
+    f32: one exact push (psum_scatter, AVG).  bf16: the flat quantized
+    scatter.  int8: quantized PER LEAF before flattening — the same
+    per-layer scale granularity :func:`allreduce_quantized` gives the
+    replicated path (one global scale would zero out small-magnitude
+    layers' gradients); the int32 scatter stays exact, and the dequant
+    scale for each position rides a segment vector sliced to this
+    worker's range.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    from harp_tpu.parallel.collective import quantize_to_int8
+
+    if cfg.grad_wire == "f32":
+        flat_g, _ = ravel_pytree(grads)
+        return C.push(jnp.pad(flat_g, (0, pad)), C.Combiner.AVG)
+    if cfg.grad_wire == "bf16":
+        flat_g, _ = ravel_pytree(grads)
+        return C.push_quantized(jnp.pad(flat_g, (0, pad)),
+                                wire_dtype=jnp.bfloat16) / nw
+    leaves = jax.tree.leaves(grads)
+    amax = lax.pmax(jnp.stack([jnp.max(jnp.abs(g)).astype(jnp.float32)
+                               for g in leaves]), C.WORKER_AXIS)
+    qs, scale_segs = [], []
+    for i, g in enumerate(leaves):
+        q, scale = quantize_to_int8(g.reshape(-1), amax[i])
+        qs.append(q)
+        scale_segs.append(jnp.full((g.size,), scale, jnp.float32))
+    flat_q = jnp.pad(jnp.concatenate(qs), (0, pad))
+    total = C.push(flat_q.astype(jnp.int32), C.Combiner.ADD)     # exact
+    scale_flat = jnp.pad(jnp.concatenate(scale_segs), (0, pad))
+    L = total.shape[0]
+    w = lax.axis_index(C.WORKER_AXIS)
+    my_scale = lax.dynamic_slice_in_dim(scale_flat, w * L, L)
+    return total.astype(jnp.float32) * my_scale / nw
+
+
 def _zero1_step_body(tx, cfg: MLPConfig, nw: int):
     """ZeRO-1 twin of :func:`_step_body`: same (params, opt_state, x, y)
     → (params, opt_state, loss, acc) contract, but ``opt_state`` is this
@@ -171,11 +207,10 @@ def _zero1_step_body(tx, cfg: MLPConfig, nw: int):
         loss, acc = C.allreduce((loss, acc), C.Combiner.AVG)
 
         flat_p, unravel = ravel_pytree(params)
-        flat_g, _ = ravel_pytree(grads)
         total = flat_p.shape[0]
         L = -(-total // nw)
         pad = nw * L - total
-        gsh = C.push(jnp.pad(flat_g, (0, pad)), C.Combiner.AVG)  # [L]
+        gsh = _zero1_grad_shard(grads, cfg, nw, pad)             # [L]
         w = lax.axis_index(C.WORKER_AXIS)
         psh = lax.dynamic_slice_in_dim(jnp.pad(flat_p, (0, pad)), w * L, L)
         updates, opt_state = tx.update(gsh, opt_state, psh)
@@ -192,7 +227,9 @@ def _opt_state_setup(mesh: WorkerMesh, cfg: MLPConfig, tx, params):
     Replicated (default): optax state over the full param pytree, P().
     zero1: state over a [L]-vector per worker — vector leaves live as
     [nw·L] arrays sharded on dim 0, scalar leaves (adam's count)
-    replicated.
+    replicated.  Vector leaves are built as fresh zeros: every supported
+    optimizer (the make_optimizer allowlist) zero-initializes its state,
+    so no device readback is needed to check.
     """
     if not cfg.zero1:
         state = jax.device_put(tx.init(params), mesh.replicated())
@@ -204,14 +241,25 @@ def _opt_state_setup(mesh: WorkerMesh, cfg: MLPConfig, tx, params):
     def globalize(leaf):
         if leaf.ndim == 0:
             return jax.device_put(leaf, mesh.replicated())
-        assert not leaf.any(), "zero1 init expects zero-initialized state"
         return mesh.shard_array(
-            np.zeros((nw * L,) + leaf.shape[1:], leaf.dtype), 0)
+            np.zeros((nw * L,) + leaf.shape[1:], np.dtype(leaf.dtype)), 0)
 
     state = jax.tree.map(globalize, local)
-    specs = jax.tree.map(lambda a: P() if a.ndim == 0 else mesh.spec(0),
-                         local)
-    return state, specs
+    return state, _opt_specs_for(mesh, cfg)
+
+
+def _opt_specs_for(mesh: WorkerMesh, cfg: MLPConfig):
+    """shard_map specs for the optimizer state — derived from cfg alone,
+    so make_train_step/make_epoch_fn can never be handed mismatched
+    specs for a zero1 config."""
+    if not cfg.zero1:
+        return P()
+    local = jax.eval_shape(  # structure only — no device work
+        make_optimizer(cfg).init,
+        jax.ShapeDtypeStruct((zero1_shard_len(cfg, mesh.num_workers),),
+                             jnp.float32))
+    return jax.tree.map(lambda a: P() if a.ndim == 0 else mesh.spec(0),
+                        local)
 
 
 def _pick_step_body(mesh: WorkerMesh, cfg: MLPConfig, tx):
@@ -221,10 +269,16 @@ def _pick_step_body(mesh: WorkerMesh, cfg: MLPConfig, tx):
     return _step_body(tx, cfg, _grad_combine(cfg))
 
 
-def make_train_step(mesh: WorkerMesh, cfg: MLPConfig, opt_specs=P()):
-    """Compile the data-parallel training step (the daal_nn hot loop)."""
+def make_train_step(mesh: WorkerMesh, cfg: MLPConfig):
+    """Compile the data-parallel training step (the daal_nn hot loop).
+
+    The optimizer-state placement follows ``cfg.zero1`` automatically
+    (specs derived internally — callers cannot hand mismatched ones);
+    pair with :func:`_opt_state_setup` for the matching initial state.
+    """
     tx = make_optimizer(cfg)
     step = _pick_step_body(mesh, cfg, tx)
+    opt_specs = _opt_specs_for(mesh, cfg)
     return jax.jit(
         mesh.shard_map(
             step,
@@ -235,7 +289,7 @@ def make_train_step(mesh: WorkerMesh, cfg: MLPConfig, opt_specs=P()):
 
 
 def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
-                  n_batches: int, epochs: int = 1, opt_specs=P()):
+                  n_batches: int, epochs: int = 1):
     """Compile ``epochs`` epochs over a device-RESIDENT shard as ONE program.
 
     Harp-DAAL NN iterates minibatches of an in-memory NumericTable; the
@@ -251,6 +305,7 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MLPConfig, batch_per_worker: int,
     """
     tx = make_optimizer(cfg)
     step = _pick_step_body(mesh, cfg, tx)
+    opt_specs = _opt_specs_for(mesh, cfg)
 
     def run(params, opt_state, xs, ys, key):
         base = jax.random.wrap_key_data(key)
@@ -307,8 +362,7 @@ class MLPTrainer:
         tx = make_optimizer(self.cfg)
         self.opt_state, self._opt_specs = _opt_state_setup(
             self.mesh, self.cfg, tx, self.params)
-        self._step, _ = make_train_step(self.mesh, self.cfg,
-                                        opt_specs=self._opt_specs)
+        self._step, _ = make_train_step(self.mesh, self.cfg)
         self._forward = jax.jit(lambda p, v: forward(p, v, self.cfg))
         self._epoch_fns: dict = {}
         self._shuffle_counter = 0
@@ -354,8 +408,7 @@ class MLPTrainer:
         xs, ys, bpw, nb = self._resident
         fn = self._epoch_fns.get((bpw, nb, epochs))
         if fn is None:
-            fn, _ = make_epoch_fn(self.mesh, self.cfg, bpw, nb, epochs,
-                                  opt_specs=self._opt_specs)
+            fn, _ = make_epoch_fn(self.mesh, self.cfg, bpw, nb, epochs)
             self._epoch_fns[(bpw, nb, epochs)] = fn
         # raw threefry key bits built on host: jax.random.PRNGKey(int)
         # specializes on the Python int, so distinct seeds would each

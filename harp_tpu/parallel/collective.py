@@ -121,6 +121,16 @@ def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
     bool, as in :func:`allreduce`).  This is a separate opt-in verb:
     Harp's allreduce contract (and ours) is full-precision by default.
     """
+    return _quantized_reduce(
+        tree, wire_dtype, axis,
+        reduce_float=lambda x: lax.psum(x, axis),
+        reduce_exact=lambda x: Combiner.ADD.reduce_over_axis(x, axis))
+
+
+def _quantized_reduce(tree, wire_dtype, axis, reduce_float, reduce_exact):
+    """Shared engine of :func:`allreduce_quantized` / :func:`push_quantized`
+    — per-leaf scales via ONE stacked pmax, bf16 or exact-int32 int8
+    accumulation; only the reduction primitive differs between the verbs."""
     wd = jnp.dtype(wire_dtype)
     if wd not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8)):
         raise ValueError(f"unsupported wire_dtype {wire_dtype!r} "
@@ -138,14 +148,37 @@ def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
     out = []
     for x, f in zip(leaves, is_float):
         if not f:
-            out.append(Combiner.ADD.reduce_over_axis(x, axis))
+            out.append(reduce_exact(x))
         elif wd == jnp.dtype(jnp.bfloat16):
-            out.append(lax.psum(x.astype(jnp.bfloat16), axis).astype(x.dtype))
+            out.append(reduce_float(x.astype(jnp.bfloat16)).astype(x.dtype))
         else:
             q, scale = quantize_to_int8(x, next(amaxes))
-            total = lax.psum(q.astype(jnp.int32), axis)
+            total = reduce_float(q.astype(jnp.int32))
             out.append((total.astype(jnp.float32) * scale).astype(x.dtype))
     return jax.tree.unflatten(treedef, out)
+
+
+def push_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
+                   axis: str = WORKER_AXIS, scatter_dim: int = 0):
+    """ADD-``push`` (reduce-scatter) on a quantized wire — the
+    :func:`allreduce_quantized` trade applied to the scatter half.
+
+    The ZeRO-1 optimizer path (``MLPConfig.zero1``) reduces gradients
+    with ``push`` instead of ``allreduce``; this is its narrow-wire
+    option.  Semantics per dtype match the allreduce twin exactly:
+    bf16 = cast → psum_scatter → cast back (wire AND accumulation bf16);
+    int8 = worker-shared per-leaf scale via one stacked ``pmax``,
+    int8 contributions, ``psum_scatter`` accumulates in exact int32,
+    dequantize (per-worker error ≤ scale/2).  Non-float leaves take the
+    exact ADD path.  ADD only — divide by ``axis_size`` for AVG, like
+    the quantized allreduce's callers do.
+    """
+    def scatter(x):
+        return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
+                                tiled=True)
+
+    return _quantized_reduce(tree, wire_dtype, axis,
+                             reduce_float=scatter, reduce_exact=scatter)
 
 
 def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):

@@ -348,3 +348,48 @@ def test_allreduce_quantized_int8_one_pmax_for_tree(mesh):
     for i, k in enumerate(sorted(tree)):
         np.testing.assert_allclose(np.asarray(out[k]),
                                    np.full((1, 4), N * (i + 1.0)), rtol=0.02)
+
+
+def test_push_quantized_bf16(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    # per worker a [N*4] contribution; push scatters tiled → [4] per worker
+    x = rng.normal(size=(N, N * 4)).astype(np.float32)
+    out = run_spmd(mesh, lambda v: C.push_quantized(v.reshape(-1)),
+                   x, out_dim=0)
+    ref = x.sum(0).reshape(N, 4)  # worker w owns rows [w*4, (w+1)*4)
+    np.testing.assert_allclose(np.asarray(out).reshape(N, 4), ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_push_quantized_int8_matches_exact_within_scale(mesh):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(N, N * 8)).astype(np.float32)
+    out = run_spmd(
+        mesh, lambda v: C.push_quantized(v.reshape(-1), wire_dtype=jnp.int8),
+        x, out_dim=0)
+    ref = x.sum(0).reshape(N, 8)
+    tol = N * np.abs(x).max() / 127.0 / 2 + 1e-6
+    assert np.abs(np.asarray(out).reshape(N, 8) - ref).max() <= tol
+
+
+def test_push_quantized_int_leaves_exact(mesh):
+    x = np.arange(N * N * 2, dtype=np.int32).reshape(N, N * 2)
+    out = run_spmd(mesh, lambda v: C.push_quantized(v.reshape(-1)),
+                   x, out_dim=0)
+    np.testing.assert_array_equal(np.asarray(out).reshape(N, 2),
+                                  x.sum(0).reshape(N, 2))
+
+
+def test_push_quantized_rejects_unknown_wire(mesh):
+    import jax.numpy as jnp
+
+    x = np.ones((N, N), np.float32)
+    with pytest.raises(ValueError, match="wire_dtype"):
+        run_spmd(mesh,
+                 lambda v: C.push_quantized(v.reshape(-1),
+                                            wire_dtype=jnp.float16),
+                 x, out_dim=0)
