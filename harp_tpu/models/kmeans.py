@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.utils.timing import device_sync
@@ -234,7 +235,7 @@ def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
             c_q, c_scale, c2 = _quantize_centroids(centroids, col_scale)
             sums, counts, best_sum = kmeans_kernel.kmeans_partials_int8(
                 pts_q, c_q, c_scale, c2, col_scale,
-                interpret=jax.default_backend() != "tpu")
+                interpret=interpret_default())
             partial_inertia = best_sum + x2
         else:
             c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)
@@ -252,7 +253,7 @@ def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
             raise ValueError("block_points has no effect with use_pallas "
                              "(the kernel picks its own tile size)")
         sums, counts, partial_inertia = kmeans_kernel.kmeans_partials(
-            points, centroids, interpret=jax.default_backend() != "tpu")
+            points, centroids, interpret=interpret_default())
     elif block <= 0 or block >= n:
         c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)  # [k]
         sums, counts, partial_inertia = _partials_block(points, centroids, c2)

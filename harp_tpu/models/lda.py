@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.parallel.rotate import resident_half_index
@@ -330,7 +331,7 @@ def _sample_entry_pallas(NdkT, NwkT, nk, z, entry, key2, cfg: LDAConfig,
     DbT, WbT, z_new, dNk = cgs_entry_update(
         DbT, WbT, nk, z, cd, cw, key2,
         alpha=cfg.alpha, beta=cfg.beta, vbeta=vocab_size * cfg.beta,
-        interpret=jax.default_backend() != "tpu")
+        interpret=interpret_default())
     NdkT = lax.dynamic_update_slice_in_dim(NdkT, DbT, od, 1)
     NwkT = lax.dynamic_update_slice_in_dim(NwkT, WbT, ow, 1)
     return NdkT, NwkT, dNk, z_new

@@ -47,6 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.parallel.rotate import resident_half_index
@@ -359,7 +360,7 @@ def _pallas_tile_block_update(W, H, block, cfg: MFSGDConfig):
         W.T, H.T, eu, ei, ev, ou, oi,
         lr=cfg.lr, reg=cfg.reg, u_tile=cfg.u_tile, i_tile=cfg.i_tile,
         compute_dtype=cfg.compute_dtype,
-        interpret=jax.default_backend() != "tpu")
+        interpret=interpret_default())
     return Wt.T, Ht.T, se, cnt
 
 

@@ -1,0 +1,25 @@
+"""Shared interpret-mode policy for the Pallas kernels.
+
+Kernels run compiled (Mosaic) on TPU and in interpret mode everywhere
+else — except when ``HARP_PALLAS_FORCE_MOSAIC=1``, which forces the
+compiled path regardless of backend.  That override exists for ONE
+purpose: cross-platform lowering pins (`.lower(lowering_platforms=
+("tpu",))` on the CPU host) that verify the full epoch programs —
+transposes, scans, scalar-prefetch grids AND the Mosaic kernels —
+at true graded shapes without hardware (see CLAUDE.md "Environment
+gotchas" and tests/test_lda_scale.py).  Executing with the override on
+a non-TPU backend will fail; that is the point.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def interpret_default() -> bool:
+    """True = run the kernel in interpret mode (non-TPU backends)."""
+    if os.environ.get("HARP_PALLAS_FORCE_MOSAIC") == "1":
+        return False
+    return jax.default_backend() != "tpu"

@@ -118,3 +118,21 @@ def test_enwiki_1m_program_lowers(mesh, algo):
     text = fn.lower(*_sds(mesh, shapes)).as_text()
     assert "while" in text       # the chunk/entry scans lowered
     assert "xi16" in text        # the int16 table is in the program
+
+
+def test_enwiki_1m_pallas_program_lowers(mesh, monkeypatch):
+    """The fused-kernel epoch at the TRUE graded shapes, MOSAIC-compiled:
+    HARP_PALLAS_FORCE_MOSAIC routes the kernel through the real Pallas→
+    Mosaic lowering (not interpret), and the whole program — topic-major
+    transposes, entry scan, scalar-prefetch grids, the kernel itself —
+    lowers for TPU on this CPU host."""
+    monkeypatch.setenv("HARP_PALLAS_FORCE_MOSAIC", "1")
+    cfg = L.LDAConfig(n_topics=K, algo="pallas", ndk_dtype="int16",
+                      sampler="exprace", rng_impl="rbg")
+    shapes = L.epoch_arg_shapes(8, N_DOCS, VOCAB, cfg, n_tokens=N_TOK)
+    fn = L.make_multi_epoch_fn(mesh, cfg, VOCAB, epochs=2)
+    lowered = fn.trace(*_sds(mesh, shapes)).lower(
+        lowering_platforms=("tpu",))
+    text = lowered.as_text()
+    assert "tpu_custom_call" in text  # the Mosaic kernel is in the program
+    assert "xi16" in text             # on the int16 table

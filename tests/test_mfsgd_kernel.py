@@ -183,3 +183,29 @@ def test_kernel_lowers_for_tpu():
         jnp.zeros((NE, C)), jnp.zeros(NE, jnp.int32),
         jnp.zeros(NE, jnp.int32)).lower(lowering_platforms=("tpu",))
     assert "tpu_custom_call" in lowered.as_text()
+
+
+def test_ml20m_pallas_epoch_lowers_for_tpu(mesh, monkeypatch):
+    """The fused-kernel ML-20M epoch (138,493×26,744 grid, rank 64,
+    512×512 tiles, 8-way mesh), MOSAIC-compiled, lowers for TPU on this
+    CPU host — transposes, rotation scan, scalar-prefetch grids and the
+    kernel itself at the true graded shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("HARP_PALLAS_FORCE_MOSAIC", "1")
+    cfg = MF.MFSGDConfig(rank=64, algo="pallas")
+    n, ns = 8, 16
+    _, _, u_bound, ib2 = MF._dense_bounds(
+        138_493, 26_744, n, ns, cfg.u_tile, cfg.i_tile)
+    NE, C = 96, 2048  # ~20M ratings / (n·ns) rows at C=2048 + coverage
+    i32, f32 = jnp.int32, jnp.float32
+    shapes = [((u_bound * n, 64), f32), ((2 * ib2 * n, 64), f32),
+              ((n * ns, NE, C), i32), ((n * ns, NE, C), i32),
+              ((n * ns, NE, C), f32), ((n * ns, NE), i32),
+              ((n * ns, NE), i32)]
+    sds = [jax.ShapeDtypeStruct(s, d, sharding=mesh.sharding(mesh.spec(0)))
+           for s, d in shapes]
+    fn = MF.make_multi_epoch_fn(mesh, cfg, epochs=2)
+    text = fn.trace(*sds).lower(lowering_platforms=("tpu",)).as_text()
+    assert "tpu_custom_call" in text  # the Mosaic kernel is in the program
