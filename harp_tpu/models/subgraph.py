@@ -9,26 +9,26 @@ then unbias by the colorfulness probability ``s!/sˢ``.  Harp parallelizes
 by vertex partition and exchanges per-vertex count tables with
 ``allgather``/``regroup`` each DP level — the "irregular" workload.
 
-TPU-native design: the per-vertex count table is a **dense [n, 2ˢ]
-array** (subset-indexed by color-set bitmask), so each DP level becomes
+TPU-native design: the per-vertex count table for a partial absorbing j
+template vertices is stored **compactly over the C(k, j) size-j color
+subsets** (a colorful partial uses exactly j distinct colors — every
+other bitmask column is identically zero), so each DP level becomes
 
   ``counts_t[v, S] = Σ_{S₁⊎S₂=S} counts_{t₁}[v, S₁] · (A @ counts_{t₂})[v, S₂]``
 
-— a sparse-neighbor aggregation (padded-CSR gather + mask, vectorized over
-all 2ˢ subsets at once) followed by a subset-convolution step restricted to
-the subset sizes that actually occur (template sizes are ≤ 7, so 2ˢ ≤ 128
-columns).  The distributed step is one ``allgather`` of the partner count
-table per DP level, matching Harp's communication pattern verb-for-verb.
+— a sparse-neighbor aggregation (padded-CSR gather + mask over the
+compact columns) followed by a subset convolution through static
+position maps.  The distributed step is one ``allgather`` of the compact
+partner table per DP level, matching Harp's communication pattern
+verb-for-verb at the C(k, j)/2ᵏ fraction of the naive dense wire
+(u5-tree: 5–10 of 32 columns per level; u7-tree ≤ 35 of 128).
 
-Round-3 column slicing: a child combine only ever reads the C(k, size)
-columns whose subset size equals the child's subtree size, so the child
-table is sliced to those columns BEFORE the allgather and the neighbor
-gathers — u5-tree moves 5–10 of 32 columns per level instead of all 32,
-shrinking both the wire and the gather traffic (the dominant cost).
-Counts are bit-identical (the dropped columns never participated);
-measured 2.4× end-to-end on the 8-worker CPU sim smoke A/B, 2026-07-31
-(275.9k vertices/s at 100k-vertex power-law u5-tree after the change;
-TPU re-measure rides the relay sprint).
+Round-3 compact-table measurements (8-worker CPU sim, 2026-07-31,
+bit-identical counts): u5-tree 100k-vertex power-law 284.4k vertices/s
+(130.4k before the column work on the smoke A/B — ~2.4×); u7-tree
+50k-vertex power-law 171.6k vertices/s (122.9k with dense tables and
+sliced exchanges — a further 1.4× from compact storage).  TPU
+re-measure rides the relay sprint (BASELINE.md candidates table).
 """
 
 from __future__ import annotations
@@ -157,11 +157,24 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh,
         acc, _ = jax.lax.scan(body, acc, (t_nbr, t_loc, t_msk, t_lo))
         return acc[: out.shape[0]]
 
+    # Colorful counting: a partial rooted at i with j template vertices
+    # absorbed uses EXACTLY j distinct colors, so its table is supported
+    # on the C(k, j) size-j subsets alone.  Tables therefore live
+    # COMPACTLY over that support (round 3 session 2) — u5-tree keeps
+    # 5–10 columns instead of 2^5 everywhere: the per-level allgather
+    # wire, the neighbor gathers (the dominant cost), the overflow
+    # tails, the subset-convolution scatter and the vmapped HBM
+    # footprint all shrink by the support ratio.  Counts are
+    # bit-identical: the dropped columns were identically zero.
+    supp = {sz: [m for m in range(n_subsets)
+                 if bin(m).count("1") == sz] for sz in range(k + 1)}
+    pos = {sz: {m: j for j, m in enumerate(cols)}
+           for sz, cols in supp.items()}
+
     def one_trial(nbr, msk, ovf, colors_shard):
-        base = jnp.zeros((colors_shard.shape[0], n_subsets), jnp.float32)
-        singleton = base.at[
-            jnp.arange(colors_shard.shape[0]), 1 << colors_shard
-        ].set(1.0)
+        # compact singleton: supp[1] is [1<<0, 1<<1, ...] ascending, so
+        # the position of color c's mask is c — a plain one-hot
+        singleton = jax.nn.one_hot(colors_shard, k, dtype=jnp.float32)
 
         # post-order DP: table[i] = counts for subtree rooted at i
         tables = [None] * len(tpl)
@@ -170,38 +183,33 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh,
             acc_size = 1
             for c in ch[i]:
                 triples = combos(acc_size, sizes[c])
-                # Only the columns whose subset SIZE matches the child's
-                # subtree size ever combine (C(k, size) of the 2^k) — slice
-                # them out BEFORE the allgather and the neighbor gathers,
-                # so both the wire and the gather traffic shrink by the
-                # full-table/size-slice ratio (u5-tree: 32 → 5–10 columns,
-                # the dominant per-level cost; round 3 session 2).
-                cols2 = sorted({t[2] for t in triples})
-                pos2 = {m: j for j, m in enumerate(cols2)}
-                child_sub = tables[c][:, jnp.asarray(cols2, jnp.int32)]
-                child_full = C.allgather(child_sub)  # Harp allgather step
+                new_size = acc_size + sizes[c]
+                p1 = jnp.asarray([pos[acc_size][t[1]] for t in triples],
+                                 jnp.int32)
+                p2 = jnp.asarray([pos[sizes[c]][t[2]] for t in triples],
+                                 jnp.int32)
+                pS = jnp.asarray([pos[new_size][t[0]] for t in triples],
+                                 jnp.int32)
+                child_full = C.allgather(tables[c])  # compact Harp step
                 nbr_counts = spmv_gather(child_full, nbr, msk, *ovf)
-                S = jnp.asarray([t[0] for t in triples], jnp.int32)
-                S1 = jnp.asarray([t[1] for t in triples], jnp.int32)
-                S2 = jnp.asarray([pos2[t[2]] for t in triples], jnp.int32)
-                contrib = acc[:, S1] * nbr_counts[:, S2]  # [n_loc, T]
-                acc = jnp.zeros_like(acc).at[:, S].add(contrib)
-                acc_size += sizes[c]
+                contrib = acc[:, p1] * nbr_counts[:, p2]  # [n_loc, T]
+                acc = jnp.zeros(
+                    (acc.shape[0], len(supp[new_size])), acc.dtype
+                ).at[:, pS].add(contrib)
+                acc_size = new_size
             tables[i] = acc
 
-        if k == s:
-            rooted = tables[0][:, (1 << k) - 1]
-        else:
-            full_cols = [m for m in range(n_subsets) if bin(m).count("1") == s]
-            rooted = tables[0][:, jnp.asarray(full_cols)].sum(-1)
-        return rooted.sum()
+        # the root table's support IS the size-s subsets (one column when
+        # k == s): summing the compact table covers both cases
+        return tables[0].sum(-1).sum()
 
     def prog(nbr, msk, *rest):
         # colors_shard [trial_chunk, n_loc]: a chunk of trials per program —
         # each dispatch+readback round trip costs ~20–150 ms (1× v5e relay,
         # 2026-07-30, BASELINE.md row 4), so a per-trial host loop would
         # dominate multi-trial estimates; chunking (not all-trials-vmap)
-        # bounds the [chunk, n, 2^k] DP tables' HBM footprint
+        # bounds the compact [chunk, n_loc, C(k, j)] DP tables' HBM
+        # footprint (≤ C(k, floor(k/2)) columns — 10 for u5, 35 for u7)
         ovf, colors_shard = rest[:-1], rest[-1]
         rooted = jax.vmap(
             lambda cs: one_trial(nbr, msk, ovf, cs)
@@ -223,9 +231,10 @@ class SubgraphConfig:
     n_colors: int = 0        # 0 → template size (standard color-coding)
     n_trials: int = 1        # average over colorings (variance reduction)
     # trials per device program: chunking bounds the DP tables' HBM use at
-    # [trial_chunk, n, 2^k] floats while still amortizing the per-dispatch
-    # round trip over a chunk (vmapping ALL trials would OOM large graphs
-    # at high n_trials)
+    # [trial_chunk, n, C(k, j)] floats (compact support — at most
+    # C(k, floor(k/2)) columns, e.g. 10 for u5 / 35 for u7, NOT 2^k)
+    # while still amortizing the per-dispatch round trip over a chunk
+    # (vmapping ALL trials would OOM large graphs at high n_trials)
     trial_chunk: int = 8
     max_degree: int = 64     # padded-CSR width
     seed: int = 0

@@ -205,3 +205,26 @@ def test_overflow_algo_validation():
 
     with pytest.raises(ValueError, match="overflow_algo"):
         SG.SubgraphConfig(overflow_algo="scatter")
+
+
+@pytest.mark.parametrize("tname,k", [("u3-path", 5), ("u5-tree", 7)])
+def test_dp_matches_brute_force_extra_colors(mesh, tname, k):
+    """k > template size: the compact root table's support is ALL size-s
+    subsets, summed — the branch the compact-table rewrite folded into
+    one sum(-1); guards the support/ordering invariant it relies on."""
+    tpl = SG.TEMPLATES[tname]
+    rng = np.random.default_rng(2)
+    colors = rng.integers(0, k, TINY_N).astype(np.int32)
+    nbr, msk, overflow = SG.pad_csr(TINY_EDGES, TINY_N, 8)
+    assert len(overflow) == 0
+    o_nbr, o_row, o_msk = SG._partition_overflow(overflow, TINY_N,
+                                                 mesh.num_workers)
+    fn = SG.make_colorful_count_fn(tpl, k, mesh)
+    out = float(np.asarray(fn(
+        mesh.shard_array(nbr, 0), mesh.shard_array(msk, 0),
+        mesh.shard_array(o_nbr, 0), mesh.shard_array(o_row, 0),
+        mesh.shard_array(o_msk, 0),
+        mesh.shard_array(colors[None, :], 1),
+    ))[0])
+    expect = brute_force_rooted_colorful(TINY_EDGES, TINY_N, tpl, colors)
+    assert out == expect, (tname, k, out, expect)
