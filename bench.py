@@ -41,6 +41,9 @@ BASELINES = {
     "lda_pallas": None,     # fused-kernel algo (round 3; no TPU number yet)
     "mlp": 22.2e6,          # samples/s, MNIST shapes, device-resident
     "subgraph": 93.8e3,     # vertices/s, u5-tree on 100k vertices
+                            # (pre-compaction code — the compact-DP-table
+                            # rewrite measured 2.4x on the CPU sim, so a
+                            # big vs_baseline jump here is expected)
     "rf": 7.92,             # trees/s, 32 trees depth 6 on 200k×64
 }
 
