@@ -135,7 +135,13 @@ def test_kernel_vmem_gate():
 
 
 @pytest.mark.parametrize("ndk_dtype", ["float32", "int16"])
-def test_kernel_lowers_for_tpu(ndk_dtype):
+@pytest.mark.parametrize("shape", [
+    # (K, DR, WR, C) — graded enwiki tiling and the 128-tile smoke
+    # shapes the driver bench compiles FIRST on real TPU
+    (1000, 512, 512, 2048),
+    (8, 128, 128, 256),
+])
+def test_kernel_lowers_for_tpu(ndk_dtype, shape):
     """Pallas->Mosaic verification at the graded tile shapes, no hardware
     (caught the uint32->f32 cast Mosaic rejects, pre-relay)."""
     import functools
@@ -145,7 +151,7 @@ def test_kernel_lowers_for_tpu(ndk_dtype):
 
     from harp_tpu.ops.lda_kernel import cgs_entry_update
 
-    K, DR, WR, C = 1000, 512, 512, 2048
+    K, DR, WR, C = shape
     f = functools.partial(cgs_entry_update, alpha=0.1, beta=0.01,
                           vbeta=500.0, interpret=False)
     lowered = jax.jit(f).trace(

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 from harp_tpu.models import mfsgd as MF
+from harp_tpu.ops import mfsgd_kernel as MF_K
 from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
 
 N = 8
@@ -76,8 +77,6 @@ def test_pallas_multi_chunk_entries_match_dense(mesh):
     Wd, Hd, rd = _run_epochs(mesh, "dense", u, i, v, n_users, n_items, **kw)
     Wp, Hp, rp = _run_epochs(mesh, "pallas", u, i, v, n_users, n_items, **kw)
     # the prep must actually have produced a multi-chunk entry
-    from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
-
     eu, ei, ev, ou, oi, *_ = MF.partition_ratings_tiles(
         u, i, v, n_users, n_items, N, 8, 8, 1024)
     assert insert_coverage_entries(eu, ei, ev, ou, oi, 8, 8)[0].shape[-1] \
@@ -163,7 +162,13 @@ def test_pallas_rejects_oversized_resident_h():
                         interpret=True)
 
 
-def test_kernel_lowers_for_tpu():
+@pytest.mark.parametrize("shape", [
+    # (R, UB, IB, NE, C, tile) — graded ML-20M tiling and the 128-tile
+    # smoke shapes the driver bench compiles FIRST on real TPU
+    (64, 2048, 13440, 8, 2048, 512),
+    (8, 512, 128, 16, 256, 128),
+])
+def test_kernel_lowers_for_tpu(shape):
     """Cross-platform lowering runs the Pallas->Mosaic verification
     (layouts, block shapes, casts) without hardware — the check that
     caught the [1, C]-block constraint before any relay time was spent."""
@@ -172,11 +177,9 @@ def test_kernel_lowers_for_tpu():
     import jax
     import jax.numpy as jnp
 
-    from harp_tpu.ops.mfsgd_kernel import sgd_tile_update
-
-    R, UB, IB, NE, C = 64, 2048, 13440, 8, 2048
-    f = functools.partial(sgd_tile_update, lr=0.01, reg=0.05, u_tile=512,
-                          i_tile=512, interpret=False)
+    R, UB, IB, NE, C, tile = shape
+    f = functools.partial(MF_K.sgd_tile_update, lr=0.01, reg=0.05,
+                          u_tile=tile, i_tile=tile, interpret=False)
     lowered = jax.jit(f).trace(
         jnp.zeros((R, UB)), jnp.zeros((R, IB)),
         jnp.zeros((NE, C), jnp.int32), jnp.zeros((NE, C), jnp.int32),
