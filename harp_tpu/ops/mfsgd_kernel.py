@@ -220,8 +220,15 @@ def insert_coverage_entries(eu, ei, ev, ou, oi, u_bound, u_tile,
     kernel's mask turns them into pure copy-through steps.
     """
     ws, ne, c = eu.shape
-    c2 = c if (c <= chunk_c or c % chunk_c == 0) else \
-        chunk_c * -(-c // chunk_c)
+    # C must satisfy the kernel's TPU lane gate (multiples of 128) at ANY
+    # size — small-corpus C values like 200 otherwise pass coverage
+    # unpadded and fail at first Mosaic compile (caught by review,
+    # 2026-07-31); above chunk_c it must also be a chunk multiple
+    # (chunk_c is itself a 128-multiple, so both cases satisfy the gate)
+    if c > chunk_c:
+        c2 = chunk_c * -(-c // chunk_c)
+    else:
+        c2 = 128 * -(-c // 128)
     nblk = u_bound // u_tile
     # Per row: list of (src_entry_index | None, ou, oi); None = inserted pad.
     rows: list[list[tuple]] = []

@@ -23,6 +23,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_common
 
 
+def _git_commit() -> str:
+    """Short HEAD hash (records must be attributable to exact code)."""
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "describe", "--always", "--dirty", "--abbrev=7"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=10).stdout.strip() or "?"
+    except Exception:
+        return "?"
+
+
 def _bench_ingest(smoke: bool):
     # shared presets (bench_ingest.run_smoke/run_full) keep this and
     # bench.py's kmeans_ingest config measuring the same shapes; the
@@ -158,6 +171,9 @@ def run_all(smoke: bool, only, watchdog=None):
         "n_devices": jax.device_count(),
         "jax": jax.__version__,
         "smoke": smoke,
+        # the r2 verdict's stale-claims weakness was ATTRIBUTION: a rate
+        # means little without the code it measured
+        "commit": _git_commit(),
     }
     for name, fn in configs.items():
         if only and name not in only:

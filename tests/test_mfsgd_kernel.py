@@ -163,10 +163,13 @@ def test_pallas_rejects_oversized_resident_h():
 
 
 @pytest.mark.parametrize("shape", [
-    # (R, UB, IB, NE, C, tile) — graded ML-20M tiling and the 128-tile
-    # smoke shapes the driver bench compiles FIRST on real TPU
+    # (R, UB, IB, NE, C, tile) — graded ML-20M tiling, the REAL smoke
+    # shapes the driver bench compiles FIRST on real TPU (captured from
+    # the smoke bench: C=200 pads to 256 by insert_coverage_entries'
+    # 128-multiple rule), and the 8-worker-sim smoke shape
     (64, 2048, 13440, 8, 2048, 512),
-    (8, 512, 128, 16, 256, 128),
+    (8, 512, 128, 2, 256, 128),    # 1-worker TPU smoke (u_bound=512)
+    (8, 128, 128, 1, 256, 128),    # 8-worker sim smoke (u_bound=128)
 ])
 def test_kernel_lowers_for_tpu(shape):
     """Cross-platform lowering runs the Pallas->Mosaic verification
