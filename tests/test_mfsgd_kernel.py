@@ -215,3 +215,46 @@ def test_ml20m_pallas_epoch_lowers_for_tpu(mesh, monkeypatch):
     fn = MF.make_multi_epoch_fn(mesh, cfg, epochs=2)
     text = fn.trace(*sds).lower(lowering_platforms=("tpu",)).as_text()
     assert "tpu_custom_call" in text  # the Mosaic kernel is in the program
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    nnz=st.integers(1, 300),
+    n_users=st.sampled_from([16, 40, 64]),
+    n_items=st.sampled_from([16, 48]),
+    u_tile=st.sampled_from([8, 16]),
+    entry_cap=st.sampled_from([8, 16, 64]),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_insert_coverage_entries_properties(nnz, n_users, n_items, u_tile,
+                                            entry_cap, seed):
+    """The kernel's streaming correctness rests on this host prep: for
+    ANY rating set — coverage (every W block appears), contiguity (one
+    run per block), value preservation (real ratings survive exactly
+    once), C a 128-multiple (the Mosaic lane gate), and in-bounds
+    offsets."""
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+    eu, ei, ev, ou, oi, uo, io, ub, ib2 = MF.partition_ratings_tiles(
+        u, i, v, n_users, n_items, N, u_tile, u_tile, entry_cap)
+    eu2, ei2, ev2, ou2, oi2 = insert_coverage_entries(
+        eu, ei, ev, ou, oi, ub, u_tile)
+
+    nblk = ub // u_tile
+    assert eu2.shape[-1] % 128 == 0          # Mosaic lane gate, any size
+    for w in range(eu2.shape[0]):
+        blks = ou2[w] // u_tile
+        assert set(range(nblk)) <= set(blks.tolist())          # coverage
+        change = np.flatnonzero(np.diff(blks) != 0)
+        assert len(set(blks.tolist())) == len(change) + 1      # contiguity
+        assert (ou2[w] >= 0).all() and (ou2[w] + u_tile <= ub).all()
+        assert (oi2[w] >= 0).all() and (oi2[w] + u_tile <= ib2).all()
+        # every real rating survives exactly once, with its value
+        real2 = np.sort(ev2[w][eu2[w] < u_tile])
+        real1 = np.sort(ev[w][eu[w] < u_tile])
+        np.testing.assert_array_equal(real2, real1)
