@@ -19,7 +19,9 @@ ever built:
     wuT  [R, C]   = WbT [R, u_tile] @ ohu                (A-lane × B-sublane)
     gWT  [R, u_tile] = gwT [R, C] @ ohu  (contract lanes of BOTH)
 
-Grid/memory plan (one grid step per entry, sequential on the TensorCore):
+Grid/memory plan (2-D sequential grid: entries × token chunks — chunking
+rides the grid because Mosaic supports neither value-level dynamic_slice
+nor mixed int+ds ref reads in-kernel):
 - The resident H half-slice rides whole in VMEM (copied in at step 0,
   flushed once at the end); entry ``oi`` offsets index it with ``pl.ds``.
 - W streams as [R, u_tile] blocks chosen by a scalar-prefetched block
@@ -29,7 +31,10 @@ Grid/memory plan (one grid step per entry, sequential on the TensorCore):
   accumulated updates stay in the live VMEM output buffer for the whole
   run and every output block is written at least once — correctness never
   depends on buffer aliasing or on cross-run revisit ordering.
-- Update order is IDENTICAL to the XLA dense path (same entries, same
+- Entry-snapshot state (tile snapshots + gradient accumulators) lives in
+  VMEM scratch, which persists across the sequential grid: every chunk
+  scores against the entry-start factors and ONE apply lands per entry —
+  update order IDENTICAL to the XLA dense path (same entries, same
   sequence), so results match it to accumulation-order rounding.
 
 Expected headroom (analytic, 2026-07-31 — NOT yet a measurement; the
