@@ -22,16 +22,19 @@ distribution to Gumbel-argmax) over hardware random bits
 per entry+chunk so runs are deterministic per backend.
 
 Numerics — read before trusting counts:
-- Count GATHERS run as single bf16 one-hot dots: counts ≤ 256 are exact;
-  larger counts round to bf16 (≤ 0.4% relative error) *in the posterior
-  only*.  The posterior a hot word sees is already ~that stale from
-  parallel chunk sampling, so this perturbs the draw less than the
-  blocked-Gibbs approximation the reference itself makes.  (An exact
-  alternative — hi/lo bf16 plane splitting — doubles the gather dots;
-  revisit if a likelihood regression ever shows.)
-- Count UPDATES stay exact: deltas are 0/±1 (bf16-exact), scatter dots
-  accumulate in f32, int16 tables round-trip exactly.  Tables remain
-  integer-valued — the invariant the tests pin.
+- Count GATHERS are EXACT by default (``exact_gathers=True``, ADVICE r3):
+  each table splits into base-256 planes (int16 doc tiles: 2 planes,
+  exact to 2^15; f32 word tiles: 3 planes, exact to 2^24 — the f32
+  table's own integer ceiling), every plane holds integers ≤ 256 (bf16-
+  exact), one bf16 dot per plane, exact f32 recombination.  Cost: +1/+2
+  gather dots and ~6·K·max(DR, WR) bytes of plane temporaries per tile.
+  ``exact_gathers=False`` keeps the single-dot bf16 gather — counts >
+  256 round (≤ 0.4% relative, *in the posterior only*); the
+  ``lda_pallas_approx`` sweep config measures whether that buys ≥10% at
+  equal chain likelihood (the flip gate's job).
+- Count UPDATES stay exact on both paths: deltas are 0/±1 (bf16-exact),
+  scatter dots accumulate in f32, int16 tables round-trip exactly.
+  Tables remain integer-valued — the invariant the tests pin.
 """
 
 from __future__ import annotations
@@ -47,8 +50,36 @@ from jax.experimental.pallas import tpu as pltpu
 _LANE = 128
 
 
+def _gather_planes(tbl_f32, oh, dot, nplanes: int):
+    """One-hot gather ``tbl @ oh`` with bf16 dots, exact for integer
+    tables below ``256 ** nplanes``.
+
+    ``nplanes == 0``: single bf16 dot of the raw table (values > 256
+    round).  Otherwise the table splits into base-256 digit planes —
+    every plane holds integers in [0, 256], which bf16 represents
+    exactly — each plane gathers with its own bf16 dot (one-hot columns
+    select single values, so the f32 accumulation is exact), and the
+    digits recombine in f32 (exact below 2^24).  Plain jnp/lax math, so
+    the same function runs inside the Pallas kernel and in numpy-backed
+    unit tests.
+    """
+    if nplanes == 0:
+        return dot(tbl_f32.astype(jnp.bfloat16), oh)
+    acc = None
+    rem = tbl_f32
+    scale = 1.0
+    for _ in range(nplanes - 1):
+        hi = jnp.floor(rem * (1.0 / 256.0))
+        lo = rem - hi * 256.0           # integer in [0, 255]: bf16-exact
+        part = dot(lo.astype(jnp.bfloat16), oh) * scale
+        acc = part if acc is None else acc + part
+        rem = hi
+        scale = scale * 256.0
+    return acc + dot(rem.astype(jnp.bfloat16), oh) * scale
+
+
 def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
-            alpha, beta, vbeta, has_noise):
+            alpha, beta, vbeta, has_noise, nplanes_d, nplanes_w):
     if has_noise:
         # CPU/interpret test path: pltpu.prng_random_bits is stubbed to
         # zeros off-TPU, so uniforms arrive as a sliced input instead
@@ -80,11 +111,14 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
 
     dot = functools.partial(lax.dot_general,
                             preferred_element_type=jnp.float32)
-    # snapshot gathers (bf16-rounded for counts > 256 — see module doc)
-    ndkT = dot(db_out[...].astype(jnp.bfloat16), ohd,
-               (((1,), (0,)), ((), ()))) - oh_old        # [K, cc]
-    nwkT = dot(wb_out[...].astype(jnp.bfloat16), ohw,
-               (((1,), (0,)), ((), ()))) - oh_old
+    gdot = functools.partial(dot,
+                             dimension_numbers=(((1,), (0,)), ((), ())))
+    # snapshot gathers — exact digit planes or single rounded bf16 dot
+    # per the nplanes_* statics (see module doc / _gather_planes)
+    ndkT = _gather_planes(db_out[...].astype(jnp.float32), ohd, gdot,
+                          nplanes_d) - oh_old            # [K, cc]
+    nwkT = _gather_planes(wb_out[...].astype(jnp.float32), ohw, gdot,
+                          nplanes_w) - oh_old
     nkT = (nk_in[...] + dnk_out[...]) - oh_old           # [K, 1] bcast
 
     a = jnp.maximum(ndkT + alpha, 1e-10)
@@ -119,7 +153,8 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
 
 
 def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
-                     chunk_c: int = 256, interpret: bool = False):
+                     chunk_c: int = 256, interpret: bool = False,
+                     exact_gathers: bool = True):
     """Resample one dense tile entry's tokens; return updated tiles.
 
     ``DbT`` [K, d_tile] (float32 or int16), ``WbT`` [K, w_tile] float32 —
@@ -138,11 +173,19 @@ def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
     K, DR = DbT.shape
     _, WR = WbT.shape
     C = z.shape[0]
+    # digit planes sized by what the table can hold: int16 doc tiles fit
+    # 2 planes exactly (counts ≤ 2^15); f32 tiles get 3 (exact to 2^24 —
+    # beyond that the f32 table itself can't count)
+    nplanes_d = (2 if DbT.dtype == jnp.int16 else 3) if exact_gathers else 0
+    nplanes_w = 3 if exact_gathers else 0
 
     def est(cc):
         # tiles in+out (+4: f32 out even for int16 in) + ~6 live [K, cc]
+        # + exact-gather plane temporaries (f32 remainder + bf16 plane of
+        # the currently-gathered table: ~6 B/elem, tables gathered in turn)
+        planes = 6 * K * max(DR, WR) if exact_gathers else 0
         return ((DbT.dtype.itemsize + 4) * K * DR + 8 * K * WR
-                + 6 * 4 * K * cc)
+                + 6 * 4 * K * cc + planes)
 
     # shrink the chunk before refusing: halving cc trades grid steps for
     # VMEM and keeps C % cc == 0 (C is padded to a 256-multiple)
@@ -197,7 +240,8 @@ def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
     )
     Db2, Wb2, z_new, dnk = pl.pallas_call(
         functools.partial(_kernel, alpha=alpha, beta=beta, vbeta=vbeta,
-                          has_noise=bool(interpret)),
+                          has_noise=bool(interpret),
+                          nplanes_d=nplanes_d, nplanes_w=nplanes_w),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((K, DR), DbT.dtype),

@@ -100,6 +100,90 @@ def test_pallas_multi_epoch_program(mesh):
     assert Ndk.sum() == model.n_tokens and (Ndk >= 0).all()
 
 
+def test_gather_planes_exact_above_256():
+    """ADVICE r3: single-dot bf16 gathers round counts > 256; the base-256
+    digit planes must reproduce the table values EXACTLY up to the f32
+    integer ceiling (2 planes to 2^16, 3 planes to 2^24)."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from harp_tpu.ops.lda_kernel import _gather_planes
+
+    # values chosen to be bf16-UNrepresentable: 257 (ties to 256),
+    # 16385, 65537, 10_000_019 (prime > 2^23)
+    vals = np.array([0, 1, 255, 256, 257, 16385, 65535, 65537, 10_000_019],
+                    np.float64)
+    K = 4
+    tbl = np.tile(vals, (K, 1)).astype(np.float32)          # [K, R]
+    ids = np.arange(len(vals), dtype=np.int32)              # gather all
+    oh = (ids[:, None] == np.arange(len(vals))[None, :]).astype(np.float32)
+    dot = functools.partial(lax.dot_general,
+                            dimension_numbers=(((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+
+    exact3 = np.asarray(_gather_planes(jnp.asarray(tbl),
+                                       jnp.asarray(oh, jnp.bfloat16), dot, 3))
+    np.testing.assert_array_equal(exact3, tbl)
+    # 2 planes: exact for everything below 2^16 (the int16 doc-tile case)
+    small = tbl.copy()
+    small[:, vals > 65535] = 0
+    exact2 = np.asarray(_gather_planes(jnp.asarray(small),
+                                       jnp.asarray(oh, jnp.bfloat16), dot, 2))
+    np.testing.assert_array_equal(exact2, small)
+    # the single-dot path really does round 257 (this is what exact mode
+    # fixes — if this ever passes, bf16 grew a mantissa and the planes
+    # can be retired)
+    approx = np.asarray(_gather_planes(jnp.asarray(tbl),
+                                       jnp.asarray(oh, jnp.bfloat16), dot, 0))
+    assert approx[0, list(vals).index(257)] != 257.0
+
+
+def test_pallas_exact_gathers_chain_quality_at_hot_counts(mesh):
+    """ADVICE r3's likelihood A/B: a small vocab drives word-topic counts
+    well past 256 (where bf16 gathers round), and the exact-gather pallas
+    chain must track the dense chain's likelihood."""
+    cfg_p = _pallas_cfg(ndk_dtype="int16")
+    cfg_d = L.LDAConfig(n_topics=8, algo="dense", d_tile=16, w_tile=16,
+                        entry_cap=1024, alpha=0.5, beta=0.1,
+                        ndk_dtype="int16")
+    d, w = L.synthetic_corpus(n_docs=64, vocab_size=16, n_topics_true=4,
+                              tokens_per_doc=200, seed=5)
+    lls = {}
+    hot = {}
+    for name, cfg in (("dense", cfg_d), ("pallas", cfg_p)):
+        m = L.LDA(64, 16, cfg, mesh, seed=7)
+        m.set_tokens(d, w)
+        for _ in range(6):
+            m.sample_epoch()
+        lls[name] = m.log_likelihood()
+        hot[name] = np.asarray(m.Nwk).max()
+    # the corpus really reaches the rounding regime (12.8k tokens over a
+    # 16-word vocab -> hot (word, topic) cells far beyond 256)
+    assert hot["pallas"] > 256, hot
+    # different random streams: same ballpark is the contract (the gate
+    # drive_check uses); a rounding-biased sampler drifts well past this
+    assert abs(lls["pallas"] - lls["dense"]) / abs(lls["dense"]) < 0.25, lls
+
+
+def test_pallas_approx_gathers_still_converge(mesh):
+    """The opt-out single-dot path stays a working chain (it is a sweep
+    candidate, not dead code)."""
+    cfg = _pallas_cfg(pallas_exact_gathers=False)
+    d, w = L.synthetic_corpus(n_docs=64, vocab_size=32, n_topics_true=4,
+                              tokens_per_doc=40, seed=4)
+    m = L.LDA(64, 32, cfg, mesh, seed=2)
+    m.set_tokens(d, w)
+    ll0 = m.log_likelihood()
+    for _ in range(5):
+        m.sample_epoch()
+    assert m.log_likelihood() > ll0
+    Nwk = np.asarray(m.Nwk)
+    assert Nwk.sum() == m.n_tokens  # updates stay exact even when
+    np.testing.assert_array_equal(Nwk, np.round(Nwk))  # gathers round
+
+
 def test_pallas_requires_fused_sampling_stack():
     with pytest.raises(ValueError, match="exprace"):
         L.LDAConfig(n_topics=8, algo="pallas")  # default gumbel/threefry

@@ -120,15 +120,17 @@ def test_enwiki_1m_program_lowers(mesh, algo):
     assert "xi16" in text        # the int16 table is in the program
 
 
-def test_enwiki_1m_pallas_program_lowers(mesh, monkeypatch):
+@pytest.mark.parametrize("carry_db", [False, True])
+def test_enwiki_1m_pallas_program_lowers(mesh, monkeypatch, carry_db):
     """The fused-kernel epoch at the TRUE graded shapes, MOSAIC-compiled:
     HARP_PALLAS_FORCE_MOSAIC routes the kernel through the real Pallas→
     Mosaic lowering (not interpret), and the whole program — topic-major
-    transposes, entry scan, scalar-prefetch grids, the kernel itself —
-    lowers for TPU on this CPU host."""
+    transposes, entry scan, scalar-prefetch grids, the kernel itself,
+    and (round 4) the carry_db flush/load cond — lowers for TPU on this
+    CPU host."""
     monkeypatch.setenv("HARP_PALLAS_FORCE_MOSAIC", "1")
     cfg = L.LDAConfig(n_topics=K, algo="pallas", ndk_dtype="int16",
-                      sampler="exprace", rng_impl="rbg")
+                      sampler="exprace", rng_impl="rbg", carry_db=carry_db)
     shapes = L.epoch_arg_shapes(8, N_DOCS, VOCAB, cfg, n_tokens=N_TOK)
     fn = L.make_multi_epoch_fn(mesh, cfg, VOCAB, epochs=2)
     lowered = fn.trace(*_sds(mesh, shapes)).lower(
