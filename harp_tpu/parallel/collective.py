@@ -177,8 +177,17 @@ def push_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
         return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim,
                                 tiled=True)
 
+    def scatter_exact(x):
+        # bool rides the wire as int32 and comes back bool (scattered OR) —
+        # the same round-trip Combiner.ADD gives allreduce_quantized's
+        # exact path, so the twins' docstring promise actually holds
+        if x.dtype == jnp.bool_:
+            return scatter(x.astype(jnp.int32)).astype(jnp.bool_)
+        return scatter(x)
+
     return _quantized_reduce(tree, wire_dtype, axis,
-                             reduce_float=scatter, reduce_exact=scatter)
+                             reduce_float=scatter,
+                             reduce_exact=scatter_exact)
 
 
 def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):

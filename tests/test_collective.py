@@ -384,6 +384,21 @@ def test_push_quantized_int_leaves_exact(mesh):
                                   x.sum(0).reshape(N, 2))
 
 
+def test_push_quantized_bool_leaves_match_allreduce_twin(mesh):
+    # ADVICE r3 (collective.py:181): the docstring promises bool leaves the
+    # same exact-ADD semantics as allreduce_quantized (int32 round-trip,
+    # back to bool = scattered OR); raw psum_scatter of bool would fail or
+    # mis-reduce instead
+    x = np.zeros((N, N * 2), np.bool_)
+    x[0, :] = True          # worker 0 contributes True everywhere
+    x[1, ::2] = True        # worker 1 overlaps on even slots
+    out = run_spmd(mesh, lambda v: C.push_quantized(v.reshape(-1)),
+                   x, out_dim=0)
+    got = np.asarray(out).reshape(N, 2)
+    assert got.dtype == np.bool_
+    np.testing.assert_array_equal(got, x.sum(0).reshape(N, 2) > 0)
+
+
 def test_push_quantized_rejects_unknown_wire(mesh):
     import jax.numpy as jnp
 

@@ -60,10 +60,10 @@ def test_pallas_multi_epoch_program_matches_dense(mesh):
 
 
 def test_pallas_multi_chunk_entries_match_dense(mesh):
-    """C > chunk_c=512 drives the kernel's inner fori_loop through
-    multiple chunks — the path the full-scale ML-20M config (C=2048)
-    runs; a chunk-slicing bug passes the small-entry tests but corrupts
-    factors only at scale."""
+    """C > chunk_c=512 drives the chunk axis of the kernel's 2-D grid
+    through multiple steps — the path the full-scale ML-20M config
+    (C=2048) runs; a chunk-slicing bug passes the small-entry tests but
+    corrupts factors only at scale."""
     rng = np.random.default_rng(11)
     # all ratings in ONE (worker, slice, tile) cell (n_items=128 → 8 items
     # per half-slice, so i<8 is slice 0 / tile 0) → one entry holding 600
