@@ -19,9 +19,17 @@ can return early on this machine's relay transport.  The watchdog
 re-arms per config; if the TPU relay hangs mid-sweep the record still
 carries every config measured before the hang, with ``error`` naming the
 hung one.
+
+Outage behavior (VERDICT r3 item 3): a bounded subprocess probe runs
+BEFORE the first config, so a dead relay yields a ``relay_down`` record
+in seconds; and every error record (probe or watchdog) carries a
+``last_measured`` block — the last committed TPU number per config with
+date + source — so an outage never reads as a bare 0.0.
 """
 
 import json
+import os
+import subprocess
 import sys
 import threading
 
@@ -47,6 +55,91 @@ BASELINES = {
     "rf": 7.92,             # trees/s, 32 trees depth 6 on 200k×64
 }
 
+# result_key → display unit; shared by _configs and _last_measured so a
+# committed BENCH_local row and a live measurement can't disagree on units
+UNITS = {
+    "iters_per_sec": "iter/s",
+    "points_per_sec": "points/s",
+    "updates_per_sec_per_chip": "updates/s/chip",
+    "tokens_per_sec_per_chip": "tokens/s/chip",
+    "samples_per_sec": "samples/s",
+    "vertices_per_sec": "vertices/s",
+    "trees_per_sec": "trees/s",
+}
+
+
+def _last_measured():
+    """Last committed TPU number per config (BENCH_local.jsonl rows, then
+    the BASELINES constants above), each with date + source — so a relay
+    outage yields a record the driver can read the framework's real
+    measured speed from instead of a bare zero (VERDICT r3 item 3)."""
+    out = {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_local.jsonl")
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("smoke") or row.get("backend") == "cpu":
+                    continue
+                for key, unit in UNITS.items():
+                    if row.get(key) is not None:
+                        # later rows overwrite earlier: last measurement wins
+                        out[row.get("config", "?")] = {
+                            "value": round(float(row[key]), 2), "unit": unit,
+                            "date": row.get("date"),
+                            "source": "BENCH_local.jsonl"}
+                        break
+    except OSError:
+        pass
+    # configs never measured in a committed row fall back to the constants
+    # (themselves transcribed from BASELINE.md's dated tables)
+    units_by_config = {name: UNITS[key] for name, key in _CONFIG_KEYS}
+    for name, base in BASELINES.items():
+        if base is not None and name not in out:
+            out[name] = {"value": base, "unit": units_by_config[name],
+                         "date": "2026-07-31",
+                         "source": "bench.py BASELINES (BASELINE.md)"}
+    return out
+
+
+def _relay_probe_error():
+    """Bounded jax.devices() probe in a subprocess BEFORE the first config,
+    so a dead relay is reported as ``relay_down`` in seconds instead of
+    discovered at watchdog minute 20 (VERDICT r3 item 3).  The probe runs
+    out-of-process because an in-process hang is uninterruptible (CLAUDE.md
+    gotchas).  Skipped on simulated-CPU runs (tests); HARP_RELAY_PROBE=0
+    disables, =force probes regardless of platform (test hook)."""
+    mode = os.environ.get("HARP_RELAY_PROBE", "1")
+    if mode in ("0", "off"):
+        return None
+    if mode != "force":
+        import jax  # importing jax does NOT touch the backend
+
+        plat = (jax.config.jax_platforms or
+                os.environ.get("JAX_PLATFORMS", ""))
+        if plat.split(",")[0] == "cpu":
+            return None  # simulated-CPU run: no relay to probe
+    timeout_s = float(os.environ.get("HARP_RELAY_PROBE_TIMEOUT", "90"))
+    code = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"relay_down: jax.devices() probe timed out after "
+                f"{timeout_s:.0f}s — TPU relay hung before any config ran")
+    if p.returncode != 0:
+        lines = (p.stderr or "").strip().splitlines()
+        tail = lines[-1] if lines else ""
+        return f"relay_down: probe exited rc {p.returncode}: {tail}"
+    return None
+
 
 def _ingest_bench(smoke):
     """Real disk ingest through fit_streaming (VERDICT r2 item 2): full
@@ -64,6 +157,22 @@ def _ingest_bench(smoke):
     return bench_ingest.run_smoke() if smoke else bench_ingest.run_full()
 
 
+# config name → result_key, in run order (headline first).  Module-level
+# (no model imports) so _last_measured can map units without touching jax.
+_CONFIG_KEYS = [
+    ("kmeans", "iters_per_sec"),
+    ("kmeans_stream", "iters_per_sec"),
+    ("kmeans_ingest", "points_per_sec"),
+    ("mfsgd", "updates_per_sec_per_chip"),
+    ("mfsgd_pallas", "updates_per_sec_per_chip"),
+    ("lda", "tokens_per_sec_per_chip"),
+    ("lda_pallas", "tokens_per_sec_per_chip"),
+    ("mlp", "samples_per_sec"),
+    ("subgraph", "vertices_per_sec"),
+    ("rf", "trees_per_sec"),
+]
+
+
 def _configs(smoke):
     """(name, unit, result_key, thunk) per graded config, headline first."""
     from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp, rf,
@@ -71,53 +180,48 @@ def _configs(smoke):
 
     import jax
 
-    return [
-        ("kmeans", "iter/s", "iters_per_sec", lambda: kmeans.benchmark(
+    thunks = {
+        "kmeans": lambda: kmeans.benchmark(
             **({"n": 8192, "d": 32, "k": 16, "iters": 20, "warmup": 2}
                if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100,
-                "warmup": 5}))),
-        ("kmeans_stream", "iter/s", "iters_per_sec",
-         lambda: kmeans_stream.benchmark_streaming(
-             **({"n": 65536, "d": 16, "k": 16, "iters": 2,
-                 "chunk_points": 8192} if smoke else
-                {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
-                 "chunk_points": 262_144}))),
-        ("kmeans_ingest", "points/s", "points_per_sec",
-         lambda: _ingest_bench(smoke)),
-        ("mfsgd", "updates/s/chip", "updates_per_sec_per_chip",
-         lambda: mfsgd.benchmark(
-             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
-                 "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
-                if smoke else {}))),
-        ("mfsgd_pallas", "updates/s/chip", "updates_per_sec_per_chip",
-         lambda: mfsgd.benchmark(
-             algo="pallas",
-             # smoke tiles must pass the kernel's TPU gate (128-multiples)
-             **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
-                 "epochs": 2, "u_tile": 128, "i_tile": 128,
-                 "entry_cap": 256} if smoke else {}))),
-        ("lda", "tokens/s/chip", "tokens_per_sec_per_chip",
-         lambda: lda.benchmark(
-             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                 "w_tile": 16, "entry_cap": 64} if smoke else {}))),
-        ("lda_pallas", "tokens/s/chip", "tokens_per_sec_per_chip",
-         lambda: lda.benchmark(
-             algo="pallas",
-             # smoke tiles must pass the kernel's TPU gate (128-multiples)
-             **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
-                 "tokens_per_doc": 16, "epochs": 1, "d_tile": 128,
-                 "w_tile": 128, "entry_cap": 64} if smoke else {}))),
-        ("mlp", "samples/s", "samples_per_sec", lambda: mlp.benchmark(
-            **({"n": 4096, "batch": 512, "steps": 5} if smoke else {}))),
-        ("subgraph", "vertices/s", "vertices_per_sec",
-         lambda: subgraph.benchmark(
-             **({"n_vertices": 2000, "avg_degree": 4} if smoke else {}))),
-        ("rf", "trees/s", "trees_per_sec", lambda: rf.benchmark(
+                "warmup": 5})),
+        "kmeans_stream": lambda: kmeans_stream.benchmark_streaming(
+            **({"n": 65536, "d": 16, "k": 16, "iters": 2,
+                "chunk_points": 8192} if smoke else
+               {"n": 100_000_000, "d": 300, "k": 1000, "iters": 2,
+                "chunk_points": 262_144})),
+        "kmeans_ingest": lambda: _ingest_bench(smoke),
+        "mfsgd": lambda: mfsgd.benchmark(
+            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                "epochs": 2, "u_tile": 16, "i_tile": 16, "entry_cap": 256}
+               if smoke else {})),
+        "mfsgd_pallas": lambda: mfsgd.benchmark(
+            algo="pallas",
+            # smoke tiles must pass the kernel's TPU gate (128-multiples)
+            **({"n_users": 512, "n_items": 256, "nnz": 20_000, "rank": 8,
+                "epochs": 2, "u_tile": 128, "i_tile": 128,
+                "entry_cap": 256} if smoke else {})),
+        "lda": lambda: lda.benchmark(
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64} if smoke else {})),
+        "lda_pallas": lambda: lda.benchmark(
+            algo="pallas",
+            # smoke tiles must pass the kernel's TPU gate (128-multiples)
+            **({"n_docs": 256, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 128,
+                "w_tile": 128, "entry_cap": 64} if smoke else {})),
+        "mlp": lambda: mlp.benchmark(
+            **({"n": 4096, "batch": 512, "steps": 5} if smoke else {})),
+        "subgraph": lambda: subgraph.benchmark(
+            **({"n_vertices": 2000, "avg_degree": 4} if smoke else {})),
+        "rf": lambda: rf.benchmark(
             **({"n": 4096, "f": 16, "max_depth": 3,
-                "n_trees": 2 * jax.device_count()} if smoke else {}))),
-    ]
+                "n_trees": 2 * jax.device_count()} if smoke else {})),
+    }
+    return [(name, UNITS[key], key, thunks[name])
+            for name, key in _CONFIG_KEYS]
 
 
 def main():
@@ -161,6 +265,8 @@ def main():
         error = error or km.get("error")
         if error:
             rec["error"] = error
+            # an outage record still reads the framework's real speed
+            rec["last_measured"] = _last_measured()
         return rec
 
     def emit_hang_record(what):
@@ -173,6 +279,13 @@ def main():
         done.set()
         print(json.dumps(record(
             error=f"TPU relay hang during {what} (watchdog)")), flush=True)
+
+    # dead relay → informative record in seconds, not at watchdog minute 20
+    probe_err = _relay_probe_error()
+    if probe_err:
+        done.set()
+        print(json.dumps(record(error=probe_err)), flush=True)
+        raise SystemExit(3)
 
     watchdog = HangWatchdog(on_fire=emit_hang_record)  # HARP_BENCH_TIMEOUT
     watchdog.arm("backend init")  # first backend use is inside _configs

@@ -540,9 +540,14 @@ def main(argv=None):
                         "MXU matmuls — same counts, different hardware "
                         "path (profile on TPU to pick)")
     args = p.parse_args(argv)
-    print(benchmark(args.vertices, args.avg_degree, args.template,
-                    max_degree=args.max_degree, graph=args.graph,
-                    overflow_algo=args.overflow_algo))
+    # JSON, not dict-repr: the relay sprint tees this into BENCH_local.jsonl
+    import json
+
+    print(json.dumps({"config": "subgraph_cli",
+                      **benchmark(args.vertices, args.avg_degree,
+                                  args.template, max_degree=args.max_degree,
+                                  graph=args.graph,
+                                  overflow_algo=args.overflow_algo)}))
 
 
 if __name__ == "__main__":

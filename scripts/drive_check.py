@@ -433,3 +433,56 @@ np.testing.assert_allclose(_zout[True][1], _zout[False][1],
                            rtol=2e-5, atol=2e-6)
 print(f"zero1 ≡ replicated adam over 3 steps (loss {_zout[True][0][-1]:.4f})")
 print(f"DRIVE OK round-15 ({mode})")
+
+# 21. round 4 (this session): carry_db through the public LDA driver —
+# the od-run-carried doc tile must be BIT-identical to the
+# slice-per-entry chain on both tiled algos; the exact-gather kernel
+# default keeps integer tables; and the flip gate refuses a degraded
+# candidate.
+from harp_tpu.models.lda import LDA as _R4L
+from harp_tpu.models.lda import LDAConfig as _R4C
+from harp_tpu.models.lda import synthetic_corpus as _r4corpus
+
+_r4d, _r4w = _r4corpus(n_docs=48, vocab_size=24, n_topics_true=3,
+                       tokens_per_doc=24, seed=9)
+for _r4algo in ("dense", "pallas"):
+    _r4extra = ({"sampler": "exprace", "rng_impl": "rbg"}
+                if _r4algo == "pallas" else {})
+    _r4chains = {}
+    for _r4carry in (False, True):
+        _r4m = _R4L(48, 24, _R4C(n_topics=4, algo=_r4algo, d_tile=8,
+                                 w_tile=8, entry_cap=32,
+                                 carry_db=_r4carry, **_r4extra),
+                    mesh, seed=2)
+        _r4m.set_tokens(_r4d, _r4w)
+        for _ in range(3):
+            _r4m.sample_epoch()
+        _r4chains[_r4carry] = (np.asarray(_r4m.Ndk), np.asarray(_r4m.Nwk),
+                               np.asarray(_r4m.z_grid))
+    for _a, _b in zip(_r4chains[False], _r4chains[True]):
+        np.testing.assert_array_equal(_a, _b)
+    print(f"carry_db ≡ slice-per-entry ({_r4algo}, bit-identical)")
+
+# exact plane gathers: a pallas chain at hot counts (tiny vocab) keeps
+# integer tables and tracks dense likelihood
+import importlib.util as _r4ilu
+import os as _r4os
+
+_r4spec = _r4ilu.spec_from_file_location(
+    "flip_decision", _r4os.path.join(
+        _r4os.path.dirname(_r4os.path.abspath(__file__)),
+        "flip_decision.py"))
+_r4fd = _r4ilu.module_from_spec(_r4spec)
+_r4spec.loader.exec_module(_r4fd)
+_r4v = _r4fd.decide(
+    {"tokens_per_sec_per_chip": 9e6, "log_likelihood": -9.5},
+    {"tokens_per_sec_per_chip": 6e6, "log_likelihood": -9.1},
+    _r4fd.CANDIDATES["lda_pallas"])
+assert not _r4v["flip"] and _r4v["quality_ok"] is False  # degraded → refused
+_r4v2 = _r4fd.decide(
+    {"tokens_per_sec_per_chip": 9e6, "log_likelihood": -9.11},
+    {"tokens_per_sec_per_chip": 6e6, "log_likelihood": -9.1},
+    _r4fd.CANDIDATES["lda_pallas"])
+assert _r4v2["flip"]  # 1.5x at equal quality → flips
+print("flip gate: degraded refused, equal-quality 1.5x flips")
+print(f"DRIVE OK round-16 ({mode})")

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Encode BASELINE.md's default-flip rule: ≥10% faster AT EQUAL QUALITY.
+
+VERDICT r3 weak #5 / next #6: the decision rule existed only as prose —
+a fast-but-degraded candidate kernel could become a default with nobody
+noticing, because nothing in code compared the candidate's quality field
+against the incumbent's.  This module is that comparison.
+
+Each candidate config in CANDIDATES names its incumbent, its throughput
+metric, its quality field, the direction quality improves, and the
+tolerance inside which the two count as "equal quality".  ``decide``
+takes the two measured rows and returns a verdict dict; the CLI reads
+BENCH_local.jsonl (last non-error full-shape row per config wins),
+prints one verdict JSON line per candidate, and exits 1 if any verdict
+could not be computed (missing rows must block the flip, not pass it).
+
+A flip verdict here authorizes the one-line default change listed in
+BASELINE.md's candidates table (MFSGDConfig.algo, LDAConfig.sampler/
+rng_impl/algo, KMeansConfig.use_pallas, SubgraphConfig.overflow_algo);
+the BASELINE.md row and bench.py BASELINES update in the same commit.
+
+Tolerances (stated, per VERDICT "within a stated tolerance"):
+- rmse_final (lower better, rel 2%): the pallas kernel replays the dense
+  update order, so real parity is ~bit-level; 2% allows accumulation-
+  order noise only.
+- log_likelihood (higher better, abs 0.05 nats/token): exprace/rbg draw
+  from the identical distribution with a different stream; 2-epoch mean
+  per-token LL jitters ~0.01 across seeds, while a biased sampler (e.g.
+  the bf16-count rounding ADVICE r3 flags) shows up well above 0.05.
+- inertia (lower better, rel 1%): int8 quantization measured 1.2e-4 rel
+  on the graded shape (BENCH_local 2026-07-31); 1% is ~100× that.
+- estimate (equal, rel 1e-6): segment/onehot are the same exact counts —
+  BASELINE.md says "identical to 7 digits".
+- train_acc (higher better, abs 0.005).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# candidate → how to judge it (see module doc for tolerance rationale)
+CANDIDATES = {
+    "mfsgd_pallas": {
+        "incumbent": "mfsgd", "metric": "updates_per_sec_per_chip",
+        "quality": "rmse_final", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MFSGDConfig.algo='pallas'"},
+    "lda_exprace": {
+        "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.sampler='exprace'"},
+    "lda_fast": {
+        "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.sampler='exprace', rng_impl='rbg'"},
+    "lda_pallas": {
+        "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.algo='pallas'"},
+    # the ADVICE-r3 likelihood A/B in gate form: approx (single-dot bf16)
+    # gathers may become the kernel default only by beating the exact
+    # kernel ≥10% at equal chain likelihood
+    "lda_pallas_approx": {
+        "incumbent": "lda_pallas", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.pallas_exact_gathers=False"},
+    # VERDICT r3 item 2's Db-carry, bit-identical chain by construction
+    # (same tile cores, tested) — the gate still demands the quality
+    # field so a broken carry can't slip through on speed alone
+    "lda_carry": {
+        "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.carry_db=True"},
+    "lda_pallas_carry": {
+        "incumbent": "lda_pallas", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.carry_db=True (pallas stack)"},
+    "kmeans_int8_fused": {
+        "incumbent": "kmeans_int8", "metric": "iters_per_sec",
+        "quality": "inertia", "sense": "lower", "rel_tol": 0.01,
+        "flips": "KMeansConfig.use_pallas=True (int8 path)"},
+    "kmeans_stream_int8": {
+        "incumbent": "kmeans_stream",
+        # prefer the ex-gen rate when present (same rule as roofline.py:
+        # synthetic chunk generation is scaffolding outside the work model)
+        "metric": "iters_per_sec_ex_gen", "metric_fallback": "iters_per_sec",
+        "quality": "inertia", "sense": "lower", "rel_tol": 0.01,
+        "flips": "kmeans_stream default quantize='int8'"},
+    # incumbent is the POWERLAW segment twin (subgraph_pl), not the
+    # uniform graded config — the uniform graph's overflow share is ~0,
+    # so comparing against it would read 1.0x at any truth
+    "subgraph_onehot": {
+        "incumbent": "subgraph_pl", "metric": "vertices_per_sec",
+        "quality": "estimate", "sense": "equal", "rel_tol": 1e-6,
+        "flips": "SubgraphConfig.overflow_algo='onehot'"},
+    "subgraph_1m_onehot": {
+        "incumbent": "subgraph_1m", "metric": "vertices_per_sec",
+        "quality": "estimate", "sense": "equal", "rel_tol": 1e-6,
+        "flips": "SubgraphConfig.overflow_algo='onehot' (graded scale)"},
+}
+
+WIN_THRESHOLD = 1.10  # "wins >=10%" half of the rule
+
+
+def _metric_value(row, spec):
+    v = row.get(spec["metric"])
+    if v is None and "metric_fallback" in spec:
+        v = row.get(spec["metric_fallback"])
+    return v
+
+
+def decide(candidate_row: dict, incumbent_row: dict, spec: dict) -> dict:
+    """Apply the ≥10%-at-equal-quality rule to one candidate/incumbent pair.
+
+    Returns {"flip": bool, "speedup": float|None, "quality_ok": bool|None,
+    "reason": str, ...}.  Missing rows, error rows, or a missing quality
+    field REFUSE the flip — the gate fails closed.
+    """
+    out = {"flip": False, "speedup": None, "quality_ok": None}
+    for which, row in (("candidate", candidate_row),
+                       ("incumbent", incumbent_row)):
+        if row is None:
+            out["reason"] = f"no measured row for {which} — refusing flip"
+            return out
+        if "error" in row:
+            out["reason"] = f"{which} row is an error record — refusing flip"
+            return out
+    cv, iv = _metric_value(candidate_row, spec), _metric_value(
+        incumbent_row, spec)
+    if not cv or not iv:
+        out["reason"] = f"metric {spec['metric']} missing — refusing flip"
+        return out
+    out["speedup"] = round(float(cv) / float(iv), 4)
+    cq, iq = candidate_row.get(spec["quality"]), incumbent_row.get(
+        spec["quality"])
+    if cq is None or iq is None:
+        out["reason"] = (f"quality field {spec['quality']!r} missing — "
+                         "refusing flip (gate fails closed)")
+        return out
+    cq, iq = float(cq), float(iq)
+    sense = spec["sense"]
+    if sense == "lower":
+        ok = cq <= iq * (1.0 + spec["rel_tol"])
+    elif sense == "higher":
+        ok = cq >= iq - spec["abs_tol"]
+    elif sense == "equal":
+        ok = abs(cq - iq) <= spec["rel_tol"] * max(abs(iq), 1e-30)
+    else:  # pragma: no cover — spec typo
+        raise ValueError(f"unknown sense {sense!r}")
+    out["quality_ok"] = bool(ok)
+    out["quality_candidate"] = cq
+    out["quality_incumbent"] = iq
+    if not ok:
+        out["reason"] = (f"QUALITY DEGRADED: {spec['quality']} "
+                         f"{cq:.6g} vs incumbent {iq:.6g} — refusing flip "
+                         f"regardless of {out['speedup']:.2f}x speed")
+        return out
+    if out["speedup"] >= WIN_THRESHOLD:
+        out["flip"] = True
+        out["reason"] = (f"FLIP: {out['speedup']:.2f}x at equal quality — "
+                         f"apply {spec['flips']}")
+    else:
+        out["reason"] = (f"keep incumbent: {out['speedup']:.2f}x < "
+                         f"{WIN_THRESHOLD:.2f}x threshold")
+    return out
+
+
+def latest_rows(path: str) -> dict:
+    """config → last full-shape non-error TPU row (later lines win).
+
+    CPU-sim rows are skipped like bench.py's ``_last_measured`` does:
+    relative CPU speeds are explicitly non-predictive of TPU here
+    (BASELINE.md's onehot-vs-segment 7.8× CPU inversion), so they must
+    never authorize a flip.
+    """
+    rows = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # sprint tee'd a non-JSON line; skip
+                cfg = row.get("config")
+                if (not cfg or row.get("smoke") or "error" in row
+                        or row.get("backend") == "cpu"):
+                    continue
+                rows[cfg] = row
+    except OSError:
+        pass
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p.add_argument("--bench", default=os.path.join(repo, "BENCH_local.jsonl"))
+    p.add_argument("--only", nargs="+", choices=sorted(CANDIDATES),
+                   default=None)
+    args = p.parse_args(argv)
+    rows = latest_rows(args.bench)
+    undecidable = 0
+    for name, spec in CANDIDATES.items():
+        if args.only and name not in args.only:
+            continue
+        verdict = decide(rows.get(name), rows.get(spec["incumbent"]), spec)
+        if verdict["speedup"] is None or verdict["quality_ok"] is None:
+            undecidable += 1
+        print(json.dumps({"flip_decision": name,
+                          "incumbent": spec["incumbent"], **verdict}))
+    return 1 if undecidable else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Pallas-kernel ≡ reference equivalence on the CURRENT backend — the
+gate the relay sprint runs BEFORE recording any pallas numbers.
+
+ADVICE r3 (ops/mfsgd_kernel.py:101): kernel correctness on real TPU
+hinges on Mosaic buffer-revision behavior that interpret mode + lowering
+cannot prove — so the first thing a relay window must do is execute the
+equivalence checks on silicon, and only then let measure_all.py record
+mfsgd_pallas / lda_pallas / kmeans_int8_fused rows.  measure_on_relay.sh
+runs this with a bounded timeout and SKIPS the pallas configs if it
+fails.
+
+Unlike scripts/drive_check.py (the full 19-section public-API drive,
+minutes of relay compiles), this is the three kernel checks only —
+small shapes, TPU-legal tiles, ~1 min of relay time.
+
+Exit 0 = all kernels equivalent; nonzero = do not record pallas rows.
+
+Usage: python scripts/kernel_equiv_check.py [cpu8]
+``cpu8`` forces the 8-device CPU sim (local validation; the axon site
+pin would otherwise send this to the TPU relay — CLAUDE.md gotchas).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    if "cpu8" in sys.argv[1:]:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu import WorkerMesh
+    from harp_tpu.models.kmeans import fit as kfit
+    from harp_tpu.models.lda import LDA, LDAConfig, synthetic_corpus
+    from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings
+    from harp_tpu.parallel.mesh import set_mesh
+
+    mesh = WorkerMesh()
+    set_mesh(mesh)
+    on_tpu = jax.default_backend() != "cpu"
+    tile = 128 if on_tpu else 8  # kernels gate 128-multiples on TPU
+    rng = np.random.default_rng(0)
+
+    # 1. MF-SGD: pallas kernel replays dense's exact update order
+    u, i, v = synthetic_ratings(96, 64, 3000, rank=4, noise=0.05, seed=2)
+    factors = {}
+    for algo in ("dense", "pallas"):
+        cfg = MFSGDConfig(rank=8, algo=algo, u_tile=tile, i_tile=tile,
+                          entry_cap=32, compute_dtype=jnp.float32,
+                          lr=0.03, reg=0.01)
+        m = MFSGD(96, 64, cfg, mesh, seed=4)
+        m.set_ratings(u, i, v)
+        rm = [m.train_epoch() for _ in range(2)]
+        factors[algo] = (m.factors(), rm)
+    np.testing.assert_allclose(factors["pallas"][0][0],
+                               factors["dense"][0][0], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(factors["pallas"][0][1],
+                               factors["dense"][0][1], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(factors["pallas"][1], factors["dense"][1],
+                               rtol=1e-5)
+    print(f"mfsgd pallas == dense (rmse {factors['pallas'][1][-1]:.4f})")
+
+    # 2. LDA: pallas chain ascends, counts exact, quality matches gumbel
+    d, w = synthetic_corpus(n_docs=64, vocab_size=32, n_topics_true=4,
+                            tokens_per_doc=40, seed=3)
+    lt = 128 if on_tpu else 16
+    lls = {}
+    for algo in ("dense", "pallas"):
+        # the pallas kernel fuses the exprace draw over hardware bits —
+        # its required sampler stack; dense keeps the gumbel default so
+        # this doubles as the sampler-stack quality A/B
+        extra = ({"sampler": "exprace", "rng_impl": "rbg"}
+                 if algo == "pallas" else {})
+        lcfg = LDAConfig(n_topics=8, algo=algo, d_tile=lt, w_tile=lt,
+                         entry_cap=64, alpha=0.5, beta=0.1, **extra)
+        lm = LDA(64, 32, lcfg, mesh, seed=1)
+        lm.set_tokens(d, w)
+        for _ in range(6):
+            lm.sample_epoch()
+        ndk, nwk = np.asarray(lm.Ndk), np.asarray(lm.Nwk)
+        assert ndk.sum() == lm.n_tokens and (ndk >= 0).all()
+        assert (nwk == np.round(nwk)).all(), "counts must stay integers"
+        lls[algo] = lm.log_likelihood()
+    # different streams on a tiny corpus: ~10% spread; gate with margin
+    assert abs(lls["pallas"] - lls["dense"]) / abs(lls["dense"]) < 0.25, lls
+    print(f"lda pallas chain quality == dense ({lls})")
+
+    # 3. KMeans: fused int8 kernel == XLA int8 formulation
+    pts = rng.normal(size=(1024, 16)).astype(np.float32) * 3
+    ca, ia = kfit(pts, k=4, iters=4, mesh=mesh, seed=5, quantize="int8")
+    cb, ib = kfit(pts, k=4, iters=4, mesh=mesh, seed=5, quantize="int8",
+                  use_pallas=True)
+    np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-5)
+    print(f"kmeans fused int8 == XLA int8 (inertia {ib:.1f})")
+
+    print(f"KERNEL EQUIV OK ({jax.default_backend()})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # bench_common
 
+# reusable benchmark artifacts (ingest npy, LDA pack cache) live here
+BENCH_DATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".bench_data")
+
 
 def _git_commit() -> str:
     """Short HEAD hash (records must be attributable to exact code)."""
@@ -46,7 +51,7 @@ def _bench_ingest(smoke: bool):
             else bench_ingest.run_full(compare_synthetic=True))
 
 
-def run_all(smoke: bool, only, watchdog=None):
+def run_all(smoke: bool, only, watchdog=None, skip=None):
     import jax
 
     from bench_common import SMOKE
@@ -97,7 +102,15 @@ def run_all(smoke: bool, only, watchdog=None):
             # smoke tiles must pass the kernel's TPU gate (128-multiples)
             **(SMOKE["mfsgd_pallas"] if smoke else {})),
         "lda": lambda: lda.benchmark(
-            **(SMOKE["lda"] if smoke else {})),
+            **(SMOKE["lda"] if smoke else
+               {"pack_cache": BENCH_DATA})),
+        # round 4: doc-tile carried across its od-run (one flush/load per
+        # run instead of per entry) — the VERDICT r3 item 2 Db-carry, now
+        # a flag; bit-identical chain (tested), TPU verdict pending
+        "lda_carry": lambda: lda.benchmark(
+            carry_db=True,
+            **(SMOKE["lda"] if smoke else
+               {"pack_cache": BENCH_DATA})),
         # graded-scale ladder (VERDICT r1 item 5): 500k docs × 1k topics
         # with the int16 doc-topic table (2 GB instead of 4 GB at 1M docs)
         "lda_scale": lambda: lda.benchmark(
@@ -106,7 +119,8 @@ def run_all(smoke: bool, only, watchdog=None):
                 "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
                if smoke else
                {"n_docs": 500_000, "vocab_size": 50_000, "n_topics": 1000,
-                "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16"})),
+                "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16",
+                "pack_cache": BENCH_DATA})),
         # TRUE graded shapes (enwiki-1M: 1M docs × 1k topics, 100M tokens,
         # int16 Ndk — fits one chip: 2 GB Ndk + 0.23 GB Nwk; the program
         # is lowering-proven in tests/test_lda_scale.py, this EXECUTES it
@@ -117,36 +131,73 @@ def run_all(smoke: bool, only, watchdog=None):
                if smoke else
                {"n_docs": 1_000_000, "vocab_size": 50_000,
                 "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
-                "ndk_dtype": "int16"})),
+                "ndk_dtype": "int16", "pack_cache": BENCH_DATA})),
         # round 3: exponential-race topic draw (identical distribution,
         # ~5× fewer VPU transcendentals) — candidate default if it wins
         "lda_exprace": lambda: lda.benchmark(
             sampler="exprace",
-            **(SMOKE["lda"] if smoke else {})),
+            **(SMOKE["lda"] if smoke else
+               {"pack_cache": BENCH_DATA})),
         # round 3: exprace + hardware RNG together — the candidate new
         # default sampling stack; vs lda/lda_exprace it attributes the
         # win between sampler math and bit generation
         "lda_fast": lambda: lda.benchmark(
             sampler="exprace", rng_impl="rbg",
-            **(SMOKE["lda"] if smoke else {})),
+            **(SMOKE["lda"] if smoke else
+               {"pack_cache": BENCH_DATA})),
         # round 3: the whole entry fused into one VMEM kernel
-        # (ops/lda_kernel.py) — candidate new default if it wins on TPU
+        # (ops/lda_kernel.py) — candidate new default if it wins on TPU.
+        # round 4: gathers are EXACT by default (base-256 digit planes)
         "lda_pallas": lambda: lda.benchmark(
             algo="pallas",
-            **(SMOKE["lda_pallas"] if smoke else {})),
+            **(SMOKE["lda_pallas"] if smoke else
+               {"pack_cache": BENCH_DATA})),
+        # round 4: the single-dot bf16 gather variant (counts > 256 round
+        # ~0.4% in the posterior) — may flip pallas_exact_gathers=False
+        # only if ≥10% faster at equal chain likelihood (flip_decision)
+        "lda_pallas_approx": lambda: lda.benchmark(
+            algo="pallas", pallas_exact_gathers=False,
+            **(SMOKE["lda_pallas"] if smoke else
+               {"pack_cache": BENCH_DATA})),
+        # round 4: fused kernel + carried doc tile — the two HBM levers
+        # stacked (entry VMEM-residency from the kernel, od-run tile
+        # amortization from the carry)
+        "lda_pallas_carry": lambda: lda.benchmark(
+            algo="pallas", carry_db=True,
+            **(SMOKE["lda_pallas"] if smoke else
+               {"pack_cache": BENCH_DATA})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",
             **(SMOKE["lda_scatter"] if smoke
-               else {})),
+               else {"pack_cache": BENCH_DATA})),
         "mlp": lambda: mlp.benchmark(
             **(SMOKE["mlp"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
+            **(SMOKE["subgraph"] if smoke else {})),
+        # overflow-tail A/B pair (r2 verdict item 7): POWERLAW graph so
+        # the tail carries real mass (the uniform graded config's
+        # ~Poisson(16) degrees never exceed max_degree=64 — segment vs
+        # onehot would execute identical work and the A/B would read
+        # 1.0x at any truth); identical counts by construction —
+        # flip_decision compares the rates and asserts the estimates
+        # match to 1e-6 before overflow_algo may change default
+        "subgraph_pl": lambda: subgraph.benchmark(
+            graph="powerlaw", max_degree=16,
+            **(SMOKE["subgraph"] if smoke else {})),
+        "subgraph_onehot": lambda: subgraph.benchmark(
+            graph="powerlaw", max_degree=16, overflow_algo="onehot",
             **(SMOKE["subgraph"] if smoke else {})),
         # the graded template at graded scale (VERDICT r2 item 4): u5-tree
         # on a 1M-vertex power-law graph — hub mass rides the exact
         # overflow segment-sum path (overflow_share reported; 0 dropped)
         "subgraph_1m": lambda: subgraph.benchmark(
             graph="powerlaw",
+            **({**SMOKE["subgraph"], "max_degree": 8}
+               if smoke else
+               {"n_vertices": 1_000_000, "avg_degree": 8,
+                "max_degree": 16, "template": "u5-tree"})),
+        "subgraph_1m_onehot": lambda: subgraph.benchmark(
+            graph="powerlaw", overflow_algo="onehot",
             **({**SMOKE["subgraph"], "max_degree": 8}
                if smoke else
                {"n_vertices": 1_000_000, "avg_degree": 8,
@@ -178,6 +229,8 @@ def run_all(smoke: bool, only, watchdog=None):
     for name, fn in configs.items():
         if only and name not in only:
             continue
+        if skip and name in skip:
+            continue
         if watchdog is not None:
             watchdog.arm(name)  # restart the hang clock per config
         try:
@@ -199,16 +252,29 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--out", default=None, help="append JSONL records here")
     p.add_argument("--smoke", action="store_true")
+    # one list for --only AND --skip: a typo in either is an argparse
+    # error, never a silent empty sweep or a silently-unskipped config
+    config_names = ["kmeans", "kmeans_int8", "kmeans_int8_fused",
+                    "kmeans_stream", "kmeans_stream_int8",
+                    "kmeans_ingest", "mfsgd", "mfsgd_scatter",
+                    "mfsgd_pallas", "lda", "lda_carry",
+                    "lda_exprace", "lda_fast", "lda_pallas",
+                    "lda_pallas_approx", "lda_pallas_carry",
+                    "lda_scale", "lda_scale_1m", "lda_scatter", "mlp",
+                    "subgraph", "subgraph_pl", "subgraph_onehot",
+                    "subgraph_1m", "subgraph_1m_onehot", "rf"]
     p.add_argument("--only", nargs="+", default=None, metavar="CONFIG",
-                   choices=["kmeans", "kmeans_int8", "kmeans_int8_fused",
-                            "kmeans_stream", "kmeans_stream_int8",
-                            "kmeans_ingest", "mfsgd", "mfsgd_scatter",
-                            "mfsgd_pallas", "lda", "lda_exprace",
-                            "lda_fast", "lda_pallas", "lda_scale",
-                            "lda_scale_1m", "lda_scatter", "mlp",
-                            "subgraph", "subgraph_1m", "rf"],
+                   choices=config_names,
                    help="subset of configs to run (typo → argparse error, "
                         "not a silent empty sweep)")
+    p.add_argument("--skip", nargs="+", default=None, metavar="CONFIG",
+                   choices=config_names,
+                   help="configs to exclude (the relay sprint skips the "
+                        "pallas configs when kernel_equiv_check.py fails "
+                        "on silicon — ADVICE r3: no pallas row may be "
+                        "recorded before the equivalence check passes; a "
+                        "typo'd skip must error, not silently record an "
+                        "unverified row)")
     p.add_argument("--platform", choices=["cpu"], default=None,
                    help="force the CPU backend (the axon site pin would "
                         "otherwise send even --smoke runs to the TPU "
@@ -242,7 +308,7 @@ def main(argv=None):
     # backend use, which happens while building the env dict.
     watchdog.arm("backend init")
     try:
-        for rec in run_all(args.smoke, args.only, watchdog):
+        for rec in run_all(args.smoke, args.only, watchdog, args.skip):
             emit(rec)
     finally:
         watchdog.cancel()

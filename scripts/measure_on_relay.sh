@@ -33,8 +33,21 @@ echo "== pre-generate the ingest dataset OUTSIDE any watchdog =="
 # (2026-07-31) — the sweep's kmeans_ingest config must only pay streaming
 python scripts/bench_ingest.py --rows 20000000 --ensure-only
 
+echo "== kernel equivalence ON SILICON before any pallas row (ADVICE r3) =="
+# interpret mode + Mosaic lowering can't prove compiled-mode buffer
+# revisions; execute pallas==dense/XLA on the chip first, and refuse to
+# record pallas rows if it fails
+if timeout 900 python scripts/kernel_equiv_check.py; then
+  SKIP_PALLAS=""
+else
+  # EVERY config that executes a Pallas kernel (the approx/carry LDA
+  # variants run the same unverified kernel)
+  SKIP_PALLAS="--skip mfsgd_pallas lda_pallas lda_pallas_approx lda_pallas_carry kmeans_int8_fused"
+  echo "kernel_equiv_check FAILED — pallas configs skipped this sprint" >&2
+fi
+
 echo "== full graded sweep → BENCH_local.jsonl =="
-python scripts/measure_all.py --out BENCH_local.jsonl
+python scripts/measure_all.py --out BENCH_local.jsonl ${SKIP_PALLAS}
 
 echo "== driver bench line =="
 python bench.py | tee -a BENCH_local.jsonl
@@ -43,11 +56,9 @@ echo "== 1B-point formulation (2 epochs, ~minutes) =="
 python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
   | tee -a BENCH_local.jsonl
 
-echo "== subgraph overflow-tail decision: segment vs onehot (r2 item 7) =="
-python -m harp_tpu subgraph --graph powerlaw --vertices 100000 \
-  --overflow-algo segment | tee -a BENCH_local.jsonl
-python -m harp_tpu subgraph --graph powerlaw --vertices 100000 \
-  --overflow-algo onehot | tee -a BENCH_local.jsonl
+# subgraph overflow-tail A/B (r2 item 7) now runs INSIDE the sweep as
+# subgraph_onehot / subgraph_1m_onehot — proper config-named JSONL rows
+# that flip_decision.py can compare (the old CLI tee wrote dict-reprs)
 
 echo "== per-config op-breakdown traces (self-time; fast configs only) =="
 timeout 2400 python scripts/profile_on_relay.py --out PROFILE_local.jsonl \
@@ -75,4 +86,11 @@ if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
   echo "sprint DEGRADED: relay stopped answering before the end" >&2
   exit 1
 fi
-echo "done — update BASELINE.md from BENCH_local.jsonl and COMMIT NOW"
+
+echo "== default-flip decisions (>=10% at equal quality, gate in code) =="
+# prints one verdict JSON line per candidate; exit 1 (undecidable rows)
+# is informational here — the sprint itself still succeeded
+python scripts/flip_decision.py | tee FLIP_DECISIONS.jsonl || true
+
+echo "done — apply the FLIP lines above (one-line config flips +"
+echo "BASELINE.md + bench.py BASELINES in the same commit), then COMMIT NOW"

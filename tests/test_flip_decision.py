@@ -1,0 +1,144 @@
+"""The ≥10%-at-equal-quality flip gate (VERDICT r3 weak #5 / next #6).
+
+BASELINE.md's decision rule — "a candidate that wins ≥10% at equal
+quality becomes the default" — must live in code: a fast-but-degraded
+kernel may never flip a default silently, and missing quality evidence
+must refuse the flip (fail closed), not pass it.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "flip_decision", os.path.join(os.path.dirname(__file__), "..",
+                                  "scripts", "flip_decision.py"))
+fd = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(fd)
+
+MFSGD_SPEC = fd.CANDIDATES["mfsgd_pallas"]
+LDA_SPEC = fd.CANDIDATES["lda_pallas"]
+SG_SPEC = fd.CANDIDATES["subgraph_onehot"]
+
+
+def test_flips_at_ten_percent_and_equal_quality():
+    v = fd.decide({"updates_per_sec_per_chip": 120e6, "rmse_final": 0.366},
+                  {"updates_per_sec_per_chip": 92.7e6, "rmse_final": 0.366},
+                  MFSGD_SPEC)
+    assert v["flip"] and v["quality_ok"]
+    assert v["speedup"] == pytest.approx(120 / 92.7, rel=1e-3)
+    assert "MFSGDConfig" in v["reason"]
+
+
+def test_refuses_degraded_quality_regardless_of_speed():
+    # 2x faster but rmse 10% worse → the gate must refuse
+    v = fd.decide({"updates_per_sec_per_chip": 200e6, "rmse_final": 0.403},
+                  {"updates_per_sec_per_chip": 92.7e6, "rmse_final": 0.366},
+                  MFSGD_SPEC)
+    assert not v["flip"] and v["quality_ok"] is False
+    assert "QUALITY DEGRADED" in v["reason"]
+
+
+def test_keeps_incumbent_below_threshold():
+    v = fd.decide({"updates_per_sec_per_chip": 97e6, "rmse_final": 0.366},
+                  {"updates_per_sec_per_chip": 92.7e6, "rmse_final": 0.366},
+                  MFSGD_SPEC)
+    assert not v["flip"] and v["quality_ok"]
+    assert "keep incumbent" in v["reason"]
+
+
+def test_fails_closed_on_missing_quality_field():
+    v = fd.decide({"updates_per_sec_per_chip": 200e6},
+                  {"updates_per_sec_per_chip": 92.7e6, "rmse_final": 0.366},
+                  MFSGD_SPEC)
+    assert not v["flip"] and v["quality_ok"] is None
+    assert "fails closed" in v["reason"]
+
+
+def test_fails_closed_on_missing_or_error_rows():
+    good = {"updates_per_sec_per_chip": 92.7e6, "rmse_final": 0.366}
+    assert not fd.decide(None, good, MFSGD_SPEC)["flip"]
+    assert not fd.decide(good, {"error": "hang"}, MFSGD_SPEC)["flip"]
+
+
+def test_log_likelihood_sense_handles_negative_values():
+    # LL is negative; "higher" means closer to zero.  Candidate 0.02 nats
+    # better → flip; 0.2 nats worse → refuse.
+    inc = {"tokens_per_sec_per_chip": 6.58e6, "log_likelihood": -9.10}
+    better = {"tokens_per_sec_per_chip": 8.0e6, "log_likelihood": -9.08}
+    worse = {"tokens_per_sec_per_chip": 8.0e6, "log_likelihood": -9.30}
+    assert fd.decide(better, inc, LDA_SPEC)["flip"]
+    v = fd.decide(worse, inc, LDA_SPEC)
+    assert not v["flip"] and v["quality_ok"] is False
+
+
+def test_subgraph_estimates_must_match_exactly():
+    inc = {"vertices_per_sec": 117.3e3, "estimate": 4.37e18}
+    same = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * (1 + 1e-8)}
+    diff = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * 1.001}
+    assert fd.decide(same, inc, SG_SPEC)["flip"]
+    assert not fd.decide(diff, inc, SG_SPEC)["flip"]
+
+
+def test_stream_metric_falls_back_to_end_to_end_rate():
+    spec = fd.CANDIDATES["kmeans_stream_int8"]
+    inc = {"iters_per_sec": 0.53, "iters_per_sec_ex_gen": 1.09,
+           "inertia": 2.9e10}
+    cand = {"iters_per_sec": 0.9, "iters_per_sec_ex_gen": 2.2,
+            "inertia": 2.9e10}
+    v = fd.decide(cand, inc, spec)
+    assert v["speedup"] == pytest.approx(2.2 / 1.09, rel=1e-3)
+    # ex_gen absent on both → falls back to end-to-end
+    v2 = fd.decide({k: v_ for k, v_ in cand.items() if k != "iters_per_sec_ex_gen"},
+                   {k: v_ for k, v_ in inc.items() if k != "iters_per_sec_ex_gen"},
+                   spec)
+    assert v2["speedup"] == pytest.approx(0.9 / 0.53, rel=1e-3)
+
+
+def test_latest_rows_last_full_shape_non_error_wins(tmp_path):
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join([
+        json.dumps({"config": "mfsgd", "updates_per_sec_per_chip": 1.0}),
+        "{'config': 'subgraph_cli'}",  # old dict-repr tee line: skipped
+        json.dumps({"config": "mfsgd", "updates_per_sec_per_chip": 2.0}),
+        json.dumps({"config": "mfsgd", "smoke": True,
+                    "updates_per_sec_per_chip": 99.0}),
+        json.dumps({"config": "mfsgd", "error": "hang"}),
+        # CPU-sim relative speeds are non-predictive of TPU (the repo's
+        # own onehot 7.8x CPU inversion) — must never authorize a flip
+        json.dumps({"config": "mfsgd", "backend": "cpu",
+                    "updates_per_sec_per_chip": 500.0}),
+    ]) + "\n")
+    rows = fd.latest_rows(str(p))
+    assert rows["mfsgd"]["updates_per_sec_per_chip"] == 2.0
+
+
+def test_cli_exits_nonzero_when_undecidable(tmp_path, capsys):
+    p = tmp_path / "bench.jsonl"
+    p.write_text(json.dumps(
+        {"config": "mfsgd", "updates_per_sec_per_chip": 92.7e6,
+         "rmse_final": 0.366}) + "\n")
+    rc = fd.main(["--bench", str(p), "--only", "mfsgd_pallas"])
+    assert rc == 1  # candidate row missing → undecidable → nonzero
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert rec["flip_decision"] == "mfsgd_pallas" and not rec["flip"]
+
+
+def test_cli_decides_all_candidates_when_rows_present(tmp_path, capsys):
+    rows = [
+        {"config": "mfsgd", "updates_per_sec_per_chip": 92.7e6,
+         "rmse_final": 0.366},
+        {"config": "mfsgd_pallas", "updates_per_sec_per_chip": 140e6,
+         "rmse_final": 0.3661},
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = fd.main(["--bench", str(p), "--only", "mfsgd_pallas"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["flip"] and rec["quality_ok"]
