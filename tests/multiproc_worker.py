@@ -6,8 +6,10 @@ multi-host code paths without TPU hardware: ``init_distributed``
 bootstrap, a mesh spanning processes, and EVERY collective family
 crossing a real process boundary (Gloo on CPU — the DCN stand-in):
 allreduce, regroup / all_to_all, dense push/pull, the sparse
-request/serve pull/push, the host-side ``kv_allreduce`` union, and full
-MF-SGD / LDA epochs.
+request/serve pull/push, the host-side ``kv_allreduce`` union, full
+MF-SGD / LDA epochs, ZeRO-1 optimizer steps (sharded state asserted per
+process, trajectory == replicated adam), and a tensor-parallel MLP step
+on a 2-D mesh whose model axis crosses the process link.
 
 ``local_devices > 1`` is the POD-SHAPED topology (VERDICT r2 item 6): a
 v4-32 is N processes × M chips, where intra-process (ICI stand-in) and
@@ -214,5 +216,54 @@ assert abs(inertia_got - inertia_ref) < 1e-3 * abs(inertia_ref)
 rot = C.host_op(mesh, C.rotate, in_dim=0, out_dim=0)
 xrot = np.arange(nw, dtype=np.float32).reshape(nw, 1)
 check_global(rot(xrot), np.roll(xrot, 1, axis=0))
+
+# ZeRO-1 optimizer steps across the process boundary (VERDICT r3 item 7):
+# the gradient push (psum_scatter) + param pull (all_gather) cross the
+# process link, each process holds ONLY its 1/nw optimizer-state shards,
+# and the loss trajectory must equal the replicated-adam trainer's
+from harp_tpu.models.mlp import MLPConfig, MLPTrainer, synthetic_mnist
+
+xz, yz = synthetic_mnist(n=4 * nw, d=8, classes=4, seed=1)
+zcfg = dict(sizes=(8, 16, 4), optimizer="adam")
+tr_z = MLPTrainer(MLPConfig(zero1=True, **zcfg), mesh, seed=0)
+tr_r = MLPTrainer(MLPConfig(**zcfg), mesh, seed=0)
+losses_z = [tr_z.train_batch(xz, yz)[0] for _ in range(3)]
+losses_r = [tr_r.train_batch(xz, yz)[0] for _ in range(3)]
+np.testing.assert_allclose(losses_z, losses_r, rtol=1e-5, atol=1e-6)
+import jax.tree_util as jtu
+
+vec_leaves = [lf for lf in jtu.tree_leaves(tr_z.opt_state) if lf.ndim > 0]
+assert vec_leaves, "adam zero1 state must have vector leaves"
+for lf in vec_leaves:
+    # TRUE sharding per process: local_devices shards of 1/nw each, at
+    # distinct offsets — a silently replicated state fails here
+    shards = lf.addressable_shards
+    assert len(shards) == local_devices, (len(shards), local_devices)
+    starts = set()
+    for sh in shards:
+        assert sh.data.shape[0] == lf.shape[0] // nw, (
+            sh.data.shape, lf.shape, nw)
+        starts.add(sh.index[0].start or 0)
+    assert len(starts) == local_devices, starts
+# adam's first moment is nonzero after real steps — the sharded state is
+# actually being updated, not dead weight
+mu_max = max(float(np.abs(np.asarray(sh.data)).max())
+             for sh in vec_leaves[0].addressable_shards)
+assert mu_max > 0.0
+
+# tensor parallel across the boundary: a 2-D (data x model) mesh whose
+# model axis spans real process links; first-step loss must match the
+# data-parallel trainer (GSPMD numerics == explicit-verb numerics)
+from harp_tpu.models.mlp import TPMLPTrainer
+from harp_tpu.parallel.mesh import mesh_2d
+
+n_model = next(d for d in (4, 2, 1) if nw % d == 0)
+tp = TPMLPTrainer(MLPConfig(sizes=(8, 16, 4)),
+                  mesh_2d(nw // n_model, n_model), seed=0)
+dp = MLPTrainer(MLPConfig(sizes=(8, 16, 4)), mesh, seed=0)
+tp_loss, tp_acc = tp.train_batch(xz, yz)
+dp_loss, dp_acc = dp.train_batch(xz, yz)
+assert abs(tp_loss - dp_loss) < 1e-4, (tp_loss, dp_loss)
+assert abs(tp_acc - dp_acc) < 1e-6, (tp_acc, dp_acc)
 
 print(f"proc {proc_id}: MULTIPROC OK", flush=True)
