@@ -103,6 +103,24 @@ def main():
     np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-5)
     print(f"kmeans fused int8 == XLA int8 (inertia {ib:.1f})")
 
+    # 4. carry_db: the od-run-carried doc tile must be bit-identical to
+    # the slice-per-entry chain ON THIS BACKEND (the cond+DUS-on-carry
+    # interaction is exactly where an XLA:TPU buffer decision could
+    # diverge from the CPU sim — gate it before lda_carry rows record)
+    chains = {}
+    for carry in (False, True):
+        cm = LDA(64, 32, LDAConfig(n_topics=8, algo="dense", d_tile=lt,
+                                   w_tile=lt, entry_cap=64, alpha=0.5,
+                                   beta=0.1, carry_db=carry), mesh, seed=3)
+        cm.set_tokens(d, w)
+        for _ in range(3):
+            cm.sample_epoch()
+        chains[carry] = (np.asarray(cm.Ndk), np.asarray(cm.Nwk),
+                         np.asarray(cm.z_grid))
+    for a, b in zip(chains[False], chains[True]):
+        np.testing.assert_array_equal(a, b)
+    print("lda carry_db == slice-per-entry (bit-identical)")
+
     print(f"KERNEL EQUIV OK ({jax.default_backend()})")
     return 0
 

@@ -111,27 +111,6 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
             carry_db=True,
             **(SMOKE["lda"] if smoke else
                {"pack_cache": BENCH_DATA})),
-        # graded-scale ladder (VERDICT r1 item 5): 500k docs × 1k topics
-        # with the int16 doc-topic table (2 GB instead of 4 GB at 1M docs)
-        "lda_scale": lambda: lda.benchmark(
-            **({"n_docs": 512, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
-               if smoke else
-               {"n_docs": 500_000, "vocab_size": 50_000, "n_topics": 1000,
-                "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16",
-                "pack_cache": BENCH_DATA})),
-        # TRUE graded shapes (enwiki-1M: 1M docs × 1k topics, 100M tokens,
-        # int16 Ndk — fits one chip: 2 GB Ndk + 0.23 GB Nwk; the program
-        # is lowering-proven in tests/test_lda_scale.py, this EXECUTES it
-        "lda_scale_1m": lambda: lda.benchmark(
-            **({"n_docs": 1024, "vocab_size": 128, "n_topics": 8,
-                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
-                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
-               if smoke else
-               {"n_docs": 1_000_000, "vocab_size": 50_000,
-                "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
-                "ndk_dtype": "int16", "pack_cache": BENCH_DATA})),
         # round 3: exponential-race topic draw (identical distribution,
         # ~5× fewer VPU transcendentals) — candidate default if it wins
         "lda_exprace": lambda: lda.benchmark(
@@ -170,6 +149,31 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
             algo="scatter",
             **(SMOKE["lda_scatter"] if smoke
                else {"pack_cache": BENCH_DATA})),
+        # ladder configs AFTER the default-shape flip pairs: the
+        # relay can die mid-sweep, and the round-4 priority is the
+        # candidates table (a dead relay at minute 40 should have
+        # already measured every gated pair)
+        # graded-scale ladder (VERDICT r1 item 5): 500k docs × 1k topics
+        # with the int16 doc-topic table (2 GB instead of 4 GB at 1M docs)
+        "lda_scale": lambda: lda.benchmark(
+            **({"n_docs": 512, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
+               if smoke else
+               {"n_docs": 500_000, "vocab_size": 50_000, "n_topics": 1000,
+                "tokens_per_doc": 100, "epochs": 1, "ndk_dtype": "int16",
+                "pack_cache": BENCH_DATA})),
+        # TRUE graded shapes (enwiki-1M: 1M docs × 1k topics, 100M tokens,
+        # int16 Ndk — fits one chip: 2 GB Ndk + 0.23 GB Nwk; the program
+        # is lowering-proven in tests/test_lda_scale.py, this EXECUTES it
+        "lda_scale_1m": lambda: lda.benchmark(
+            **({"n_docs": 1024, "vocab_size": 128, "n_topics": 8,
+                "tokens_per_doc": 16, "epochs": 1, "d_tile": 16,
+                "w_tile": 16, "entry_cap": 64, "ndk_dtype": "int16"}
+               if smoke else
+               {"n_docs": 1_000_000, "vocab_size": 50_000,
+                "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
+                "ndk_dtype": "int16", "pack_cache": BENCH_DATA})),
         "mlp": lambda: mlp.benchmark(
             **(SMOKE["mlp"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
