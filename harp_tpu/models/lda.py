@@ -1114,6 +1114,49 @@ def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
     }))
 
 
+def _load_pack(path: str) -> dict:
+    """Read a cached :meth:`LDA.pack_tokens` npz back into a pack dict."""
+    with np.load(path) as z:
+        nt = len([k for k in z.files if k.startswith("tok")])
+        return {"tokens": tuple(z[f"tok{i}"] for i in range(nt)),
+                "z_grid": z["z_grid"], "Ndk": z["Ndk"],
+                "Nwk": z["Nwk"], "Nk": z["Nk"],
+                "n_tokens": int(z["n_tokens"])}
+
+
+def _save_pack(path: str, pack: dict) -> None:
+    """Write a pack dict as npz — temp + atomic rename, because the
+    sprint is routinely killed mid-config (relay hangs, watchdogs) and a
+    truncated npz at the final path would poison every later cache hit."""
+    tmp_path = path + ".tmp"
+    np.savez(tmp_path, z_grid=pack["z_grid"], Ndk=pack["Ndk"],
+             Nwk=pack["Nwk"], Nk=pack["Nk"], n_tokens=pack["n_tokens"],
+             **{f"tok{i}": a for i, a in enumerate(pack["tokens"])})
+    # np.savez appends .npz to names without it
+    os.replace(tmp_path if os.path.exists(tmp_path) else tmp_path + ".npz",
+               path)
+
+
+def _pack_cache_path(pack_cache, cfg: LDAConfig, num_workers, n_docs,
+                     vocab_size, n_topics, tokens_per_doc, seed) -> str:
+    """Cache path for a :func:`benchmark` corpus pack — layout-relevant
+    knobs ONLY, keyed by the EXACT algo: dense/pallas pack differently
+    (pallas pads C to _PALLAS_C), and scatter vs pushpull use different
+    partitioners entirely (partition_ratings grid vs
+    partition_tokens_by_doc), so they must never share a pack.  Shared
+    with scripts/prewarm_bench_cache.py so an offline prewarm writes the
+    same keys the sprint reads."""
+    import hashlib
+
+    layout = (cfg.algo, cfg.algo == "pallas", cfg.d_tile, cfg.w_tile,
+              cfg.entry_cap, cfg.chunk, cfg.ndk_dtype)
+    sig = repr((_PACK_VERSION, n_docs, vocab_size, n_topics,
+                tokens_per_doc, seed, num_workers, layout))
+    key = hashlib.sha1(sig.encode()).hexdigest()[:16]
+    os.makedirs(pack_cache, exist_ok=True)
+    return os.path.join(pack_cache, f"lda_pack_{key}.npz")
+
+
 def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
               tokens_per_doc=100, epochs=2, mesh=None, chunk=None, seed=0,
               algo="dense", d_tile=None, w_tile=None, entry_cap=None,
@@ -1144,45 +1187,16 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
     d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
     w_ids = rng.integers(0, vocab_size, n_tok).astype(np.int32)
     t0 = time.perf_counter()
-    pack_path = None
-    if pack_cache is not None:
-        import hashlib
-
-        # layout-relevant knobs ONLY — but keyed by the EXACT algo:
-        # dense/pallas pack differently (pallas pads C to _PALLAS_C), and
-        # scatter vs pushpull use different partitioners entirely
-        # (partition_ratings grid vs partition_tokens_by_doc), so they
-        # must never share a pack
-        layout = (cfg.algo, cfg.algo == "pallas", cfg.d_tile, cfg.w_tile,
-                  cfg.entry_cap, cfg.chunk, cfg.ndk_dtype)
-        sig = repr((_PACK_VERSION, n_docs, vocab_size, n_topics,
-                    tokens_per_doc, seed, mesh.num_workers, layout))
-        key = hashlib.sha1(sig.encode()).hexdigest()[:16]
-        os.makedirs(pack_cache, exist_ok=True)
-        pack_path = os.path.join(pack_cache, f"lda_pack_{key}.npz")
+    pack_path = (None if pack_cache is None else _pack_cache_path(
+        pack_cache, cfg, mesh.num_workers, n_docs, vocab_size, n_topics,
+        tokens_per_doc, seed))
     if pack_path is not None and os.path.exists(pack_path):
-        with np.load(pack_path) as z:
-            nt = len([k for k in z.files if k.startswith("tok")])
-            pack = {"tokens": tuple(z[f"tok{i}"] for i in range(nt)),
-                    "z_grid": z["z_grid"], "Ndk": z["Ndk"],
-                    "Nwk": z["Nwk"], "Nk": z["Nk"],
-                    "n_tokens": int(z["n_tokens"])}
-        model._install_pack(pack)
+        model._install_pack(_load_pack(pack_path))
     else:
         pack = model.pack_tokens(d_ids, w_ids)
         model._install_pack(pack)
         if pack_path is not None:
-            # temp + atomic rename: the sprint is routinely killed
-            # mid-config (relay hangs, watchdogs) — a truncated npz at
-            # the final path would poison every later cache hit
-            tmp_path = pack_path + ".tmp"
-            np.savez(tmp_path, z_grid=pack["z_grid"], Ndk=pack["Ndk"],
-                     Nwk=pack["Nwk"], Nk=pack["Nk"],
-                     n_tokens=pack["n_tokens"],
-                     **{f"tok{i}": a for i, a in enumerate(pack["tokens"])})
-            # np.savez appends .npz to names without it
-            os.replace(tmp_path if os.path.exists(tmp_path)
-                       else tmp_path + ".npz", pack_path)
+            _save_pack(pack_path, pack)
     prep = time.perf_counter() - t0
 
     model.sample_epoch()         # warmup + single-epoch compile

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Prewarm .bench_data/ so a relay window is spent on silicon, not prep.
+
+The sprint's two big host costs are pure CPU work with no TPU
+dependency: the LDA corpus packs (~675 s at enwiki-1M, ~30-320 s for
+the others, identical bytes whatever backend later installs them) and
+the 12 GB ingest npy.  Run this script any time the relay is down (it
+forces the CPU backend, one device — matching the 1-chip sprint mesh,
+which the pack key includes) and the next `measure_on_relay.sh` run
+hits warm caches for every lda config and the ingest file.
+
+Usage: python scripts/prewarm_bench_cache.py [--skip-ingest]
+Idempotent: existing cache files are kept.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from measure_all import BENCH_DATA  # the one shared artifacts dir
+
+# every FULL-mode lda config in measure_all, by distinct pack layout:
+# dense covers lda/lda_carry/lda_exprace/lda_fast; pallas covers
+# lda_pallas/_approx/_carry (sampler/rng/carry knobs don't touch layout)
+PACKS = [
+    dict(algo="dense"),
+    dict(algo="pallas", sampler="exprace", rng_impl="rbg"),
+    dict(algo="scatter"),
+    dict(algo="dense", n_docs=500_000, ndk_dtype="int16"),
+    dict(algo="dense", n_docs=1_000_000, ndk_dtype="int16"),
+]
+
+
+def prewarm_pack(n_docs=100_000, vocab_size=50_000, n_topics=1000,
+                 tokens_per_doc=100, seed=0, **cfg_kw):
+    import numpy as np
+
+    from harp_tpu import WorkerMesh
+    from harp_tpu.models import lda as L
+
+    mesh = WorkerMesh()  # 1 CPU device == the 1-chip sprint mesh
+    assert mesh.num_workers == 1, mesh.num_workers
+    algo = cfg_kw.pop("algo", "dense")
+    cfg = L._make_cfg(n_topics, algo, **{k: cfg_kw.get(k) for k in
+                                         ("sampler", "rng_impl")},
+                      ndk_dtype=cfg_kw.get("ndk_dtype", "float32"))
+    path = L._pack_cache_path(BENCH_DATA, cfg, mesh.num_workers, n_docs,
+                              vocab_size, n_topics, tokens_per_doc, seed)
+    label = f"{algo} n_docs={n_docs} ndk={cfg.ndk_dtype}"
+    if os.path.exists(path):
+        print(f"pack ok (cached): {label} -> {os.path.basename(path)}")
+        return
+    t0 = time.time()
+    rng = np.random.default_rng(seed)
+    n_tok = n_docs * tokens_per_doc
+    d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
+    w_ids = rng.integers(0, vocab_size, n_tok).astype(np.int32)
+    model = L.LDA(n_docs, vocab_size, cfg, mesh, seed)
+    pack = model.pack_tokens(d_ids, w_ids)
+    L._save_pack(path, pack)
+    print(f"pack built: {label} -> {os.path.basename(path)} "
+          f"({time.time() - t0:.0f}s, {os.path.getsize(path) / 2**30:.2f} GiB)")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--skip-ingest", action="store_true")
+    args = p.parse_args()
+    for kw in PACKS:
+        prewarm_pack(**kw)
+    if not args.skip_ingest:
+        # same presets the sprint uses (bench_ingest --ensure-only)
+        import bench_ingest
+
+        bench_ingest.main(["--rows", "20000000", "--ensure-only"])
+    print("prewarm done")
+
+
+if __name__ == "__main__":
+    main()

@@ -316,6 +316,27 @@ def test_carry_db_rejects_non_tiled_algos():
         L.LDAConfig(algo="pushpull", carry_db=True)
 
 
+def test_pack_cache_key_shared_across_non_layout_knobs(tmp_path):
+    """The prewarm script relies on sampler/rng/carry knobs NOT changing
+    the pack key (one pack serves lda/lda_carry/lda_exprace/lda_fast),
+    while algo and tiling MUST change it."""
+    args = (1, 1000, 50_000, 1000, 100, 0)
+
+    def path(**kw):
+        cfg = L._make_cfg(1000, kw.pop("algo", "dense"), **kw)
+        return L._pack_cache_path(str(tmp_path), cfg, args[0], *args[1:-1],
+                                  seed=args[-1])
+
+    base = path()
+    assert path(sampler="exprace") == base
+    assert path(sampler="exprace", rng_impl="rbg") == base
+    assert path(carry_db=True) == base
+    assert path(algo="pallas") != base
+    assert path(algo="scatter") != base
+    assert path(ndk_dtype="int16") != base
+    assert path(entry_cap=1024) != base
+
+
 def test_benchmark_pack_cache_roundtrip(mesh, tmp_path):
     """pack_cache: the second benchmark run must install the cached pack
     (one file, shared across sampler variants of the same tiling) and
