@@ -90,11 +90,23 @@ class MFSGDConfig:
     # datasets are safe: blocks narrower than this clamp themselves
     # (partition_ratings pads only to the real max block size).
     chunk: int = 32768
+    # algo="dense" only: carry the W tile across its tou-run instead of
+    # slice+DUS per entry (the LDA carry_db lever — entries are u-major,
+    # so a hot W block's entries currently re-pay the [u_tile, r] in+out
+    # per entry).  The pallas kernel already keeps W resident across its
+    # block runs, so this applies to the XLA path alone.  Default OFF
+    # until the mfsgd_carry sweep config measures it (flip gate).
+    carry_w: bool = False
 
     def __post_init__(self):
         if self.algo not in ("dense", "scatter", "pallas"):
             raise ValueError(
                 f"algo must be 'dense', 'scatter' or 'pallas', got {self.algo!r}")
+        if self.carry_w and self.algo != "dense":
+            raise ValueError(
+                "carry_w applies to algo='dense' only (the pallas kernel "
+                "already keeps W resident across its block runs; scatter "
+                "has no tile slicing to amortize)")
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +322,29 @@ def _block_update(W, H, block, cfg: MFSGDConfig):
     return W, H, se, cnt
 
 
+def _entry_tiles_update(Wb, Hb, cu, ci, cv, cfg: MFSGDConfig):
+    """Tile-level core of :func:`_tile_block_update`: one entry's update on
+    pre-sliced ``Wb [u_tile, r]`` / ``Hb [i_tile, r]`` — no table slicing
+    here, so the ``carry_w`` path can keep a W tile resident across its
+    u-run (slicing strategy is the caller's concern; shared math keeps
+    carry and non-carry chains bit-identical)."""
+    UR, IR = cfg.u_tile, cfg.i_tile
+    cd = cfg.compute_dtype
+    dot = partial(lax.dot_general, preferred_element_type=jnp.float32)
+    ohu = jax.nn.one_hot(cu, UR, dtype=cd)          # [C, UR]
+    ohi = jax.nn.one_hot(ci, IR, dtype=cd)          # [C, IR]
+    wu = dot(ohu, Wb.astype(cd), (((1,), (0,)), ((), ())))  # gather
+    hi = dot(ohi, Hb.astype(cd), (((1,), (0,)), ((), ())))
+    cm = (cu < UR).astype(jnp.float32)
+    err = cm * (cv - (wu * hi).sum(-1))
+    gw = (err[:, None] * hi - cfg.reg * cm[:, None] * wu).astype(cd)
+    gh = (err[:, None] * wu - cfg.reg * cm[:, None] * hi).astype(cd)
+    gW = dot(ohu, gw, (((0,), (0,)), ((), ())))     # scatter-add
+    gH = dot(ohi, gh, (((0,), (0,)), ((), ())))
+    return (Wb + cfg.lr * gW, Hb + cfg.lr * gH,
+            (err * err).sum(), cm.sum())
+
+
 def _tile_block_update(W, H, block, cfg: MFSGDConfig):
     """Scan dense-tile entries of one (user-range × item-half-slice) block.
 
@@ -318,30 +353,50 @@ def _tile_block_update(W, H, block, cfg: MFSGDConfig):
     the duplicate-summing scatter as one-hot matmuls — four MXU dots, zero
     XLA scatters.  Pad ids equal the tile width, so their one-hot rows are
     all-zero and they drop out of every product.
+
+    ``cfg.carry_w``: entries are u-major (partition_ratings_tiles), so the
+    W tile is carried across its tou-run and flushed/loaded only on a
+    tou-change ``lax.cond`` — the LDA ``carry_db`` lever applied here
+    (the switch always flushes before a region can be re-sliced, so this
+    is exact under any entry order; bit-identical chains tested).
     """
     eu, ei, ev, ou, oi = block
     UR, IR = cfg.u_tile, cfg.i_tile
-    cd = cfg.compute_dtype
-    dot = partial(lax.dot_general, preferred_element_type=jnp.float32)
+
+    if cfg.carry_w:
+        def body(carry, xs):
+            W, H, se, cnt, wb, cur = carry
+            cu, ci, cv, tou, toi = xs
+
+            def switch(opr):
+                W, wb, cur = opr
+                new_wb = lax.dynamic_slice_in_dim(W, tou, UR, 0)
+                W = lax.dynamic_update_slice_in_dim(W, wb, cur, 0)
+                return W, new_wb, tou
+
+            W, wb, cur = lax.cond(tou != cur, switch, lambda opr: opr,
+                                  (W, wb, cur))
+            Hb = lax.dynamic_slice_in_dim(H, toi, IR, 0)
+            wb, Hb, dse, dcnt = _entry_tiles_update(wb, Hb, cu, ci, cv, cfg)
+            H = lax.dynamic_update_slice_in_dim(H, Hb, toi, 0)
+            return (W, H, se + dse, cnt + dcnt, wb, cur), None
+
+        wb0 = lax.dynamic_slice_in_dim(W, ou[0], UR, 0)
+        (W, H, se, cnt, wb_f, cur_f), _ = lax.scan(
+            body, (W, H, jnp.float32(0.0), jnp.float32(0.0), wb0, ou[0]),
+            (eu, ei, ev, ou, oi))
+        W = lax.dynamic_update_slice_in_dim(W, wb_f, cur_f, 0)
+        return W, H, se, cnt
 
     def body(carry, xs):
         W, H, se, cnt = carry
         cu, ci, cv, tou, toi = xs
         Wb = lax.dynamic_slice_in_dim(W, tou, UR, 0)
         Hb = lax.dynamic_slice_in_dim(H, toi, IR, 0)
-        ohu = jax.nn.one_hot(cu, UR, dtype=cd)          # [C, UR]
-        ohi = jax.nn.one_hot(ci, IR, dtype=cd)          # [C, IR]
-        wu = dot(ohu, Wb.astype(cd), (((1,), (0,)), ((), ())))  # gather
-        hi = dot(ohi, Hb.astype(cd), (((1,), (0,)), ((), ())))
-        cm = (cu < UR).astype(jnp.float32)
-        err = cm * (cv - (wu * hi).sum(-1))
-        gw = (err[:, None] * hi - cfg.reg * cm[:, None] * wu).astype(cd)
-        gh = (err[:, None] * wu - cfg.reg * cm[:, None] * hi).astype(cd)
-        gW = dot(ohu, gw, (((0,), (0,)), ((), ())))     # scatter-add
-        gH = dot(ohi, gh, (((0,), (0,)), ((), ())))
-        W = lax.dynamic_update_slice_in_dim(W, Wb + cfg.lr * gW, tou, 0)
-        H = lax.dynamic_update_slice_in_dim(H, Hb + cfg.lr * gH, toi, 0)
-        return (W, H, se + (err * err).sum(), cnt + cm.sum()), None
+        Wb, Hb, dse, dcnt = _entry_tiles_update(Wb, Hb, cu, ci, cv, cfg)
+        W = lax.dynamic_update_slice_in_dim(W, Wb, tou, 0)
+        H = lax.dynamic_update_slice_in_dim(H, Hb, toi, 0)
+        return (W, H, se + dse, cnt + dcnt), None
 
     (W, H, se, cnt), _ = lax.scan(
         body, (W, H, jnp.float32(0.0), jnp.float32(0.0)), (eu, ei, ev, ou, oi)
@@ -647,17 +702,19 @@ def algo_kwargs(algo: str, groups: dict) -> dict:
 
 def _make_config(rank: int, chunk: int | None, algo: str = "dense",
                  u_tile: int | None = None, i_tile: int | None = None,
-                 entry_cap: int | None = None) -> MFSGDConfig:
+                 entry_cap: int | None = None,
+                 carry_w: bool | None = None) -> MFSGDConfig:
     return MFSGDConfig(rank=rank, **algo_kwargs(algo, {
         "scatter": {"chunk": chunk},
         _DENSE_ALGOS: {"u_tile": u_tile, "i_tile": i_tile,
                        "entry_cap": entry_cap},
+        "dense": {"carry_w": carry_w},
     }))
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
               epochs=3, mesh=None, seed=0, chunk=None, algo="dense",
-              u_tile=None, i_tile=None, entry_cap=None):
+              u_tile=None, i_tile=None, entry_cap=None, carry_w=None):
     """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
 
     One 'update' = one rating visit (one (w_u, h_i) SGD update pair),
@@ -671,7 +728,8 @@ def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
     default past 64k).
     """
     mesh = mesh or current_mesh()
-    cfg = _make_config(rank, chunk, algo, u_tile, i_tile, entry_cap)
+    cfg = _make_config(rank, chunk, algo, u_tile, i_tile, entry_cap,
+                       carry_w)
     model = MFSGD(n_users, n_items, cfg, mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     t0 = time.perf_counter()

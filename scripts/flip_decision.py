@@ -45,6 +45,10 @@ CANDIDATES = {
         "incumbent": "mfsgd", "metric": "updates_per_sec_per_chip",
         "quality": "rmse_final", "sense": "lower", "rel_tol": 0.02,
         "flips": "MFSGDConfig.algo='pallas'"},
+    "mfsgd_carry": {
+        "incumbent": "mfsgd", "metric": "updates_per_sec_per_chip",
+        "quality": "rmse_final", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MFSGDConfig.carry_w=True"},
     "lda_exprace": {
         "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,

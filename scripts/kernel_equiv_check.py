@@ -103,10 +103,11 @@ def main():
     np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-5)
     print(f"kmeans fused int8 == XLA int8 (inertia {ib:.1f})")
 
-    # 4. carry_db: the od-run-carried doc tile must be bit-identical to
-    # the slice-per-entry chain ON THIS BACKEND (the cond+DUS-on-carry
+    # 4. carry variants: the run-carried tiles must be bit-identical to
+    # the slice-per-entry chains ON THIS BACKEND (the cond+DUS-on-carry
     # interaction is exactly where an XLA:TPU buffer decision could
-    # diverge from the CPU sim — gate it before lda_carry rows record)
+    # diverge from the CPU sim — gate it before lda_carry / mfsgd_carry
+    # rows record)
     chains = {}
     for carry in (False, True):
         cm = LDA(64, 32, LDAConfig(n_topics=8, algo="dense", d_tile=lt,
@@ -120,6 +121,23 @@ def main():
     for a, b in zip(chains[False], chains[True]):
         np.testing.assert_array_equal(a, b)
     print("lda carry_db == slice-per-entry (bit-identical)")
+
+    mf_chains = {}
+    for carry in (False, True):
+        mc = MFSGD(96, 64, MFSGDConfig(rank=8, algo="dense", u_tile=tile,
+                                       i_tile=tile, entry_cap=32,
+                                       compute_dtype=jnp.float32, lr=0.03,
+                                       reg=0.01, carry_w=carry),
+                   mesh, seed=4)
+        mc.set_ratings(u, i, v)
+        rm = [mc.train_epoch() for _ in range(2)]
+        mf_chains[carry] = (mc.factors(), rm)
+    np.testing.assert_array_equal(mf_chains[True][0][0],
+                                  mf_chains[False][0][0])
+    np.testing.assert_array_equal(mf_chains[True][0][1],
+                                  mf_chains[False][0][1])
+    np.testing.assert_array_equal(mf_chains[True][1], mf_chains[False][1])
+    print("mfsgd carry_w == slice-per-entry (bit-identical)")
 
     print(f"KERNEL EQUIV OK ({jax.default_backend()})")
     return 0

@@ -95,6 +95,11 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "mfsgd_scatter": lambda: mfsgd.benchmark(
             algo="scatter",
             **(SMOKE["mfsgd_scatter"] if smoke else {})),
+        # round 4: W tile carried across its tou-run (the LDA carry_db
+        # lever applied to the dense MF-SGD path); bit-identical chain
+        "mfsgd_carry": lambda: mfsgd.benchmark(
+            carry_w=True,
+            **(SMOKE["mfsgd"] if smoke else {})),
         # round 3: the dense update fused into one VMEM Pallas kernel
         # (ops/mfsgd_kernel.py) — candidate new default if it wins on TPU
         "mfsgd_pallas": lambda: mfsgd.benchmark(
@@ -261,7 +266,7 @@ def main(argv=None):
     config_names = ["kmeans", "kmeans_int8", "kmeans_int8_fused",
                     "kmeans_stream", "kmeans_stream_int8",
                     "kmeans_ingest", "mfsgd", "mfsgd_scatter",
-                    "mfsgd_pallas", "lda", "lda_carry",
+                    "mfsgd_carry", "mfsgd_pallas", "lda", "lda_carry",
                     "lda_exprace", "lda_fast", "lda_pallas",
                     "lda_pallas_approx", "lda_pallas_carry",
                     "lda_scale", "lda_scale_1m", "lda_scatter", "mlp",

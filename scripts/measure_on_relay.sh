@@ -46,7 +46,7 @@ else
   # configs (the approx/carry LDA variants run the same unverified
   # kernel) AND lda_carry (the check also proves carry_db == baseline
   # on this backend; a divergent carry must not record either)
-  SKIP_PALLAS="--skip mfsgd_pallas lda_pallas lda_pallas_approx lda_pallas_carry lda_carry kmeans_int8_fused"
+  SKIP_PALLAS="--skip mfsgd_pallas mfsgd_carry lda_pallas lda_pallas_approx lda_pallas_carry lda_carry kmeans_int8_fused"
   echo "kernel_equiv_check FAILED — gated configs skipped this sprint" >&2
 fi
 

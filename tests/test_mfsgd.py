@@ -238,6 +238,42 @@ def test_dense_epoch_matches_numpy_model(mesh):
     assert abs(rmse - rmse_ref) < 1e-3
 
 
+def test_carry_w_bit_identical_chain(mesh):
+    """carry_w=True (the LDA carry_db lever on MF-SGD's dense path)
+    shares the entry core with the slice-per-entry path, so the trained
+    factors — same ratings, same seed — must be BIT-identical.  More
+    users than one u_tile per worker so real tou changes exercise the
+    flush/load cond."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(8)
+    n_users, n_items, nnz = 8 * 24, 48, 2000
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    v = rng.normal(size=nnz).astype(np.float32)
+    out = {}
+    for carry in (False, True):
+        cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                             entry_cap=16, compute_dtype=jnp.float32,
+                             lr=0.02, reg=0.01, carry_w=carry)
+        m = MF.MFSGD(n_users, n_items, cfg, mesh, seed=3)
+        m.set_ratings(u, i, v)
+        rm = m.train_epochs(3)
+        out[carry] = (np.asarray(m.W), np.asarray(m.H), np.asarray(rm))
+    np.testing.assert_array_equal(out[True][0], out[False][0])
+    np.testing.assert_array_equal(out[True][1], out[False][1])
+    np.testing.assert_array_equal(out[True][2], out[False][2])
+
+
+def test_carry_w_rejects_non_dense_algos():
+    import pytest
+
+    with pytest.raises(ValueError, match="carry_w"):
+        MF.MFSGDConfig(algo="scatter", carry_w=True)
+    with pytest.raises(ValueError, match="carry_w"):
+        MF.MFSGDConfig(algo="pallas", carry_w=True)
+
+
 def test_dense_matches_scatter_convergence(mesh):
     """Same data, same seed: both algos must converge to the same ballpark
     (they batch differently, so trajectories differ only slightly)."""
