@@ -12,13 +12,13 @@ Read the output asking two questions per config:
    memory-bound scatter/gather) actually dominate the trace?
 2. is there an op eating >10% that the model has no term for?
 
-Run on the TPU relay (`./scripts/measure_on_relay.sh` does NOT call this
-— traces are large and the relay can die; run it after the sweep
-commits).  Works on CPU too for plumbing checks (--smoke --platform
-cpu), but CPU traces have no device track so compile/host events appear
-in the table (op_breakdown's device filter only engages on TPU, where
-each benchmark's internal compile lands on the host track and the op
-table is pure device time).
+`./scripts/measure_on_relay.sh` runs this AFTER the sweep (bounded
+2400 s; a relay death then costs only the partial PROFILE_local).
+Works on CPU too for plumbing checks (--smoke --platform cpu), but CPU
+traces have no device track so compile/host events appear in the table
+(op_breakdown's device filter only engages on TPU, where each
+benchmark's internal compile lands on the host track and the op table
+is pure device time).
 """
 
 import argparse
@@ -37,11 +37,13 @@ def profiled_configs(smoke: bool):
     from bench_common import SMOKE
     from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
 
+    from measure_all import BENCH_DATA
+
     small = {name: SMOKE[name]
              for name in ("kmeans", "mfsgd", "lda", "mlp", "subgraph", "rf")}
     full = {"kmeans": {"n": 1_000_000, "d": 300, "k": 100, "iters": 10},
             "mfsgd": {"epochs": 2},
-            "lda": {"epochs": 1},
+            "lda": {"epochs": 1, "pack_cache": BENCH_DATA},
             "mlp": {"steps": 50},
             "subgraph": {},
             "rf": {}}
@@ -49,16 +51,30 @@ def profiled_configs(smoke: bool):
             "subgraph": subgraph, "rf": rf}
     kw = small if smoke else full
     configs = {name: (mods[name], kw[name]) for name in mods}
-    # round-3 candidates: trace the fused/sampler variants next to their
-    # baselines so the op tables attribute the wins
+    # candidate variants traced next to their baselines so the op tables
+    # ATTRIBUTE the wins (and answer the queued decisions: Db/W-carry,
+    # exprace/rbg, fused kernels, overflow-tail formulation)
     configs["mfsgd_pallas"] = (
         mfsgd, {"algo": "pallas",
                 **(SMOKE["mfsgd_pallas"] if smoke else kw["mfsgd"])})
+    configs["mfsgd_carry"] = (mfsgd, {**kw["mfsgd"], "carry_w": True})
     configs["lda_fast"] = (lda, {**kw["lda"], "sampler": "exprace",
                                  "rng_impl": "rbg"})
     configs["lda_pallas"] = (
         lda, {"algo": "pallas",
               **(SMOKE["lda_pallas"] if smoke else kw["lda"])})
+    configs["lda_carry"] = (lda, {**kw["lda"], "carry_db": True})
+    configs["lda_pallas_carry"] = (
+        lda, {"algo": "pallas", "carry_db": True,
+              **(SMOKE["lda_pallas"] if smoke else kw["lda"])})
+    # overflow-tail A/B on a graph whose tail carries real mass (the
+    # uniform default's tail is empty — the r2-item-7 profile question
+    # needs the powerlaw shape)
+    pl = ({**SMOKE["subgraph"], "max_degree": 8} if smoke
+          else {"max_degree": 16})
+    configs["subgraph_pl"] = (subgraph, {**pl, "graph": "powerlaw"})
+    configs["subgraph_onehot"] = (
+        subgraph, {**pl, "graph": "powerlaw", "overflow_algo": "onehot"})
     return configs
 
 
