@@ -140,6 +140,32 @@ def test_gather_planes_exact_above_256():
     assert approx[0, list(vals).index(257)] != 257.0
 
 
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.lists(st.integers(0, 2**24 - 1), min_size=1, max_size=32))
+def test_gather_planes_exact_for_arbitrary_f32_integers(vals):
+    """Property form of the plane-exactness claim: ANY integer table the
+    f32 count tables can represent (< 2^24) gathers exactly through 3
+    bf16 digit planes."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from harp_tpu.ops.lda_kernel import _gather_planes
+
+    tbl = np.asarray(vals, np.float32)[None, :]            # [1, R]
+    oh = np.eye(len(vals), dtype=np.float32)               # gather all
+    dot = functools.partial(lax.dot_general,
+                            dimension_numbers=(((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    got = np.asarray(_gather_planes(jnp.asarray(tbl),
+                                    jnp.asarray(oh, jnp.bfloat16), dot, 3))
+    np.testing.assert_array_equal(got, tbl)
+
+
 def test_pallas_exact_gathers_chain_quality_at_hot_counts(mesh):
     """ADVICE r3's likelihood A/B: a small vocab drives word-topic counts
     well past 256 (where bf16 gathers round), and the exact-gather pallas
