@@ -32,6 +32,7 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import time
 
@@ -356,7 +357,7 @@ def _sample_entry(Ndk, Nwk, Nk, z, entry, key, cfg: LDAConfig, vocab_size):
 
 
 def _sample_tiles_pallas(DbT, WbT, nk, z, cd, cw, key2, cfg: LDAConfig,
-                         vocab_size):
+                         vocab_size, count_bounds=(None, None)):
     """Tile-level core of :func:`_sample_entry_pallas` (topic-major
     blocks in/out) — the fused-kernel twin of
     :func:`_sample_entry_tiles`, shared by the carry and slice-per-entry
@@ -367,12 +368,13 @@ def _sample_tiles_pallas(DbT, WbT, nk, z, cd, cw, key2, cfg: LDAConfig,
         DbT, WbT, nk, z, cd, cw, key2,
         alpha=cfg.alpha, beta=cfg.beta, vbeta=vocab_size * cfg.beta,
         interpret=interpret_default(),
-        exact_gathers=cfg.pallas_exact_gathers)
+        exact_gathers=cfg.pallas_exact_gathers,
+        ndk_count_bound=count_bounds[0], nwk_count_bound=count_bounds[1])
     return DbT, WbT, dNk, z_new
 
 
 def _sample_entry_pallas(NdkT, NwkT, nk, z, entry, key2, cfg: LDAConfig,
-                         vocab_size):
+                         vocab_size, count_bounds=(None, None)):
     """Fused-kernel twin of :func:`_sample_entry` on TOPIC-MAJOR tables
     (ops/lda_kernel.py): tiles slice along lanes, the whole [C, K] chain
     stays in VMEM.  Chunk-granular snapshots (fresher than the XLA
@@ -382,7 +384,8 @@ def _sample_entry_pallas(NdkT, NwkT, nk, z, entry, key2, cfg: LDAConfig,
     DbT = lax.dynamic_slice_in_dim(NdkT, od, DR, 1)
     WbT = lax.dynamic_slice_in_dim(NwkT, ow, WR, 1)
     DbT, WbT, dNk, z_new = _sample_tiles_pallas(DbT, WbT, nk, z, cd, cw,
-                                                key2, cfg, vocab_size)
+                                                key2, cfg, vocab_size,
+                                                count_bounds)
     NdkT = lax.dynamic_update_slice_in_dim(NdkT, DbT, od, 1)
     NwkT = lax.dynamic_update_slice_in_dim(NwkT, WbT, ow, 1)
     return NdkT, NwkT, dNk, z_new
@@ -399,7 +402,8 @@ _PALLAS_C = 256
 _PACK_VERSION = 1
 
 
-def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
+                     count_bounds=(None, None)):
     """Device-view epoch body: every token resampled once.
 
     Pipelined half-slice schedule identical to MF-SGD's (see
@@ -448,8 +452,9 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
                     # chains are bit-identical (tested).
                     ax = 1 if pallas else 0
                     DR = cfg.d_tile
-                    core = (_sample_tiles_pallas if pallas
-                            else _sample_entry_tiles)
+                    core = (functools.partial(_sample_tiles_pallas,
+                                              count_bounds=count_bounds)
+                            if pallas else _sample_entry_tiles)
 
                     def entry_body(st, inp):
                         Ndk, Nwk, dNk_acc, db, cur_od = st
@@ -486,8 +491,9 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
                     Ndk = lax.dynamic_update_slice_in_dim(
                         Ndk, db_f, od_f, ax)
                 else:
-                    sample = (_sample_entry_pallas if pallas
-                              else _sample_entry)
+                    sample = (functools.partial(_sample_entry_pallas,
+                                                count_bounds=count_bounds)
+                              if pallas else _sample_entry)
 
                     def entry_body(st, inp):
                         Ndk, Nwk, dNk_acc = st
@@ -572,11 +578,12 @@ def _pushpull_epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig,
     return epoch
 
 
-def _device_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+def _device_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
+                     count_bounds=(None, None)):
     """Pick the epoch body for ``cfg.algo`` (rotation vs pull/push)."""
     if cfg.algo == "pushpull":
         return _pushpull_epoch_device_fn(mesh, cfg, vocab_size)
-    return _epoch_device_fn(mesh, cfg, vocab_size)
+    return _epoch_device_fn(mesh, cfg, vocab_size, count_bounds)
 
 
 def _n_token_args(cfg: LDAConfig) -> int:
@@ -589,12 +596,18 @@ def _epoch_out_specs(mesh, cfg):
     return base + ((P(),) if cfg.algo == "pushpull" else ())
 
 
-def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
+def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
+                  count_bounds=(None, None)):
     """Compile one epoch — see :func:`_epoch_device_fn` (rotation algos)
-    and :func:`_pushpull_epoch_device_fn`."""
+    and :func:`_pushpull_epoch_device_fn`.
+
+    ``count_bounds``: static (max doc-topic, max word-topic) count bounds
+    the pallas kernel uses to pick its exact-gather plane counts — chain
+    invariants derived by ``LDA._install_pack`` from the initial tables.
+    """
     return jax.jit(
         mesh.shard_map(
-            _device_epoch_fn(mesh, cfg, vocab_size),
+            _device_epoch_fn(mesh, cfg, vocab_size, count_bounds),
             in_specs=(mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
             + (mesh.spec(0),) * _n_token_args(cfg),
             out_specs=_epoch_out_specs(mesh, cfg),
@@ -603,7 +616,7 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int):
 
 
 def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
-                        epochs: int):
+                        epochs: int, count_bounds=(None, None)):
     """Compile ``epochs`` Gibbs sweeps as ONE device program.
 
     Same dispatch-amortization as mfsgd.make_multi_epoch_fn (round trips
@@ -612,7 +625,7 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
     worker's base key, so the chain is identical to per-epoch dispatches
     with the same derivation.
     """
-    inner = _device_epoch_fn(mesh, cfg, vocab_size)
+    inner = _device_epoch_fn(mesh, cfg, vocab_size, count_bounds)
 
     pp = cfg.algo == "pushpull"
 
@@ -794,6 +807,10 @@ class LDA:
             self.d_bound = self.d_own = -(-n_docs // n)
             self.w_bound = 2 * (-(-vocab_size // (2 * n)))
             self.w_own = self.w_bound // 2
+        # (max doc-topic, max word-topic) static count bounds — derived
+        # per corpus in _install_pack (pallas only); (None, None) = the
+        # kernel falls back to dtype-based gather plane counts
+        self._count_bounds = (None, None)
         self._epoch_fn = make_epoch_fn(self.mesh, self.cfg, vocab_size)
         self._multi_fns: dict = {}
         self._seed = seed
@@ -901,6 +918,20 @@ class LDA:
         :meth:`pack_tokens` dict onto the mesh."""
         n = self.mesh.num_workers
         sh = self.mesh.shard_array
+        if self.cfg.algo == "pallas":
+            # static count bounds for the kernel's exact gathers (chain
+            # invariants: a doc-topic count ≤ its doc length, a
+            # word-topic count ≤ its word frequency — Gibbs preserves
+            # both row sums).  Enwiki-shape corpora have doc lengths
+            # ≤ 256, so the Db gather usually needs ONE bf16 dot instead
+            # of 2-3 digit planes.  Epoch program rebuilt: the bounds are
+            # trace-time statics.
+            self._count_bounds = (
+                int(np.asarray(pack["Ndk"], np.float32).sum(1).max()),
+                int(np.asarray(pack["Nwk"]).sum(1).max()))
+            self._epoch_fn = make_epoch_fn(self.mesh, self.cfg,
+                                           self.vocab_size,
+                                           self._count_bounds)
         self.Ndk, self.Nwk = sh(pack["Ndk"], 0), sh(pack["Nwk"], 0)
         self.Nk = jax.device_put(jnp.asarray(pack["Nk"]),
                                  self.mesh.replicated())
@@ -972,7 +1003,8 @@ class LDA:
         fn = self._multi_fns.get(epochs)
         if fn is None:
             jitted = make_multi_epoch_fn(
-                self.mesh, self.cfg, self.vocab_size, epochs)
+                self.mesh, self.cfg, self.vocab_size, epochs,
+                self._count_bounds)
             keys = self.mesh.shard_array(self._keys, 0)
             fn = self._multi_fns[epochs] = jitted.lower(
                 self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,

@@ -28,6 +28,10 @@ Numerics — read before trusting counts:
   table's own integer ceiling), every plane holds integers ≤ 256 (bf16-
   exact), one bf16 dot per plane, exact f32 recombination.  Cost: +1/+2
   gather dots and ~6·K·max(DR, WR) bytes of plane temporaries per tile.
+  Static ``ndk/nwk_count_bound``\\ s shrink the plane counts (chain
+  invariants — doc-topic ≤ doc length, word-topic ≤ word frequency;
+  ``LDA._install_pack`` derives them per corpus): enwiki-shape doc
+  lengths ≤ 256 make the Db gather ONE plain bf16 dot, still exact.
   ``exact_gathers=False`` keeps the single-dot bf16 gather — counts >
   256 round (≤ 0.4% relative, *in the posterior only*); the
   ``lda_pallas_approx`` sweep config measures whether that buys ≥10% at
@@ -75,7 +79,10 @@ def _gather_planes(tbl_f32, oh, dot, nplanes: int):
         acc = part if acc is None else acc + part
         rem = hi
         scale = scale * 256.0
-    return acc + dot(rem.astype(jnp.bfloat16), oh) * scale
+    top = dot(rem.astype(jnp.bfloat16), oh) * scale
+    # nplanes == 1: the caller proved values ≤ 256 (bf16-exact), so the
+    # top "plane" IS the whole gather
+    return top if acc is None else acc + top
 
 
 def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
@@ -152,9 +159,28 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
     dnk_out[...] += delta.astype(jnp.float32).sum(axis=1, keepdims=True)
 
 
+def _planes_for(count_bound, dtype) -> int:
+    """Fewest base-256 digit planes that gather a count table EXACTLY.
+
+    ``count_bound`` is a static upper bound on any table value — a chain
+    INVARIANT when supplied (doc-topic counts ≤ doc length, word-topic
+    counts ≤ word frequency; row sums never change under Gibbs), so the
+    caller may derive it once from the initial tables.  None falls back
+    to what the dtype can hold.
+    """
+    if count_bound is not None:
+        if count_bound <= 256:
+            return 1        # bf16 holds 0..256 exactly: one plain dot
+        if count_bound < 2 ** 16:
+            return 2
+        return 3
+    return 2 if jnp.dtype(dtype) == jnp.int16 else 3
+
+
 def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
                      chunk_c: int = 256, interpret: bool = False,
-                     exact_gathers: bool = True):
+                     exact_gathers: bool = True, ndk_count_bound=None,
+                     nwk_count_bound=None):
     """Resample one dense tile entry's tokens; return updated tiles.
 
     ``DbT`` [K, d_tile] (float32 or int16), ``WbT`` [K, w_tile] float32 —
@@ -173,17 +199,21 @@ def cgs_entry_update(DbT, WbT, nk, z, cd, cw, seed2, *, alpha, beta, vbeta,
     K, DR = DbT.shape
     _, WR = WbT.shape
     C = z.shape[0]
-    # digit planes sized by what the table can hold: int16 doc tiles fit
-    # 2 planes exactly (counts ≤ 2^15); f32 tiles get 3 (exact to 2^24 —
-    # beyond that the f32 table itself can't count)
-    nplanes_d = (2 if DbT.dtype == jnp.int16 else 3) if exact_gathers else 0
-    nplanes_w = 3 if exact_gathers else 0
+    # digit planes sized by the tightest static bound available: a
+    # corpus-derived count bound (see _planes_for — chain-invariant),
+    # else what the dtype can hold
+    nplanes_d = (_planes_for(ndk_count_bound, DbT.dtype)
+                 if exact_gathers else 0)
+    nplanes_w = (_planes_for(nwk_count_bound, WbT.dtype)
+                 if exact_gathers else 0)
 
     def est(cc):
         # tiles in+out (+4: f32 out even for int16 in) + ~6 live [K, cc]
         # + exact-gather plane temporaries (f32 remainder + bf16 plane of
-        # the currently-gathered table: ~6 B/elem, tables gathered in turn)
-        planes = 6 * K * max(DR, WR) if exact_gathers else 0
+        # the currently-gathered table: ~6 B/elem, tables gathered in
+        # turn; single-plane gathers only pay the bf16 cast)
+        per_elem = 6 if max(nplanes_d, nplanes_w) >= 2 else 2
+        planes = per_elem * K * max(DR, WR) if exact_gathers else 0
         return ((DbT.dtype.itemsize + 4) * K * DR + 8 * K * WR
                 + 6 * 4 * K * cc + planes)
 

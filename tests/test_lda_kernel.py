@@ -140,6 +140,39 @@ def test_gather_planes_exact_above_256():
     assert approx[0, list(vals).index(257)] != 257.0
 
 
+def test_count_bounds_pick_fewer_planes_identically():
+    """A static count bound lets the kernel gather with fewer digit
+    planes (1 when every count ≤ 256 — the enwiki doc-length case);
+    outputs must be IDENTICAL to the unbounded 2/3-plane paths when the
+    bound really holds."""
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.lda_kernel import _planes_for, cgs_entry_update
+
+    assert _planes_for(256, jnp.float32) == 1
+    assert _planes_for(257, jnp.float32) == 2
+    assert _planes_for(2**16, jnp.float32) == 3
+    assert _planes_for(None, jnp.int16) == 2
+    assert _planes_for(None, jnp.float32) == 3
+
+    K, DR, WR, C = 8, 8, 8, 256
+    rng = np.random.default_rng(0)
+    DbT = jnp.asarray(rng.integers(0, 200, (K, DR)).astype(np.float32))
+    WbT = jnp.asarray(rng.integers(0, 200, (K, WR)).astype(np.float32))
+    nk = jnp.asarray(DbT.sum(1) + 1000.0)
+    z = jnp.zeros(C, jnp.int32)
+    cd = jnp.asarray(rng.integers(0, DR, C).astype(np.int32))
+    cw = jnp.asarray(rng.integers(0, WR, C).astype(np.int32))
+    kw = dict(alpha=0.5, beta=0.1, vbeta=3.2, interpret=True)
+    outs = {}
+    for bounds in ((None, None), (200, 200)):
+        outs[bounds] = cgs_entry_update(
+            DbT, WbT, nk, z, cd, cw, jnp.array([7, 9], jnp.int32),
+            ndk_count_bound=bounds[0], nwk_count_bound=bounds[1], **kw)
+    for a, b in zip(outs[(None, None)], outs[(200, 200)]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 from hypothesis import given, settings, strategies as st  # noqa: E402
 
 
