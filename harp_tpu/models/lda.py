@@ -926,9 +926,11 @@ class LDA:
             # ≤ 256, so the Db gather usually needs ONE bf16 dot instead
             # of 2-3 digit planes.  Epoch program rebuilt: the bounds are
             # trace-time statics.
+            # int64 accumulator on the stored dtype — no 2x table copy
+            # (an f32 astype of the enwiki int16 Ndk would be 4 GB)
             self._count_bounds = (
-                int(np.asarray(pack["Ndk"], np.float32).sum(1).max()),
-                int(np.asarray(pack["Nwk"]).sum(1).max()))
+                int(np.asarray(pack["Ndk"]).sum(1, dtype=np.int64).max()),
+                int(np.asarray(pack["Nwk"]).sum(1, dtype=np.int64).max()))
             self._epoch_fn = make_epoch_fn(self.mesh, self.cfg,
                                            self.vocab_size,
                                            self._count_bounds)
@@ -1169,6 +1171,19 @@ def _save_pack(path: str, pack: dict) -> None:
                path)
 
 
+def benchmark_corpus(n_docs, vocab_size, tokens_per_doc, seed):
+    """The deterministic i.i.d. synthetic corpus :func:`benchmark` times
+    (structure irrelevant to cost).  ONE definition, shared with
+    scripts/prewarm_bench_cache.py — the pack-cache key assumes both
+    build identical corpora, so a second construction would let them
+    drift apart silently (same key, different bytes)."""
+    rng = np.random.default_rng(seed)
+    n_tok = n_docs * tokens_per_doc
+    d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
+    w_ids = rng.integers(0, vocab_size, n_tok).astype(np.int32)
+    return d_ids, w_ids
+
+
 def _pack_cache_path(pack_cache, cfg: LDAConfig, num_workers, n_docs,
                      vocab_size, n_topics, tokens_per_doc, seed) -> str:
     """Cache path for a :func:`benchmark` corpus pack — layout-relevant
@@ -1213,11 +1228,8 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
                     pull_cap, ndk_dtype, dedup_pulls, sampler, rng_impl,
                     pallas_exact_gathers, carry_db)
     model = LDA(n_docs, vocab_size, cfg, mesh, seed)
-    rng = np.random.default_rng(seed)
     n_tok = n_docs * tokens_per_doc
-    # i.i.d. synthetic corpus at benchmark scale (structure irrelevant to cost)
-    d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
-    w_ids = rng.integers(0, vocab_size, n_tok).astype(np.int32)
+    d_ids, w_ids = benchmark_corpus(n_docs, vocab_size, tokens_per_doc, seed)
     t0 = time.perf_counter()
     pack_path = (None if pack_cache is None else _pack_cache_path(
         pack_cache, cfg, mesh.num_workers, n_docs, vocab_size, n_topics,

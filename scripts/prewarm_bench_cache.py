@@ -40,18 +40,15 @@ PACKS = [
 
 
 def prewarm_pack(n_docs=100_000, vocab_size=50_000, n_topics=1000,
-                 tokens_per_doc=100, seed=0, **cfg_kw):
-    import numpy as np
-
+                 tokens_per_doc=100, seed=0, algo="dense", sampler=None,
+                 rng_impl=None, ndk_dtype="float32"):
     from harp_tpu import WorkerMesh
     from harp_tpu.models import lda as L
 
     mesh = WorkerMesh()  # 1 CPU device == the 1-chip sprint mesh
     assert mesh.num_workers == 1, mesh.num_workers
-    algo = cfg_kw.pop("algo", "dense")
-    cfg = L._make_cfg(n_topics, algo, **{k: cfg_kw.get(k) for k in
-                                         ("sampler", "rng_impl")},
-                      ndk_dtype=cfg_kw.get("ndk_dtype", "float32"))
+    cfg = L._make_cfg(n_topics, algo, sampler=sampler, rng_impl=rng_impl,
+                      ndk_dtype=ndk_dtype)
     path = L._pack_cache_path(BENCH_DATA, cfg, mesh.num_workers, n_docs,
                               vocab_size, n_topics, tokens_per_doc, seed)
     label = f"{algo} n_docs={n_docs} ndk={cfg.ndk_dtype}"
@@ -59,10 +56,10 @@ def prewarm_pack(n_docs=100_000, vocab_size=50_000, n_topics=1000,
         print(f"pack ok (cached): {label} -> {os.path.basename(path)}")
         return
     t0 = time.time()
-    rng = np.random.default_rng(seed)
-    n_tok = n_docs * tokens_per_doc
-    d_ids = np.repeat(np.arange(n_docs, dtype=np.int32), tokens_per_doc)
-    w_ids = rng.integers(0, vocab_size, n_tok).astype(np.int32)
+    # the SAME corpus constructor benchmark uses — a second construction
+    # here would let the cached bytes drift from the key's promise
+    d_ids, w_ids = L.benchmark_corpus(n_docs, vocab_size, tokens_per_doc,
+                                      seed)
     model = L.LDA(n_docs, vocab_size, cfg, mesh, seed)
     pack = model.pack_tokens(d_ids, w_ids)
     L._save_pack(path, pack)
