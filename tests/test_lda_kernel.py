@@ -284,7 +284,12 @@ def test_kernel_vmem_gate():
     (1000, 512, 512, 2048),
     (8, 128, 128, 256),
 ])
-def test_kernel_lowers_for_tpu(ndk_dtype, shape):
+@pytest.mark.parametrize("bounds", [
+    (None, None),   # dtype-based planes (2-3)
+    (100, 2100),    # the bounds the sprint's graded corpora derive
+                    # (doc length ≤ 256 → 1 Db plane; word freq → 2 Wb)
+])
+def test_kernel_lowers_for_tpu(ndk_dtype, shape, bounds):
     """Pallas->Mosaic verification at the graded tile shapes, no hardware
     (caught the uint32->f32 cast Mosaic rejects, pre-relay)."""
     import functools
@@ -296,7 +301,9 @@ def test_kernel_lowers_for_tpu(ndk_dtype, shape):
 
     K, DR, WR, C = shape
     f = functools.partial(cgs_entry_update, alpha=0.1, beta=0.01,
-                          vbeta=500.0, interpret=False)
+                          vbeta=500.0, interpret=False,
+                          ndk_count_bound=bounds[0],
+                          nwk_count_bound=bounds[1])
     lowered = jax.jit(f).trace(
         jnp.zeros((K, DR), jnp.dtype(ndk_dtype)), jnp.zeros((K, WR)),
         jnp.zeros((K,)), jnp.zeros(C, jnp.int32), jnp.zeros(C, jnp.int32),
