@@ -29,6 +29,19 @@ def _run_bench(argv):
     return buf.getvalue()
 
 
+def test_bench_tables_stay_consistent():
+    # BASELINES, _CONFIG_KEYS and UNITS are parallel tables — a config
+    # added to one but not the others would KeyError only on the error
+    # path (_last_measured), the worst place to discover it
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    assert set(b.BASELINES) == {name for name, _ in b._CONFIG_KEYS}
+    assert {key for _, key in b._CONFIG_KEYS} <= set(b.UNITS)
+
+
 def test_bench_smoke_emits_one_line_with_north_star_pair(mesh):
     out = _run_bench(["--smoke", "kmeans", "mfsgd"])
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
