@@ -111,17 +111,26 @@ def main(argv=None):
                 result = mod.benchmark(**kw)
             ops = op_breakdown(logdir, top=args.top)
         except Exception as e:
-            rec = {"config": name, "error": f"{type(e).__name__}: {e}"}
+            rec = {"config": name, "error": f"{type(e).__name__}: {e}",
+                   "trace_dir": logdir}
         else:
             # an empty op table (relay died mid-trace, all spans filtered)
             # is a per-config error, not a sweep-aborting ZeroDivision
             traced = sum(t for _, t in ops) or 1.0
+            raw = op_breakdown(logdir, top=args.top, self_time=False)
             rec = {"config": name,
                    **{k: (round(v, 4) if isinstance(v, float) else v)
                       for k, v in annotate(name, result).items()},
+                   # op_breakdown has never parsed a REAL TPU trace; keep
+                   # the trace dir + the raw (non-self-time) table so the
+                   # window's capture can be re-analyzed from disk if the
+                   # self-time parse turns out wrong on device tracks
+                   "trace_dir": logdir,
                    "top_ops": [{"op": o, "sec": round(t, 5),
                                 "share_of_traced": round(t / traced, 3)}
-                               for o, t in ops]}
+                               for o, t in ops],
+                   "top_ops_raw": [{"op": o, "sec": round(t, 5)}
+                                   for o, t in raw]}
         line = json.dumps(rec)
         print(line, flush=True)
         sink.write(line + "\n")
