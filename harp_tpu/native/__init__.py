@@ -11,6 +11,7 @@ from harp_tpu.native.build import load_native, native_available
 from harp_tpu.native.datasource import (
     CSVPoints,
     CSVStream,
+    ParquetPoints,
     csr_to_ell,
     load_csv,
     load_libsvm,
@@ -18,4 +19,5 @@ from harp_tpu.native.datasource import (
 )
 
 __all__ = ["load_native", "native_available", "load_csv", "load_libsvm",
-           "load_triples", "csr_to_ell", "CSVStream", "CSVPoints"]
+           "load_triples", "csr_to_ell", "CSVStream", "CSVPoints",
+           "ParquetPoints"]

@@ -414,9 +414,10 @@ class FileSplits:
     by default (``by_size``), Harp's ``MultiFileInputFormat`` rule — and
     only ``local_workers`` — the workers this process serves — are
     opened, so a multi-host job touches each file exactly once across
-    the fleet.  ``.npy`` files open as memmaps; anything else goes
-    through :class:`CSVPoints` (native streaming parser, bounded
-    memory).  All files must agree on the column count.
+    the fleet.  ``.npy`` files open as memmaps; ``.parquet``/``.pq``
+    through :class:`ParquetPoints` (pyarrow row-group streaming);
+    anything else through :class:`CSVPoints` (native streaming parser,
+    bounded memory).  All files must agree on the column count.
 
     Per worker: ``rows(w)`` (total), ``next_block(w, count)`` (the next
     ≤count rows, crossing file boundaries), and :meth:`reset` rewinds
@@ -440,8 +441,12 @@ class FileSplits:
         for w in self.local_workers:
             srcs = []
             for p in assign[w]:
-                s = (np.load(p, mmap_mode="r") if p.endswith(".npy")
-                     else CSVPoints(p, chunk_rows))
+                if p.endswith(".npy"):
+                    s = np.load(p, mmap_mode="r")
+                elif p.endswith((".parquet", ".pq")):
+                    s = ParquetPoints(p, chunk_rows)
+                else:
+                    s = CSVPoints(p, chunk_rows)
                 if len(s.shape) != 2:
                     raise ValueError(f"{p}: expected 2-D rows, got shape "
                                      f"{s.shape}")
@@ -563,42 +568,32 @@ class FileSplits:
         self.close()
 
 
-class CSVPoints:
-    """Sequential-access view of a CSV file shaped like an array —
-    the ``points`` source contract of
-    :func:`harp_tpu.models.kmeans_stream.fit_streaming` for text corpora
-    too large for RAM.
+class SequentialPoints:
+    """Shared engine of the ``points`` source contract of
+    :func:`harp_tpu.models.kmeans_stream.fit_streaming` — a file viewed
+    as a 2-D array that only supports the access pattern the streaming
+    apps use:
 
-    Supports exactly the access pattern the streaming apps use:
     ``points[lo:hi]`` with ascending, contiguous ``lo`` that restarts at
     0 each epoch (each restart reopens the underlying stream), plus
     ``points[sorted_index_array]`` row gathers (one dedicated streaming
-    pass — used by centroid init).  ``shape`` comes from the native
-    row-count pass.  Anything else raises, loudly.
+    pass — used by centroid init).  Anything else raises, loudly.
+
+    Subclasses set ``self.shape`` in ``__init__`` and implement
+    ``_open_stream() -> iterator of [n, cols] float32 blocks`` (with an
+    optional ``close()``); everything else — position bookkeeping,
+    skip-forward, the gather pass — lives here once
+    (:class:`CSVPoints`, :class:`ParquetPoints`).
     """
 
-    def __init__(self, path: str, chunk_rows: int = 65_536):
-        self.path, self.chunk_rows = path, chunk_rows
-        lib = load_native()
-        if lib is not None:
-            # streaming count (bounded memory) — harp_count_rows reads the
-            # whole file into RAM, which this class exists to avoid
-            rows = ctypes.c_int64()
-            cols = ctypes.c_int64()
-            rc = lib.harp_csv_count_stream(path.encode(),
-                                           ctypes.byref(rows),
-                                           ctypes.byref(cols))
-            if rc != 0:
-                raise OSError(f"native loader failed to read {path!r}")
-            self.shape = (int(rows.value), int(cols.value))
-        else:
-            n, c = 0, 0
-            with CSVStream(path, chunk_rows) as st:
-                for blk in st:
-                    n += blk.shape[0]
-                    c = blk.shape[1]
-            self.shape = (n, c)
-        self._stream: CSVStream | None = None
+    shape: tuple
+    chunk_rows: int
+
+    def _open_stream(self):
+        raise NotImplementedError
+
+    def _init_cursor(self):
+        self._stream = None
         self._pos = 0
         self._pending: np.ndarray | None = None  # rows read but not consumed
 
@@ -606,9 +601,9 @@ class CSVPoints:
         return self.shape[0]
 
     def _restart(self):
-        if self._stream is not None:
+        if self._stream is not None and hasattr(self._stream, "close"):
             self._stream.close()
-        self._stream = CSVStream(self.path, self.chunk_rows)
+        self._stream = self._open_stream()
         self._pos = 0
         self._pending = None
 
@@ -634,14 +629,15 @@ class CSVPoints:
             np.zeros((0, self.shape[1]), np.float32)
 
     def __getitem__(self, key):
+        name = type(self).__name__
         if isinstance(key, slice):
             lo = key.start or 0
             hi = self.shape[0] if key.stop is None else key.stop
             if key.step not in (None, 1):
-                raise ValueError("CSVPoints slices must be contiguous")
+                raise ValueError(f"{name} slices must be contiguous")
             if lo < 0 or hi < 0:
                 raise IndexError(
-                    "CSVPoints does not support negative slice bounds "
+                    f"{name} does not support negative slice bounds "
                     f"(got {lo}:{hi})")
             hi = min(hi, self.shape[0])
             if lo == 0 or self._stream is None:
@@ -650,21 +646,22 @@ class CSVPoints:
                     self._read(lo, keep=False)  # skip forward (init paths)
             elif lo != self._pos:
                 raise ValueError(
-                    f"CSVPoints is sequential: asked for rows {lo}:{hi} at "
+                    f"{name} is sequential: asked for rows {lo}:{hi} at "
                     f"position {self._pos} (slices must ascend contiguously "
                     "and restart at 0)")
             return self._read(hi - lo)
         idx = np.asarray(key)
         if idx.ndim != 1 or not np.issubdtype(idx.dtype, np.integer):
-            raise TypeError("CSVPoints supports slices or 1-D integer "
+            raise TypeError(f"{name} supports slices or 1-D integer "
                             "index arrays")
         if len(idx) and (np.diff(idx) < 0).any():
-            raise ValueError("CSVPoints index arrays must be sorted")
+            raise ValueError(f"{name} index arrays must be sorted")
         if len(idx) and int(idx[0]) < 0:
-            raise IndexError("CSVPoints does not support negative indices "
+            raise IndexError(f"{name} does not support negative indices "
                              f"(got {int(idx[0])})")
         out = np.empty((len(idx), self.shape[1]), np.float32)
-        with CSVStream(self.path, self.chunk_rows) as st:
+        st = self._open_stream()
+        try:
             base, j = 0, 0
             for blk in st:
                 hi = base + blk.shape[0]
@@ -674,6 +671,9 @@ class CSVPoints:
                 base = hi
                 if j >= len(idx):
                     break
+        finally:
+            if hasattr(st, "close"):
+                st.close()
         if j < len(idx):
             raise IndexError(f"index {int(idx[j])} out of range "
                              f"({self.shape[0]} rows)")
@@ -681,7 +681,8 @@ class CSVPoints:
 
     def close(self):
         if self._stream is not None:
-            self._stream.close()
+            if hasattr(self._stream, "close"):
+                self._stream.close()
             self._stream = None
 
     def __enter__(self):
@@ -689,3 +690,98 @@ class CSVPoints:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class CSVPoints(SequentialPoints):
+    """:class:`SequentialPoints` over a CSV/whitespace text file — text
+    corpora too large for RAM stream through the native parser
+    (:class:`CSVStream`); ``shape`` comes from the native bounded-memory
+    row-count pass."""
+
+    def __init__(self, path: str, chunk_rows: int = 65_536):
+        self.path, self.chunk_rows = path, chunk_rows
+        lib = load_native()
+        if lib is not None:
+            # streaming count (bounded memory) — harp_count_rows reads the
+            # whole file into RAM, which this class exists to avoid
+            rows = ctypes.c_int64()
+            cols = ctypes.c_int64()
+            rc = lib.harp_csv_count_stream(path.encode(),
+                                           ctypes.byref(rows),
+                                           ctypes.byref(cols))
+            if rc != 0:
+                raise OSError(f"native loader failed to read {path!r}")
+            self.shape = (int(rows.value), int(cols.value))
+        else:
+            n, c = 0, 0
+            with CSVStream(path, chunk_rows) as st:
+                for blk in st:
+                    n += blk.shape[0]
+                    c = blk.shape[1]
+            self.shape = (n, c)
+        self._init_cursor()
+
+    def _open_stream(self):
+        return CSVStream(self.path, self.chunk_rows)
+
+
+class ParquetPoints(SequentialPoints):
+    """:class:`SequentialPoints` over a Parquet file (columnar splits —
+    the common modern shape of the HDFS-style datasets Harp's input
+    formats consumed).  ``shape`` comes from the file METADATA (no data
+    read); blocks stream via ``pyarrow.parquet.iter_batches`` in bounded
+    memory.  All columns must be numeric; blocks arrive float32."""
+
+    def __init__(self, path: str, chunk_rows: int = 65_536):
+        pq = _require_pyarrow()
+        self.path, self.chunk_rows = path, chunk_rows
+        pf = pq.ParquetFile(path)
+        try:
+            md = pf.metadata
+            self.shape = (int(md.num_rows), int(md.num_columns))
+            import pyarrow as pa
+
+            bad = [f for f in pf.schema_arrow
+                   if not (pa.types.is_floating(f.type)
+                           or pa.types.is_integer(f.type))]
+            if bad:
+                raise ValueError(
+                    f"{path}: non-numeric parquet column(s) "
+                    f"{[f.name for f in bad]} — point sources are numeric")
+        finally:
+            pf.close()
+        self._init_cursor()
+
+    def _open_stream(self):
+        pq = _require_pyarrow()
+        pf = pq.ParquetFile(self.path)
+
+        class _Batches:
+            def __init__(self, pf, chunk_rows):
+                self._pf = pf
+                self._it = pf.iter_batches(batch_size=chunk_rows)
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                batch = next(self._it)  # StopIteration propagates
+                return np.stack(
+                    [batch.column(i).to_numpy(zero_copy_only=False)
+                     for i in range(batch.num_columns)], axis=1,
+                ).astype(np.float32, copy=False)
+
+            def close(self):
+                self._pf.close()
+
+        return _Batches(pf, self.chunk_rows)
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow.parquet as pq
+    except ImportError as e:  # pragma: no cover — pyarrow is in the image
+        raise ImportError(
+            "ParquetPoints needs pyarrow (not installed); convert the "
+            "input to .npy/.csv or install pyarrow") from e
+    return pq
