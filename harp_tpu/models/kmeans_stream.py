@@ -969,7 +969,7 @@ def main(argv=None):
     p.add_argument("--iters", type=int, default=3)
     p.add_argument("--chunk", type=int, default=262_144)
     p.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"])
-    p.add_argument("--input", default=None, metavar="NPY_CSV_OR_GLOB",
+    p.add_argument("--input", default=None, metavar="NPY_PARQUET_CSV_OR_GLOB",
                    help="stream a .npy file (np.memmap), a CSV/text file "
                         "(native prefetch-threaded reader, bounded "
                         "memory), or a glob/directory of split files — "
@@ -1016,6 +1016,10 @@ def main(argv=None):
         else:
             if paths[0].endswith(".npy"):
                 pts = np.load(paths[0], mmap_mode="r")
+            elif paths[0].endswith((".parquet", ".pq")):
+                from harp_tpu.native.datasource import ParquetPoints
+
+                pts = ParquetPoints(paths[0], chunk_rows=args.chunk)
             else:  # text: native streaming reader, never materialized
                 from harp_tpu.native.datasource import CSVPoints
 
