@@ -30,7 +30,17 @@ def _loadtxt_any_sep(path: str) -> np.ndarray:
 
 
 def load_csv(path: str, n_threads: int = 0) -> np.ndarray:
-    """Dense CSV/whitespace numeric file → float32 [rows, cols]."""
+    """Dense CSV/whitespace numeric file → float32 [rows, cols].
+
+    ``.parquet``/``.pq`` files load columnarly through pyarrow (all
+    columns must be numeric) — one front door for dense matrices
+    whatever the split encoding."""
+    if path.endswith((".parquet", ".pq")):
+        pq = _require_pyarrow()
+        t = pq.read_table(path)
+        return np.stack(
+            [t.column(i).to_numpy(zero_copy_only=False)
+             for i in range(t.num_columns)], axis=1).astype(np.float32)
     n_threads = n_threads or (os.cpu_count() or 1)
     lib = load_native()
     if lib is None:
@@ -203,7 +213,13 @@ def load_triples_glob(pattern_or_dir: str, n_threads: int = 0):
         raise ValueError(f"{pattern_or_dir}: no input files matched")
     ncols: set[int] = set()
     for f in paths:
-        ncols |= _scan_columns(f)
+        if f.endswith((".parquet", ".pq")):
+            # column count from metadata — the text scanner would read
+            # binary bytes as garbage tokens
+            pq = _require_pyarrow()
+            ncols.add(int(pq.ParquetFile(f).metadata.num_columns))
+        else:
+            ncols |= _scan_columns(f)
     if len(ncols) > 1:
         raise ValueError(
             f"{pattern_or_dir}: rows disagree on column count "
@@ -249,8 +265,22 @@ def load_triples(path: str, n_threads: int = 0):
     """'u i [v]' rating/token lines → (int32 [n], int32 [n], float32 [n]).
 
     A missing third column reads as v=0.0 (both paths — the native parser
-    already tolerates it).
+    already tolerates it).  ``.parquet``/``.pq`` files load columnarly:
+    first two numeric columns are the ids, an optional third is the
+    value (rating tables in the wild are overwhelmingly parquet).
     """
+    if path.endswith((".parquet", ".pq")):
+        pq = _require_pyarrow()
+        t = pq.read_table(path)
+        if t.num_columns not in (2, 3):
+            raise ValueError(f"{path}: triples need 2 or 3 columns, "
+                             f"got {t.num_columns}")
+        cols = [t.column(i).to_numpy(zero_copy_only=False)
+                for i in range(t.num_columns)]
+        v = (cols[2] if len(cols) == 3
+             else np.zeros(len(cols[0])))
+        return (cols[0].astype(np.int32), cols[1].astype(np.int32),
+                v.astype(np.float32))
     n_threads = n_threads or (os.cpu_count() or 1)
     lib = load_native()
     if lib is None:

@@ -371,6 +371,50 @@ def test_file_splits_mixes_parquet_with_csv_and_npy(native_lib, tmp_path):
     fs.close()
 
 
+def test_load_csv_and_triples_accept_parquet(tmp_path):
+    """The materializing front doors (stats/kmeans dense input, the
+    mfsgd/lda triples input) take parquet splits too — and the glob
+    loader's column validation reads parquet METADATA, not binary bytes
+    through the text scanner."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from harp_tpu.native.datasource import (load_csv, load_triples,
+                                            load_triples_glob)
+
+    pts = np.random.default_rng(7).normal(size=(50, 4)).astype(np.float32)
+    p_dense = str(tmp_path / "d.parquet")
+    _write_parquet(p_dense, pts)
+    np.testing.assert_allclose(load_csv(p_dense), pts, rtol=1e-6)
+
+    u = np.arange(30, dtype=np.int64)
+    i = (u * 7) % 11
+    v = np.linspace(0, 1, 30)
+    p_tri = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"u": u, "i": i, "r": v}), p_tri)
+    gu, gi, gv = load_triples(p_tri)
+    np.testing.assert_array_equal(gu, u.astype(np.int32))
+    np.testing.assert_array_equal(gi, i.astype(np.int32))
+    np.testing.assert_allclose(gv, v.astype(np.float32), rtol=1e-6)
+
+    # two-column parquet: v reads 0.0 and has_value_column is False
+    p2 = str(tmp_path / "m1.parquet")
+    pq.write_table(pa.table({"u": u, "i": i}), p2)
+    gu2, gi2, gv2, has_v = load_triples_glob(p2)
+    assert not has_v and (gv2 == 0).all() and len(gu2) == 30
+    # mixed text + parquet glob agrees on columns -> concatenates
+    p_txt = str(tmp_path / "m2.txt")
+    with open(p_txt, "w") as f:
+        for a, b in zip(u, i):
+            f.write(f"{a} {b}\n")
+    gu3, _, _, _ = load_triples_glob(str(tmp_path / "m*"))
+    assert len(gu3) == 60
+    # a glob MIXING 2- and 3-column files still fails loudly, parquet
+    # metadata participating in the same check as the text scan
+    with pytest.raises(ValueError, match="disagree"):
+        load_triples_glob(str(tmp_path / "[tm]*"))
+
+
 def test_csv_stream_exact_chunk_newline_split(native_lib, tmp_path):
     # a block landing with EXACTLY chunk_rows newlines plus a partial
     # trailing line must carry the partial bytes, not drop/corrupt them
