@@ -15,10 +15,25 @@ import numpy as np
 from harp_tpu.native.build import load_native
 
 
+def _is_gz(path: str) -> bool:
+    return path.endswith(".gz")
+
+
+def _open_text(path: str):
+    """Text handle for plain or gzip-compressed files — HDFS-style text
+    splits are routinely .gz; the native C++ parser reads plain bytes
+    only, so gz inputs take the Python parse path (same semantics)."""
+    if _is_gz(path):
+        import gzip
+
+        return gzip.open(path, "rt")
+    return open(path)
+
+
 def _loadtxt_any_sep(path: str) -> np.ndarray:
     """numpy fallback accepting comma OR whitespace separators, matching the
     native parser's behavior so results don't depend on g++ availability."""
-    with open(path) as f:
+    with _open_text(path) as f:
         text = f.read().replace(",", " ")
     import io
     import warnings
@@ -42,7 +57,7 @@ def load_csv(path: str, n_threads: int = 0) -> np.ndarray:
             [t.column(i).to_numpy(zero_copy_only=False)
              for i in range(t.num_columns)], axis=1).astype(np.float32)
     n_threads = n_threads or (os.cpu_count() or 1)
-    lib = load_native()
+    lib = None if _is_gz(path) else load_native()
     if lib is None:
         return _loadtxt_any_sep(path).astype(np.float32)
     rows = ctypes.c_int64()
@@ -71,7 +86,7 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
     ``indices i32 [nnz]``, ``values f32 [nnz]``, and ``n_features``.
     """
     n_threads = n_threads or (os.cpu_count() or 1)
-    lib = load_native()
+    lib = None if _is_gz(path) else load_native()
     n_features_native = None
     if lib is None:
         # tolerance mirrors the native parser: the label is the numeric
@@ -92,7 +107,7 @@ def load_libsvm(path: str, n_threads: int = 0, zero_based: bool = False):
                 return float(m.group()) if m else 0.0
 
         labels, indptr, indices, values = [], [0], [], []
-        with open(path) as f:
+        with _open_text(path) as f:
             for line in f:
                 toks = line.split("#", 1)[0].split()
                 if not toks:
@@ -180,7 +195,7 @@ def _scan_columns(path: str) -> set[int]:
     native parser.  Returns an empty set for an empty file.
     """
     seen: set[int] = set()
-    with open(path) as f:
+    with _open_text(path) as f:
         rows = 0
         for line in f:
             toks = line.split("#", 1)[0].replace(",", " ").split()
@@ -282,7 +297,7 @@ def load_triples(path: str, n_threads: int = 0):
         return (cols[0].astype(np.int32), cols[1].astype(np.int32),
                 v.astype(np.float32))
     n_threads = n_threads or (os.cpu_count() or 1)
-    lib = load_native()
+    lib = None if _is_gz(path) else load_native()
     if lib is None:
         a = _loadtxt_any_sep(path)
         if a.shape[0] == 0:  # empty shard: loadtxt yields (0, 1)
@@ -331,7 +346,9 @@ class CSVStream:
         if chunk_rows < 1:
             raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
         self.path, self.chunk_rows = path, chunk_rows
-        self._lib = load_native()
+        # .gz takes the Python parse path: the native reader consumes
+        # plain bytes (see _open_text)
+        self._lib = None if _is_gz(path) else load_native()
         self._h = None
         self._f = None
         if self._lib is not None:
@@ -343,7 +360,7 @@ class CSVStream:
             if self._cols < 0:
                 raise OSError(f"native stream failed to read {path!r}")
         else:
-            self._f = open(path)
+            self._f = _open_text(path)
             self._cols = None  # discovered on first block
             self._py_buf: list = []
 
@@ -730,7 +747,7 @@ class CSVPoints(SequentialPoints):
 
     def __init__(self, path: str, chunk_rows: int = 65_536):
         self.path, self.chunk_rows = path, chunk_rows
-        lib = load_native()
+        lib = None if _is_gz(path) else load_native()
         if lib is not None:
             # streaming count (bounded memory) — harp_count_rows reads the
             # whole file into RAM, which this class exists to avoid
