@@ -415,6 +415,46 @@ def test_load_csv_and_triples_accept_parquet(tmp_path):
         load_triples_glob(str(tmp_path / "[tm]*"))
 
 
+def test_sequential_points_random_slice_partitions(native_lib, tmp_path):
+    """Property: ANY ascending contiguous partition of [0, n) — with
+    arbitrary chunk_rows, block-boundary-crossing slices, and occasional
+    restarts — reads back exactly the underlying rows (the shared
+    SequentialPoints pending-buffer bookkeeping, exercised through both
+    the CSV and parquet subclasses)."""
+    from hypothesis import given, settings, strategies as st
+
+    from harp_tpu.native.datasource import CSVPoints, ParquetPoints
+
+    n = 700
+    pts = np.random.default_rng(9).normal(size=(n, 3)).astype(np.float32)
+    p_csv = str(tmp_path / "prop.csv")
+    _write_csv(p_csv, pts)
+    p_pq = str(tmp_path / "prop.parquet")
+    _write_parquet(p_pq, pts)
+    sources = [CSVPoints(p_csv, chunk_rows=97),
+               ParquetPoints(p_pq, chunk_rows=97)]
+
+    @settings(max_examples=20, deadline=None)
+    @given(st.lists(st.integers(1, 200), min_size=1, max_size=12),
+           st.integers(0, 3))
+    def check(widths, restart_at):
+        for src in sources:
+            lo = 0
+            for j, w in enumerate(widths):
+                if j == restart_at and j > 0:
+                    lo = 0  # epoch restart mid-pattern
+                hi = min(lo + w, n)
+                np.testing.assert_allclose(src[lo:hi], pts[lo:hi],
+                                           rtol=2e-6, atol=1e-6)
+                lo = hi
+                if lo >= n:
+                    break
+
+    check()
+    for src in sources:
+        src.close()
+
+
 def test_gzip_text_inputs_parse_identically(native_lib, tmp_path):
     """.gz text splits (the routine HDFS encoding) parse through the
     Python path with identical results to the plain file on every text
