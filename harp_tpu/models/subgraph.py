@@ -59,6 +59,12 @@ TEMPLATES = {
     "u5-star": [-1, 0, 0, 0, 0],
     "u5-tree": [-1, 0, 0, 1, 1],    # balanced binary-ish tree
     "u7-tree": [-1, 0, 0, 1, 1, 2, 2],
+    # the deep end of the reference's template ladder (upstream shipped
+    # 10-15-vertex trees): DP table width is 2^k subset columns, so
+    # u10 = 1024 and u12 = 4096 columns — the compact C(k, j) storage
+    # keeps memory at the size-j support only
+    "u10-tree": [-1, 0, 0, 1, 1, 2, 2, 3, 3, 4],
+    "u12-tree": [-1, 0, 0, 1, 1, 2, 2, 3, 3, 4, 4, 5],
 }
 
 

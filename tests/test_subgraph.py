@@ -67,6 +67,32 @@ def test_dp_matches_brute_force_colorful(mesh, tname):
     assert out == expect, (tname, out, expect)
 
 
+@pytest.mark.parametrize("tname", ["u10-tree", "u12-tree"])
+def test_deep_templates_exact_on_complete_graph(mesh, tname):
+    """The 10/12-vertex templates (the deep end of the reference's
+    ladder; 2^10/2^12 DP columns) against a CLOSED FORM no brute force
+    can reach: on K_s with all-distinct colors, every injective map
+    respects edges, so the rooted colorful count is exactly s!."""
+    tpl = SG.TEMPLATES[tname]
+    s = len(tpl)
+    n = 16  # pad with isolated vertices so rows shard evenly over 8
+    edges = [(a, b) for a in range(s) for b in range(a + 1, s)]
+    colors = np.zeros(n, np.int32)
+    colors[:s] = np.arange(s)  # distinct on K_s; isolated extras inert
+    nbr, msk, overflow = SG.pad_csr(edges, n, s)
+    assert len(overflow) == 0
+    o_nbr, o_row, o_msk = SG._partition_overflow(overflow, n,
+                                                 mesh.num_workers)
+    fn = SG.make_colorful_count_fn(tpl, s, mesh)
+    out = float(np.asarray(fn(
+        mesh.shard_array(nbr, 0), mesh.shard_array(msk, 0),
+        mesh.shard_array(o_nbr, 0), mesh.shard_array(o_row, 0),
+        mesh.shard_array(o_msk, 0),
+        mesh.shard_array(colors[None, :], 1),
+    ))[0])
+    assert out == math.factorial(s), (tname, out, math.factorial(s))
+
+
 def test_automorphism_counts():
     assert SG._count_automorphism_roots(SG.TEMPLATES["u3-path"]) == 2   # path
     assert SG._count_automorphism_roots(SG.TEMPLATES["u3-star"]) == 2   # same tree
