@@ -258,7 +258,10 @@ def main(argv=None):
     p.add_argument("--rank", type=int, default=32)
     p.add_argument("--epochs", type=int, default=2)
     args = p.parse_args(argv)
-    print(benchmark(nnz=args.nnz, rank=args.rank, epochs=args.epochs))
+    from harp_tpu.utils.metrics import benchmark_json
+
+    print(benchmark_json("ccd_cli", benchmark(
+        nnz=args.nnz, rank=args.rank, epochs=args.epochs)))
 
 
 if __name__ == "__main__":

@@ -502,6 +502,8 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
 def main(argv=None):
     import argparse
 
+    from harp_tpu.utils.metrics import benchmark_json
+
     p = argparse.ArgumentParser(description="harp-tpu KMeans (edu.iu.kmeans parity)")
     p.add_argument("--n", type=int, default=1_000_000)
     p.add_argument("--d", type=int, default=300)
@@ -543,8 +545,8 @@ def main(argv=None):
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
                          variant=args.variant, quantize=args.quantize,
                          init=args.init)
-        print({"k": args.k, "iters": args.iters, "n": pts.shape[0],
-               "d": pts.shape[1], "inertia": inertia})
+        print(benchmark_json("kmeans_cli", {"k": args.k, "iters": args.iters, "n": pts.shape[0],
+               "d": pts.shape[1], "inertia": inertia}))
 
 
 if __name__ == "__main__":

@@ -1270,6 +1270,8 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
 def main(argv=None):
     import argparse
 
+    from harp_tpu.utils.metrics import benchmark_json
+
     p = argparse.ArgumentParser(description="harp-tpu LDA-CGS (edu.iu.lda parity)")
     p.add_argument("--docs", type=int, default=None,
                    help="default: 100000, or max doc id + 1 with --input")
@@ -1367,17 +1369,19 @@ def main(argv=None):
                               args.sampler, args.rng_impl))
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
-        print({"epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
-               "log_likelihood": round(model.log_likelihood(), 4)})
+        print(benchmark_json("lda_fit_cli", {
+            "epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
+            "log_likelihood": round(model.log_likelihood(), 4)}))
     else:
-        print(benchmark(args.docs or 100_000, args.vocab or 50_000, args.topics,
-                        args.tokens_per_doc, args.epochs, chunk=args.chunk,
-                        algo=args.algo, d_tile=args.d_tile,
-                        w_tile=args.w_tile, entry_cap=args.entry_cap,
-                        pull_cap=args.pull_cap, ndk_dtype=args.ndk_dtype,
-                        dedup_pulls=(False if args.no_dedup_pulls
-                                     else None), sampler=args.sampler,
-                        rng_impl=args.rng_impl))
+        print(benchmark_json("lda_cli", benchmark(
+            args.docs or 100_000, args.vocab or 50_000, args.topics,
+            args.tokens_per_doc, args.epochs, chunk=args.chunk,
+            algo=args.algo, d_tile=args.d_tile,
+            w_tile=args.w_tile, entry_cap=args.entry_cap,
+            pull_cap=args.pull_cap, ndk_dtype=args.ndk_dtype,
+            dedup_pulls=(False if args.no_dedup_pulls
+                         else None), sampler=args.sampler,
+            rng_impl=args.rng_impl)))
 
 
 if __name__ == "__main__":

@@ -756,6 +756,8 @@ def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
 def main(argv=None):
     import argparse
 
+    from harp_tpu.utils.metrics import benchmark_json
+
     p = argparse.ArgumentParser(description="harp-tpu MF-SGD (edu.iu.sgd parity)")
     p.add_argument("--users", type=int, default=None,
                    help="default: 138493 (ML-20M); with --input, raised to "
@@ -822,15 +824,16 @@ def main(argv=None):
         model.set_ratings(u, i, v)
         rmses = model.fit(args.epochs, args.ckpt_dir,
                           ckpt_every=args.ckpt_every)
-        print({"epochs_run": len(rmses),
+        print(benchmark_json("mfsgd_fit_cli", {"epochs_run": len(rmses),
                "rmse_final": rmses[-1] if rmses else None,
                "nnz": len(u), "users": n_users, "items": n_items,
-               "ckpt_dir": args.ckpt_dir})
+               "ckpt_dir": args.ckpt_dir}))
     else:
-        print(benchmark(args.users or 138_493, args.items or 26_744,
-                        args.nnz, args.rank, args.epochs, chunk=args.chunk,
-                        algo=args.algo, u_tile=args.u_tile,
-                        i_tile=args.i_tile, entry_cap=args.entry_cap))
+        print(benchmark_json("mfsgd_cli", benchmark(
+            args.users or 138_493, args.items or 26_744,
+            args.nnz, args.rank, args.epochs, chunk=args.chunk,
+            algo=args.algo, u_tile=args.u_tile,
+            i_tile=args.i_tile, entry_cap=args.entry_cap)))
 
 
 if __name__ == "__main__":

@@ -675,7 +675,10 @@ def main(argv=None):
         print({"first_loss": hist[0][0], "last_loss": hist[-1][0],
                "train_acc": tr.accuracy(x[:10000], y[:10000])})
     else:
-        print(benchmark(batch=args.batch, steps=args.steps, cfg=cfg))
+        from harp_tpu.utils.metrics import benchmark_json
+
+        print(benchmark_json("mlp_cli", benchmark(
+            batch=args.batch, steps=args.steps, cfg=cfg)))
 
 
 if __name__ == "__main__":

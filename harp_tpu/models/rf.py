@@ -330,7 +330,10 @@ def main(argv=None):
     p.add_argument("--trees", type=int, default=32)
     p.add_argument("--depth", type=int, default=6)
     args = p.parse_args(argv)
-    print(benchmark(args.n, args.features, args.trees, args.depth))
+    from harp_tpu.utils.metrics import benchmark_json
+
+    print(benchmark_json("rf_cli", benchmark(
+        args.n, args.features, args.trees, args.depth)))
 
 
 if __name__ == "__main__":

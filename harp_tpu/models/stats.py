@@ -337,6 +337,8 @@ def main(argv=None):
     per-app launchers collapsed into one (`python -m harp_tpu stats <algo>`)."""
     import argparse
 
+    from harp_tpu.utils.metrics import benchmark_json
+
     p = argparse.ArgumentParser(
         description="harp-tpu classic analytics (edu.iu.daal_* parity)")
     p.add_argument("algo", choices=["pca", "cov", "moments", "naive",
@@ -386,15 +388,15 @@ def main(argv=None):
         x = rng.normal(size=(args.n, args.d)).astype(np.float32)
     if args.algo == "pca":
         _, evals = pca(x)
-        print({"algo": "pca", "top5_evals": np.asarray(evals)[:5].tolist()})
+        print(benchmark_json("stats_cli", {"algo": "pca", "top5_evals": np.asarray(evals)[:5].tolist()}))
     elif args.algo == "cov":
         _, c = covariance(x)
-        print({"algo": "cov", "trace": float(np.trace(np.asarray(c)))})
+        print(benchmark_json("stats_cli", {"algo": "cov", "trace": float(np.trace(np.asarray(c)))}))
     elif args.algo == "moments":
         m = moments(x)
-        print({"algo": "moments",
+        print(benchmark_json("stats_cli", {"algo": "moments",
                "mean_norm": float(np.linalg.norm(np.asarray(m["mean"]))),
-               "var_mean": float(np.mean(np.asarray(m["variance"])))})
+               "var_mean": float(np.mean(np.asarray(m["variance"])))}))
     elif args.algo == "naive":
         if y_file is not None:
             if not np.all(y_file == np.round(y_file)):
@@ -415,7 +417,7 @@ def main(argv=None):
             y, n_classes = rng.integers(0, 4, args.n), 4
         model = naive_bayes_fit(np.abs(x), y, n_classes=n_classes)
         acc = float((naive_bayes_predict(model, np.abs(x)) == y).mean())
-        print({"algo": "naive_bayes", "train_acc": acc})
+        print(benchmark_json("stats_cli", {"algo": "naive_bayes", "train_acc": acc}))
     elif args.algo in ("linreg", "ridge"):
         if y_file is not None:
             y = y_file
@@ -426,15 +428,15 @@ def main(argv=None):
         coef, _intercept = fit(x, y)
         pred = x @ np.asarray(coef) + float(np.asarray(_intercept))
         rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
-        print({"algo": args.algo, "fit_rmse": rmse})
+        print(benchmark_json("stats_cli", {"algo": args.algo, "fit_rmse": rmse}))
     elif args.algo == "qr":
         q, r = tsqr(x)
         resid = float(np.linalg.norm(np.asarray(q) @ np.asarray(r) - x) /
                       np.linalg.norm(x))
-        print({"algo": "tsqr", "rel_resid": resid})
+        print(benchmark_json("stats_cli", {"algo": "tsqr", "rel_resid": resid}))
     elif args.algo == "svd":
         u, s, vt = svd(x)
-        print({"algo": "svd", "top5_sv": np.asarray(s)[:5].tolist()})
+        print(benchmark_json("stats_cli", {"algo": "svd", "top5_sv": np.asarray(s)[:5].tolist()}))
     elif args.algo == "als":
         if args.input:
             users, items, vals = u_in, i_in, v_in
@@ -446,7 +448,7 @@ def main(argv=None):
             vals = rng.normal(size=nnz).astype(np.float32)
             nu, ni = 1000, 500
         _, _, hist = als(users, items, vals, nu, ni, rank=8, iters=3)
-        print({"algo": "als", "rmse_history": [round(h, 4) for h in hist]})
+        print(benchmark_json("stats_cli", {"algo": "als", "rmse_history": [round(h, 4) for h in hist]}))
 
 
 if __name__ == "__main__":

@@ -220,6 +220,8 @@ def benchmark(n=500_000, d=128, mesh=None, seed=0):
 def main(argv=None):
     import argparse
 
+    from harp_tpu.utils.metrics import benchmark_json
+
     p = argparse.ArgumentParser(description="harp-tpu SVM (edu.iu.svm parity)")
     p.add_argument("--n", type=int, default=500_000)
     p.add_argument("--d", type=int, default=128)
@@ -247,10 +249,10 @@ def main(argv=None):
         model = SVM().fit_sparse(ids, vals, mask, y, nf)
         fx = (vals * model.w[ids] * mask).sum(1) + model.b
         acc = float((np.sign(fx) == y).mean())
-        print({"file": args.libsvm, "n": len(labels), "d": nf,
-               "classes": classes.tolist(), "train_acc": acc})
+        print(benchmark_json("svm_fit_cli", {"file": args.libsvm, "n": len(labels), "d": nf,
+               "classes": classes.tolist(), "train_acc": acc}))
     else:
-        print(benchmark(args.n, args.d))
+        print(benchmark_json("svm_cli", benchmark(args.n, args.d)))
 
 
 if __name__ == "__main__":

@@ -247,7 +247,9 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="harp-tpu WDA-MDS (edu.iu.wdamds parity)")
     p.add_argument("--n", type=int, default=4096)
     args = p.parse_args(argv)
-    print(benchmark(args.n))
+    from harp_tpu.utils.metrics import benchmark_json
+
+    print(benchmark_json("wdamds_cli", benchmark(args.n)))
 
 
 if __name__ == "__main__":

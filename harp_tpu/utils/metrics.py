@@ -14,6 +14,8 @@ import logging
 import time
 from typing import Any, IO
 
+import numpy as np
+
 log = logging.getLogger("harp_tpu.metrics")
 
 
@@ -36,3 +38,24 @@ class MetricsLogger:
         if self._fh:
             self._fh.close()
             self._fh = None
+
+
+def benchmark_json(config: str, result: dict) -> str:
+    """One JSON line for a CLI benchmark result.
+
+    Every app CLI prints its benchmark dict through this (round 4): the
+    relay sprint tees CLI output into BENCH_local.jsonl, and a Python
+    dict repr there is an unparseable line every JSONL reader must skip.
+    numpy scalars coerce to plain Python so json never chokes.
+    """
+    def _plain(v: Any):
+        if isinstance(v, (np.floating, float)):
+            return round(float(v), 4)
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        return v
+
+    return json.dumps({"config": config,
+                       **{k: _plain(v) for k, v in result.items()}})

@@ -77,7 +77,9 @@ def test_dispatch_svm_libsvm_file(capsys, tmp_path):
     assert rc == 0
     out = capsys.readouterr().out
     assert "train_acc" in out
-    acc = float(out.split("'train_acc': ")[1].split("}")[0])
+    import json as _json
+
+    acc = _json.loads(out.strip().splitlines()[-1])["train_acc"]
     assert acc > 0.85  # separable-ish data must actually train
 
 
@@ -154,7 +156,7 @@ def test_dispatch_file_inputs(capsys, tmp_path):
     assert cli.main(["kmeans", "--input", str(tmp_path / "pts*.csv"),
                      "--k", "2", "--iters", "2"]) == 0
     out = capsys.readouterr().out
-    assert "'n': 128" in out and "inertia" in out
+    assert '"n": 128' in out and "inertia" in out
 
     # mfsgd: rating triples, dims inferred from ids
     lines = [f"{rng.integers(0, 24)} {rng.integers(0, 16)} {rng.normal():.3f}"
@@ -164,7 +166,7 @@ def test_dispatch_file_inputs(capsys, tmp_path):
                      "--rank", "4", "--epochs", "2",
                      "--u-tile", "8", "--i-tile", "8"]) == 0
     out = capsys.readouterr().out
-    assert "'nnz': 300" in out and "rmse_final" in out
+    assert '"nnz": 300' in out and "rmse_final" in out
 
     # lda: doc-word tokens with a count column (expanded)
     tok = ["0 1 2", "0 3 1", "1 2 3", "2 0 1"]
@@ -188,7 +190,7 @@ def test_dispatch_file_inputs(capsys, tmp_path):
     (tmp_path / "pts_empty.csv").write_text("")
     assert cli.main(["kmeans", "--input", str(tmp_path / "pts*.csv"),
                      "--k", "2", "--iters", "1"]) == 0
-    assert "'n': 128" in capsys.readouterr().out
+    assert '"n": 128' in capsys.readouterr().out
 
     # rating files without a rating column are refused (a silent all-zero
     # fit would look like success)
@@ -296,7 +298,9 @@ def test_stats_file_inputs(capsys, tmp_path):
     stats.main(["linreg", "--input", str(tmp_path / "xy.csv")])
     out = capsys.readouterr().out
     assert "fit_rmse" in out
-    assert float(out.split("'fit_rmse': ")[1].split("}")[0]) < 1e-2
+    import json as _json
+
+    assert _json.loads(out.strip().splitlines()[-1])["fit_rmse"] < 1e-2
 
     # naive bayes with integer labels in the last column
     labels = rng.integers(0, 3, 64).astype(np.float32)
