@@ -1032,14 +1032,19 @@ def main(argv=None):
                                        wire_dtype=wire)
             n_rows, d_cols = int(pts.shape[0]), int(pts.shape[1])
         # JSON, not dict repr: measure_on_relay.sh tees this into a .jsonl
-        print(json.dumps({"k": args.k, "iters": args.iters,
-                          "n": n_rows, "d": d_cols, "files": len(paths),
-                          "inertia": float(inertia)}))
+        from harp_tpu.utils.metrics import benchmark_json
+
+        print(benchmark_json("kmeans_stream_fit_cli",
+                             {"k": args.k, "iters": args.iters,
+                              "n": n_rows, "d": d_cols,
+                              "files": len(paths),
+                              "inertia": float(inertia)}))
     else:
-        print(json.dumps(benchmark_streaming(args.n, args.d, args.k,
-                                             args.iters, args.chunk,
-                                             dtype=dtype,
-                                             quantize=args.quantize)))
+        from harp_tpu.utils.metrics import benchmark_json
+
+        print(benchmark_json("kmeans_stream_cli", benchmark_streaming(
+            args.n, args.d, args.k, args.iters, args.chunk, dtype=dtype,
+            quantize=args.quantize)))
 
 
 if __name__ == "__main__":
