@@ -147,15 +147,56 @@ def run_smoke() -> dict:
                chunk_points=4096, verbose=False)
 
 
+def relay_sized_chunk(cols=300, dtype_bytes=2, default=262_144,
+                      target_s=2.0, bench_path=None) -> int:
+    """Streaming chunk rows sized so ONE H2D dispatch takes ~``target_s``
+    at the MEASURED relay bandwidth (VERDICT r3 item 4: "size
+    kmeans_ingest chunks from the measured relay H2D rate").
+
+    Reads the last ``probe_h2d`` record the sprint teed into
+    BENCH_local.jsonl (largest-probe h2d_mb_s — the sustained rate).
+    The r3 hang was 12 GB of 157 MB chunks through an unmeasured
+    tunnel; a measured-slow relay now gets proportionally smaller
+    dispatches instead of multi-minute ones.  No probe on record →
+    ``default`` (the tuned real-TPU-VM chunk).  Clamped to
+    [16384, default], rounded down to a 8192 multiple.
+    """
+    import json
+
+    path = bench_path or os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_local.jsonl")
+    rate_mb_s = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("config") == "probe_h2d" and row.get("probes"):
+                    rate_mb_s = row["probes"][-1]["h2d_mb_s"]
+    except OSError:
+        pass
+    if not rate_mb_s:
+        return default
+    rows = int(rate_mb_s * target_s * 1e6 / (cols * dtype_bytes))
+    rows = max(16_384, min(default, rows))
+    return (rows // 8192) * 8192
+
+
 def run_full(compare_synthetic: bool = False) -> dict:
     """The ONE full preset shared by bench.py and measure_all: 20M×300
     float16 (12 GB), kept in .bench_data/ for reuse across runs.
     ``compare_synthetic`` adds the device-regenerated compute twin (a
     second full-scale compile + timed run) — measure_all opts in; the
     driver's bench.py skips it to stay well inside its per-config
-    watchdog."""
+    watchdog.  Chunk size follows the measured relay H2D rate when a
+    probe is on record (:func:`relay_sized_chunk`)."""
     return run("npy", 20_000_000, 300, "float16", k=1000, iters=2,
-               chunk_points=262_144, keep=True,
+               chunk_points=relay_sized_chunk(), keep=True,
                compare_synthetic=compare_synthetic)
 
 

@@ -20,7 +20,9 @@ def main():
     import jax
 
     dev = jax.devices()[0]
-    out = {"device": str(dev), "probes": []}
+    # config key: the sprint tees this line into BENCH_local.jsonl, and
+    # bench_ingest reads it back to size its streaming chunks
+    out = {"config": "probe_h2d", "device": str(dev), "probes": []}
     for mb in (1, 16, 64, 157):
         arr = np.random.default_rng(0).standard_normal(
             (mb * 1 << 20) // 2).astype(np.float16)

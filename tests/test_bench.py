@@ -42,7 +42,37 @@ def test_bench_tables_stay_consistent():
     assert {key for _, key in b._CONFIG_KEYS} <= set(b.UNITS)
 
 
-def test_bench_smoke_emits_one_line_with_north_star_pair(mesh):
+def test_relay_sized_chunk_follows_measured_h2d(tmp_path, monkeypatch):
+    """VERDICT r3 item 4: ingest chunks size themselves from the teed
+    probe_h2d record — slow tunnel -> small dispatches; no record or a
+    fast link -> the tuned default."""
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_ingest", os.path.join(os.path.dirname(__file__), "..",
+                                     "scripts", "bench_ingest.py"))
+    bi = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bi)
+
+    fake = tmp_path / "BENCH_local.jsonl"
+
+    def sized(rate_mb_s):
+        fake.write_text(json.dumps(
+            {"config": "probe_h2d",
+             "probes": [{"mb": 157, "h2d_mb_s": rate_mb_s}]}) + "\n")
+        return bi.relay_sized_chunk(bench_path=str(fake))
+
+    # 50 MB/s tunnel -> ~2 s * 50 MB / 600 B per row ~ 166k rows,
+    # rounded down to a 8192 multiple and below the default
+    assert sized(50.0) == (int(50.0 * 2.0 * 1e6 / 600) // 8192) * 8192
+    # fast link -> clamped at the tuned default
+    assert sized(10_000.0) == 262_144
+    # crawling link -> floor, never zero
+    assert sized(0.5) == 16_384
+    # no probe on record -> the tuned default
+    assert bi.relay_sized_chunk(
+        bench_path=str(tmp_path / "missing.jsonl")) == 262_144
     out = _run_bench(["--smoke", "kmeans", "mfsgd"])
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
     assert len(lines) == 1, out
