@@ -73,6 +73,9 @@ def test_relay_sized_chunk_follows_measured_h2d(tmp_path, monkeypatch):
     # no probe on record -> the tuned default
     assert bi.relay_sized_chunk(
         bench_path=str(tmp_path / "missing.jsonl")) == 262_144
+
+
+def test_bench_smoke_emits_one_line_with_north_star_pair(mesh):
     out = _run_bench(["--smoke", "kmeans", "mfsgd"])
     lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
     assert len(lines) == 1, out
