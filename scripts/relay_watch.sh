@@ -15,7 +15,17 @@ while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[relay_watch] relay ANSWERED at $(date -u +%FT%TZ) — sprinting"
     if ./scripts/measure_on_relay.sh; then
-      echo "[relay_watch] sprint done at $(date -u +%FT%TZ) — COMMIT the results"
+      # preserve the window's evidence immediately — the sprint may fire
+      # unattended and the relay history says it can die minutes later.
+      # -f: PROFILE/FLIP artifacts are gitignored as scratch but a
+      # completed sprint's copies are records.  Default flips still go
+      # through a human reading FLIP_DECISIONS + BASELINE.md (the gate
+      # only AUTHORIZES them).
+      git add -f BENCH_local.jsonl FLIP_DECISIONS.jsonl \
+        PROFILE_local.jsonl 2>/dev/null
+      git commit -m "Record the relay-window measurement sprint" \
+        || echo "[relay_watch] nothing new to commit"
+      echo "[relay_watch] sprint done at $(date -u +%FT%TZ) — apply FLIP verdicts + update BASELINE.md"
       exit 0
     fi
     # the documented flapping mode: answered the probe, hung again before
