@@ -33,7 +33,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import glob
 import os
+import re
 import time
 
 import jax
@@ -50,6 +52,7 @@ from harp_tpu.models.mfsgd import (
     _ceil_div,
     _dense_bounds,
     algo_kwargs,
+    carry_tile_switch,
     partition_ratings,
     partition_ratings_tiles,
 )
@@ -460,17 +463,8 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
                         Ndk, Nwk, dNk_acc, db, cur_od = st
                         cd, cw, zc, eo, wo, k = inp
 
-                        def switch(opr):
-                            Ndk, db, cur = opr
-                            new_db = lax.dynamic_slice_in_dim(
-                                Ndk, eo, DR, ax)
-                            Ndk = lax.dynamic_update_slice_in_dim(
-                                Ndk, db, cur, ax)
-                            return Ndk, new_db, eo
-
-                        Ndk, db, cur_od = lax.cond(
-                            eo != cur_od, switch, lambda opr: opr,
-                            (Ndk, db, cur_od))
+                        Ndk, db, cur_od = carry_tile_switch(
+                            Ndk, db, cur_od, eo, DR, ax)
                         Wb = lax.dynamic_slice_in_dim(
                             Nwk, wo, cfg.w_tile, ax)
                         db, Wb, dNk, z_new = core(
@@ -1161,8 +1155,31 @@ def _load_pack(path: str) -> dict:
 def _save_pack(path: str, pack: dict) -> None:
     """Write a pack dict as npz — temp + atomic rename, because the
     sprint is routinely killed mid-config (relay hangs, watchdogs) and a
-    truncated npz at the final path would poison every later cache hit."""
-    tmp_path = path + ".tmp"
+    truncated npz at the final path would poison every later cache hit.
+    The tmp name is per-process so a manual prewarm racing a watcher-fired
+    sprint can't interleave writes into one tmp file (ADVICE r4); stale
+    tmp siblings from killed writers are swept first so watchdog kills
+    don't accumulate orphaned multi-hundred-MB partials."""
+    # legacy constant-name orphans (pre-ADVICE-r4 writers) have no owner
+    # pid: always stale, sweep unconditionally
+    for stale in (path + ".tmp", path + ".tmp.npz"):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    for stale in glob.glob(glob.escape(path) + ".*.tmp*"):
+        m = re.search(r"\.(\d+)\.tmp", stale)
+        try:
+            if m and int(m.group(1)) != os.getpid():
+                os.kill(int(m.group(1)), 0)  # raises if writer is dead
+        except ProcessLookupError:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        except OSError:
+            pass  # can't signal (perms): assume live, leave it
+    tmp_path = f"{path}.{os.getpid()}.tmp"
     np.savez(tmp_path, z_grid=pack["z_grid"], Ndk=pack["Ndk"],
              Nwk=pack["Nwk"], Nk=pack["Nk"], n_tokens=pack["n_tokens"],
              **{f"tok{i}": a for i, a in enumerate(pack["tokens"])})

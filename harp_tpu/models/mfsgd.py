@@ -345,6 +345,25 @@ def _entry_tiles_update(Wb, Hb, cu, ci, cv, cfg: MFSGDConfig):
             (err * err).sum(), cm.sum())
 
 
+def carry_tile_switch(table, tile, cur, new_off, size, ax):
+    """Run-carry tile switch shared by MF-SGD ``carry_w`` and LDA
+    ``carry_db``: on an offset change, flush the carried tile back into
+    the table BEFORE slicing the new region, so the result equals the
+    slice-per-entry path even for overlapping (non-tile-aligned) offsets
+    — not just the aligned ones current partitioners emit (ADVICE r4;
+    overlap pinned by test_carry_w_exact_for_overlapping_tile_offsets).
+    An unchanged offset pays zero tile HBM traffic via the ``lax.cond``.
+    """
+    def switch(opr):
+        table, tile, cur = opr
+        table = lax.dynamic_update_slice_in_dim(table, tile, cur, ax)
+        new = lax.dynamic_slice_in_dim(table, new_off, size, ax)
+        return table, new, new_off
+
+    return lax.cond(new_off != cur, switch, lambda opr: opr,
+                    (table, tile, cur))
+
+
 def _tile_block_update(W, H, block, cfg: MFSGDConfig):
     """Scan dense-tile entries of one (user-range × item-half-slice) block.
 
@@ -368,14 +387,7 @@ def _tile_block_update(W, H, block, cfg: MFSGDConfig):
             W, H, se, cnt, wb, cur = carry
             cu, ci, cv, tou, toi = xs
 
-            def switch(opr):
-                W, wb, cur = opr
-                new_wb = lax.dynamic_slice_in_dim(W, tou, UR, 0)
-                W = lax.dynamic_update_slice_in_dim(W, wb, cur, 0)
-                return W, new_wb, tou
-
-            W, wb, cur = lax.cond(tou != cur, switch, lambda opr: opr,
-                                  (W, wb, cur))
+            W, wb, cur = carry_tile_switch(W, wb, cur, tou, UR, 0)
             Hb = lax.dynamic_slice_in_dim(H, toi, IR, 0)
             wb, Hb, dse, dcnt = _entry_tiles_update(wb, Hb, cu, ci, cv, cfg)
             H = lax.dynamic_update_slice_in_dim(H, Hb, toi, 0)

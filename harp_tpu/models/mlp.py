@@ -668,15 +668,18 @@ def main(argv=None):
     p.add_argument("--train", action="store_true", help="2-epoch training demo")
     args = p.parse_args(argv)
     cfg = MLPConfig(optimizer=args.optimizer, half_precision=args.bf16)
+    from harp_tpu.utils.metrics import benchmark_json
+
     if args.train:
         x, y = synthetic_mnist()
         tr = MLPTrainer(cfg)
         hist = tr.fit(x, y, batch_size=args.batch, epochs=2)
-        print({"first_loss": hist[0][0], "last_loss": hist[-1][0],
-               "train_acc": tr.accuracy(x[:10000], y[:10000])})
+        # one-line JSON like every other CLI branch, so a teed line is a
+        # parseable BENCH_local.jsonl row (ADVICE r4)
+        print(benchmark_json("mlp_fit_cli", {
+            "first_loss": float(hist[0][0]), "last_loss": float(hist[-1][0]),
+            "train_acc": float(tr.accuracy(x[:10000], y[:10000]))}))
     else:
-        from harp_tpu.utils.metrics import benchmark_json
-
         print(benchmark_json("mlp_cli", benchmark(
             batch=args.batch, steps=args.steps, cfg=cfg)))
 

@@ -486,3 +486,91 @@ _r4v2 = _r4fd.decide(
 assert _r4v2["flip"]  # 1.5x at equal quality → flips
 print("flip gate: degraded refused, equal-quality 1.5x flips")
 print(f"DRIVE OK round-16 ({mode})")
+
+# 22. round 5 (this session): ADVICE r4 fixes through the public surface.
+# (a) the shared carry_tile_switch stays exact for OVERLAPPING
+# (non-tile-aligned) offsets — carry vs slice-per-entry bit-identical on
+# a hand-built block whose u-runs overlap (0 -> 4 -> 0 with u_tile=8);
+from harp_tpu.models import mfsgd as _R5M
+
+_r5rng = np.random.default_rng(11)
+_r5blk = (jnp.asarray(_r5rng.integers(0, 8, (5, 4)).astype(np.int32)),
+          jnp.asarray(_r5rng.integers(0, 8, (5, 4)).astype(np.int32)),
+          jnp.asarray(_r5rng.normal(size=(5, 4)).astype(np.float32)),
+          jnp.asarray(np.array([0, 0, 4, 4, 0], np.int32)),
+          jnp.asarray(np.array([0, 8, 0, 8, 0], np.int32)))
+_r5W0 = _r5rng.normal(size=(24, 3)).astype(np.float32)
+_r5H0 = _r5rng.normal(size=(16, 3)).astype(np.float32)
+_r5out = {}
+for _r5c in (False, True):
+    _r5cfg = _R5M.MFSGDConfig(rank=3, algo="dense", u_tile=8, i_tile=8,
+                              entry_cap=4, compute_dtype=jnp.float32,
+                              lr=0.05, reg=0.01, carry_w=_r5c)
+    _r5out[_r5c] = jax.jit(
+        lambda W, H, b, c=_r5cfg: _R5M._tile_block_update(W, H, b, c))(
+        jnp.asarray(_r5W0), jnp.asarray(_r5H0), _r5blk)
+for _a, _b in zip(_r5out[False], _r5out[True]):
+    np.testing.assert_array_equal(np.asarray(_a), np.asarray(_b))
+print("carry_tile_switch exact for overlapping offsets (bit-identical)")
+
+# (b) the flip gate refuses a MIXED metric basis (ex-gen vs end-to-end);
+_r5spec = _r4fd.CANDIDATES["kmeans_stream_int8"]
+_r5v = _r4fd.decide(
+    {"iters_per_sec": 0.9, "iters_per_sec_ex_gen": 2.2, "inertia": 1e10},
+    {"iters_per_sec": 0.53, "inertia": 1e10}, _r5spec)
+assert not _r5v["flip"] and _r5v["speedup"] is None
+assert "mixed" in _r5v["reason"]
+print("flip gate: mixed metric basis refused")
+
+# (c) _save_pack sweeps dead writers' tmp orphans, survives a racing
+# live-pid tmp, and round-trips the pack;
+import subprocess as _r5sp
+import tempfile as _r5tf
+
+from harp_tpu.models.lda import _load_pack as _r5load
+from harp_tpu.models.lda import _save_pack as _r5save
+
+with _r5tf.TemporaryDirectory() as _r5d:
+    _r5p = _r4os.path.join(_r5d, "pack.npz")
+    # a guaranteed-dead pid: a reaped child (999999 could be live under
+    # a large kernel.pid_max)
+    _r5dead = _r5sp.Popen(["true"])
+    _r5dead.wait()
+    open(f"{_r5p}.{_r5dead.pid}.tmp.npz", "w").close()  # dead pid: swept
+    open(_r5p + ".tmp.npz", "w").close()              # legacy name: swept
+    # a LIVE foreign writer (sleeping child): its tmp must survive
+    _r5alive = _r5sp.Popen(["sleep", "30"])
+    _r5live = f"{_r5p}.{_r5alive.pid}.tmp.npz"
+    open(_r5live, "w").close()
+    _r5pack = {"tokens": (np.arange(6, dtype=np.int32),),
+               "z_grid": np.zeros((2, 3), np.int32),
+               "Ndk": np.ones((2, 2), np.int32),
+               "Nwk": np.ones((2, 2), np.int32),
+               "Nk": np.ones((2,), np.int32), "n_tokens": 6}
+    _r5save(_r5p, _r5pack)
+    assert not _r4os.path.exists(f"{_r5p}.{_r5dead.pid}.tmp.npz")
+    assert not _r4os.path.exists(_r5p + ".tmp.npz")
+    assert _r4os.path.exists(_r5live)                 # live writer kept
+    _r5alive.kill()
+    _r5alive.wait()
+    _r5back = _r5load(_r5p)
+    assert _r5back["n_tokens"] == 6
+    np.testing.assert_array_equal(_r5back["tokens"][0], _r5pack["tokens"][0])
+print("_save_pack: dead-writer tmp swept, pack round-trips")
+
+# (d) the mlp fit CLI emits one parseable JSON line (ADVICE r4 #5).
+import contextlib as _r5ctx
+import io as _r5io
+import json as _r5json
+
+from harp_tpu.models import mlp as _R5mlp
+
+_r5buf = _r5io.StringIO()
+with _r5ctx.redirect_stdout(_r5buf):
+    _R5mlp.main(["--train", "--batch", "256"])
+_r5rows = [_r5json.loads(ln) for ln in _r5buf.getvalue().splitlines()
+           if ln.strip()]
+assert any(r.get("config") == "mlp_fit_cli" and "train_acc" in r
+           for r in _r5rows)
+print("mlp --train CLI emits parseable mlp_fit_cli JSON")
+print(f"DRIVE OK round-17 ({mode})")

@@ -42,6 +42,30 @@ def test_bench_tables_stay_consistent():
     assert {key for _, key in b._CONFIG_KEYS} <= set(b.UNITS)
 
 
+def test_last_measured_uses_declared_config_key(tmp_path):
+    # ADVICE r4: a kmeans_ingest row carries iters_per_sec AND
+    # points_per_sec; _last_measured must report the config's DECLARED
+    # headline (points/s), not the first UNITS hit (iter/s)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    (tmp_path / "BENCH_local.jsonl").write_text(json.dumps(
+        {"config": "kmeans_ingest", "iters_per_sec": 3.0,
+         "points_per_sec": 5.5e7, "date": "2026-08-01"}) + "\n")
+    b.__dict__["__file__"] = str(tmp_path / "bench.py")
+    lm = b._last_measured()
+    assert lm["kmeans_ingest"]["unit"] == "points/s"
+    assert lm["kmeans_ingest"]["value"] == 5.5e7
+    # unknown configs still fall back to the UNITS scan
+    (tmp_path / "BENCH_local.jsonl").write_text(json.dumps(
+        {"config": "mystery", "trees_per_sec": 2.0,
+         "date": "2026-08-01"}) + "\n")
+    lm = b._last_measured()
+    assert lm["mystery"]["unit"] == "trees/s"
+
+
 def test_relay_sized_chunk_follows_measured_h2d(tmp_path, monkeypatch):
     """VERDICT r3 item 4: ingest chunks size themselves from the teed
     probe_h2d record — slow tunnel -> small dispatches; no record or a

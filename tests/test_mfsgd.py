@@ -265,6 +265,43 @@ def test_carry_w_bit_identical_chain(mesh):
     np.testing.assert_array_equal(out[True][2], out[False][2])
 
 
+def test_carry_w_exact_for_overlapping_tile_offsets():
+    """Pin the ADVICE r4 fix: the carry switch flushes the old tile BEFORE
+    slicing the new region, so carry vs slice-per-entry stays bit-identical
+    even for OVERLAPPING (non-tile-aligned) offsets no current partitioner
+    emits.  Reverting to slice-before-flush makes offset 4 read rows 4..7
+    stale after the offset-0 run updated them, and this fails."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    UR = IR = 8
+    cap = 4
+    W0 = rng.normal(size=(24, 3)).astype(np.float32)
+    H0 = rng.normal(size=(16, 3)).astype(np.float32)
+    # u-runs at offsets 0 → 4 → 0: both transitions overlap the prior tile
+    ou = np.array([0, 0, 4, 4, 0], np.int32)
+    oi = np.array([0, 8, 0, 8, 0], np.int32)
+    eu = rng.integers(0, UR, (5, cap)).astype(np.int32)
+    ei = rng.integers(0, IR, (5, cap)).astype(np.int32)
+    ev = rng.normal(size=(5, cap)).astype(np.float32)
+    block = (jnp.asarray(eu), jnp.asarray(ei), jnp.asarray(ev),
+             jnp.asarray(ou), jnp.asarray(oi))
+    out = {}
+    for carry in (False, True):
+        cfg = MF.MFSGDConfig(rank=3, algo="dense", u_tile=UR, i_tile=IR,
+                             entry_cap=cap, compute_dtype=jnp.float32,
+                             lr=0.05, reg=0.01, carry_w=carry)
+        W, H, se, cnt = jax.jit(
+            lambda W, H, b: MF._tile_block_update(W, H, b, cfg))(
+            jnp.asarray(W0), jnp.asarray(H0), block)
+        out[carry] = (np.asarray(W), np.asarray(H),
+                      float(se), float(cnt))
+    np.testing.assert_array_equal(out[True][0], out[False][0])
+    np.testing.assert_array_equal(out[True][1], out[False][1])
+    assert out[True][2:] == out[False][2:]
+
+
 def test_carry_w_rejects_non_dense_algos():
     import pytest
 
