@@ -81,6 +81,7 @@ def _last_measured():
     out = {}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_local.jsonl")
+    declared_by_cfg = dict(_CONFIG_KEYS)
     try:
         with open(path) as f:
             for line in f:
@@ -93,11 +94,19 @@ def _last_measured():
                     continue
                 if row.get("smoke") or row.get("backend") == "cpu":
                     continue
-                for key, unit in UNITS.items():
+                cfg = row.get("config", "?")
+                # the config's DECLARED headline key first (a kmeans_ingest
+                # row carries iters_per_sec too; reporting that would swap
+                # the points/s headline for iter/s — ADVICE r4); the UNITS
+                # scan is only for configs _CONFIG_KEYS doesn't know
+                declared = declared_by_cfg.get(cfg)
+                keys = [declared] if declared else list(UNITS)
+                for key in keys:
                     if row.get(key) is not None:
                         # later rows overwrite earlier: last measurement wins
-                        out[row.get("config", "?")] = {
-                            "value": round(float(row[key]), 2), "unit": unit,
+                        out[cfg] = {
+                            "value": round(float(row[key]), 2),
+                            "unit": UNITS[key],
                             "date": row.get("date"),
                             "source": "BENCH_local.jsonl"}
                         break
@@ -241,6 +250,13 @@ def main():
     from harp_tpu.utils.timing import HangWatchdog
 
     smoke = "--smoke" in sys.argv
+    if "--cpu" in sys.argv:
+        # rehearsal hook (measure_on_relay.sh --rehearse): the axon site
+        # pin would otherwise send even --smoke runs to the TPU relay,
+        # which can hang (CLAUDE.md); the relay probe auto-skips on cpu
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     only = [a for a in sys.argv[1:] if not a.startswith("-")]
     unknown = set(only) - set(BASELINES)
     if unknown:

@@ -67,7 +67,17 @@ CANDIDATES = {
     "lda_pallas_approx": {
         "incumbent": "lda_pallas", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
-        "flips": "LDAConfig.pallas_exact_gathers=False"},
+        "flips": "LDAConfig.pallas_exact_gathers=False (ALSO requires the "
+                 "lda_pallas_approx_hot LL gate)"},
+    # VERDICT r4 item 7: the same knob gated at a >256-count shape where
+    # bf16 gather rounding CAN show in the LL (default sweep counts are
+    # double-digit — there the quality gate passes vacuously).  The knob
+    # flips only if BOTH this and lda_pallas_approx say flip.
+    "lda_pallas_approx_hot": {
+        "incumbent": "lda_pallas_hot", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.pallas_exact_gathers=False (hot-count LL gate; "
+                 "flip only together with lda_pallas_approx)"},
     # VERDICT r3 item 2's Db-carry, bit-identical chain by construction
     # (same tile cores, tested) — the gate still demands the quality
     # field so a broken carry can't slip through on speed alone
@@ -105,12 +115,28 @@ CANDIDATES = {
 
 WIN_THRESHOLD = 1.10  # "wins >=10%" half of the rule
 
+# candidate groups flipping the SAME knob: all must flip or none does
+# (main() enforces this after per-candidate verdicts)
+JOINT_GATES = [("lda_pallas_approx", "lda_pallas_approx_hot")]
 
-def _metric_value(row, spec):
-    v = row.get(spec["metric"])
-    if v is None and "metric_fallback" in spec:
-        v = row.get(spec["metric_fallback"])
-    return v
+
+def _metric_key(candidate_row, incumbent_row, spec):
+    """Pick ONE metric key valid for BOTH rows, or None.
+
+    The fallback applies only when BOTH rows lack the primary metric —
+    dividing an ex-gen rate by an end-to-end rate (mixed basis) would
+    overstate the speedup the gate authorizes (ADVICE r4), so a mixed
+    pair refuses like the missing-quality path does.
+    """
+    primary = spec["metric"]
+    has_c = candidate_row.get(primary) is not None
+    has_i = incumbent_row.get(primary) is not None
+    if has_c and has_i:
+        return primary
+    fb = spec.get("metric_fallback")
+    if fb and not has_c and not has_i:
+        return fb
+    return None
 
 
 def decide(candidate_row: dict, incumbent_row: dict, spec: dict) -> dict:
@@ -129,10 +155,14 @@ def decide(candidate_row: dict, incumbent_row: dict, spec: dict) -> dict:
         if "error" in row:
             out["reason"] = f"{which} row is an error record — refusing flip"
             return out
-    cv, iv = _metric_value(candidate_row, spec), _metric_value(
-        incumbent_row, spec)
+    key = _metric_key(candidate_row, incumbent_row, spec)
+    if key is None:
+        out["reason"] = (f"metric {spec['metric']} missing or on mixed "
+                         "basis across the pair — refusing flip")
+        return out
+    cv, iv = candidate_row.get(key), incumbent_row.get(key)
     if not cv or not iv:
-        out["reason"] = f"metric {spec['metric']} missing — refusing flip"
+        out["reason"] = f"metric {key} missing — refusing flip"
         return out
     out["speedup"] = round(float(cv) / float(iv), 4)
     cq, iq = candidate_row.get(spec["quality"]), incumbent_row.get(
@@ -207,14 +237,34 @@ def main(argv=None):
     args = p.parse_args(argv)
     rows = latest_rows(args.bench)
     undecidable = 0
+    verdicts = {}
     for name, spec in CANDIDATES.items():
         if args.only and name not in args.only:
             continue
-        verdict = decide(rows.get(name), rows.get(spec["incumbent"]), spec)
+        verdicts[name] = decide(rows.get(name), rows.get(spec["incumbent"]),
+                                spec)
+    # joint gates IN CODE, not prose: candidates flipping the same knob
+    # must ALL say flip, or none does ("apply the FLIP lines above" must
+    # stay safe to follow mechanically — review finding, round 5)
+    for group in JOINT_GATES:
+        present = [n for n in group if n in verdicts]
+        if len(present) < 2:
+            continue  # --only selected one half; its line stands alone
+        if not all(verdicts[n]["flip"] for n in present):
+            for n in present:
+                if verdicts[n]["flip"]:
+                    verdicts[n]["flip"] = False
+                    verdicts[n]["reason"] = (
+                        "joint gate: " + verdicts[n]["reason"] +
+                        " — BUT partner gate(s) "
+                        f"{[m for m in present if m != n]} refused; "
+                        "the knob flips only if every gate flips")
+    for name, verdict in verdicts.items():
         if verdict["speedup"] is None or verdict["quality_ok"] is None:
             undecidable += 1
         print(json.dumps({"flip_decision": name,
-                          "incumbent": spec["incumbent"], **verdict}))
+                          "incumbent": CANDIDATES[name]["incumbent"],
+                          **verdict}))
     return 1 if undecidable else 0
 
 

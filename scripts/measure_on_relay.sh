@@ -3,74 +3,125 @@
 #
 # Probes the relay (bounded, per CLAUDE.md: never block on it), then runs
 # the full measurement checklist from BASELINE.md's outage list:
-#   1. scripts/measure_all.py  → BENCH_local.jsonl (all graded configs +
-#      round-3 candidates: mfsgd_pallas, lda_exprace/lda_fast/lda_pallas;
-#      roofline annotations; per-config watchdog)
-#   2. bench.py                → one driver-contract JSON line
+#   1. scripts/measure_all.py  → BENCH_local.jsonl (candidates FIRST —
+#      the sweep order prices relay scarcity, VERDICT r4 weak #3 — then
+#      incumbent re-measures; roofline annotations; per-config watchdog)
+#   2. scripts/flip_decision.py → FLIP_DECISIONS.jsonl (run right after
+#      the sweep AND again at the end: a relay death in a later step
+#      must not cost the sprint its verdicts)
+#   3. bench.py                → one driver-contract JSON line
 # Each step is watchdogged (HARP_BENCH_TIMEOUT, default 1200 s/config), so
 # a relay that dies mid-sweep still leaves parseable partial records.
 # After it finishes: update BASELINE.md rows from BENCH_local.jsonl and
 # commit immediately (the relay can die again).
+#
+# --rehearse: run the WHOLE protocol end-to-end on the CPU backend with
+# smoke shapes (VERDICT r4 weak #2: the integrated pipeline must have run
+# once before a scarce relay window pays for it).  Sweep records go to
+# BENCH_rehearsal.jsonl (never BENCH_local.jsonl); flip decisions run
+# against the real committed BENCH_local.jsonl rows, so the rehearsal
+# produces a genuine FLIP_DECISIONS.jsonl from existing TPU data.
+# Relay-only steps (H2D probe, prewarm, 1B run, traces, wire sweep) print
+# an explicit skip line so the rehearsal log shows the full sequence.
 
 set -u
 cd "$(dirname "$0")/.."
-# NB: grep -vc prints the 0 AND exits 1 on zero matches — no `|| echo 0`
-# (that would yield "0\n0" and break the arithmetic below)
-start_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null)
-start_ok=${start_ok:-0}
 
-echo "== probing relay (45 s bound) =="
-if ! timeout 45 python -c "import jax; print(jax.devices())"; then
-  echo "relay not answering — try again later (poll, don't block)" >&2
-  exit 1
+REHEARSE=""
+if [ "${1:-}" = "--rehearse" ]; then
+  REHEARSE=1
+  OUT=BENCH_rehearsal.jsonl
+  SWEEP_FLAGS="--smoke --platform cpu"
+  EQUIV_ARGS="cpu8"
+  # required new-record count scales with the gate below
+  MIN_NEW=5
+  echo "== REHEARSAL: CPU backend, smoke shapes, out=${OUT} =="
+else
+  OUT=BENCH_local.jsonl
+  SWEEP_FLAGS=""
+  EQUIV_ARGS=""
+  MIN_NEW=5
 fi
 
-echo "== raw H2D/D2H bandwidth over the relay (kmeans_ingest diagnosis) =="
-timeout 600 python scripts/probe_h2d.py | tee -a BENCH_local.jsonl
+# NB: grep -vc prints the 0 AND exits 1 on zero matches — no `|| echo 0`
+# (that would yield "0\n0" and break the arithmetic below)
+start_ok=$(grep -vc '"error"' "$OUT" 2>/dev/null)
+start_ok=${start_ok:-0}
 
-echo "== prewarm host-side caches OUTSIDE any watchdog =="
-# 12 GB ingest npy took 864 s and the enwiki-1M LDA pack ~675 s on this
-# 1-core host (2026-07-31) — the sweep configs must only pay device
-# time.  Idempotent: instant when scripts/prewarm_bench_cache.py was
-# already run during the outage (recommended).
-python scripts/prewarm_bench_cache.py
+if [ -z "$REHEARSE" ]; then
+  echo "== probing relay (45 s bound) =="
+  if ! timeout 45 python -c "import jax; print(jax.devices())"; then
+    echo "relay not answering — try again later (poll, don't block)" >&2
+    exit 1
+  fi
 
-echo "== kernel equivalence ON SILICON before any pallas row (ADVICE r3) =="
+  echo "== raw H2D/D2H bandwidth over the relay (kmeans_ingest diagnosis) =="
+  timeout 600 python scripts/probe_h2d.py | tee -a "$OUT"
+
+  echo "== prewarm host-side caches OUTSIDE any watchdog =="
+  # 12 GB ingest npy took 864 s and the enwiki-1M LDA pack ~675 s on this
+  # 1-core host (2026-07-31) — the sweep configs must only pay device
+  # time.  Idempotent: instant when scripts/prewarm_bench_cache.py was
+  # already run during the outage (recommended).
+  python scripts/prewarm_bench_cache.py
+else
+  echo "== [rehearse] relay probe skipped (CPU backend) =="
+  echo "== [rehearse] H2D probe skipped (relay-only) =="
+  echo "== [rehearse] prewarm skipped (smoke shapes need no packs) =="
+fi
+
+echo "== kernel equivalence BEFORE any pallas row (ADVICE r3) =="
 # interpret mode + Mosaic lowering can't prove compiled-mode buffer
 # revisions; execute pallas==dense/XLA on the chip first, and refuse to
 # record pallas rows if it fails
-if timeout 900 python scripts/kernel_equiv_check.py; then
+if timeout 900 python scripts/kernel_equiv_check.py ${EQUIV_ARGS}; then
   SKIP_PALLAS=""
 else
   # EVERY config gated on the equivalence check: all Pallas-kernel
-  # configs (the approx/carry LDA variants run the same unverified
+  # configs (the approx/carry/hot LDA variants run the same unverified
   # kernel) AND lda_carry (the check also proves carry_db == baseline
   # on this backend; a divergent carry must not record either)
-  SKIP_PALLAS="--skip mfsgd_pallas mfsgd_carry lda_pallas lda_pallas_approx lda_pallas_carry lda_carry kmeans_int8_fused"
+  SKIP_PALLAS="--skip mfsgd_pallas mfsgd_carry lda_pallas lda_pallas_approx lda_pallas_hot lda_pallas_approx_hot lda_pallas_carry lda_carry kmeans_int8_fused"
   echo "kernel_equiv_check FAILED — gated configs skipped this sprint" >&2
 fi
 
-echo "== full graded sweep → BENCH_local.jsonl =="
-python scripts/measure_all.py --out BENCH_local.jsonl ${SKIP_PALLAS}
+echo "== full graded sweep → ${OUT} (candidates FIRST) =="
+# measure_all's internal order prices scarcity (VERDICT r4 weak #3):
+# unmeasured candidates, then incumbent re-measures, then ladder shapes
+python scripts/measure_all.py --out "$OUT" ${SWEEP_FLAGS} ${SKIP_PALLAS}
 
-echo "== driver bench line =="
-python bench.py | tee -a BENCH_local.jsonl
+echo "== default-flip decisions, first pass (before anything else can die) =="
+# a relay death in any LATER step must not cost the sprint its verdicts;
+# re-run at the end with full data — this file is overwritten then.
+# Always reads the committed BENCH_local.jsonl: in rehearsal that makes
+# the verdicts REAL (existing TPU rows), and smoke/CPU rows can never
+# authorize a flip anyway (latest_rows skips them).
+python scripts/flip_decision.py | tee FLIP_DECISIONS.jsonl || true
 
-echo "== 1B-point formulation (2 epochs, ~minutes) =="
-python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
-  | tee -a BENCH_local.jsonl
+if [ -z "$REHEARSE" ]; then
+  echo "== driver bench line =="
+  python bench.py | tee -a "$OUT"
 
-# subgraph overflow-tail A/B (r2 item 7) now runs INSIDE the sweep as
-# subgraph_onehot / subgraph_1m_onehot — proper config-named JSONL rows
-# that flip_decision.py can compare (the old CLI tee wrote dict-reprs)
+  echo "== 1B-point formulation (2 epochs, ~minutes) =="
+  python -m harp_tpu kmeans-stream --n 1000000000 --iters 2 \
+    | tee -a "$OUT"
 
-echo "== per-config op-breakdown traces (self-time; fast configs only) =="
-timeout 2400 python scripts/profile_on_relay.py --out PROFILE_local.jsonl \
-  || echo "profile pass died (relay?) — partial PROFILE_local.jsonl kept"
+  # subgraph overflow-tail A/B (r2 item 7) runs INSIDE the sweep as
+  # subgraph_onehot / subgraph_1m_onehot — proper config-named JSONL rows
+  # that flip_decision.py can compare (the old CLI tee wrote dict-reprs)
 
-echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
-python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
-  | tee -a BENCH_local.jsonl
+  echo "== per-config op-breakdown traces (self-time; fast configs only) =="
+  timeout 2400 python scripts/profile_on_relay.py --out PROFILE_local.jsonl \
+    || echo "profile pass died (relay?) — partial PROFILE_local.jsonl kept"
+
+  echo "== sparse pull/push capacity-vs-skew table (TPU wire timings) =="
+  python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
+    | tee -a "$OUT"
+else
+  echo "== [rehearse] driver bench line (smoke, CPU) =="
+  python bench.py --smoke --cpu | tee -a "$OUT"
+  echo "== [rehearse] 1B run / traces / wire sweep skipped (relay-only) =="
+fi
 
 # Success = the sweep actually produced records AND the relay still
 # answers (per-config watchdogs os._exit the python steps on a hang but
@@ -79,19 +130,21 @@ python -m harp_tpu bench --sparse-capacity-sweep --reps 5 \
 # would stop watching).
 # count only REAL measurements: watchdogged steps append {"error": ...}
 # records, which must not satisfy the success gate
-total_ok=$(grep -vc '"error"' BENCH_local.jsonl 2>/dev/null)
+total_ok=$(grep -vc '"error"' "$OUT" 2>/dev/null)
 total_ok=${total_ok:-0}
 new_ok=$(( total_ok - start_ok ))
-if [ "$new_ok" -lt 5 ]; then
-  echo "sprint FAILED: only ${new_ok} new error-free records in BENCH_local.jsonl" >&2
+if [ "$new_ok" -lt "$MIN_NEW" ]; then
+  echo "sprint FAILED: only ${new_ok} new error-free records in ${OUT}" >&2
   exit 1
 fi
-if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-  echo "sprint DEGRADED: relay stopped answering before the end" >&2
-  exit 1
+if [ -z "$REHEARSE" ]; then
+  if ! timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "sprint DEGRADED: relay stopped answering before the end" >&2
+    exit 1
+  fi
 fi
 
-echo "== default-flip decisions (>=10% at equal quality, gate in code) =="
+echo "== default-flip decisions, final (>=10% at equal quality, in code) =="
 # prints one verdict JSON line per candidate; exit 1 (undecidable rows)
 # is informational here — the sprint itself still succeeded
 python scripts/flip_decision.py | tee FLIP_DECISIONS.jsonl || true
