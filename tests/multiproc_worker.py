@@ -53,12 +53,13 @@ nw = mesh.num_workers
 assert nw == n_procs * local_devices, (nw, n_procs, local_devices)
 
 
-def check_global(arr, expected):
+def check_global(arr, expected, rtol=1e-7, atol=0.0):
     """Validate every shard THIS process can address against the expected
     global array — works for any sharding and any devices-per-process."""
     expected = np.asarray(expected)
     for sh in arr.addressable_shards:
-        np.testing.assert_allclose(np.asarray(sh.data), expected[sh.index])
+        np.testing.assert_allclose(np.asarray(sh.data), expected[sh.index],
+                                   rtol=rtol, atol=atol)
 
 
 # device collective across the process boundary
@@ -265,5 +266,104 @@ tp_loss, tp_acc = tp.train_batch(xz, yz)
 dp_loss, dp_acc = dp.train_batch(xz, yz)
 assert abs(tp_loss - dp_loss) < 1e-4, (tp_loss, dp_loss)
 assert abs(tp_acc - dp_acc) < 1e-6, (tp_acc, dp_acc)
+
+# --- VERDICT r4 item 6: the remaining parallelism strategies cross the
+# same real process boundary the verbs/ZeRO-1/TP already do ---
+
+# pipeline parallelism: one GPipe loss+grad step — activations hop the
+# stage ring via rotate/ppermute, so every microbatch crosses the
+# process link (and intra-process segments, pod-shaped) S+M-1 times;
+# loss AND per-stage grads must match the serial host chain rule
+from harp_tpu.parallel.pipeline import pipeline_loss_and_grads
+
+PW, PMB, PM = 8, 2, 3  # width, microbatch, n_microbatches
+pp_rng = np.random.default_rng(40)
+pp_params = {"w": (pp_rng.normal(size=(nw, PW, PW)) * 0.5).astype(np.float32),
+             "b": (pp_rng.normal(size=(nw, PW)) * 0.1).astype(np.float32)}
+px = pp_rng.normal(size=(PM, PMB, PW)).astype(np.float32)
+pt = pp_rng.normal(size=(PM, PMB, PW)).astype(np.float32)
+
+
+def pp_stage(params, h):
+    return jax.nn.tanh(h @ params["w"] + params["b"])
+
+
+def pp_loss(outs, targets):
+    return ((outs - targets) ** 2).mean()
+
+
+pp_fn = jax.jit(mesh.shard_map(
+    lambda p, xx, tt: pipeline_loss_and_grads(
+        pp_stage, pp_loss, jax.tree_util.tree_map(lambda a: a[0], p),
+        xx, tt),
+    in_specs=({"w": mesh.spec(0), "b": mesh.spec(0)}, P(), P()),
+    out_specs=(P(), {"w": mesh.spec(0), "b": mesh.spec(0)})))
+pp_l, pp_g = pp_fn(pp_params, px, pt)
+
+
+def pp_serial_loss(p):
+    outs = []
+    for i in range(PM):
+        h = jnp.asarray(px[i])
+        for s in range(nw):
+            h = pp_stage({"w": p["w"][s], "b": p["b"][s]}, h)
+        outs.append(h)
+    return pp_loss(jnp.stack(outs), jnp.asarray(pt))
+
+
+pp_ref_l, pp_ref_g = jax.value_and_grad(pp_serial_loss)(
+    jax.tree_util.tree_map(jnp.asarray, pp_params))
+lz = np.asarray(pp_l.addressable_shards[0].data)
+assert abs(float(lz) - float(pp_ref_l)) < 1e-5, (lz, pp_ref_l)
+# shard_map concatenated per-stage grads along dim 0 (see test_pipeline)
+check_global(pp_g["w"], np.asarray(pp_ref_g["w"]).reshape(nw * PW, PW),
+             rtol=1e-4, atol=1e-6)
+check_global(pp_g["b"], np.asarray(pp_ref_g["b"]).reshape(nw * PW),
+             rtol=1e-4, atol=1e-6)
+
+# expert-parallel MoE: the regroup (all_to_all) dispatch + inverse
+# exchange cross the process link; capacity sized so nothing drops
+from harp_tpu.ops.moe import moe_ffn, reference_moe
+
+MD, MH = 8, 16
+moe_rng = np.random.default_rng(41)
+moe_w = {"gate": moe_rng.normal(size=(MD, nw)).astype(np.float32),
+         "w1": (moe_rng.normal(size=(nw, MD, MH)) * 0.5).astype(np.float32),
+         "b1": (moe_rng.normal(size=(nw, MH)) * 0.1).astype(np.float32),
+         "w2": (moe_rng.normal(size=(nw, MH, MD)) * 0.5).astype(np.float32),
+         "b2": (moe_rng.normal(size=(nw, MD)) * 0.1).astype(np.float32)}
+mx = moe_rng.normal(size=(nw * 8, MD)).astype(np.float32)
+moe_fn = jax.jit(mesh.shard_map(
+    lambda xx, wt: moe_ffn(xx, wt["gate"], wt["w1"][0], wt["b1"][0],
+                           wt["w2"][0], wt["b2"][0], capacity=8),
+    in_specs=(mesh.spec(0),
+              {"gate": P(), "w1": mesh.spec(0), "b1": mesh.spec(0),
+               "w2": mesh.spec(0), "b2": mesh.spec(0)}),
+    out_specs=(mesh.spec(0), P())))
+my, mdrop = moe_fn(mx, moe_w)
+assert int(np.asarray(mdrop.addressable_shards[0].data)) == 0
+moe_ref = reference_moe(mx, moe_w["gate"], moe_w["w1"], moe_w["b1"],
+                        moe_w["w2"], moe_w["b2"], 8, nw)
+check_global(my, np.asarray(moe_ref), rtol=2e-4, atol=2e-5)
+
+# ring attention (causal): the K/V ring ppermute crosses the process
+# link every block step; online-softmax result must match full attention
+from harp_tpu.ops.flash_attention import reference_attention
+from harp_tpu.ops.ring_attention import make_ring_attention_fn
+
+ab, ah, ad = 2, 2, 8
+an = 8 * nw  # sequence sharded over the whole mesh
+at_rng = np.random.default_rng(42)
+aq, ak, av = (at_rng.normal(size=(ab, an, ah, ad)).astype(np.float32)
+              for _ in range(3))
+a_out = make_ring_attention_fn(mesh, causal=True)(aq, ak, av)
+qf = jnp.asarray(aq).transpose(0, 2, 1, 3).reshape(ab * ah, an, ad)
+kf = jnp.asarray(ak).transpose(0, 2, 1, 3).reshape(ab * ah, an, ad)
+vf = jnp.asarray(av).transpose(0, 2, 1, 3).reshape(ab * ah, an, ad)
+a_ref = np.asarray(reference_attention(qf, kf, vf, causal=True))
+a_ref = a_ref.reshape(ab, ah, an, ad).transpose(0, 2, 1, 3)
+for sh in a_out.addressable_shards:
+    np.testing.assert_allclose(np.asarray(sh.data), a_ref[sh.index],
+                               rtol=2e-4, atol=2e-5)
 
 print(f"proc {proc_id}: MULTIPROC OK", flush=True)
