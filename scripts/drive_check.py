@@ -574,3 +574,25 @@ assert any(r.get("config") == "mlp_fit_cli" and "train_acc" in r
            for r in _r5rows)
 print("mlp --train CLI emits parseable mlp_fit_cli JSON")
 print(f"DRIVE OK round-17 ({mode})")
+
+# 23. round 5 (this session): scaling-evidence CLIs drive end to end.
+# project_scaling emits a complete dated (app x N) grid whose BASELINE.md
+# table derives from it; every row cites a measured rate date and the
+# rotation rows show the double-buffered ring hiding under compute.
+import subprocess as _r5sp2
+
+_r5proj = _r5sp2.run([sys.executable, "scripts/project_scaling.py"],
+                     capture_output=True, text=True, timeout=300,
+                     cwd=_r4os.path.dirname(_r4os.path.dirname(
+                         _r4os.path.abspath(__file__))))
+assert _r5proj.returncode == 0, _r5proj.stderr[-500:]
+_r5rows = [_r5json.loads(ln) for ln in _r5proj.stdout.splitlines()
+           if ln.strip()]
+assert {r["app"] for r in _r5rows} == {
+    "kmeans", "kmeans_stream_1b", "mfsgd", "lda", "mlp", "subgraph", "rf"}
+assert all(0.0 < r["efficiency"] <= 1.0 and r["measured_date"]
+           for r in _r5rows)
+assert all(r["efficiency"] == 1.0 for r in _r5rows
+           if r["pattern"] == "rotate")
+print(f"project_scaling: {len(_r5rows)}-row grid, rotation comm hidden")
+print(f"DRIVE OK round-18 ({mode})")

@@ -254,9 +254,14 @@ def main(argv=None):
             for n in present:
                 if verdicts[n]["flip"]:
                     verdicts[n]["flip"] = False
+                    # the veto reason must NOT contain the literal
+                    # "FLIP:" marker — an operator grepping for it to
+                    # apply flips mechanically must not match a vetoed
+                    # line (review finding, round 5)
                     verdicts[n]["reason"] = (
-                        "joint gate: " + verdicts[n]["reason"] +
-                        " — BUT partner gate(s) "
+                        "VETOED by joint gate: this half passed "
+                        f"({verdicts[n]['speedup']:.2f}x at equal "
+                        "quality) but partner gate(s) "
                         f"{[m for m in present if m != n]} refused; "
                         "the knob flips only if every gate flips")
     for name, verdict in verdicts.items():

@@ -36,19 +36,25 @@ PACKS = [
     dict(algo="scatter"),
     dict(algo="dense", n_docs=500_000, ndk_dtype="int16"),
     dict(algo="dense", n_docs=1_000_000, ndk_dtype="int16"),
+    # round 5: the hot-count LL A/B pair (lda_pallas_hot/_approx_hot) —
+    # exact_gathers is not layout-relevant, one pack serves both
+    dict(algo="pallas", sampler="exprace", rng_impl="rbg", n_docs=20_000,
+         vocab_size=256, n_topics=32, tokens_per_doc=200, d_tile=128,
+         w_tile=128),
 ]
 
 
 def prewarm_pack(n_docs=100_000, vocab_size=50_000, n_topics=1000,
                  tokens_per_doc=100, seed=0, algo="dense", sampler=None,
-                 rng_impl=None, ndk_dtype="float32"):
+                 rng_impl=None, ndk_dtype="float32", d_tile=None,
+                 w_tile=None):
     from harp_tpu import WorkerMesh
     from harp_tpu.models import lda as L
 
     mesh = WorkerMesh()  # 1 CPU device == the 1-chip sprint mesh
     assert mesh.num_workers == 1, mesh.num_workers
     cfg = L._make_cfg(n_topics, algo, sampler=sampler, rng_impl=rng_impl,
-                      ndk_dtype=ndk_dtype)
+                      ndk_dtype=ndk_dtype, d_tile=d_tile, w_tile=w_tile)
     path = L._pack_cache_path(BENCH_DATA, cfg, mesh.num_workers, n_docs,
                               vocab_size, n_topics, tokens_per_doc, seed)
     label = f"{algo} n_docs={n_docs} ndk={cfg.ndk_dtype}"

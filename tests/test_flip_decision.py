@@ -201,6 +201,8 @@ def test_joint_gate_vetoes_half_passed_knob(tmp_path, capsys):
     assert not out["lda_pallas_approx_hot"]["flip"]
     assert not out["lda_pallas_approx"]["flip"]          # vetoed
     assert "joint gate" in out["lda_pallas_approx"]["reason"]
+    # an operator grepping for the FLIP: marker must not match a veto
+    assert "FLIP:" not in out["lda_pallas_approx"]["reason"]
     # both flipping → the joint gate lets them through
     rows[3]["log_likelihood"] = -7.0
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")

@@ -138,3 +138,23 @@ def test_enwiki_1m_pallas_program_lowers(mesh, monkeypatch, carry_db):
     text = lowered.as_text()
     assert "tpu_custom_call" in text  # the Mosaic kernel is in the program
     assert "xi16" in text             # on the int16 table
+
+
+@pytest.mark.parametrize("exact", [True, False])
+def test_hot_count_ab_shape_lowers_mosaic(mesh, monkeypatch, exact):
+    """The round-5 LL A/B pair (`lda_pallas_hot` / `_approx_hot`,
+    measure_all.py) runs at 20k docs x 256 vocab x 32 topics x 200
+    tok/doc — avg Nwk cell ~488 > 256, where bf16 gather rounding CAN
+    show.  The sprint must not discover a lowering error inside a scarce
+    relay window: pin that BOTH gather variants Mosaic-compile at the
+    exact sweep shape."""
+    monkeypatch.setenv("HARP_PALLAS_FORCE_MOSAIC", "1")
+    cfg = L.LDAConfig(n_topics=32, algo="pallas", d_tile=128, w_tile=128,
+                      sampler="exprace", rng_impl="rbg",
+                      pallas_exact_gathers=exact)
+    shapes = L.epoch_arg_shapes(8, 20_000, 256, cfg,
+                                n_tokens=20_000 * 200)
+    fn = L.make_multi_epoch_fn(mesh, cfg, 256, epochs=2)
+    text = fn.trace(*_sds(mesh, shapes)).lower(
+        lowering_platforms=("tpu",)).as_text()
+    assert "tpu_custom_call" in text
