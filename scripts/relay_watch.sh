@@ -9,7 +9,9 @@
 
 set -u
 cd "$(dirname "$0")/.."
-POLL_S="${POLL_S:-600}"
+POLL_S="${POLL_S:-240}"  # r5: 240s default — a 45s-bounded probe is
+                         # cheap and a shorter poll loses less of a
+                         # short relay window (round-3's lasted ~2.5h)
 
 while true; do
   if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then

@@ -226,6 +226,23 @@ def test_example_longctx_layer_runs():
     assert rec["loss_final"] < rec["loss_first"]
 
 
+def test_example_pipeline_moe_app_runs():
+    """The PP+EP composition example: GPipe loss descends over the
+    stage ring; the MoE dispatch matches the dense reference."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(root, "examples", "pipeline_moe_app.py"),
+         "--cpu8", "--steps", "8"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "pipeline[8 stages" in out.stdout
+    assert "== dense reference" in out.stdout
+
+
 def test_profiling_op_breakdown(mesh, tmp_path):
     """trace() + op_breakdown: capture a jitted run, get a per-op table."""
     import jax

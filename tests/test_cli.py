@@ -271,6 +271,48 @@ def test_measure_all_script_smoke(tmp_path):
     assert "iters_per_sec" in recs[0] and "error" not in recs[0]
 
 
+def test_measure_all_full_mode_kwargs_bind(monkeypatch):
+    """Every FULL-shape sweep config must CONSTRUCT correctly with no
+    relay: the lambdas' kwargs are bound against the real benchmark
+    signatures via stubs, so a typo'd/removed kwarg (or a config name
+    missing from SPRINT_ORDER) fails HERE — not twenty minutes into a
+    scarce TPU window.  Smoke mode only ever validates the smoke shapes;
+    this is the full-mode twin."""
+    import importlib.util
+    import inspect
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "measure_all_bind", os.path.join(
+            os.path.dirname(__file__), "..", "scripts", "measure_all.py"))
+    ma = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ma)
+
+    from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp,
+                                 rf, subgraph)
+    from harp_tpu.utils import roofline
+
+    def stubbed(mod, attr):
+        sig = inspect.signature(getattr(mod, attr))
+
+        def stub(**kw):
+            sig.bind(**kw)  # TypeError on any kwarg the real fn rejects
+            return {"stub": 1.0}
+
+        monkeypatch.setattr(mod, attr, stub)
+
+    for mod in (kmeans, lda, mfsgd, mlp, rf, subgraph):
+        stubbed(mod, "benchmark")
+    stubbed(kmeans_stream, "benchmark_streaming")
+    monkeypatch.setattr(ma, "_bench_ingest", lambda smoke: {"stub": 1.0})
+    monkeypatch.setattr(roofline, "annotate", lambda name, res: res)
+
+    rows = list(ma.run_all(smoke=False, only=None))
+    bad = [r for r in rows if "error" in r]
+    assert not bad, bad  # a binding failure shows up as the error row
+    assert [r["config"] for r in rows] == ma.SPRINT_ORDER
+
+
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
                    "--min-kb", "1024", "--max-mb", "1", "--reps", "2"])
