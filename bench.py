@@ -123,6 +123,35 @@ def _last_measured():
     return out
 
 
+def _flip_state():
+    """Summary of FLIP_DECISIONS.jsonl for the driver record: how much of
+    the candidates table has real verdicts, and how many flips the gate
+    has authorized.  None before the gate has ever produced the file."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "FLIP_DECISIONS.jsonl")
+    rows = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    row = json.loads(ln)
+                except ValueError:
+                    continue  # truncated tee line (sprint killed mid-write)
+                if "flip_decision" in row:
+                    rows.append(row)
+    except OSError:
+        return None
+    if not rows:
+        return None
+    return {"candidates": len(rows),
+            "decided": sum(1 for r in rows
+                           if r.get("speedup") is not None
+                           and r.get("quality_ok") is not None),
+            "flips_authorized": sum(1 for r in rows if r.get("flip"))}
+
+
 def _relay_probe_error():
     """Bounded jax.devices() probe in a subprocess BEFORE the first config,
     so a dead relay is reported as ``relay_down`` in seconds instead of
@@ -289,6 +318,12 @@ def main():
                 rec[k] = km[k]
         if not kmeans_selected:
             rec["headline_skipped"] = True
+        fs = _flip_state()
+        if fs is not None:
+            # protocol state travels with the record: the judge/driver can
+            # see how much of the candidates table has verdicts without
+            # opening FLIP_DECISIONS.jsonl
+            rec["flip_state"] = fs
         # a kmeans exception must surface on the headline, not vanish
         # when submetrics drops the kmeans key
         error = error or km.get("error")

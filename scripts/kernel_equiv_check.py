@@ -139,6 +139,42 @@ def main():
     np.testing.assert_array_equal(mf_chains[True][1], mf_chains[False][1])
     print("mfsgd carry_w == slice-per-entry (bit-identical)")
 
+    # 5. hot counts (round 5): the lda_pallas_hot/_approx_hot sweep pair
+    # runs where per-cell counts exceed 256, engaging the SECOND base-256
+    # digit plane in the exact gathers — a plane-count bug on silicon
+    # would only show here, so gate it before those rows record.  Corpus:
+    # 10240 tokens over 8 distinct words (count bound 1280 >> 256).
+    dh = np.repeat(np.arange(64, dtype=np.int32), 160)
+    wh = (np.arange(64 * 160, dtype=np.int32) % 8)
+    hot_lls = {}
+    for algo, exact in (("dense", None), ("pallas", True),
+                        ("pallas", False)):
+        extra = ({"sampler": "exprace", "rng_impl": "rbg",
+                  "pallas_exact_gathers": exact}
+                 if algo == "pallas" else {})
+        hm = LDA(64, 128, LDAConfig(n_topics=4, algo=algo, d_tile=lt,
+                                    w_tile=lt, entry_cap=64, alpha=0.5,
+                                    beta=0.1, **extra), mesh, seed=7)
+        hm.set_tokens(dh, wh)
+        for _ in range(3):
+            hm.sample_epoch()
+        ndk = np.asarray(hm.Ndk)
+        assert ndk.sum() == hm.n_tokens and (ndk >= 0).all()
+        nwk = np.asarray(hm.Nwk)
+        assert (nwk == np.round(nwk)).all(), (algo, exact,
+                                              "counts must stay integers")
+        assert nwk.max() > 256, "shape failed to reach hot counts"
+        hot_lls[(algo, exact)] = hm.log_likelihood()
+    ref = hot_lls[("dense", None)]
+    assert abs(hot_lls[("pallas", True)] - ref) / abs(ref) < 0.25, hot_lls
+    # the approx variant gets only a GARBAGE bound (2x the exact
+    # tolerance): its fine-grained quality question is exactly what the
+    # sprint's LL A/B measures and flip_decision judges — but a gather
+    # path that zeroes (not rounds) the high plane must not burn the
+    # window recording junk rows
+    assert abs(hot_lls[("pallas", False)] - ref) / abs(ref) < 0.5, hot_lls
+    print(f"lda pallas hot-count (>256) exact gathers == dense ({hot_lls})")
+
     print(f"KERNEL EQUIV OK ({jax.default_backend()})")
     return 0
 

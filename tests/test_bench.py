@@ -185,3 +185,18 @@ def test_bench_probe_skipped_on_cpu_sim(mesh):
     out = _run_bench(["--smoke", "kmeans"])
     rec = json.loads(out.strip().splitlines()[-1])
     assert "error" not in rec
+
+
+def test_bench_record_carries_flip_state(mesh):
+    # FLIP_DECISIONS.jsonl exists (committed by the round-5 rehearsal):
+    # the driver record must summarize the gate's state
+    out = _run_bench(["--smoke", "kmeans"])
+    rec = json.loads([ln for ln in out.strip().splitlines()
+                      if ln.startswith("{")][0])
+    fs = rec["flip_state"]
+    # >= 1, not the current table size: the relay pipeline rewrites and
+    # auto-commits this artifact unattended — CI must not break when the
+    # candidate table shrinks
+    assert fs["candidates"] >= 1
+    assert 0 <= fs["decided"] <= fs["candidates"]
+    assert 0 <= fs["flips_authorized"] <= fs["decided"]
