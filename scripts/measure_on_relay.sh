@@ -120,7 +120,20 @@ if [ -z "$REHEARSE" ]; then
 else
   echo "== [rehearse] driver bench line (smoke, CPU) =="
   python bench.py --smoke --cpu | tee -a "$OUT"
-  echo "== [rehearse] 1B run / traces / wire sweep skipped (relay-only) =="
+  echo "== [rehearse] op-breakdown trace pass (one config, smoke, CPU) =="
+  # the only sprint step the first rehearsal skipped; one config proves
+  # the trace->parse->record plumbing without relay time
+  # unlike the real sprint (partial results deliberately kept), a broken
+  # trace pipeline must FAIL the rehearsal — certifying it as rehearsed
+  # and discovering the break inside a relay window defeats the point
+  if ! timeout 600 python scripts/profile_on_relay.py --smoke \
+      --platform cpu --only kmeans --out PROFILE_rehearsal.jsonl; then
+    echo "[rehearse] profile pass FAILED — rehearsal NOT certified" >&2
+    exit 1
+  fi
+  grep -q '"top_ops"' PROFILE_rehearsal.jsonl || {
+    echo "[rehearse] profile pass wrote no op table" >&2; exit 1; }
+  echo "== [rehearse] 1B run / wire sweep skipped (relay-only) =="
 fi
 
 # Success = the sweep actually produced records AND the relay still

@@ -188,15 +188,31 @@ def test_bench_probe_skipped_on_cpu_sim(mesh):
 
 
 def test_bench_record_carries_flip_state(mesh):
-    # FLIP_DECISIONS.jsonl exists (committed by the round-5 rehearsal):
-    # the driver record must summarize the gate's state
+    # the driver record must MIRROR FLIP_DECISIONS.jsonl: summarized
+    # when the file has verdicts, absent when it doesn't.  The relay
+    # pipeline rewrites and auto-commits that artifact unattended (tee
+    # truncation on a crashed gate can even leave it empty), so the test
+    # checks record/file consistency, not a hardcoded table size
     out = _run_bench(["--smoke", "kmeans"])
     rec = json.loads([ln for ln in out.strip().splitlines()
                       if ln.startswith("{")][0])
-    fs = rec["flip_state"]
-    # >= 1, not the current table size: the relay pipeline rewrites and
-    # auto-commits this artifact unattended — CI must not break when the
-    # candidate table shrinks
-    assert fs["candidates"] >= 1
+    fs = rec.get("flip_state")
+    rows = []
+    try:
+        with open(os.path.join(os.path.dirname(BENCH),
+                               "FLIP_DECISIONS.jsonl")) as f:
+            for ln in f:
+                try:
+                    row = json.loads(ln)
+                except ValueError:
+                    continue
+                if "flip_decision" in row:
+                    rows.append(row)
+    except OSError:
+        pass
+    if not rows:
+        assert fs is None
+        return
+    assert fs["candidates"] == len(rows)
     assert 0 <= fs["decided"] <= fs["candidates"]
     assert 0 <= fs["flips_authorized"] <= fs["decided"]
