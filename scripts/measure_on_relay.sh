@@ -21,8 +21,10 @@
 # BENCH_rehearsal.jsonl (never BENCH_local.jsonl); flip decisions run
 # against the real committed BENCH_local.jsonl rows, so the rehearsal
 # produces a genuine FLIP_DECISIONS.jsonl from existing TPU data.
-# Relay-only steps (H2D probe, prewarm, 1B run, traces, wire sweep) print
-# an explicit skip line so the rehearsal log shows the full sequence.
+# Relay-only steps (H2D probe, prewarm, 1B run, wire sweep) print an
+# explicit skip line so the rehearsal log shows the full sequence; the
+# trace pass DOES run (one smoke config, ~1 min) and failing it fails
+# the rehearsal.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -122,7 +124,10 @@ else
   python bench.py --smoke --cpu | tee -a "$OUT"
   echo "== [rehearse] op-breakdown trace pass (one config, smoke, CPU) =="
   # the only sprint step the first rehearsal skipped; one config proves
-  # the trace->parse->record plumbing without relay time
+  # the trace->parse->record plumbing without relay time.  Fresh file:
+  # profile_on_relay APPENDS and a stale top_ops line from a previous
+  # rehearsal must not certify a now-broken pass
+  rm -f PROFILE_rehearsal.jsonl
   # unlike the real sprint (partial results deliberately kept), a broken
   # trace pipeline must FAIL the rehearsal — certifying it as rehearsed
   # and discovering the break inside a relay window defeats the point

@@ -106,3 +106,37 @@ def test_projection_north_star_is_absolute_rate():
         1.0 / (r32["compute_sec_per_chip_per_quantum"]
                + ps.t_wire(r32["wire_bytes_per_chip"], ps.ring_hops(32))),
         rel=1e-2)
+
+
+def test_sweep_parent_survives_hung_and_failed_cells(tmp_path, monkeypatch):
+    """A hung cell (TimeoutExpired) or a crashed child must cost only
+    itself: the parent records an error row and keeps going (review
+    finding, round 5)."""
+    import subprocess
+    import types
+
+    ss = _load("scaling_sweep")
+
+    def fake_run(cmd, **kw):
+        app = cmd[cmd.index("--child") + 1]
+        if app == "kmeans":
+            raise subprocess.TimeoutExpired(cmd, 1800)
+        if app == "mfsgd":
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="boom\ndied")
+        return types.SimpleNamespace(
+            returncode=0, stdout='{"app": "%s", "ok": 1}\n' % app,
+            stderr="")
+
+    monkeypatch.setattr(ss.subprocess, "run", fake_run)
+    out = tmp_path / "scaling.jsonl"
+    rc = ss.main(["--out", str(out), "--workers", "2",
+                  "--apps", "kmeans", "mfsgd", "lda",
+                  "--modes", "strong"])
+    assert rc == 1  # failures are reported in the exit status
+    rows = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(rows) == 3  # every cell produced a row, good or bad
+    by_app = {r["app"]: r for r in rows}
+    assert "timeout" in by_app["kmeans"]["error"]
+    assert by_app["mfsgd"]["error"] == "died"
+    assert by_app["lda"]["ok"] == 1
