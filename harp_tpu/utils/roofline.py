@@ -102,10 +102,24 @@ WORK_MODELS = {
     "mfsgd": _mfsgd_work,
     "mfsgd_scatter": _mfsgd_work,
     "mfsgd_pallas": _mfsgd_work,
+    # the carry/approx/hot variants share their family's model.  NB the
+    # floor's meaning shifts for carry rows: without carry every entry
+    # re-pays its tile, so actual HBM bytes >= the per-update floor and
+    # achieved_gbs is a lower bound; WITH carry a run's rows amortize and
+    # actual bytes can drop BELOW the floor, so a carry row's
+    # achieved_gbs/pct_peak_bw read as the ALGORITHMIC traffic rate (an
+    # upper bound on real DRAM), not an achieved-bandwidth claim — the
+    # trace pass, not this model, settles real bytes for those rows
+    "mfsgd_carry": _mfsgd_work,
     "lda": _lda_work,
+    "lda_carry": _lda_work,
     "lda_exprace": _lda_work,
     "lda_fast": _lda_work,
     "lda_pallas": _lda_work,
+    "lda_pallas_approx": _lda_work,
+    "lda_pallas_carry": _lda_work,
+    "lda_pallas_hot": _lda_work,
+    "lda_pallas_approx_hot": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
     "lda_scatter": _lda_work,

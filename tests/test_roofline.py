@@ -75,3 +75,26 @@ def test_measure_all_smoke_record_carries_roofline(mesh):
     spec.loader.exec_module(mod)
     recs = list(mod.run_all(smoke=True, only=["kmeans"]))
     assert len(recs) == 1 and "pct_peak_flops" in recs[0], recs
+
+
+def test_variant_configs_share_their_family_model():
+    """EVERY mfsgd/lda config the sweep runs must be annotated with its
+    family's minimum-byte floor — a variant missing from WORK_MODELS
+    records an in-window row with no roofline fields, silently thinning
+    the very analysis the sprint exists to produce (round 5).  Derived
+    from SPRINT_ORDER so the NEXT variant added to the sweep is guarded
+    too, not just the six that existed when this was written."""
+    import importlib.util
+    import os
+
+    from harp_tpu.utils import roofline as R
+
+    spec = importlib.util.spec_from_file_location(
+        "measure_all_rr", os.path.join(os.path.dirname(__file__), "..",
+                                       "scripts", "measure_all.py"))
+    ma = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ma)
+    for cfg in ma.SPRINT_ORDER:
+        for fam in ("mfsgd", "lda"):
+            if cfg == fam or cfg.startswith(fam + "_"):
+                assert R.WORK_MODELS.get(cfg) is R.WORK_MODELS[fam], cfg
