@@ -29,15 +29,29 @@ def _run_bench(argv):
     return buf.getvalue()
 
 
+def _load_bench(tmp_path=None):
+    """Fresh bench module; optionally point its __file__ at tmp_path so
+    the _last_measured/_flip_state file lookups read fixtures there."""
+    import importlib.util
+
+    name = f"bench_mod_{_load_bench.n}"
+    _load_bench.n += 1
+    spec = importlib.util.spec_from_file_location(name, BENCH)
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    if tmp_path is not None:
+        b.__dict__["__file__"] = str(tmp_path / "bench.py")
+    return b
+
+
+_load_bench.n = 0
+
+
 def test_bench_tables_stay_consistent():
     # BASELINES, _CONFIG_KEYS and UNITS are parallel tables — a config
     # added to one but not the others would KeyError only on the error
     # path (_last_measured), the worst place to discover it
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
-    b = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(b)
+    b = _load_bench()
     assert set(b.BASELINES) == {name for name, _ in b._CONFIG_KEYS}
     assert {key for _, key in b._CONFIG_KEYS} <= set(b.UNITS)
 
@@ -46,15 +60,10 @@ def test_last_measured_uses_declared_config_key(tmp_path):
     # ADVICE r4: a kmeans_ingest row carries iters_per_sec AND
     # points_per_sec; _last_measured must report the config's DECLARED
     # headline (points/s), not the first UNITS hit (iter/s)
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)
-    b = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(b)
+    b = _load_bench(tmp_path)
     (tmp_path / "BENCH_local.jsonl").write_text(json.dumps(
         {"config": "kmeans_ingest", "iters_per_sec": 3.0,
          "points_per_sec": 5.5e7, "date": "2026-08-01"}) + "\n")
-    b.__dict__["__file__"] = str(tmp_path / "bench.py")
     lm = b._last_measured()
     assert lm["kmeans_ingest"]["unit"] == "points/s"
     assert lm["kmeans_ingest"]["value"] == 5.5e7
@@ -216,3 +225,20 @@ def test_bench_record_carries_flip_state(mesh):
     assert fs["candidates"] == len(rows)
     assert 0 <= fs["decided"] <= fs["candidates"]
     assert 0 <= fs["flips_authorized"] <= fs["decided"]
+
+
+def test_flip_state_tolerates_truncated_tee_lines(tmp_path):
+    # a sprint killed mid-write leaves a truncated last line; the summary
+    # must count the valid rows, not vanish (review finding, round 5)
+    b = _load_bench(tmp_path)
+    (tmp_path / "FLIP_DECISIONS.jsonl").write_text(
+        json.dumps({"flip_decision": "a", "flip": True, "speedup": 1.2,
+                    "quality_ok": True}) + "\n"
+        + json.dumps({"flip_decision": "b", "flip": False,
+                      "speedup": None, "quality_ok": None}) + "\n"
+        + '{"flip_decision": "c", "flip": fal')  # truncated mid-write
+    fs = b._flip_state()
+    assert fs == {"candidates": 2, "decided": 1, "flips_authorized": 1}
+    # no file at all -> None (no flip_state key in the record)
+    b.__dict__["__file__"] = str(tmp_path / "nowhere" / "bench.py")
+    assert b._flip_state() is None
