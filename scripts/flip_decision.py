@@ -116,8 +116,25 @@ CANDIDATES = {
 WIN_THRESHOLD = 1.10  # "wins >=10%" half of the rule
 
 # candidate groups flipping the SAME knob: all must flip or none does
-# (main() enforces this after per-candidate verdicts)
-JOINT_GATES = [("lda_pallas_approx", "lda_pallas_approx_hot")]
+# (main() enforces this after per-candidate verdicts).  The subgraph
+# pair gates overflow_algo at BOTH the controlled powerlaw A/B shape
+# and the graded 1M scale — a knob that wins only off-scale must not
+# print a FLIP line (round 5).
+JOINT_GATES = [("lda_pallas_approx", "lda_pallas_approx_hot"),
+               ("subgraph_onehot", "subgraph_1m_onehot")]
+
+# alternatives for the same default slot: MFSGDConfig rejects
+# carry_w=True with algo != "dense" (mfsgd.py __post_init__), so both
+# FLIP lines applied together would crash the default config — if both
+# pass, only the faster prints a FLIP line
+EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry")]
+
+# stack-conditional: carry_db=True is one knob, but the evidence row
+# that authorizes it depends on which algo the verdicts make default
+CONDITIONAL_GATES = {
+    "lda_pallas_carry": ("requires", "lda_pallas"),
+    "lda_carry": ("requires_not", "lda_pallas"),
+}
 
 
 def _metric_key(candidate_row, incumbent_row, spec):
@@ -236,41 +253,100 @@ def main(argv=None):
                    default=None)
     args = p.parse_args(argv)
     rows = latest_rows(args.bench)
-    undecidable = 0
+    # evaluate every selected candidate PLUS every gate partner/anchor a
+    # selected one depends on — "--only subgraph_onehot" must not bypass
+    # the graded-scale half of its joint gate (fail open); partners are
+    # evaluated but only selected names print (review finding, round 5)
+    selected = set(args.only) if args.only else set(CANDIDATES)
+    needed = set(selected)
+    for group in JOINT_GATES + EXCLUSIVE_GATES:
+        if needed & set(group):
+            needed |= set(group)
+    for name, (_, anchor) in CONDITIONAL_GATES.items():
+        if name in needed:
+            needed.add(anchor)
     verdicts = {}
     for name, spec in CANDIDATES.items():
-        if args.only and name not in args.only:
+        if name not in needed:
             continue
         verdicts[name] = decide(rows.get(name), rows.get(spec["incumbent"]),
                                 spec)
-    # joint gates IN CODE, not prose: candidates flipping the same knob
-    # must ALL say flip, or none does ("apply the FLIP lines above" must
-    # stay safe to follow mechanically — review finding, round 5)
+    # gates IN CODE, not prose: "apply the FLIP lines above" must stay
+    # safe to follow mechanically (round 5).  Veto reasons must NOT
+    # contain the literal "FLIP:" marker — an operator grepping for it
+    # must never match a vetoed line.
+    # 1. joint: same knob, every gate must flip or none does (an
+    #    unevaluated partner counts as refused — fail closed)
+    blocked_by_unmeasured = False  # a partner's MISSING rows vetoed a
+    #                                selected winner -> exit 1 (rerun)
+
+    def _undecided(v):
+        return v["speedup"] is None or v["quality_ok"] is None
+
     for group in JOINT_GATES:
         present = [n for n in group if n in verdicts]
-        if len(present) < 2:
-            continue  # --only selected one half; its line stands alone
+        if not present:
+            continue
         if not all(verdicts[n]["flip"] for n in present):
             for n in present:
                 if verdicts[n]["flip"]:
                     verdicts[n]["flip"] = False
-                    # the veto reason must NOT contain the literal
-                    # "FLIP:" marker — an operator grepping for it to
-                    # apply flips mechanically must not match a vetoed
-                    # line (review finding, round 5)
                     verdicts[n]["reason"] = (
                         "VETOED by joint gate: this half passed "
                         f"({verdicts[n]['speedup']:.2f}x at equal "
                         "quality) but partner gate(s) "
                         f"{[m for m in present if m != n]} refused; "
                         "the knob flips only if every gate flips")
+                    if n in selected and any(
+                            _undecided(verdicts[m]) for m in present
+                            if m != n):
+                        blocked_by_unmeasured = True
+    # 2. exclusive: alternatives for the same default slot (applying
+    #    both would violate the config's own validation) — keep the
+    #    faster, veto the rest
+    for group in EXCLUSIVE_GATES:
+        flipping = sorted(
+            (n for n in group if n in verdicts and verdicts[n]["flip"]),
+            key=lambda n: -verdicts[n]["speedup"])
+        for n in flipping[1:]:
+            verdicts[n]["flip"] = False
+            verdicts[n]["reason"] = (
+                f"VETOED by exclusive gate: {flipping[0]} also flips and "
+                f"is faster ({verdicts[flipping[0]]['speedup']:.2f}x vs "
+                f"{verdicts[n]['speedup']:.2f}x); the two knobs cannot "
+                "both be defaults")
+    # 3. conditional: valid only on the stack the anchor verdict selects
+    for name, (mode, anchor) in CONDITIONAL_GATES.items():
+        if name not in verdicts or not verdicts[name]["flip"]:
+            continue
+        av = verdicts.get(anchor)
+        anchor_flips = bool(av and av["flip"])
+        if (anchor_flips if mode == "requires" else not anchor_flips):
+            continue
+        verdicts[name]["flip"] = False
+        verdicts[name]["reason"] = (
+            "VETOED by conditional gate: this half passed "
+            f"({verdicts[name]['speedup']:.2f}x) but applies only when "
+            f"{anchor} {'flips' if mode == 'requires' else 'does not flip'}"
+            " — which is not the verdict")
+        if (name in selected and mode == "requires"
+                and (av is None or _undecided(av))):
+            blocked_by_unmeasured = True  # anchor unmeasured, not refused
+    # exit 1 is the "rerun the benches" signal: any SELECTED verdict
+    # that could not be computed, or a selected winner vetoed because a
+    # gate partner's rows are MISSING (not because the partner measured
+    # and refused — that is a genuine, fully-decided refusal).  An
+    # unmeasured EXCLUSIVE partner never blocks, so it never signals.
+    undecidable = 0
     for name, verdict in verdicts.items():
-        if verdict["speedup"] is None or verdict["quality_ok"] is None:
+        if name not in selected:
+            continue  # evaluated only as a gate partner
+        if _undecided(verdict):
             undecidable += 1
         print(json.dumps({"flip_decision": name,
                           "incumbent": CANDIDATES[name]["incumbent"],
                           **verdict}))
-    return 1 if undecidable else 0
+    return 1 if (undecidable or blocked_by_unmeasured) else 0
 
 
 if __name__ == "__main__":

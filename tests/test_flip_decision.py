@@ -213,3 +213,115 @@ def test_joint_gate_vetoes_half_passed_knob(tmp_path, capsys):
            for ln in capsys.readouterr().out.strip().splitlines()}
     assert out["lda_pallas_approx"]["flip"]
     assert out["lda_pallas_approx_hot"]["flip"]
+
+
+def test_subgraph_joint_gate_requires_both_scales(tmp_path, capsys):
+    # overflow_algo flips only when onehot wins at BOTH the controlled
+    # powerlaw shape and the graded 1M scale (round 5)
+    rows = [
+        {"config": "subgraph_pl", "vertices_per_sec": 100e3,
+         "estimate": 1.0e12},
+        {"config": "subgraph_onehot", "vertices_per_sec": 130e3,
+         "estimate": 1.0e12},          # wins off-scale
+        {"config": "subgraph_1m", "vertices_per_sec": 110e3,
+         "estimate": 4.0e18},
+        {"config": "subgraph_1m_onehot", "vertices_per_sec": 112e3,
+         "estimate": 4.0e18},          # <10% at graded scale
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    fd.main(["--bench", str(p),
+             "--only", "subgraph_onehot", "subgraph_1m_onehot"])
+    out = {json.loads(ln)["flip_decision"]: json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()}
+    assert not out["subgraph_onehot"]["flip"]      # vetoed by the pair
+    assert not out["subgraph_1m_onehot"]["flip"]
+    assert "FLIP:" not in out["subgraph_onehot"]["reason"]
+
+
+def test_joint_gate_fails_closed_under_only(tmp_path, capsys):
+    # --only with ONE half of a gated pair must still evaluate the
+    # partner and veto when it refuses — selection must not bypass the
+    # gate (fail open, review finding round 5)
+    rows = [
+        {"config": "subgraph_pl", "vertices_per_sec": 100e3,
+         "estimate": 1.0e12},
+        {"config": "subgraph_onehot", "vertices_per_sec": 130e3,
+         "estimate": 1.0e12},  # wins — but the 1M half has no rows
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    fd.main(["--bench", str(p), "--only", "subgraph_onehot"])
+    out = [json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()]
+    assert len(out) == 1  # the partner is evaluated, not printed
+    assert out[0]["flip_decision"] == "subgraph_onehot"
+    assert not out[0]["flip"]
+    assert "FLIP:" not in out[0]["reason"]
+
+
+def test_exclusive_gate_keeps_only_the_faster(tmp_path, capsys):
+    # both mfsgd candidates pass: applying both would crash
+    # MFSGDConfig's own validation — only the faster prints FLIP
+    rows = [
+        {"config": "mfsgd", "updates_per_sec_per_chip": 92.7e6,
+         "rmse_final": 0.366},
+        {"config": "mfsgd_pallas", "updates_per_sec_per_chip": 150e6,
+         "rmse_final": 0.366},
+        {"config": "mfsgd_carry", "updates_per_sec_per_chip": 120e6,
+         "rmse_final": 0.366},
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    fd.main(["--bench", str(p), "--only", "mfsgd_pallas", "mfsgd_carry"])
+    out = {json.loads(ln)["flip_decision"]: json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()}
+    assert out["mfsgd_pallas"]["flip"]
+    assert not out["mfsgd_carry"]["flip"]
+    assert "exclusive" in out["mfsgd_carry"]["reason"]
+    assert "FLIP:" not in out["mfsgd_carry"]["reason"]
+
+
+def test_conditional_gate_binds_carry_to_its_stack(tmp_path, capsys):
+    # lda_carry's evidence is the DENSE stack: if lda_pallas flips the
+    # default algo, lda_carry's row no longer describes the default and
+    # must not print FLIP (lda_pallas_carry's would instead)
+    rows = [
+        {"config": "lda", "tokens_per_sec_per_chip": 6.58e6,
+         "log_likelihood": -9.1},
+        {"config": "lda_pallas", "tokens_per_sec_per_chip": 9e6,
+         "log_likelihood": -9.1},   # flips the algo
+        {"config": "lda_carry", "tokens_per_sec_per_chip": 7.5e6,
+         "log_likelihood": -9.1},   # passed, but on the dense stack
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    fd.main(["--bench", str(p), "--only", "lda_carry"])
+    out = [json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()]
+    assert len(out) == 1 and not out[0]["flip"]
+    assert "conditional" in out[0]["reason"]
+    # and with lda_pallas NOT flipping, lda_carry's flip stands
+    rows[1]["tokens_per_sec_per_chip"] = 6.6e6  # <10%: no algo flip
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    fd.main(["--bench", str(p), "--only", "lda_carry"])
+    out = [json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()]
+    assert out[0]["flip"], out
+
+
+def test_unmeasured_gate_partner_counts_as_undecidable(tmp_path, capsys):
+    # exit 1 is the "rerun the benches" signal; a veto caused by a
+    # MISSING partner row must carry it even though the partner's own
+    # line never prints (round 5)
+    rows = [
+        {"config": "subgraph_pl", "vertices_per_sec": 100e3,
+         "estimate": 1.0e12},
+        {"config": "subgraph_onehot", "vertices_per_sec": 130e3,
+         "estimate": 1.0e12},
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = fd.main(["--bench", str(p), "--only", "subgraph_onehot"])
+    capsys.readouterr()
+    assert rc == 1  # the 1M partner is unmeasured -> undecidable
