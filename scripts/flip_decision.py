@@ -315,13 +315,26 @@ def main(argv=None):
                 f"is faster ({verdicts[flipping[0]]['speedup']:.2f}x vs "
                 f"{verdicts[n]['speedup']:.2f}x); the two knobs cannot "
                 "both be defaults")
-    # 3. conditional: valid only on the stack the anchor verdict selects
+    # 3. conditional: valid only on the stack the anchor verdict selects.
+    #    An UNMEASURED anchor is not a verdict at all — both modes veto
+    #    and signal exit 1, else requires_not would fail open (apply
+    #    carry on the dense stack, then a later sprint flips the algo
+    #    and the applied flip is exactly the off-stack evidence this
+    #    gate exists to block — review finding, round 5)
     for name, (mode, anchor) in CONDITIONAL_GATES.items():
         if name not in verdicts or not verdicts[name]["flip"]:
             continue
         av = verdicts.get(anchor)
-        anchor_flips = bool(av and av["flip"])
-        if (anchor_flips if mode == "requires" else not anchor_flips):
+        if av is None or _undecided(av):
+            verdicts[name]["flip"] = False
+            verdicts[name]["reason"] = (
+                "VETOED by conditional gate: this half passed "
+                f"({verdicts[name]['speedup']:.2f}x) but its anchor "
+                f"{anchor} is UNMEASURED — measure it, then re-decide")
+            if name in selected:
+                blocked_by_unmeasured = True
+            continue
+        if (av["flip"] if mode == "requires" else not av["flip"]):
             continue
         verdicts[name]["flip"] = False
         verdicts[name]["reason"] = (
@@ -329,9 +342,6 @@ def main(argv=None):
             f"({verdicts[name]['speedup']:.2f}x) but applies only when "
             f"{anchor} {'flips' if mode == 'requires' else 'does not flip'}"
             " — which is not the verdict")
-        if (name in selected and mode == "requires"
-                and (av is None or _undecided(av))):
-            blocked_by_unmeasured = True  # anchor unmeasured, not refused
     # exit 1 is the "rerun the benches" signal: any SELECTED verdict
     # that could not be computed, or a selected winner vetoed because a
     # gate partner's rows are MISSING (not because the partner measured

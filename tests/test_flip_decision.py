@@ -325,3 +325,25 @@ def test_unmeasured_gate_partner_counts_as_undecidable(tmp_path, capsys):
     rc = fd.main(["--bench", str(p), "--only", "subgraph_onehot"])
     capsys.readouterr()
     assert rc == 1  # the 1M partner is unmeasured -> undecidable
+
+
+def test_conditional_gate_vetoes_on_unmeasured_anchor(tmp_path, capsys):
+    # requires_not must NOT read an unmeasured anchor as "does not
+    # flip" — carry applied on the dense stack today could be off-stack
+    # evidence after the next sprint flips the algo (round 5)
+    rows = [
+        {"config": "lda", "tokens_per_sec_per_chip": 6.58e6,
+         "log_likelihood": -9.1},
+        {"config": "lda_carry", "tokens_per_sec_per_chip": 7.5e6,
+         "log_likelihood": -9.1},
+        # no lda_pallas row at all (e.g. the sprint --skip'd pallas)
+    ]
+    p = tmp_path / "bench.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rc = fd.main(["--bench", str(p), "--only", "lda_carry"])
+    out = [json.loads(ln)
+           for ln in capsys.readouterr().out.strip().splitlines()]
+    assert rc == 1                       # rerun-the-benches signal
+    assert not out[0]["flip"]
+    assert "UNMEASURED" in out[0]["reason"]
+    assert "FLIP:" not in out[0]["reason"]
