@@ -135,8 +135,15 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
     if has_noise:
         u = noise_in[...]                                # [K, cc] in (0,1)
     else:
-        # distinct stream per (entry, chunk): entry-key words + chunk id
-        pltpu.prng_seed(seed_ref[0], seed_ref[1], j)
+        # distinct stream per (entry, chunk).  The real TPU compiler
+        # accepts at most TWO seed words ("Setting seed with more than
+        # 2 values is not supported", silicon 2026-08-01; the CPU
+        # Mosaic lowering pass does NOT enforce this), so the chunk id
+        # is folded into the second entry-key word with an odd-constant
+        # multiply (golden-ratio 0x9E3779B9, int32 wraparound) + xor —
+        # distinct j stay distinct, streams stay decorrelated
+        pltpu.prng_seed(seed_ref[0],
+                        seed_ref[1] ^ (j * jnp.int32(-1640531527)))
         bits = pltpu.prng_random_bits((K, cc))
         # logical shift keeps int32 (Mosaic has no uint32->f32 cast):
         # 24 uniform bits -> (0, 1)

@@ -596,3 +596,47 @@ assert all(r["efficiency"] == 1.0 for r in _r5rows
            if r["pattern"] == "rotate")
 print(f"project_scaling: {len(_r5rows)}-row grid, rotation comm hidden")
 print(f"DRIVE OK round-18 ({mode})")
+
+# 24. round 5 session 2: the two-word prng_seed invariant.  The real TPU
+# compiler rejects pltpu.prng_seed with >2 seed words ("Setting seed
+# with more than 2 values is not supported" — silicon 2026-08-01, which
+# cost the sprint its pallas rows until the mid-window fix); the local
+# Mosaic lowering pass does NOT enforce it and the kernel MLIR is
+# serialized inside the lowered module (not text-greppable), so the pin
+# records the call arity AT TRACE TIME: wrap pltpu.prng_seed, lower the
+# noise-free (compiled-mode) kernel for TPU, assert every call passed
+# <= 2 words.
+import functools as _r5f2
+
+from harp_tpu.ops import lda_kernel as _r5lk
+
+_r5arities = []
+_r5orig_seed = _r5lk.pltpu.prng_seed
+
+
+def _r5rec_seed(*a):
+    # count seed WORDS, not positional args — prng_seed accepts array
+    # args, so a [3]-shaped single argument is still 3 words to the
+    # compiler (review finding, round 5)
+    _r5arities.append(sum(int(np.size(x)) for x in a))
+    return _r5orig_seed(*a)
+
+
+_r5lk.pltpu.prng_seed = _r5rec_seed
+try:
+    _r5kf = _r5f2.partial(_r5lk.cgs_entry_update,
+                          alpha=0.1, beta=0.01, vbeta=1.28)
+    _r5kargs = (jnp.zeros((128, 128), jnp.float32),
+                jnp.zeros((128, 128), jnp.float32),
+                jnp.zeros((128,), jnp.float32),
+                jnp.zeros((256,), jnp.int32), jnp.zeros((256,), jnp.int32),
+                jnp.zeros((256,), jnp.int32), jnp.zeros((2,), jnp.int32))
+    jax.jit(_r5kf).trace(*_r5kargs).lower(lowering_platforms=("tpu",))
+finally:
+    _r5lk.pltpu.prng_seed = _r5orig_seed
+assert _r5arities, "noise-free kernel never seeded the PRNG"
+assert max(_r5arities) <= 2, (
+    f"prng_seed called with {max(_r5arities)} words — the real TPU "
+    "compiler takes at most 2 (silicon 2026-08-01)")
+print(f"prng_seed arity <= 2 across {len(_r5arities)} trace-time calls")
+print(f"DRIVE OK round-19 ({mode})")
