@@ -143,16 +143,20 @@ def main():
     # runs where per-cell counts exceed 256, engaging the SECOND base-256
     # digit plane in the exact gathers — a plane-count bug on silicon
     # would only show here, so gate it before those rows record.  Corpus:
-    # 10240 tokens over 8 distinct words (count bound 1280 >> 256).
-    dh = np.repeat(np.arange(64, dtype=np.int32), 160)
-    wh = (np.arange(64 * 160, dtype=np.int32) % 8)
+    # 20480 tokens over 8 distinct words (count bound 2560 >> 256), and
+    # n_topics=8 is the kernel's TPU minimum (the first in-window run
+    # failed the kernel's own multiple-of-8 check at n_topics=4, which
+    # interpret-mode rehearsal cannot catch); max(Nwk) >= 2560/8 = 320
+    # keeps the >256 hot condition true by construction.
+    dh = np.repeat(np.arange(64, dtype=np.int32), 320)
+    wh = (np.arange(64 * 320, dtype=np.int32) % 8)
     hot_lls = {}
     for algo, exact in (("dense", None), ("pallas", True),
                         ("pallas", False)):
         extra = ({"sampler": "exprace", "rng_impl": "rbg",
                   "pallas_exact_gathers": exact}
                  if algo == "pallas" else {})
-        hm = LDA(64, 128, LDAConfig(n_topics=4, algo=algo, d_tile=lt,
+        hm = LDA(64, 128, LDAConfig(n_topics=8, algo=algo, d_tile=lt,
                                     w_tile=lt, entry_cap=64, alpha=0.5,
                                     beta=0.1, **extra), mesh, seed=7)
         hm.set_tokens(dh, wh)
