@@ -40,13 +40,52 @@ class MetricsLogger:
             self._fh = None
 
 
+_PROVENANCE: dict | None = None
+
+
+def _provenance() -> dict:
+    """backend/date/jax/commit stamp, computed once per process.
+
+    Round 5 (review finding): `flip_decision.latest_rows` and bench.py's
+    `_last_measured` exclude CPU-sim evidence via ``backend == "cpu"`` —
+    a config-keyed CLI row WITHOUT the field (e.g. the teed
+    `kmeans_stream_cli` 1B record) would pass as TPU evidence, exactly
+    the CPU-inversion failure those filters exist for.  Stamping here
+    covers every CLI that prints through benchmark_json.
+    """
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        import datetime
+        import subprocess
+
+        import jax
+
+        try:
+            commit = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or None
+        except OSError:
+            commit = None
+        _PROVENANCE = {
+            "date": datetime.date.today().isoformat(),
+            "backend": jax.default_backend(),
+            "n_devices": jax.device_count(),
+            "jax": jax.__version__,
+            "commit": commit,
+        }
+    return _PROVENANCE
+
+
 def benchmark_json(config: str, result: dict) -> str:
     """One JSON line for a CLI benchmark result.
 
     Every app CLI prints its benchmark dict through this (round 4): the
     relay sprint tees CLI output into BENCH_local.jsonl, and a Python
     dict repr there is an unparseable line every JSONL reader must skip.
-    numpy scalars coerce to plain Python so json never chokes.
+    numpy scalars coerce to plain Python so json never chokes.  Rows
+    carry the same provenance fields measure_all stamps (round 5), so
+    downstream TPU-evidence filters can classify them.
     """
     def _plain(v: Any):
         if isinstance(v, (np.floating, float)):
@@ -58,4 +97,5 @@ def benchmark_json(config: str, result: dict) -> str:
         return v
 
     return json.dumps({"config": config,
-                       **{k: _plain(v) for k, v in result.items()}})
+                       **{k: _plain(v) for k, v in result.items()},
+                       **_provenance()})

@@ -51,6 +51,45 @@ def main():
     tile = 128 if on_tpu else 8  # kernels gate 128-multiples on TPU
     rng = np.random.default_rng(0)
 
+    if not on_tpu:
+        # TPU shape pre-pass (round 5, review finding): rehearsal runs
+        # with CPU tiles, so the kernels' TPU-only validation branches
+        # (n_topics/tile multiple-of rules) never execute — the
+        # n_topics=4 hot-count shape burned part of a live window that
+        # way.  Trace-lower each LDA pallas config THIS SCRIPT runs on
+        # TPU, at the TPU-mode tiles, through the same Mosaic pin the
+        # kernel tests use (CLAUDE.md: catches relay-burners hardware-
+        # free).  Any future shape edit here fails the rehearsal, not
+        # the window.
+        import harp_tpu.models.lda as Lm
+
+        os.environ["HARP_PALLAS_FORCE_MOSAIC"] = "1"
+        try:
+            for n_topics, n_docs, vocab, n_tok, exact in (
+                    (8, 64, 32, 64 * 40, True),        # check 2's config
+                    (8, 64, 128, 64 * 320, True),      # check 5, exact
+                    (8, 64, 128, 64 * 320, False)):    # check 5, approx
+                pcfg = Lm.LDAConfig(
+                    n_topics=n_topics, algo="pallas", d_tile=128,
+                    w_tile=128, entry_cap=64, alpha=0.5, beta=0.1,
+                    sampler="exprace", rng_impl="rbg",
+                    pallas_exact_gathers=exact)
+                shapes = Lm.epoch_arg_shapes(mesh.num_workers, n_docs,
+                                             vocab, pcfg, n_tokens=n_tok)
+                sds = [jax.ShapeDtypeStruct(
+                    shape, dt,
+                    sharding=(mesh.replicated() if i == 2
+                              else mesh.sharding(mesh.spec(0))))
+                    for i, (shape, dt) in enumerate(shapes)]
+                fn = Lm.make_multi_epoch_fn(mesh, pcfg, vocab, epochs=1)
+                text = fn.trace(*sds).lower(
+                    lowering_platforms=("tpu",)).as_text()
+                assert "tpu_custom_call" in text
+        finally:
+            del os.environ["HARP_PALLAS_FORCE_MOSAIC"]
+        print("tpu shape pre-pass: every TPU-mode LDA config "
+              "traces + Mosaic-lowers")
+
     # 1. MF-SGD: pallas kernel replays dense's exact update order
     u, i, v = synthetic_ratings(96, 64, 3000, rank=4, noise=0.05, seed=2)
     factors = {}

@@ -75,10 +75,15 @@ def test_log_likelihood_sense_handles_negative_values():
     assert not v["flip"] and v["quality_ok"] is False
 
 
-def test_subgraph_estimates_must_match_exactly():
+def test_subgraph_estimates_match_within_order_drift():
+    # rel_tol 1e-3 (round 5): the two formulations reorder an f32 sum
+    # whose value exceeds 2^24, so ~3.7e-4 rel drift was MEASURED on
+    # silicon between correct implementations (2026-08-01); a real
+    # counting bug (dropped overflow edges) moves the estimate by
+    # percents and must still refuse
     inc = {"vertices_per_sec": 117.3e3, "estimate": 4.37e18}
-    same = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * (1 + 1e-8)}
-    diff = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * 1.001}
+    same = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * (1 + 3.7e-4)}
+    diff = {"vertices_per_sec": 150e3, "estimate": 4.37e18 * 1.01}
     assert fd.decide(same, inc, SG_SPEC)["flip"]
     assert not fd.decide(diff, inc, SG_SPEC)["flip"]
 
