@@ -41,23 +41,28 @@ _BENCH_DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            ".bench_data")
 
 # Regression baselines, 1× TPU v5e (BASELINE.md) — re-measured on
-# ROUND-3 code 2026-07-31 (every config, same day, same chip; the stale
-# round-1 values and the refactor caveat are retired).
+# ROUND-5 code 2026-08-01, the window that measured every candidate and
+# flipped the winners (FLIP_DECISIONS.jsonl): MFSGDConfig.algo and
+# LDAConfig.algo/sampler/rng_impl/carry_db now default to the measured
+# winners; the dense arms remain pinned configs for regression tracking.
 # None = no TPU number recorded yet (vs_baseline stays null until one is).
 BASELINES = {
-    "kmeans": 399.3,        # iter/s, 1M×300 k=100 f32
+    "kmeans": 381.2,        # iter/s, 1M×300 k=100 f32 (±5% window spread)
     "kmeans_stream": 0.53,  # iter/s end-to-end, 100M×300 k=1000 (1.09 ex-gen)
-    "kmeans_ingest": None,  # points/s, 20M×300 f16 disk npy (round 3)
-    "mfsgd": 92.7e6,        # updates/s/chip, ML-20M shapes, dense algo
-    "mfsgd_pallas": None,   # fused-kernel algo (round 3; no TPU number yet)
-    "lda": 6.58e6,          # tokens/s/chip, 100k docs × 1k topics, dense
-    "lda_pallas": None,     # fused-kernel algo (round 3; no TPU number yet)
-    "mlp": 22.2e6,          # samples/s, MNIST shapes, device-resident
-    "subgraph": 93.8e3,     # vertices/s, u5-tree on 100k vertices
-                            # (pre-compaction code — the compact-DP-table
-                            # rewrite measured 2.4x on the CPU sim, so a
-                            # big vs_baseline jump here is expected)
-    "rf": 7.92,             # trees/s, 32 trees depth 6 on 200k×64
+    "kmeans_ingest": 66.4e3,  # points/s, 20M×300 f16 disk npy — relay-
+                            # tunnel-bound (44.6 MB/s host == probed H2D)
+    "mfsgd": 83.1e6,        # updates/s/chip, ML-20M shapes, dense algo
+    "mfsgd_pallas": 188.1e6,  # fused kernel — the DEFAULT algo since the
+                            # 2026-08-01 flip (2.26× dense, equal RMSE)
+    "lda": 6.46e6,          # tokens/s/chip, 100k docs × 1k topics, dense
+    "lda_pallas": 7.92e6,   # fused kernel, carry off (the default stack
+                            # adds carry_db: 10.50M = 1.63× dense)
+    "mlp": 22.1e6,          # samples/s, MNIST shapes, device-resident
+    "subgraph": 75.8e3,     # vertices/s, u5-tree on 100k vertices —
+                            # post-compaction: the compact tables win
+                            # +10% at the graded 1M shape (129.2k) but
+                            # cost ~19% at this small uniform shape
+    "rf": 8.80,             # trees/s, 32 trees depth 6 on 200k×64
 }
 
 # result_key → display unit; shared by _configs and _last_measured so a

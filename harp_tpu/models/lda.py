@@ -83,7 +83,12 @@ class LDAConfig:
     # acceptable.
     # Delta matmuls are EXACT in bf16 (operands are 0/±1; f32 accumulate),
     # so counts remain integers on all paths.
-    algo: str = "dense"
+    # FLIPPED to "pallas" 2026-08-01 (1× v5e, FLIP_DECISIONS.jsonl):
+    # fused kernel + exprace + rbg + carry_db measured 10.50M
+    # tok/s/chip vs 6.46M dense gumbel = 1.63× at equal likelihood
+    # (−12.0815 vs −12.0824, tol 0.05) at the 100k-doc × 1k-topic
+    # sweep shape; the plain kernel alone is 7.92M = 1.23×.
+    algo: str = "pallas"
     d_tile: int = 512   # dense: doc-topic tile rows
     w_tile: int = 512   # dense: word-topic tile rows
     entry_cap: int = 2048  # dense: max tokens per tile entry
@@ -125,8 +130,19 @@ class LDAConfig:
     # whole-table-copy trap's neighborhood (a round-3 regrouping
     # prototype was reverted there), so the sweep configs lda_carry /
     # lda_pallas_carry measure it and the flip gate decides (VERDICT r3
-    # item 2's queued decision, now one flag).
-    carry_db: bool = False
+    # item 2's queued decision, now one flag).  FLIPPED ON 2026-08-01
+    # for the pallas stack: lda_pallas_carry measured 10.50M tok/s =
+    # 1.33× over the plain kernel (1.63× over dense) on 1× v5e — the
+    # trace shows the carry removing the dominant [K, d_tile] DUS
+    # write-back; chain bit-identical (silicon kernel_equiv_check) and
+    # no whole-table copies in the HLO.  The DENSE-stack arm
+    # (`lda_carry`, 1.13×) was VETOED by the conditional gate, so the
+    # auto default stays off there.
+    # None = "on for the tiled algos" (the knob has no meaning for
+    # scatter/pushpull, and a bool default would make bare
+    # LDAConfig(algo='scatter') unconstructible); an explicit True on a
+    # non-tiled algo still raises.
+    carry_db: bool | None = None
     # algo="pallas" only: exact base-256-plane count gathers (ADVICE r3 —
     # single-dot bf16 gathers round counts > 256, perturbing the posterior
     # ~0.4% at enwiki hot-word counts).  Default ON: correctness first.
@@ -148,9 +164,12 @@ class LDAConfig:
     # from the IDENTICAL distribution (the winner of an exponential race
     # at rates p_k is k with probability p_k/Σp) with 1 log + 2 mul +
     # 1 div per element, ~5× fewer transcendentals on the VPU.  Same
-    # chain statistics, different random stream.  Kept opt-in until a
-    # TPU measurement picks the default (CLAUDE.md perf discipline).
-    sampler: str = "gumbel"
+    # chain statistics, different random stream.  FLIPPED 2026-08-01
+    # with the pallas algo (its required stack; the lda_fast A/B alone
+    # measured exprace+rbg 1.24× over gumbel+threefry at equal LL,
+    # while exprace+threefry was 0.98× — the noise TENSOR, not the
+    # transcendentals, was the wall).
+    sampler: str = "exprace"
     # Random-bit source for the per-[token, K] draws.  "threefry"
     # (default): JAX's counter-based PRNG — splittable, reproducible
     # across backends, but ~15 VPU ops per element; at 1k topics the
@@ -158,9 +177,10 @@ class LDAConfig:
     # share of the epoch.  "rbg": XLA's RngBitGenerator — the TPU
     # hardware generator, near-free, still deterministic per key but a
     # different (backend-dependent) stream.  Chain statistics unaffected
-    # (any iid uniform source is a valid Gibbs draw).  Opt-in until a
-    # TPU measurement picks the default (CLAUDE.md perf discipline).
-    rng_impl: str = "threefry"
+    # (any iid uniform source is a valid Gibbs draw).  FLIPPED
+    # 2026-08-01 with the pallas algo (see sampler above — rbg is where
+    # the lda_fast 1.24× comes from).
+    rng_impl: str = "rbg"
 
     def __post_init__(self):
         if self.ndk_dtype not in ("float32", "int16"):
@@ -186,6 +206,13 @@ class LDAConfig:
                 f"rng_impl must be 'threefry' or 'rbg', got {self.rng_impl!r}")
         if self.pull_cap is not None and self.algo != "pushpull":
             raise ValueError("pull_cap only applies to algo='pushpull'")
+        if self.carry_db is None:
+            # auto: ON for the PALLAS stack only — exactly the verdict
+            # (2026-08-01): `lda_pallas_carry` FLIPPED; `lda_carry`
+            # (the dense-stack arm) was VETOED by the conditional gate,
+            # so a dense config defaulting the carry on would apply an
+            # unauthorized flip.  Structurally OFF for scatter/pushpull.
+            self.carry_db = self.algo == "pallas"
         if self.carry_db and self.algo not in _TILED_ALGOS:
             raise ValueError("carry_db applies to the tiled algos "
                              f"{_TILED_ALGOS}, not algo={self.algo!r}")
@@ -1131,6 +1158,15 @@ def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
         sampler = "exprace" if algo == "pallas" else "gumbel"
     if rng_impl is None:
         rng_impl = "rbg" if algo == "pallas" else "threefry"
+    # benchmark/sweep identity is per-NAME: the `_carry` configs own the
+    # carry knob, so an unstated carry_db pins to OFF here even though
+    # the user-facing LDAConfig default flipped ON (2026-08-01) — else
+    # the flip would silently turn `lda`/`lda_pallas` sweep rows into
+    # carry rows and the A/B would compare a config against itself
+    # (owning algos only — a pinned False would trip algo_kwargs's
+    # non-owning-knob check for scatter/pushpull)
+    if carry_db is None and algo in _TILED_ALGOS:
+        carry_db = False
     return LDAConfig(n_topics=n_topics, ndk_dtype=ndk_dtype, sampler=sampler,
                      rng_impl=rng_impl,
                      **algo_kwargs(algo, {

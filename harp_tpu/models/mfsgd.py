@@ -70,8 +70,12 @@ class MFSGDConfig:
     # "pallas" fuses the dense entry update into one VMEM-resident kernel
     # (ops/mfsgd_kernel.py) — same data layout and update order as "dense",
     # minus the HBM round trips between XLA fusions; needs 128-multiple
-    # tiles and rank % 8 == 0 on TPU.
-    algo: str = "dense"
+    # tiles and rank % 8 == 0 on TPU.  FLIPPED to "pallas" 2026-08-01
+    # (1× v5e, FLIP_DECISIONS.jsonl): 188.1M ups/s/chip vs 83.1M dense
+    # = 2.26× at identical rmse_final (0.366, silicon-equivalence-gated);
+    # the trace shows the kernel absorbing the one-hot operand traffic
+    # that made dense memory-bound at ~11% of HBM peak.
+    algo: str = "pallas"
     # dense tiling: 512×512 measured best on v5e (84–102M ups vs 60–80M at
     # 1024/2048 tiles — one-hot traffic grows with tile width and dominates
     # before scan-step overhead does)
@@ -94,8 +98,10 @@ class MFSGDConfig:
     # slice+DUS per entry (the LDA carry_db lever — entries are u-major,
     # so a hot W block's entries currently re-pay the [u_tile, r] in+out
     # per entry).  The pallas kernel already keeps W resident across its
-    # block runs, so this applies to the XLA path alone.  Default OFF
-    # until the mfsgd_carry sweep config measures it (flip gate).
+    # block runs, so this applies to the XLA path alone.  MEASURED
+    # 2026-08-01 (1× v5e): 1.01× vs dense — no win (the analytic 20%
+    # byte saving is hidden behind other traffic) — and the kernel flip
+    # supersedes the slot anyway; stays OFF.
     carry_w: bool = False
 
     def __post_init__(self):

@@ -244,8 +244,14 @@ def test_pallas_approx_gathers_still_converge(mesh):
 
 
 def test_pallas_requires_fused_sampling_stack():
+    # since the 2026-08-01 flip the DEFAULT stack is the kernel's own
+    # (exprace + rbg), so a bare pallas config is valid...
+    assert L.LDAConfig(n_topics=8, algo="pallas").sampler == "exprace"
+    # ...but an EXPLICIT mismatched stack still refuses: the config must
+    # never claim a sampler the kernel doesn't run
     with pytest.raises(ValueError, match="exprace"):
-        L.LDAConfig(n_topics=8, algo="pallas")  # default gumbel/threefry
+        L.LDAConfig(n_topics=8, algo="pallas", sampler="gumbel",
+                    rng_impl="threefry")
 
 
 def test_pallas_benchmark_defaults_upgrade(mesh):
