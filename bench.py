@@ -52,8 +52,11 @@ BASELINES = {
     "kmeans_ingest": 66.4e3,  # points/s, 20M×300 f16 disk npy — relay-
                             # tunnel-bound (44.6 MB/s host == probed H2D)
     "mfsgd": 83.1e6,        # updates/s/chip, ML-20M shapes, dense algo
-    "mfsgd_pallas": 188.1e6,  # fused kernel — the DEFAULT algo since the
-                            # 2026-08-01 flip (2.26× dense, equal RMSE)
+    "mfsgd_pallas": 246.5e6,  # fused kernel — the DEFAULT algo since the
+                            # 2026-08-01 flip; 256×256 auto-tile after
+                            # the same-day sweep (250.2M vs 195.5M at
+                            # 512; 246.5M re-confirmed through the
+                            # default path) = 2.97× dense, equal RMSE
     "lda": 6.46e6,          # tokens/s/chip, 100k docs × 1k topics, dense
     "lda_pallas": 7.92e6,   # fused kernel, carry pinned off (incumbent arm)
     "lda_pallas_carry": 10.50e6,  # kernel + Db-carry — the DEFAULT

@@ -71,16 +71,26 @@ class MFSGDConfig:
     # (ops/mfsgd_kernel.py) — same data layout and update order as "dense",
     # minus the HBM round trips between XLA fusions; needs 128-multiple
     # tiles and rank % 8 == 0 on TPU.  FLIPPED to "pallas" 2026-08-01
-    # (1× v5e, FLIP_DECISIONS.jsonl): 188.1M ups/s/chip vs 83.1M dense
-    # = 2.26× at identical rmse_final (0.366, silicon-equivalence-gated);
-    # the trace shows the kernel absorbing the one-hot operand traffic
-    # that made dense memory-bound at ~11% of HBM peak.
+    # (1× v5e, FLIP_DECISIONS.jsonl): 246.5M ups/s/chip at the swept
+    # 256×256 auto-tiles vs 83.1M dense = 2.97× at identical rmse_final
+    # (0.366, silicon-equivalence-gated; 188.1M = 2.26× pre-sweep at
+    # 512 tiles); the trace shows the kernel absorbing the one-hot
+    # operand traffic that made dense memory-bound at ~11% of HBM peak.
     algo: str = "pallas"
-    # dense tiling: 512×512 measured best on v5e (84–102M ups vs 60–80M at
-    # 1024/2048 tiles — one-hot traffic grows with tile width and dominates
-    # before scan-step overhead does)
-    u_tile: int = 512
-    i_tile: int = 512
+    # Tiling, auto per algo (None).  dense: 512×512 measured best on v5e
+    # (84–102M ups vs 60–80M at 1024/2048 — one-hot traffic grows with
+    # tile width and dominates before scan-step overhead does).  pallas:
+    # 256×256 measured best 2026-08-01 (SWEEP_pallas.jsonl, 1× v5e,
+    # ML-20M shapes, identical rmse_final 0.366): 250.2M ups/s vs
+    # 195.5M at 512 and 147.3M at 128 — the kernel keeps one-hots in
+    # VMEM, so smaller W/H tiles (less slice traffic per entry) win
+    # until grid overhead bites.
+    # None = auto, resolved at READ time by :func:`tiles` — not baked in
+    # at construction, so ``dataclasses.replace(cfg, algo=...)`` keeps
+    # the auto default tracking the new algo instead of freezing the
+    # old algo's resolved value (review finding, round 5).
+    u_tile: int | None = None
+    i_tile: int | None = None
     # max ratings per dense entry; overfull tiles split into several entries
     # (keeps padding bounded under power-law item skew)
     entry_cap: int = 2048
@@ -113,6 +123,20 @@ class MFSGDConfig:
                 "carry_w applies to algo='dense' only (the pallas kernel "
                 "already keeps W resident across its block runs; scatter "
                 "has no tile slicing to amortize)")
+
+
+def tiles(cfg: MFSGDConfig) -> tuple[int, int]:
+    """Resolved ``(u_tile, i_tile)`` — None means auto per algo.
+
+    pallas: 256×256 (measured best 2026-08-01, SWEEP_pallas.jsonl, 1×
+    v5e ML-20M: 250.2M ups/s vs 195.5M@512 / 163.3M@1024 / 147.3M@128,
+    identical rmse — smaller tiles win inside the kernel because the
+    one-hots never leave VMEM, until grid overhead bites).  dense: 512
+    (measured best vs 1024/2048, 2026-07-30).
+    """
+    auto = 256 if cfg.algo == "pallas" else 512
+    return (cfg.u_tile if cfg.u_tile is not None else auto,
+            cfg.i_tile if cfg.i_tile is not None else auto)
 
 
 # ---------------------------------------------------------------------------
@@ -334,7 +358,7 @@ def _entry_tiles_update(Wb, Hb, cu, ci, cv, cfg: MFSGDConfig):
     here, so the ``carry_w`` path can keep a W tile resident across its
     u-run (slicing strategy is the caller's concern; shared math keeps
     carry and non-carry chains bit-identical)."""
-    UR, IR = cfg.u_tile, cfg.i_tile
+    UR, IR = tiles(cfg)
     cd = cfg.compute_dtype
     dot = partial(lax.dot_general, preferred_element_type=jnp.float32)
     ohu = jax.nn.one_hot(cu, UR, dtype=cd)          # [C, UR]
@@ -386,7 +410,7 @@ def _tile_block_update(W, H, block, cfg: MFSGDConfig):
     is exact under any entry order; bit-identical chains tested).
     """
     eu, ei, ev, ou, oi = block
-    UR, IR = cfg.u_tile, cfg.i_tile
+    UR, IR = tiles(cfg)
 
     if cfg.carry_w:
         def body(carry, xs):
@@ -431,7 +455,7 @@ def _pallas_tile_block_update(W, H, block, cfg: MFSGDConfig):
     eu, ei, ev, ou, oi = block
     Wt, Ht, se, cnt = sgd_tile_update(
         W.T, H.T, eu, ei, ev, ou, oi,
-        lr=cfg.lr, reg=cfg.reg, u_tile=cfg.u_tile, i_tile=cfg.i_tile,
+        lr=cfg.lr, reg=cfg.reg, u_tile=tiles(cfg)[0], i_tile=tiles(cfg)[1],
         compute_dtype=cfg.compute_dtype,
         interpret=interpret_default())
     return Wt.T, Ht.T, se, cnt
@@ -551,7 +575,7 @@ class MFSGD:
         n = self.mesh.num_workers
         if self.cfg.algo in _DENSE_ALGOS:
             self.u_own, self.i_own, self.u_bound, ib2 = _dense_bounds(
-                n_users, n_items, n, 2 * n, self.cfg.u_tile, self.cfg.i_tile)
+                n_users, n_items, n, 2 * n, *tiles(self.cfg))
             self.i_bound = 2 * ib2
         else:
             self.u_bound = self.u_own = _ceil_div(n_users, n)
@@ -575,14 +599,14 @@ class MFSGD:
         if self.cfg.algo in _DENSE_ALGOS:
             eu, ei, ev, ou, oi, uo, io, ub, ib2 = partition_ratings_tiles(
                 users, items, vals, self.n_users, self.n_items, n,
-                self.cfg.u_tile, self.cfg.i_tile, self.cfg.entry_cap,
+                *tiles(self.cfg), self.cfg.entry_cap,
             )
             assert (uo, io) == (self.u_own, self.i_own)
             if self.cfg.algo == "pallas":
                 from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
 
                 eu, ei, ev, ou, oi = insert_coverage_entries(
-                    eu, ei, ev, ou, oi, ub, self.cfg.u_tile)
+                    eu, ei, ev, ou, oi, ub, tiles(self.cfg)[0])
             blocks = (eu, ei, ev, ou, oi)
         else:
             bu, bi, bv, bm, ub, ib2 = partition_ratings(

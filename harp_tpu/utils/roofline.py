@@ -122,6 +122,7 @@ WORK_MODELS = {
     "lda_pallas_approx_hot": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
+    "lda_scale_1m_pallas": _lda_work,
     "lda_scatter": _lda_work,
     "mlp": _mlp_work,
 }

@@ -167,7 +167,9 @@ def test_pallas_rejects_oversized_resident_h():
     # shapes the driver bench compiles FIRST on real TPU (captured from
     # the smoke bench: C=200 pads to 256 by insert_coverage_entries'
     # 128-multiple rule), and the 8-worker-sim smoke shape
-    (64, 2048, 13440, 8, 2048, 512),
+    (64, 2048, 13440, 8, 2048, 256),  # DEFAULT tiles since the
+                                      # 2026-08-01 sweep (250.2M@256)
+    (64, 2048, 13440, 8, 2048, 512),  # explicit 512 stays supported
     (8, 512, 128, 2, 256, 128),    # 1-worker TPU smoke (u_bound=512)
     (8, 128, 128, 1, 256, 128),    # 8-worker sim smoke (u_bound=128)
 ])
@@ -193,8 +195,9 @@ def test_kernel_lowers_for_tpu(shape):
 
 def test_ml20m_pallas_epoch_lowers_for_tpu(mesh, monkeypatch):
     """The fused-kernel ML-20M epoch (138,493×26,744 grid, rank 64,
-    512×512 tiles, 8-way mesh), MOSAIC-compiled, lowers for TPU on this
-    CPU host — transposes, rotation scan, scalar-prefetch grids and the
+    the auto-resolved default tiles — 256×256 since the 2026-08-01
+    sweep — 8-way mesh), MOSAIC-compiled, lowers for TPU on this CPU
+    host — transposes, rotation scan, scalar-prefetch grids and the
     kernel itself at the true graded shapes."""
     import jax
     import jax.numpy as jnp
@@ -203,7 +206,7 @@ def test_ml20m_pallas_epoch_lowers_for_tpu(mesh, monkeypatch):
     cfg = MF.MFSGDConfig(rank=64, algo="pallas")
     n, ns = 8, 16
     _, _, u_bound, ib2 = MF._dense_bounds(
-        138_493, 26_744, n, ns, cfg.u_tile, cfg.i_tile)
+        138_493, 26_744, n, ns, *MF.tiles(cfg))
     NE, C = 96, 2048  # ~20M ratings / (n·ns) rows at C=2048 + coverage
     i32, f32 = jnp.int32, jnp.float32
     shapes = [((u_bound * n, 64), f32), ((2 * ib2 * n, 64), f32),
