@@ -51,9 +51,16 @@ class KMeansConfig:
     # XLA's ring psum already lowers to reduce-scatter+allgather, so this
     # is not a performance knob.
     variant: str = "allreduce"
-    # opt-in single-pass Pallas kernel; the default XLA path measured faster
-    # on v5e (see harp_tpu/ops/kmeans_kernel.py for the numbers)
-    use_pallas: bool = False
+    # Single-pass Pallas kernel.  None = auto per path, exactly the
+    # measured verdicts (FLIP_DECISIONS.jsonl): ON for quantize="int8"
+    # — FLIPPED 2026-08-01, 555.1 iter/s vs 486.9 XLA int8 = 1.14× at
+    # equal inertia on the graded 1M×300 k=100 shape (the VMEM-budget
+    # tile chooser unlocked it: 8000-row tiles vs the old 2000 cap,
+    # see ops/kmeans_kernel._tile_rows_int8) — and OFF for f32, where
+    # the XLA path measured equal-or-faster (kernel 2.83 ms vs XLA
+    # ~2.5 ms, ops/kmeans_kernel.py).  Resolved at READ time
+    # (:func:`_use_pallas`) so dataclasses.replace keeps auto tracking.
+    use_pallas: bool | None = None
     # opt-in int8 point quantization: per-feature symmetric scales, distances
     # and partial sums as int8 MXU matmuls with exact int32 accumulation —
     # quarter the per-iteration HBM traffic of f32 points.  Accuracy
@@ -213,6 +220,15 @@ def kmeans_kernel_supported(n: int) -> bool:
     return kmeans_kernel.supported(n)
 
 
+def _use_pallas(cfg: KMeansConfig) -> bool:
+    """Resolved use_pallas — None means auto per path (the 2026-08-01
+    verdicts: fused kernel ON for int8 — 1.14× at equal inertia — OFF
+    for f32 where XLA measured equal-or-faster)."""
+    if cfg.use_pallas is None:
+        return cfg.quantize == "int8"
+    return cfg.use_pallas
+
+
 def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
     """One Lloyd iteration (device view, per-worker shard).
 
@@ -222,15 +238,20 @@ def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
     make_fit_fn).
     """
     if cfg.quantize == "int8":
+        from harp_tpu.ops import kmeans_kernel
+
         pts_q, col_scale = points  # (int8 [n, d], f32 [d]) — see fit()
-        if cfg.use_pallas and kmeans_kernel_supported(pts_q.shape[0]):
+        # the gate consults the int8 kernel's OWN supportability (tile
+        # within the VMEM budget AND d inside the exact-accumulation
+        # bound) and falls back to the XLA path — the auto default must
+        # not make previously-working shapes raise
+        if _use_pallas(cfg) and kmeans_kernel.int8_supported(
+                pts_q.shape[0], pts_q.shape[1], cfg.k):
             # fused single-pass kernel: the XLA int8 path materializes
             # ~2 GB/iter of [n, k] intermediates at the graded shape and
             # clocks the same 2.5 ms/iter as f32 (1M×300 k=100, 1× v5e,
             # 2026-07-31); the kernel reads only the int8 stream.  x2 is
             # required: the fused path never re-reads points for it.
-            from harp_tpu.ops import kmeans_kernel
-
             assert x2 is not None, "fused int8 path needs the hoisted x2"
             c_q, c_scale, c2 = _quantize_centroids(centroids, col_scale)
             sums, counts, best_sum = kmeans_kernel.kmeans_partials_int8(
@@ -246,7 +267,7 @@ def kmeans_step(points, centroids, cfg: KMeansConfig, x2=None):
                                  cfg, nw)
     n = points.shape[0]
     block = cfg.block_points
-    if cfg.use_pallas and kmeans_kernel_supported(n):
+    if _use_pallas(cfg) and kmeans_kernel_supported(n):
         from harp_tpu.ops import kmeans_kernel
 
         if block:
@@ -372,7 +393,7 @@ def kmeanspp_init(points, k, seed=0, sample=50_000):
 
 
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
-        dtype=jnp.float32, block_points=0, use_pallas=False,
+        dtype=jnp.float32, block_points=0, use_pallas=None,
         variant="allreduce", quantize=None, init="random"):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
@@ -419,7 +440,7 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
-              warmup=2, seed=0, use_pallas=False, variant="allreduce",
+              warmup=2, seed=0, use_pallas=None, variant="allreduce",
               quantize=None):
     """Measure iter/sec on the graded 1M×300 k=100 config (north-star metric)."""
     mesh = mesh or current_mesh()

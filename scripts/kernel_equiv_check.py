@@ -136,7 +136,11 @@ def main():
 
     # 3. KMeans: fused int8 kernel == XLA int8 formulation
     pts = rng.normal(size=(1024, 16)).astype(np.float32) * 3
-    ca, ia = kfit(pts, k=4, iters=4, mesh=mesh, seed=5, quantize="int8")
+    # use_pallas=False EXPLICIT: since the int8 auto default flipped to
+    # the kernel (2026-08-01), an unset arm would make this check
+    # kernel-vs-kernel — vacuously green (review finding, round 5)
+    ca, ia = kfit(pts, k=4, iters=4, mesh=mesh, seed=5, quantize="int8",
+                  use_pallas=False)
     cb, ib = kfit(pts, k=4, iters=4, mesh=mesh, seed=5, quantize="int8",
                   use_pallas=True)
     np.testing.assert_allclose(ca, cb, rtol=1e-5, atol=1e-5)

@@ -94,8 +94,11 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "kmeans": lambda: kmeans.benchmark(
             **(SMOKE["kmeans"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        # use_pallas=False pins the XLA incumbent arm: the user-facing
+        # auto default is the fused kernel since the 2026-08-01 flip,
+        # and the A/B identity must not follow it
         "kmeans_int8": lambda: kmeans.benchmark(
-            quantize="int8",
+            quantize="int8", use_pallas=False,
             **(SMOKE["kmeans"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         # round 3: the FUSED int8 kernel (ops/kmeans_kernel.py) — the XLA

@@ -163,7 +163,8 @@ def test_int8_quantized_fit_matches_f32_on_separated_clusters(mesh):
         for i in range(4)
     ])
     c_f32, _ = fit(pts, k=4, iters=8, mesh=mesh, seed=0)
-    c_q, _ = fit(pts, k=4, iters=8, mesh=mesh, seed=0, quantize="int8")
+    c_q, _ = fit(pts, k=4, iters=8, mesh=mesh, seed=0, quantize="int8",
+               use_pallas=False)  # the XLA int8 arm, explicitly
     # same clustering: centroids agree to quantization tolerance
     np.testing.assert_allclose(np.sort(c_q, 0), np.sort(c_f32, 0),
                                rtol=5e-2, atol=0.2)

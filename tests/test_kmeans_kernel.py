@@ -117,8 +117,50 @@ def test_int8_fit_pallas_matches_xla_int8_fit(mesh):
     # end-to-end: fit(quantize='int8', use_pallas=True) ≡ the XLA int8
     # fit — identical assignments → identical centroid chains
     pts, _ = _blobs(1024, 24, 6, seed=3)
-    c_a, i_a = fit(pts, k=6, iters=5, mesh=mesh, seed=2, quantize="int8")
+    # use_pallas=False explicit: the int8 auto default IS the kernel
+    # now, so an unset arm would compare the kernel with itself
+    c_a, i_a = fit(pts, k=6, iters=5, mesh=mesh, seed=2, quantize="int8",
+                   use_pallas=False)
     c_b, i_b = fit(pts, k=6, iters=5, mesh=mesh, seed=2, quantize="int8",
                    use_pallas=True)
     np.testing.assert_allclose(c_a, c_b, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(i_a, i_b, rtol=1e-4)
+
+
+def test_int8_tile_chooser_respects_vmem_budget():
+    # the byte model is calibrated by the measured silicon OOM
+    # (tn=10000 → 16.23 MB scoped vs the 16 MB limit, 2026-08-01):
+    # at the graded shape the biggest fitting divisor is 8000
+    from harp_tpu.ops.kmeans_kernel import _tile_rows_int8, int8_supported
+    assert _tile_rows_int8(1_000_000, 300, 128) == 8000
+    # a wider d shrinks the chosen tile
+    wide = _tile_rows_int8(1_000_000, 1000, 128)
+    assert wide is not None and wide < 8000
+    # a huge padded k can make no tile fit
+    assert _tile_rows_int8(8, 300, 1 << 22) is None
+    # d beyond the exact-f32-accumulation bound is unsupported regardless
+    assert not int8_supported(1024, 1100, 4)
+    assert int8_supported(1024, 300, 4)
+
+
+def test_use_pallas_auto_per_path():
+    import dataclasses
+
+    from harp_tpu.models.kmeans import KMeansConfig, _use_pallas
+    # the 2026-08-01 verdicts: auto = kernel ON for int8, OFF for f32
+    assert _use_pallas(KMeansConfig(quantize="int8"))
+    assert not _use_pallas(KMeansConfig())
+    # explicit always wins
+    assert not _use_pallas(KMeansConfig(quantize="int8", use_pallas=False))
+    assert _use_pallas(KMeansConfig(use_pallas=True))
+    # None stays None through replace, so auto keeps tracking the path
+    cfg = KMeansConfig(quantize="int8")
+    assert not _use_pallas(dataclasses.replace(cfg, quantize=None))
+
+
+def test_int8_auto_falls_back_when_kernel_unsupported(mesh):
+    # d=1048 exceeds the kernel's exact-accumulation bound (d <= 1040):
+    # the auto default must route to the XLA int8 path, not raise
+    pts = np.random.default_rng(0).normal(size=(64, 1048)).astype(np.float32)
+    c, inertia = fit(pts, k=4, iters=2, mesh=mesh, seed=0, quantize="int8")
+    assert np.isfinite(inertia) and c.shape == (4, 1048)
