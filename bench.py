@@ -48,6 +48,9 @@ _BENCH_DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # None = no TPU number recorded yet (vs_baseline stays null until one is).
 BASELINES = {
     "kmeans": 381.2,        # iter/s, 1M×300 k=100 f32 (±5% window spread)
+    "kmeans_int8_fused": 555.1,  # fused int8 kernel — the int8-path
+                            # default since the 2026-08-01 flip (1.14×
+                            # XLA int8 at equal inertia, 8000-row tiles)
     "kmeans_stream": 0.53,  # iter/s end-to-end, 100M×300 k=1000 (1.09 ex-gen)
     "kmeans_ingest": 66.4e3,  # points/s, 20M×300 f16 disk npy — relay-
                             # tunnel-bound (44.6 MB/s host == probed H2D)
@@ -218,6 +221,7 @@ def _ingest_bench(smoke):
 # only itself, not the configs after it (same rule as measure_all).
 _CONFIG_KEYS = [
     ("kmeans", "iters_per_sec"),
+    ("kmeans_int8_fused", "iters_per_sec"),
     ("kmeans_stream", "iters_per_sec"),
     ("mfsgd", "updates_per_sec_per_chip"),
     ("mfsgd_pallas", "updates_per_sec_per_chip"),
@@ -240,6 +244,17 @@ def _configs(smoke):
 
     thunks = {
         "kmeans": lambda: kmeans.benchmark(
+            # use_pallas=False pins the f32 XLA arm (the f32 auto is
+            # also False today, but the row identity must not follow a
+            # future default change)
+            use_pallas=False,
+            **({"n": 8192, "d": 32, "k": 16, "iters": 20, "warmup": 2}
+               if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100,
+                "warmup": 5})),
+        # the int8-path default since the 2026-08-01 flip, knobs pinned
+        "kmeans_int8_fused": lambda: kmeans.benchmark(
+            quantize="int8", use_pallas=True,
             **({"n": 8192, "d": 32, "k": 16, "iters": 20, "warmup": 2}
                if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100,
