@@ -91,7 +91,9 @@ class LDAConfig:
     algo: str = "pallas"
     d_tile: int = 512   # dense: doc-topic tile rows
     w_tile: int = 512   # dense: word-topic tile rows
-    entry_cap: int = 2048  # dense: max tokens per tile entry
+    entry_cap: int = 2048  # dense/pallas: max tokens per tile entry —
+    # 2048 measured best on the kernel+carry stack (2026-08-01, 1× v5e:
+    # 10.5M tok/s vs 10.17M @1024 / 10.30M @4096)
     chunk: int = 8192   # scatter/pushpull: tokens sampled per count-snapshot
     # pushpull: row-request slots per (worker, owner) pair and chunk.  The
     # default (= chunk) guarantees zero drops (a chunk can never request
