@@ -148,23 +148,27 @@ def project(n_workers=(4, 8, 16, 32)):
              projected=1.0 / (t_comp + t_comm))
 
         # MF-SGD MovieLens-20M: epoch = 20M updates; H [26744, 64] f32
-        # rotates in N double-buffered slices
-        r = lm["mfsgd"]["value"]  # updates/s/chip
+        # rotates in N double-buffered slices.  Rate = the DEFAULT stack
+        # (fused kernel since the 2026-08-01 flip): ~3× the dense rate
+        # shrinks the compute window the ring hides under — the honest
+        # projection must use the shipped default, not the slower arm
+        r = lm["mfsgd_pallas"]["value"]  # updates/s/chip
         t_comp = 20e6 / n / r
         slice_b = 4 * 26_744 * 64 / n
-        emit("mfsgd", "mfsgd", n, rotate_eff(t_comp, slice_b, n), t_comp,
-             slice_b * n, "rotate", "epoch", True,
-             "projected updates/s/chip; rotation comm double-buffers "
-             "under compute")
+        emit("mfsgd", "mfsgd_pallas", n, rotate_eff(t_comp, slice_b, n),
+             t_comp, slice_b * n, "rotate", "epoch", True,
+             "projected updates/s/chip (fused-kernel default); rotation "
+             "comm double-buffers under compute")
 
-        # LDA enwiki-1M: epoch = 100M tokens; Nwk [50k, 1000] f32 rotates
-        r = lm["lda"]["value"]  # tokens/s/chip
+        # LDA enwiki-1M: epoch = 100M tokens; Nwk [50k, 1000] f32 rotates.
+        # Rate = the default stack (kernel + exprace + rbg + Db-carry)
+        r = lm["lda_pallas_carry"]["value"]  # tokens/s/chip
         t_comp = 100e6 / n / r
         slice_b = 4 * 50_000 * 1000 / n
-        emit("lda", "lda", n, rotate_eff(t_comp, slice_b, n), t_comp,
-             slice_b * n, "rotate", "epoch", True,
-             "projected tokens/s/chip; the 200 MB Nwk ring is the "
-             "heaviest wire in the suite")
+        emit("lda", "lda_pallas_carry", n, rotate_eff(t_comp, slice_b, n),
+             t_comp, slice_b * n, "rotate", "epoch", True,
+             "projected tokens/s/chip (default stack); the 200 MB Nwk "
+             "ring is the heaviest wire in the suite")
 
         # MLP MNIST: DP step at per-chip batch 8192; grads psum
         r = lm["mlp"]["value"]  # samples/s (1 chip)
