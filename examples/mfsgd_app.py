@@ -50,7 +50,9 @@ def main():
             # through self.reader; see `python -m harp_tpu mfsgd --input`)
             u, i, v = synthetic_ratings(args.users, args.items, args.nnz,
                                         rank=4, noise=0.05, seed=0)
-            cfg = MFSGDConfig(rank=args.rank, lr=0.05,
+            # algo="dense" explicitly: the demo's 64-row tiles are below
+            # the default pallas kernel's 128-multiple TPU minimum
+            cfg = MFSGDConfig(rank=args.rank, lr=0.05, algo="dense",
                               u_tile=64, i_tile=64, entry_cap=256)
             model = MFSGD(args.users, args.items, cfg, self.mesh, seed=0)
             model.set_ratings(u, i, v)
