@@ -102,7 +102,8 @@ def ensure_dataset(fmt: str, rows: int, cols: int, disk_dtype: str,
 
 def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
         k=1000, iters=2, chunk_points=262_144, keep=False,
-        compare_synthetic=False, drop_caches=False, verbose=True) -> dict:
+        compare_synthetic=False, drop_caches=False, verbose=True,
+        quantize=None) -> dict:
     import numpy as np
 
     from harp_tpu.models.kmeans_stream import benchmark_ingest
@@ -128,7 +129,8 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
         res = benchmark_ingest(pts, k=k, iters=iters,
                                chunk_points=chunk_points,
                                disk_bytes=os.path.getsize(path),
-                               compare_synthetic=compare_synthetic)
+                               compare_synthetic=compare_synthetic,
+                               quantize=quantize)
         res.update({"format": fmt, "disk_dtype":
                     (disk_dtype if fmt == "npy" else "text"),
                     "cold_cache": cold})
@@ -140,11 +142,11 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
             os.remove(path)
 
 
-def run_smoke() -> dict:
+def run_smoke(quantize=None) -> dict:
     """The ONE smoke preset shared by bench.py and measure_all — tiny
     npy, CPU-safe, regenerated per run."""
     return run("npy", 20_000, 32, "float32", k=16, iters=2,
-               chunk_points=4096, verbose=False)
+               chunk_points=4096, verbose=False, quantize=quantize)
 
 
 def relay_sized_chunk(cols=300, dtype_bytes=2, default=262_144,
@@ -187,7 +189,7 @@ def relay_sized_chunk(cols=300, dtype_bytes=2, default=262_144,
     return (rows // 8192) * 8192
 
 
-def run_full(compare_synthetic: bool = False) -> dict:
+def run_full(compare_synthetic: bool = False, quantize=None) -> dict:
     """The ONE full preset shared by bench.py and measure_all: 20M×300
     float16 (12 GB), kept in .bench_data/ for reuse across runs.
     ``compare_synthetic`` adds the device-regenerated compute twin (a
@@ -197,7 +199,7 @@ def run_full(compare_synthetic: bool = False) -> dict:
     probe is on record (:func:`relay_sized_chunk`)."""
     return run("npy", 20_000_000, 300, "float16", k=1000, iters=2,
                chunk_points=relay_sized_chunk(), keep=True,
-               compare_synthetic=compare_synthetic)
+               compare_synthetic=compare_synthetic, quantize=quantize)
 
 
 def main(argv=None):

@@ -304,7 +304,8 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
     for mod in (kmeans, lda, mfsgd, mlp, rf, subgraph):
         stubbed(mod, "benchmark")
     stubbed(kmeans_stream, "benchmark_streaming")
-    monkeypatch.setattr(ma, "_bench_ingest", lambda smoke: {"stub": 1.0})
+    monkeypatch.setattr(ma, "_bench_ingest",
+                        lambda smoke, quantize=None: {"stub": 1.0})
     monkeypatch.setattr(roofline, "annotate", lambda name, res: res)
 
     rows = list(ma.run_all(smoke=False, only=None))
