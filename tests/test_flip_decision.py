@@ -179,7 +179,8 @@ def test_sprint_order_prices_scarcity():
         assert cspec["incumbent"] in order, cspec["incumbent"]
         assert order.index(name) < boundary, (
             f"{name} must run before the re-measure block")
-    assert order[-1] == "kmeans_ingest"  # host-bound: last
+    # host-bound ingest pair stays last (f16 then its int8-wire twin)
+    assert order[-2:] == ["kmeans_ingest", "kmeans_ingest_int8"]
 
 
 def test_joint_gate_vetoes_half_passed_knob(tmp_path, capsys):
