@@ -353,3 +353,38 @@ def test_conditional_gate_vetoes_on_unmeasured_anchor(tmp_path, capsys):
     assert not out[0]["flip"]
     assert "UNMEASURED" in out[0]["reason"]
     assert "FLIP:" not in out[0]["reason"]
+
+
+def test_applied_flips_match_committed_verdicts():
+    """The gate's contract: an authorized FLIP line is APPLIED (defaults
+    follow verdicts, same commit).  This pins the coupling so an
+    accidental default revert — or a FLIP line committed unapplied —
+    fails loudly.  Reads the committed FLIP_DECISIONS.jsonl (round-5
+    window verdicts, 2026-08-01)."""
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "FLIP_DECISIONS.jsonl")
+    verdicts = {}
+    with open(path) as f:
+        for line in f:
+            r = json.loads(line)
+            verdicts[r["flip_decision"]] = r["flip"]
+    # the five round-5 flips (lda_fast's edit is subsumed by lda_pallas)
+    assert verdicts["mfsgd_pallas"] and verdicts["lda_pallas"]
+    assert verdicts["lda_pallas_carry"] and verdicts["lda_fast"]
+    assert verdicts["kmeans_int8_fused"]
+
+    from harp_tpu.models.kmeans import KMeansConfig, _use_pallas
+    from harp_tpu.models.lda import LDAConfig
+    from harp_tpu.models.mfsgd import MFSGDConfig
+
+    assert MFSGDConfig().algo == "pallas"
+    lcfg = LDAConfig()
+    assert (lcfg.algo, lcfg.sampler, lcfg.rng_impl) == (
+        "pallas", "exprace", "rbg")
+    assert lcfg.carry_db is True
+    assert _use_pallas(KMeansConfig(quantize="int8"))
+    # and the VETOED arms stayed un-applied
+    assert not verdicts["lda_carry"] and not verdicts["mfsgd_carry"]
+    assert LDAConfig(algo="dense").carry_db is False
+    assert MFSGDConfig().carry_w is False
+    assert not _use_pallas(KMeansConfig())  # f32 arm: XLA stays
