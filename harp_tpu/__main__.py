@@ -31,6 +31,8 @@ APPS = {
     "stats": ("harp_tpu.models.stats",
               "classic analytics: pca/cov/moments/naive/linreg/ridge/qr/svd/als"),
     "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
+    "report": ("harp_tpu.report",
+               "merged run report: comm ledger + spans + metrics + top ops"),
 }
 
 

@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import telemetry
 from harp_tpu.utils.timing import device_sync
 
 VERBS = {
@@ -50,12 +51,18 @@ def bench_verb(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
     n_rows = max(mult, size_bytes // (4 * 128) // mult * mult)
     x = np.random.default_rng(0).normal(size=(n_rows, 128)).astype(np.float32)
     op = C.host_op(mesh, fn, in_dim=0, out_dim=out_dim, **kwargs)
-    out = op(x)
-    device_sync(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    # telemetry: the warmup call traces the verb's comm site; the timed
+    # loop re-invokes the cached executable reps times — the ledger's
+    # execution counter is what turns one traced byte sheet into volume
+    with telemetry.ledger.run(f"bench.{name}", steps=1):
         out = op(x)
     device_sync(out)
+    t0 = time.perf_counter()
+    with telemetry.span(f"bench.{name}", bytes=x.nbytes, reps=reps), \
+            telemetry.ledger.run(f"bench.{name}", steps=reps):
+        for _ in range(reps):
+            out = op(x)
+        device_sync(out)
     dt = (time.perf_counter() - t0) / reps
     payload = x.nbytes * wire(nw)
     return {"verb": name, "bytes": x.nbytes, "sec": dt,
@@ -230,6 +237,9 @@ def main(argv=None):
         bench = bench_sparse if verb in SPARSE_VERBS else bench_verb
         for s in sizes:
             print(json.dumps(bench(verb, mesh, s, args.reps)))
+    from harp_tpu.report import maybe_emit
+
+    maybe_emit("bench")
 
 
 if __name__ == "__main__":

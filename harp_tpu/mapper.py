@@ -25,6 +25,7 @@ from typing import Any
 from harp_tpu.parallel import collective
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, init_distributed
 from harp_tpu.utils.metrics import MetricsLogger
+from harp_tpu.utils.telemetry import span
 
 log = logging.getLogger("harp_tpu")
 
@@ -128,7 +129,12 @@ class CollectiveApp:
         log.info("harp-tpu app starting: %d workers, config=%s",
                  self.num_workers, self.config)
         try:
-            result = self.map_collective()
+            # MetricsLogger is a context manager (close is idempotent):
+            # the file closes on ANY exit path, including mid-iteration
+            # exceptions inside map_collective
+            with self.metrics, span("map_collective",
+                                    app=type(self).__name__):
+                result = self.map_collective()
         finally:
             self.metrics.close()
         log.info("harp-tpu app finished in %.2fs", time.perf_counter() - t0)

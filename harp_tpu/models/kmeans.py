@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import telemetry
 from harp_tpu.utils.timing import device_sync
 
 
@@ -435,8 +436,13 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
             np.asarray(points, dtype=np.dtype(jnp.dtype(dtype).name)), 0)
     centroids = jax.device_put(centroids, mesh.replicated())
     fit_fn = make_fit_fn(mesh, cfg)
-    new_c, inertia = fit_fn(pts, centroids)
-    return np.asarray(new_c), float(inertia)
+    # telemetry: the T iterations run inside ONE dispatch, so the traced
+    # per-iteration comm sites execute cfg.iters times per invocation
+    with telemetry.span("kmeans.fit", iters=cfg.iters, k=k), \
+            telemetry.ledger.run("kmeans.fit", steps=cfg.iters):
+        new_c, inertia = fit_fn(pts, centroids)
+        inertia = float(inertia)
+    return np.asarray(new_c), inertia
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
@@ -501,12 +507,17 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
             run, in_specs=(pts_spec, P(), P()), out_specs=(P(), P()),
         )
     )
-    c_w, inertia = run_fn(points, centroids, jnp.int32(max(warmup, 1)))
-    device_sync(inertia)
+    # telemetry: n_iters is a traced scalar, so the loop body's comm sites
+    # trace once — the host knows the real per-invocation trip count
+    with telemetry.ledger.run("kmeans.benchmark", steps=max(warmup, 1)):
+        c_w, inertia = run_fn(points, centroids, jnp.int32(max(warmup, 1)))
+        device_sync(inertia)
 
     t0 = time.perf_counter()
-    centroids, inertia = run_fn(points, centroids, jnp.int32(iters))
-    inertia_val = device_sync(inertia)
+    with telemetry.span("kmeans.benchmark", iters=iters), \
+            telemetry.ledger.run("kmeans.benchmark", steps=iters):
+        centroids, inertia = run_fn(points, centroids, jnp.int32(iters))
+        inertia_val = device_sync(inertia)
     dt = time.perf_counter() - t0
     return {
         "iters_per_sec": iters / dt,
@@ -548,10 +559,13 @@ def main(argv=None):
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
+    from harp_tpu.report import maybe_emit
+
     if args.bench:
         out = benchmark(args.n, args.d, args.k, args.iters, dtype=dtype,
                         variant=args.variant, quantize=args.quantize)
         print(out)
+        maybe_emit("kmeans_bench")
     else:
         if args.input:
             from harp_tpu.native.datasource import load_csv_glob
@@ -568,6 +582,7 @@ def main(argv=None):
                          init=args.init)
         print(benchmark_json("kmeans_cli", {"k": args.k, "iters": args.iters, "n": pts.shape[0],
                "d": pts.shape[1], "inertia": inertia}))
+        maybe_emit("kmeans")
 
 
 if __name__ == "__main__":

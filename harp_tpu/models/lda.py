@@ -1027,13 +1027,18 @@ class LDA:
             raise RuntimeError("call set_tokens() before compile_epochs()")
         fn = self._multi_fns.get(epochs)
         if fn is None:
+            from harp_tpu.utils import telemetry
+
             jitted = make_multi_epoch_fn(
                 self.mesh, self.cfg, self.vocab_size, epochs,
                 self._count_bounds)
             keys = self.mesh.shard_array(self._keys, 0)
-            fn = self._multi_fns[epochs] = jitted.lower(
-                self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
-                keys).compile()
+            # steps=0: lowering traces the sweep's comm sites under the
+            # execution tag without counting an execution
+            with telemetry.ledger.run("lda.epochs", steps=0):
+                fn = self._multi_fns[epochs] = jitted.lower(
+                    self.Ndk, self.Nwk, self.Nk, self.z_grid,
+                    *self._tokens, keys).compile()
         return fn
 
     def _install_epoch_out(self, out):
@@ -1050,22 +1055,32 @@ class LDA:
         """Run ``epochs`` Gibbs sweeps as one device program (one dispatch,
         one sync) — see :func:`make_multi_epoch_fn`.  Use :meth:`fit` when
         checkpointing between sweeps."""
+        from harp_tpu.utils import telemetry
+
         fn = self.compile_epochs(epochs)
         keys = self.mesh.shard_array(self._keys, 0)
-        out = fn(self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
-                 keys)
-        self._advance_keys()
-        self._install_epoch_out(out)
+        # the scan body's traced comm sites execute once per Gibbs sweep
+        with telemetry.span("lda.epochs", epochs=epochs), \
+                telemetry.ledger.run("lda.epochs", steps=epochs):
+            out = fn(self.Ndk, self.Nwk, self.Nk, self.z_grid,
+                     *self._tokens, keys)
+            self._advance_keys()
+            self._install_epoch_out(out)
 
     def sample_epoch(self):
         if self._tokens is None:
             raise RuntimeError("call set_tokens() before sample_epoch()")
+        from harp_tpu.utils import telemetry
+
         keys = self.mesh.shard_array(self._keys, 0)
-        out = self._epoch_fn(
-            self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens, keys
-        )
-        self._advance_keys()
-        self._install_epoch_out(out)
+        with telemetry.span("lda.epoch"), \
+                telemetry.ledger.run("lda.epochs", steps=1):
+            out = self._epoch_fn(
+                self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
+                keys
+            )
+            self._advance_keys()
+            self._install_epoch_out(out)
 
     def _advance_keys(self):
         # PRNGKey(python_int) specializes on the int — a remote compile per
@@ -1437,6 +1452,9 @@ def main(argv=None):
             dedup_pulls=(False if args.no_dedup_pulls
                          else None), sampler=args.sampler,
             rng_impl=args.rng_impl)))
+    from harp_tpu.report import maybe_emit
+
+    maybe_emit("lda")
 
 
 if __name__ == "__main__":

@@ -623,8 +623,14 @@ class MFSGD:
         """One rotation epoch; returns training RMSE over visited ratings."""
         if self._blocks is None:
             raise RuntimeError("call set_ratings() before train_epoch()")
-        self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H, *self._blocks)
-        return float(np.sqrt(max(device_sync(se), 0.0) / max(device_sync(cnt), 1.0)))
+        from harp_tpu.utils import telemetry
+
+        with telemetry.span("mfsgd.epoch"), \
+                telemetry.ledger.run("mfsgd.epochs", steps=1):
+            self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H,
+                                                     *self._blocks)
+            return float(np.sqrt(max(device_sync(se), 0.0)
+                                 / max(device_sync(cnt), 1.0)))
 
     def compile_epochs(self, epochs: int):
         """AOT-compile the ``epochs``-epoch program WITHOUT running it.
@@ -638,9 +644,14 @@ class MFSGD:
             raise RuntimeError("call set_ratings() before compile_epochs()")
         fn = self._multi_fns.get(epochs)
         if fn is None:
+            from harp_tpu.utils import telemetry
+
             jitted = make_multi_epoch_fn(self.mesh, self.cfg, epochs)
-            fn = self._multi_fns[epochs] = jitted.lower(
-                self.W, self.H, *self._blocks).compile()
+            # steps=0: lowering traces the comm sites (attributed to the
+            # same tag the executions count under) without executing them
+            with telemetry.ledger.run("mfsgd.epochs", steps=0):
+                fn = self._multi_fns[epochs] = jitted.lower(
+                    self.W, self.H, *self._blocks).compile()
         return fn
 
     def train_epochs(self, epochs: int):
@@ -650,9 +661,14 @@ class MFSGD:
         on the relay-attached v5e — see :func:`make_multi_epoch_fn`).  Use
         ``fit()`` instead when checkpointing between epochs.
         """
+        from harp_tpu.utils import telemetry
+
         fn = self.compile_epochs(epochs)
-        self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
-        ses, cnts = np.asarray(ses), np.asarray(cnts)
+        # the scan body's traced comm sites execute once per epoch
+        with telemetry.span("mfsgd.epochs", epochs=epochs), \
+                telemetry.ledger.run("mfsgd.epochs", steps=epochs):
+            self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
+            ses, cnts = np.asarray(ses), np.asarray(cnts)
         return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
                 for s, c in zip(ses, cnts)]
 
@@ -876,6 +892,9 @@ def main(argv=None):
             args.nnz, args.rank, args.epochs, chunk=args.chunk,
             algo=args.algo, u_tile=args.u_tile,
             i_tile=args.i_tile, entry_cap=args.entry_cap)))
+    from harp_tpu.report import maybe_emit
+
+    maybe_emit("mfsgd")
 
 
 if __name__ == "__main__":

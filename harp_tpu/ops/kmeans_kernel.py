@@ -68,8 +68,11 @@ def _kernel(pts_ref, c_ref, sums_ref, counts_ref, inertia_ref, *, k: int):
     scores = jnp.where(row >= k, jnp.inf, c2 - 2.0 * dots)
 
     best = scores.min(axis=0, keepdims=True)           # [1, tn]
-    # lowest index among ties (argmin semantics) without a 1-D argmin
-    assign = jnp.where(scores == best, row, kp).min(axis=0, keepdims=True)
+    # lowest index among ties (argmin semantics) without a 1-D argmin; the
+    # min runs in f32 (exact for indices ≤ kp < 2^24) because Mosaic lacks
+    # integer reduce_min on older toolchains
+    assign = jnp.where(scores == best, row, kp).astype(jnp.float32) \
+        .min(axis=0, keepdims=True).astype(jnp.int32)
     onehot = (row == assign).astype(pts.dtype)         # [kp, tn]
 
     tile_sums = jax.lax.dot_general(
@@ -172,7 +175,9 @@ def _kernel_int8(pts_ref, cq_ref, cscale_ref, c2_ref, sums_ref, counts_ref,
     scores = jnp.where(row >= k, jnp.inf, c2_ref[:] - 2.0 * dots)
 
     best = scores.min(axis=0, keepdims=True)           # [1, tn]
-    assign = jnp.where(scores == best, row, kp).min(axis=0, keepdims=True)
+    # f32 tie-break min: see _kmeans_kernel (no integer reduce_min in Mosaic)
+    assign = jnp.where(scores == best, row, kp).astype(jnp.float32) \
+        .min(axis=0, keepdims=True).astype(jnp.int32)
     onehot = (row == assign).astype(jnp.bfloat16)      # [kp, tn] 0/1
 
     tile_sums = jax.lax.dot_general(

@@ -152,7 +152,10 @@ def _kernel(seed_ref, db_in, wb_in, nk_in, z_in, cd_in, cw_in, *rest,
     ratio = -jnp.log(u) * c / (a * b)                    # [K, cc]
 
     best = ratio.min(axis=0, keepdims=True)              # [1, cc]
-    z_new = jnp.where(ratio == best, rows_k, K).min(axis=0, keepdims=True)
+    # tie-break min runs in f32 (exact for indices ≤ K < 2^24): Mosaic has
+    # no integer reduce_min on older toolchains
+    z_new = jnp.where(ratio == best, rows_k, K).astype(jnp.float32) \
+        .min(axis=0, keepdims=True).astype(jnp.int32)
     z_new = jnp.where(m > 0, z_new, z)
     z_out[...] = z_new
 

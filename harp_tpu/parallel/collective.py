@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from harp_tpu.parallel.mesh import WORKER_AXIS
+from harp_tpu.utils.telemetry import record_comm
 
 
 class Combiner(enum.Enum):
@@ -96,6 +97,7 @@ def allreduce(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WOR
     sockets; on TPU it is one fused ``psum`` riding ICI.
     """
     comb = _as_combiner(op)
+    record_comm("allreduce", tree, axis=axis, combiner=comb.value)
     return jax.tree.map(lambda x: comb.reduce_over_axis(x, axis), tree)
 
 
@@ -122,12 +124,13 @@ def allreduce_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
     Harp's allreduce contract (and ours) is full-precision by default.
     """
     return _quantized_reduce(
-        tree, wire_dtype, axis,
+        tree, wire_dtype, axis, verb="allreduce_quantized",
         reduce_float=lambda x: lax.psum(x, axis),
         reduce_exact=lambda x: Combiner.ADD.reduce_over_axis(x, axis))
 
 
-def _quantized_reduce(tree, wire_dtype, axis, reduce_float, reduce_exact):
+def _quantized_reduce(tree, wire_dtype, axis, reduce_float, reduce_exact,
+                      verb):
     """Shared engine of :func:`allreduce_quantized` / :func:`push_quantized`
     — per-leaf scales via ONE stacked pmax, bf16 or exact-int32 int8
     accumulation; only the reduction primitive differs between the verbs."""
@@ -135,6 +138,9 @@ def _quantized_reduce(tree, wire_dtype, axis, reduce_float, reduce_exact):
     if wd not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8)):
         raise ValueError(f"unsupported wire_dtype {wire_dtype!r} "
                          "(use jnp.bfloat16 or jnp.int8)")
+    # recorded after the wire validation so a bad dtype raises the verb's
+    # ValueError whether or not telemetry is on; ADD is both twins' only op
+    record_comm(verb, tree, axis=axis, combiner="add", wire_dtype=wd)
     leaves, treedef = jax.tree.flatten(tree)
     is_float = [jnp.issubdtype(x.dtype, jnp.floating) for x in leaves]
 
@@ -185,7 +191,7 @@ def push_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
             return scatter(x.astype(jnp.int32)).astype(jnp.bool_)
         return scatter(x)
 
-    return _quantized_reduce(tree, wire_dtype, axis,
+    return _quantized_reduce(tree, wire_dtype, axis, verb="push_quantized",
                              reduce_float=scatter,
                              reduce_exact=scatter_exact)
 
@@ -197,6 +203,7 @@ def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):
     matching Harp's "table ends up holding all partitions" semantics; with
     ``tiled=False`` a new leading worker axis is added.
     """
+    record_comm("allgather", tree, axis=axis)
     return jax.tree.map(lambda x: lax.all_gather(x, axis, tiled=tiled), tree)
 
 
@@ -240,6 +247,7 @@ def _broadcast_float_jvp(root, axis, primals, tangents):
 
 def broadcast(tree: Any, root: int = 0, *, axis: str = WORKER_AXIS):
     """Every worker receives root's value — Harp chain/MST ``broadcast``."""
+    record_comm("broadcast", tree, axis=axis)
 
     def bcast(x):
         if jnp.issubdtype(x.dtype, jnp.floating):
@@ -261,6 +269,7 @@ def reduce(tree: Any, op: "Combiner | str" = Combiner.ADD, root: int = 0,
     (Harp leaves non-root tables empty; zeros are the dense analogue.)
     """
     comb = _as_combiner(op)
+    record_comm("reduce", tree, axis=axis, combiner=comb.value)
 
     def red(x):
         y = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
@@ -281,6 +290,7 @@ def regroup(tree: Any, *, axis: str = WORKER_AXIS, split_dim: int = 0,
     to one ``all_to_all``.
     """
     cd = split_dim if concat_dim is None else concat_dim
+    record_comm("regroup", tree, axis=axis)
     return jax.tree.map(
         lambda x: lax.all_to_all(x, axis, split_axis=split_dim,
                                  concat_axis=cd, tiled=True),
@@ -295,6 +305,7 @@ def rotate(tree: Any, shift: int = 1, *, axis: str = WORKER_AXIS):
     worker *i*'s data goes to worker *(i + shift) % N*.  Lowers to
     ``ppermute``, the same primitive ring attention is built on.
     """
+    record_comm("rotate", tree, axis=axis)
 
     def rot(x):
         n = lax.axis_size(axis)
@@ -315,6 +326,7 @@ def push(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WORKER_A
     block.  ``psum_scatter`` does exactly this in one op.
     """
     comb = _as_combiner(op)
+    record_comm("push", tree, axis=axis, combiner=comb.value)
 
     def do_push(x):
         if comb is Combiner.ADD:
@@ -347,6 +359,7 @@ def pull(tree: Any, *, axis: str = WORKER_AXIS, concat_dim: int = 0):
     pulls, gather rows *after* pulling (XLA keeps it fused) or use
     :func:`harp_tpu.table.pull_rows`.
     """
+    record_comm("pull", tree, axis=axis)
     return jax.tree.map(
         lambda x: lax.all_gather(x, axis, axis=concat_dim, tiled=True), tree
     )
@@ -360,7 +373,9 @@ def barrier(*, axis: str = WORKER_AXIS):
     boundary, which is occasionally useful for profiling phase separation).
     Host-level synchronization is ``jax.block_until_ready`` on any output.
     """
-    return lax.psum(jnp.zeros((), jnp.int32), axis)
+    z = jnp.zeros((), jnp.int32)
+    record_comm("barrier", z, axis=axis)
+    return lax.psum(z, axis)
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
 
+# jax.shard_map landed as a top-level export (with check_vma) after the
+# experimental era; on older jax the same callable lives in
+# jax.experimental.shard_map and the replication-check kwarg is check_rep.
+if hasattr(jax, "shard_map"):
+    _shard_map, _CHECK_KW = jax.shard_map, "check_vma"
+else:  # pragma: no cover - exercised only on old-jax environments
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+if not hasattr(lax, "axis_size"):  # pragma: no cover - old-jax only
+    # lax.axis_size is a late addition; psum of the static int 1 over the
+    # axis folds to the same static size on every jax that lacks it.  The
+    # shim lands on lax itself so the 20+ call sites (and any Harp-style
+    # app code written against the current API) need no indirection.
+    def _axis_size_compat(axis_name):
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = _axis_size_compat
+
 _CURRENT_MESH: "WorkerMesh | None" = None
 
 
@@ -183,9 +203,9 @@ class WorkerMesh:
         every worker: inside ``f`` each worker sees only its shard, and the
         collective verbs (:mod:`harp_tpu.parallel.collective`) exchange data.
         """
-        return jax.shard_map(
+        return _shard_map(
             f, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_vma,
+            **{_CHECK_KW: check_vma},
         )
 
     def __repr__(self) -> str:

@@ -28,6 +28,7 @@ from jax import lax
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WORKER_AXIS
+from harp_tpu.utils.telemetry import span
 
 
 def pipeline_forward(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
@@ -39,7 +40,20 @@ def pipeline_forward(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
       stage_fn: ``(params, [mb, width]) → [mb, width]`` — one stage.
       microbatches: ``[M, mb, width]``, replicated (stage 0 reads them).
     Returns ``[M, mb, width]`` outputs of the final stage, replicated.
+
+    Telemetry: this function runs at trace time, so the span it opens
+    measures pipeline *program construction* (S+M-1-step scan build); the
+    per-hop ``rotate``/``broadcast`` wire bytes land in the CommLedger at
+    their call sites below, multiplied by the host-side execution counter
+    of whichever jitted step invokes the pipeline.
     """
+    with span("pipeline_forward.trace",
+              microbatches=int(microbatches.shape[0])):
+        return _pipeline_forward(stage_fn, stage_params, microbatches,
+                                 axis=axis)
+
+
+def _pipeline_forward(stage_fn, stage_params, microbatches, *, axis):
     s = lax.axis_size(axis)
     me = lax.axis_index(axis)
     m, mb, width = microbatches.shape

@@ -1,0 +1,221 @@
+"""Run report — the telemetry spine's one merged view.
+
+Reference parity (SURVEY.md §6): Harp observability ends at grepping YARN
+container logs; harp-tpu's pieces each emit structured records — the
+CommLedger (collective bytes per call site, :mod:`harp_tpu.utils.telemetry`),
+the SpanTracer (nested host phases), :class:`harp_tpu.utils.metrics.
+MetricsLogger` (per-iteration JSONL), and :func:`harp_tpu.utils.profiling.
+op_breakdown` (per-op device time from an XLA trace).  This module merges
+them into ONE human-readable run report plus ONE machine-readable JSON line
+(printed through :func:`harp_tpu.utils.metrics.benchmark_json`, so the
+backend/date/commit provenance stamp rides along like every bench row).
+
+Two entry points:
+
+- ``python -m harp_tpu report --telemetry run.jsonl [--metrics m.jsonl]
+  [--trace-logdir DIR]`` — post-hoc, from files a run exported
+  (``HARP_TELEMETRY_OUT=run.jsonl`` makes instrumented CLIs write one).
+- :func:`maybe_emit` — called by instrumented app CLIs at exit; with
+  ``HARP_TELEMETRY=1`` the human report lands on stderr and the JSON line
+  on stdout (stderr for the table so a teed BENCH_local.jsonl still only
+  collects parseable lines).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, IO
+
+from harp_tpu.utils import telemetry
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return (f"{n:.0f} {unit}" if unit == "B" else f"{n:.2f} {unit}")
+        n /= 1024
+    raise AssertionError
+
+
+def comm_summary_from_rows(rows: list[dict]) -> dict:
+    """Rebuild :meth:`CommLedger.summary`'s shape from exported comm rows."""
+    out: dict[str, dict] = {}
+    for r in rows:
+        t = out.setdefault(r["tag"], {"executions": r.get("executions", 0),
+                                      "bytes_per_execution": 0,
+                                      "total_bytes": 0, "sites": []})
+        site = {k: r.get(k) for k in ("site", "verb", "axis", "combiner",
+                                      "wire_dtype", "payload_bytes",
+                                      "calls_per_trace", "leaves")}
+        t["sites"].append(site)
+        t["bytes_per_execution"] += site["payload_bytes"] or 0
+    for name, t in out.items():
+        execs = t["executions"] if name != telemetry._UNTAGGED else max(
+            1, t["executions"])
+        t["total_bytes"] = t["bytes_per_execution"] * execs
+        t["sites"].sort(key=lambda s: -(s["payload_bytes"] or 0))
+    return out
+
+
+def span_summary_from_rows(rows: list[dict]) -> dict:
+    agg: dict[str, list[float]] = {}
+    for r in rows:
+        agg.setdefault(r["span"], []).append(float(r["dur"]))
+    return {k: {"mean_s": sum(v) / len(v), "total_s": sum(v), "n": len(v)}
+            for k, v in agg.items()}
+
+
+def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
+              metrics_rows: list[dict] | None = None,
+              top_ops: list | None = None) -> dict:
+    """The machine-readable merge (the dict behind the JSON line)."""
+    row: dict[str, Any] = {
+        "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
+        "comm_verbs": {},
+        "comm_tags": comm,
+        "spans": spans,
+    }
+    for t in comm.values():
+        execs = max(1, t["executions"])
+        for s in t["sites"]:
+            v = s["verb"]
+            row["comm_verbs"][v] = (row["comm_verbs"].get(v, 0)
+                                    + (s["payload_bytes"] or 0) * execs)
+    if span_records:
+        row["n_spans"] = len(span_records)
+    if metrics_rows is not None:
+        row["metrics_rows"] = len(metrics_rows)
+        if metrics_rows:
+            row["metrics_last"] = metrics_rows[-1]
+    if top_ops:
+        row["top_ops"] = [{"op": n, "sec": round(s, 5)} for n, s in top_ops]
+    return row
+
+
+def render(row: dict, span_records: list[dict] | None = None) -> str:
+    """The human-readable run report."""
+    lines = ["== harp-tpu run report =="]
+    comm = row.get("comm_tags", {})
+    lines.append(f"comm volume (per-shard wire bytes): "
+                 f"{_fmt_bytes(row.get('comm_total_bytes', 0))}")
+    for verb, b in sorted(row.get("comm_verbs", {}).items(),
+                          key=lambda kv: -kv[1]):
+        lines.append(f"  by verb: {verb:<20s} {_fmt_bytes(b)}")
+    for tag, t in sorted(comm.items()):
+        lines.append(
+            f"  tag {tag}: {t['executions']} execution(s) × "
+            f"{_fmt_bytes(t['bytes_per_execution'])}/exec = "
+            f"{_fmt_bytes(t['total_bytes'])}")
+        for s in t["sites"]:
+            wire = f" wire={s['wire_dtype']}" if s.get("wire_dtype") else ""
+            comb = f" op={s['combiner']}" if s.get("combiner") else ""
+            lines.append(
+                f"    {s['verb']:<20s} {s['site']:<24s} "
+                f"{_fmt_bytes(s['payload_bytes'] or 0)}/exec "
+                f"× {s['calls_per_trace']} call(s)"
+                f" axis={s['axis']}{comb}{wire}")
+    spans = row.get("spans", {})
+    if spans:
+        lines.append("spans (host phases):")
+        if span_records:
+            for r in sorted(span_records, key=lambda r: r["t0"]):
+                lines.append(f"  {'  ' * r['depth']}{r['span']:<24s} "
+                             f"{r['dur']:.4f} s")
+        else:
+            for name, s in sorted(spans.items(),
+                                  key=lambda kv: -kv[1]["total_s"]):
+                lines.append(f"  {name:<26s} total {s['total_s']:.4f} s  "
+                             f"n={s['n']}  mean {s['mean_s']:.4f} s")
+    if "metrics_rows" in row:
+        lines.append(f"metrics: {row['metrics_rows']} row(s)")
+        if row.get("metrics_last"):
+            lines.append(f"  last: {json.dumps(row['metrics_last'])}")
+    if row.get("top_ops"):
+        lines.append("top device ops (self time):")
+        for o in row["top_ops"]:
+            lines.append(f"  {o['op']:<40s} {o['sec']:.5f} s")
+    return "\n".join(lines)
+
+
+def live_report() -> tuple[dict, list[dict]]:
+    """(machine row, span records) from the in-process collectors."""
+    comm = telemetry.ledger.summary()
+    spans = telemetry.tracer.summary()
+    return (build_row(comm, spans, telemetry.tracer.records),
+            telemetry.tracer.records)
+
+
+def maybe_emit(config: str, *, out: IO | None = None,
+               err: IO | None = None) -> None:
+    """App-CLI exit hook: no-op unless telemetry is enabled.
+
+    Prints the human report to ``err`` (stderr) and the provenance-stamped
+    JSON line to ``out`` (stdout), and honors ``HARP_TELEMETRY_OUT`` by
+    exporting the raw span+ledger JSONL for later ``report`` runs.
+    """
+    if not telemetry.enabled():
+        return
+    from harp_tpu.utils.metrics import benchmark_json
+
+    out = sys.stdout if out is None else out
+    err = sys.stderr if err is None else err
+    path = telemetry.out_path()
+    if path:
+        telemetry.export(path)
+    row, span_records = live_report()
+    print(render(row, span_records), file=err, flush=True)
+    print(benchmark_json(f"{config}_telemetry", row), file=out, flush=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from harp_tpu.utils.metrics import benchmark_json
+
+    p = argparse.ArgumentParser(
+        description="merge telemetry (comm ledger + spans) with metrics "
+                    "JSONL and an optional XLA trace into one run report")
+    p.add_argument("--telemetry", metavar="FILE",
+                   help="JSONL written by telemetry.export / "
+                        "HARP_TELEMETRY_OUT")
+    p.add_argument("--metrics", metavar="FILE",
+                   help="MetricsLogger JSONL to merge")
+    p.add_argument("--trace-logdir", metavar="DIR",
+                   help="profiling.trace() logdir: adds the op_breakdown "
+                        "top-ops table")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows of the top-ops table (default 10)")
+    p.add_argument("--json-only", action="store_true",
+                   help="print only the machine-readable line")
+    args = p.parse_args(argv)
+
+    span_rows: list[dict] = []
+    comm_rows: list[dict] = []
+    if args.telemetry:
+        span_rows, comm_rows = telemetry.load_jsonl(args.telemetry)
+    metrics_rows = None
+    if args.metrics:
+        metrics_rows = []
+        with open(args.metrics) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    metrics_rows.append(json.loads(line))
+    top_ops = None
+    if args.trace_logdir:
+        from harp_tpu.utils.profiling import op_breakdown
+
+        top_ops = op_breakdown(args.trace_logdir, top=args.top)
+
+    row = build_row(comm_summary_from_rows(comm_rows),
+                    span_summary_from_rows(span_rows),
+                    span_rows, metrics_rows, top_ops)
+    if not args.json_only:
+        print(render(row, span_rows))
+    print(benchmark_json("report", row))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

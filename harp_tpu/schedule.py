@@ -26,6 +26,8 @@ from typing import Any, Callable, Generic, Iterable, Sequence, TypeVar
 
 import jax
 
+from harp_tpu.utils.telemetry import span
+
 I = TypeVar("I")
 O = TypeVar("O")
 
@@ -75,6 +77,10 @@ class StaticScheduler(Generic[I, O]):
 
     def schedule(self, items: Sequence[I]) -> list[O]:
         """Run every item; item *i* goes to task ``i % len(tasks)``."""
+        with span("schedule.static", items=len(items), tasks=len(self.tasks)):
+            return self._schedule(items)
+
+    def _schedule(self, items: Sequence[I]) -> list[O]:
         n = len(self.tasks)
         results: list[Any] = [None] * len(items)
         errors: list[BaseException] = []
@@ -169,6 +175,10 @@ class DynamicScheduler(Generic[I, O]):
         been drained first — otherwise a stale result would be mis-slotted
         into this batch.
         """
+        with span("schedule.dynamic", tasks=len(self.tasks)):
+            return self._schedule(items)
+
+    def _schedule(self, items: Iterable[I]) -> list[O]:
         started = bool(self._threads)
         if started and self._submitted != self._drained:
             raise RuntimeError(

@@ -346,7 +346,9 @@ def _kv_process_union(local: KVTable) -> KVTable:
     def gather_rows(arr2d: np.ndarray, n_rows_max: int) -> np.ndarray:
         """Allgather a [n, b] byte matrix padded to [n_rows_max, b] → [P, n_rows_max, b]."""
         padded = np.pad(arr2d, ((0, n_rows_max - arr2d.shape[0]), (0, 0)))
-        return np.asarray(multihost_utils.process_allgather(padded))
+        out = np.asarray(multihost_utils.process_allgather(padded))
+        # some jax versions omit the leading process axis when P == 1
+        return out if out.ndim == 3 else out[None]
 
     def as_bytes(arr: np.ndarray) -> np.ndarray:
         a = np.ascontiguousarray(arr)
@@ -364,7 +366,9 @@ def _kv_process_union(local: KVTable) -> KVTable:
         sig[1] = np.dtype(vals.dtype).num
         sig[2] = len(vshape)
         sig[3:3 + len(vshape)] = vshape
-    all_sig = np.asarray(multihost_utils.process_allgather(sig))
+    # atleast_2d: some jax versions return the bare [11] vector (no leading
+    # process axis) from a single-process allgather instead of [1, 11]
+    all_sig = np.atleast_2d(np.asarray(multihost_utils.process_allgather(sig)))
     n_max = int(all_sig[:, 0].max())
     nonempty = all_sig[all_sig[:, 0] > 0]
     if n_max == 0:

@@ -20,9 +20,20 @@ log = logging.getLogger("harp_tpu.metrics")
 
 
 class MetricsLogger:
+    """Use as a context manager (``with MetricsLogger(path) as m: ...``)
+    so the file handle closes on any exit path; :meth:`close` is
+    idempotent, so drivers that close explicitly (``CollectiveApp.run``'s
+    ``finally``) and a surrounding ``with`` can coexist."""
+
     def __init__(self, path: str | None = None):
         self._fh: IO | None = open(path, "a") if path else None
         self._t0 = time.perf_counter()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     def log(self, step: int | None = None, **metrics: Any) -> dict:
         rec = {"t": round(time.perf_counter() - self._t0, 6), **metrics}
@@ -35,7 +46,7 @@ class MetricsLogger:
         return rec
 
     def close(self):
-        if self._fh:
+        if self._fh is not None:
             self._fh.close()
             self._fh = None
 

@@ -1,0 +1,380 @@
+"""Comm ledger + span tracer — one telemetry spine for the collective layer.
+
+Reference parity (SURVEY.md §6): Harp's observability is log4j iteration
+logs plus whatever byte counters Netty exposes per socket; nothing ties "how
+many bytes did allreduce move this run" to the app's phases.  TACCL-style
+communication *sketches* (PAPERS.md) — structured accounting of which
+collectives move how much — are the prerequisite for optimizing them, and
+the quantized-wire verbs (`allreduce_quantized`, `push_quantized`) make
+EQuARX-style bandwidth claims this module lets a run audit.
+
+Two cooperating pieces:
+
+**CommLedger** — every verb in :mod:`harp_tpu.parallel.collective` calls
+:func:`record_comm` at *trace time* (the only time Python runs inside
+``shard_map``/jit).  One entry per call site records verb, axis, combiner,
+wire dtype, and the per-shard payload bytes summed over the pytree — byte
+math comes from ``aval.shape``/``dtype`` only, never per-element work.
+Because a cached executable never re-runs Python, trace-time byte counts
+must be multiplied by a *host-side execution counter*: wrap each jitted
+invocation in :meth:`CommLedger.run` with ``steps`` = how many times the
+traced sites execute per program run (epochs of a multi-epoch scan, iters
+of a ``fori_loop``, reps of a bench loop).
+
+Re-trace/cache semantics are explicit: each ``run()`` activation opens a
+new *generation*; records landing in a generation overwrite (not add to)
+the same call site's bytes from earlier generations, and per-execution
+volume sums only the most recent generation that recorded anything.  So a
+re-traced program (new jit wrapper, same sites) does not double-count, a
+cached executable keeps its last traced byte sheet, and a Python chunk loop
+hitting one site several times within a single trace still sums correctly.
+
+**SpanTracer** — nested host-level phase spans
+(``with span("epoch"): ...``) with JSONL export.  Spans interoperate with
+the existing tools: each enabled span also enters
+``jax.profiler.TraceAnnotation`` (so host phases show on the XLA trace
+timeline next to :func:`harp_tpu.utils.profiling.annotate` regions), and
+:meth:`SpanTracer.summary` returns the same ``{name: {mean_s, total_s, n}}``
+shape as :class:`harp_tpu.utils.timing.Timer.summary`, so report code can
+merge both.
+
+Everything is **zero-cost when disabled** (the default): ``record_comm``
+returns before touching the tree, ``span`` yields without bookkeeping, and
+neither ever does per-element work — so telemetry can stay on for relay
+sprints without perturbing BENCH numbers.  Enable with ``HARP_TELEMETRY=1``
+in the environment or :func:`enable` in code; ``HARP_TELEMETRY_OUT=<path>``
+makes instrumented CLIs export the raw JSONL for ``python -m harp_tpu
+report``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Any
+
+_ENABLED = os.environ.get("HARP_TELEMETRY", "0").lower() not in (
+    "", "0", "off", "false")
+
+
+def enabled() -> bool:
+    """Is telemetry collection on? (module flag; see :func:`enable`)."""
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Turn collection on/off process-wide (tests use :func:`scope`)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+@contextlib.contextmanager
+def scope(on: bool = True, *, reset: bool = True):
+    """Enable (or disable) telemetry within a block, restoring the prior
+    flag on exit; ``reset`` clears both collectors on entry so a test sees
+    only its own records."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(on)
+    if reset:
+        ledger.reset()
+        tracer.reset()
+    try:
+        yield
+    finally:
+        _ENABLED = prev
+
+
+def out_path() -> str | None:
+    """Export destination for instrumented CLIs (``HARP_TELEMETRY_OUT``)."""
+    return os.environ.get("HARP_TELEMETRY_OUT") or None
+
+
+# ---------------------------------------------------------------------------
+# CommLedger
+# ---------------------------------------------------------------------------
+
+_UNTAGGED = "(untagged)"
+
+
+def _tree_wire_bytes(tree: Any, wire_dtype: Any | None) -> tuple[int, int]:
+    """(payload_bytes, n_leaves) for one verb call, per shard.
+
+    Bytes come from static shape/dtype only.  With a ``wire_dtype``, float
+    leaves are accounted at the wire format's width — the verb's *logical*
+    wire (the int8 wire accounts 1 byte/element even though the current
+    lowering accumulates the psum in int32); non-float leaves ride exact at
+    their own width, matching the quantized verbs' exact path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    wd = None if wire_dtype is None else jnp.dtype(wire_dtype)
+    total = 0
+    leaves = jax.tree.leaves(tree)
+    for x in leaves:
+        # leaves are usually tracers/arrays; Python scalars (a bare float
+        # pushed through a verb) still account at their promoted dtype
+        dt = jnp.dtype(getattr(x, "dtype", None) or jnp.result_type(x))
+        size = 1
+        for s in getattr(x, "shape", np.shape(x)):
+            size *= int(s)
+        if wd is not None and jnp.issubdtype(dt, jnp.floating):
+            dt = wd
+        total += size * dt.itemsize
+    return total, len(leaves)
+
+
+def _call_site() -> str:
+    """Stable key for the user frame that invoked the verb: the nearest
+    stack frame outside this module, the collective module, and the jax
+    package (jit/shard_map tracing interposes jax frames between the
+    verb and the user's code)."""
+    import jax
+
+    jax_dir = os.path.dirname(os.path.abspath(jax.__file__))
+    here = os.path.abspath(__file__)
+    f = sys._getframe(1)
+    while f is not None:
+        fn = os.path.abspath(f.f_code.co_filename)
+        if (fn != here and not fn.endswith("parallel/collective.py")
+                and not fn.startswith(jax_dir)
+                and "contextlib" not in os.path.basename(fn)):
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "?:0"
+
+
+class CommLedger:
+    """Per-call-site collective byte accounting (see module docstring)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        # tag -> {"gen", "last_record_gen", "executions", "sites"}
+        # sites: (site, verb, axis, combiner, wire) -> record dict
+        self._tags: dict[str, dict] = {}
+        self._tag_stack: list[str] = []
+
+    # -- recording (trace time) --------------------------------------------
+    def record(self, verb: str, tree: Any, *, axis: str,
+               combiner: str | None = None,
+               wire_dtype: Any | None = None) -> None:
+        if not _ENABLED:
+            return
+        payload, n_leaves = _tree_wire_bytes(tree, wire_dtype)
+        import jax.numpy as jnp
+
+        wire = None if wire_dtype is None else jnp.dtype(wire_dtype).name
+        site = _call_site()
+        tag = self._tag_stack[-1] if self._tag_stack else _UNTAGGED
+        t = self._tags.setdefault(
+            tag, {"gen": 0, "last_record_gen": 0, "executions": 0,
+                  "sites": {}})
+        key = (site, verb, axis, combiner, wire)
+        rec = t["sites"].get(key)
+        if rec is None or rec["gen"] != t["gen"]:
+            # first record for this site in this generation: a re-trace of
+            # a cached program overwrites its old sheet instead of adding
+            rec = {"site": site, "verb": verb, "axis": axis,
+                   "combiner": combiner, "wire_dtype": wire,
+                   "payload_bytes": 0, "calls_per_trace": 0,
+                   "leaves": n_leaves, "gen": t["gen"]}
+            t["sites"][key] = rec
+        rec["payload_bytes"] += payload
+        rec["calls_per_trace"] += 1
+        rec["leaves"] = n_leaves
+        t["last_record_gen"] = t["gen"]
+
+    # -- execution counting (host side) ------------------------------------
+    @contextlib.contextmanager
+    def run(self, tag: str, *, steps: int = 1):
+        """Attribute trace-time records inside the block to ``tag`` and
+        count ``steps`` executions of its traced sites.
+
+        ``steps`` is how many times the sites recorded under this tag
+        execute during the block: the epoch count of a multi-epoch scan,
+        the ``fori_loop`` trip count, the rep count of a bench loop —
+        ``steps=0`` attributes a trace without counting executions (AOT
+        ``.lower().compile()`` warmup).
+        """
+        if not _ENABLED:
+            yield self
+            return
+        t = self._tags.setdefault(
+            tag, {"gen": 0, "last_record_gen": 0, "executions": 0,
+                  "sites": {}})
+        t["gen"] += 1
+        self._tag_stack.append(tag)
+        try:
+            yield self
+        finally:
+            self._tag_stack.pop()
+            t["executions"] += int(steps)
+
+    # -- reading ------------------------------------------------------------
+    def _live_sites(self, t: dict) -> list[dict]:
+        g = t["last_record_gen"]
+        return [r for r in t["sites"].values() if r["gen"] == g]
+
+    def bytes_per_execution(self, tag: str) -> int:
+        t = self._tags.get(tag)
+        return 0 if t is None else sum(
+            r["payload_bytes"] for r in self._live_sites(t))
+
+    def executions(self, tag: str) -> int:
+        t = self._tags.get(tag)
+        return 0 if t is None else t["executions"]
+
+    def volume(self, tag: str | None = None) -> int:
+        """Total comm bytes: per-execution bytes × executions (one tag, or
+        summed over all tags when ``tag`` is None; untagged sites have no
+        execution counter and contribute their per-trace bytes once)."""
+        tags = [tag] if tag is not None else list(self._tags)
+        total = 0
+        for name in tags:
+            t = self._tags.get(name)
+            if t is None:
+                continue
+            per = sum(r["payload_bytes"] for r in self._live_sites(t))
+            total += per * (t["executions"] if name != _UNTAGGED
+                            else max(1, t["executions"]))
+        return total
+
+    def summary(self) -> dict:
+        """Machine-readable ledger: one entry per tag with live sites."""
+        out = {}
+        for name, t in sorted(self._tags.items()):
+            sites = [
+                {k: r[k] for k in ("site", "verb", "axis", "combiner",
+                                   "wire_dtype", "payload_bytes",
+                                   "calls_per_trace", "leaves")}
+                for r in sorted(self._live_sites(t),
+                                key=lambda r: -r["payload_bytes"])]
+            out[name] = {
+                "executions": t["executions"],
+                "bytes_per_execution": sum(s["payload_bytes"]
+                                           for s in sites),
+                "total_bytes": self.volume(name),
+                "sites": sites,
+            }
+        return out
+
+    def export_jsonl(self, fh) -> None:
+        for tag, t in sorted(self._tags.items()):
+            for r in self._live_sites(t):
+                row = {"kind": "comm", "tag": tag,
+                       "executions": t["executions"]}
+                row.update({k: r[k] for k in (
+                    "site", "verb", "axis", "combiner", "wire_dtype",
+                    "payload_bytes", "calls_per_trace", "leaves")})
+                fh.write(json.dumps(row) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# SpanTracer
+# ---------------------------------------------------------------------------
+
+class SpanTracer:
+    """Nested host-level spans with JSONL export (see module docstring)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._stack: list[str] = []
+        self.records: list[dict] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: Any):
+        """``with span("epoch"): ...`` — records {span, path, t0, dur,
+        depth} plus any ``attrs``; nesting comes from the live stack.  Also
+        enters ``jax.profiler.TraceAnnotation(name)`` so the phase shows on
+        an XLA trace captured by :func:`harp_tpu.utils.profiling.trace`."""
+        if not _ENABLED:
+            yield
+            return
+        import jax
+
+        path = "/".join(self._stack + [name])
+        depth = len(self._stack)
+        self._stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._stack.pop()
+            rec = {"span": name, "path": path,
+                   "t0": round(t0 - self._t0, 6),
+                   "dur": round(dur, 6), "depth": depth}
+            if attrs:
+                rec.update(attrs)
+            self.records.append(rec)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-name aggregate in :meth:`Timer.summary`'s shape, so span and
+        timer tables merge in the run report."""
+        agg: dict[str, list[float]] = {}
+        for r in self.records:
+            agg.setdefault(r["span"], []).append(r["dur"])
+        return {
+            k: {"mean_s": sum(v) / len(v), "total_s": sum(v), "n": len(v)}
+            for k, v in agg.items()
+        }
+
+    def export_jsonl(self, fh) -> None:
+        for r in self.records:
+            fh.write(json.dumps({"kind": "span", **r}) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Module singletons + the verbs' hook
+# ---------------------------------------------------------------------------
+
+ledger = CommLedger()
+tracer = SpanTracer()
+
+
+def span(name: str, **attrs: Any):
+    """Module-level shorthand for ``tracer.span`` (the common import)."""
+    return tracer.span(name, **attrs)
+
+
+def record_comm(verb: str, tree: Any, *, axis: str,
+                combiner: str | None = None,
+                wire_dtype: Any | None = None) -> None:
+    """The one hook the collective verbs call (trace time only)."""
+    if not _ENABLED:
+        return
+    ledger.record(verb, tree, axis=axis, combiner=combiner,
+                  wire_dtype=wire_dtype)
+
+
+def export(path: str) -> None:
+    """Write every collected record (spans + ledger) as one JSONL file —
+    the input format of ``python -m harp_tpu report``."""
+    with open(path, "w") as fh:
+        tracer.export_jsonl(fh)
+        ledger.export_jsonl(fh)
+
+
+def load_jsonl(path: str) -> tuple[list[dict], list[dict]]:
+    """Read an :func:`export` file back: (span rows, comm rows)."""
+    spans, comms = [], []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            (spans if row.get("kind") == "span" else comms).append(row)
+    return spans, comms

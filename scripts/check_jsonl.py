@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Validate the committed measurement JSONL files.
+
+Two invariants, enforced as a tier-1 test (tests/test_check_jsonl.py) and
+runnable standalone (``python scripts/check_jsonl.py [--repo DIR]``):
+
+1. **Every line parses as JSON.**  The relay sprint tees CLI stdout into
+   these files; a Python dict repr or a line truncated by a killed sprint
+   is a record every downstream reader silently skips — make it loud.
+
+2. **Bench rows carry the provenance stamp** (``backend``, ``date``,
+   ``commit`` — the fields :func:`harp_tpu.utils.metrics._provenance`
+   writes).  This is the CPU-inversion guard from metrics.py: a
+   config-keyed row WITHOUT ``backend`` can pass downstream TPU-evidence
+   filters (``flip_decision.latest_rows``, bench.py ``_last_measured``
+   exclude only ``backend == "cpu"``), so an unstamped CPU record reads
+   as silicon evidence.  Rows committed before the stamp existed are
+   grandfathered BY LINE INDEX (the history is append-only; reannotate.py
+   rewrites rows in place), so every row appended after this check landed
+   must comply — "my row has no date, so I look legacy" is not a loophole.
+
+PROFILE_local.jsonl and FLIP_DECISIONS.jsonl rows are trace/decision rows,
+not bench evidence: they get the parse check only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# line counts at the commit where this check landed (2026-08-04); rows up
+# to these indices predate the provenance stamp and are exempt from check
+# 2 (never from check 1).  Bump ONLY when deliberately rewriting history.
+GRANDFATHERED = {"BENCH_local.jsonl": 73}
+
+PARSE_ONLY = ("PROFILE_local.jsonl", "FLIP_DECISIONS.jsonl")
+PROVENANCE_FIELDS = ("backend", "date", "commit")
+
+
+def check_file(path: str, grandfathered: int = 0,
+               provenance: bool = False) -> list[str]:
+    """Return a list of violation messages (empty = clean)."""
+    errors: list[str] = []
+    name = os.path.basename(path)
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        return [f"{name}: unreadable: {e}"]
+    for i, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            errors.append(f"{name}:{i}: unparseable JSON ({e})")
+            continue
+        if not provenance or i <= grandfathered:
+            continue
+        if not isinstance(row, dict) or "config" not in row:
+            continue  # not a bench row (e.g. a raw verb-sweep record)
+        missing = [f for f in PROVENANCE_FIELDS if f not in row]
+        if missing:
+            errors.append(
+                f"{name}:{i}: bench row config={row.get('config')!r} "
+                f"missing provenance field(s) {missing} — print it "
+                "through harp_tpu.utils.metrics.benchmark_json")
+    return errors
+
+
+def check_repo(repo: str) -> list[str]:
+    errors: list[str] = []
+    for name, legacy in GRANDFATHERED.items():
+        p = os.path.join(repo, name)
+        if os.path.exists(p):
+            errors += check_file(p, grandfathered=legacy, provenance=True)
+    for name in PARSE_ONLY:
+        p = os.path.join(repo, name)
+        if os.path.exists(p):
+            errors += check_file(p)
+    return errors
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = p.parse_args(argv)
+    errors = check_repo(args.repo)
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"check_jsonl: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print("check_jsonl: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,19 @@ def test_metrics_logger_without_file():
     m.close()
 
 
+def test_metrics_logger_context_manager_closes_idempotently(tmp_path):
+    import json
+
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path) as m:
+        m.log(step=0, loss=2.0)
+        m.close()  # explicit close inside the with: __exit__ must tolerate
+    assert m._fh is None
+    m.close()  # and again after exit
+    rows = [json.loads(ln) for ln in open(path)]
+    assert rows[0]["loss"] == 2.0 and rows[0]["step"] == 0
+
+
 def test_checkpoint_roundtrip(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
     assert mgr.latest_step() is None

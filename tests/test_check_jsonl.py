@@ -1,0 +1,77 @@
+"""scripts/check_jsonl.py — committed measurement files stay parseable and
+provenance-stamped (the CPU-inversion guard, tier-1)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import check_jsonl  # noqa: E402
+
+
+def test_committed_files_are_clean():
+    """THE tier-1 gate: every committed BENCH_local / PROFILE_local /
+    FLIP_DECISIONS line parses, and post-grandfather bench rows carry
+    backend/date/commit."""
+    errors = check_jsonl.check_repo(ROOT)
+    assert errors == [], "\n".join(errors)
+
+
+def test_unparseable_line_is_loud(tmp_path):
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text('{"config": "x", "backend": "cpu"}\n'
+                 "{'config': 'dictrepr'}\n")  # the teed dict-repr bug
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 1 and "unparseable" in errors[0]
+    assert ":2:" in errors[0]
+
+
+def test_new_bench_row_must_carry_provenance(tmp_path):
+    rows = [
+        {"config": "legacy_row", "iters_per_sec": 1.0},   # grandfathered
+        {"config": "new_row", "iters_per_sec": 2.0},      # must be stamped
+    ]
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p), grandfathered=1,
+                                    provenance=True)
+    assert len(errors) == 1
+    assert "new_row" in errors[0] and "backend" in errors[0]
+
+
+def test_stamped_row_passes(tmp_path):
+    row = {"config": "ok", "iters_per_sec": 2.0, "backend": "tpu",
+           "date": "2026-08-04", "commit": "abc1234"}
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(json.dumps(row) + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_non_bench_rows_need_only_parse(tmp_path):
+    # verb-sweep and metric-headline rows have no "config": parse-only
+    rows = [{"verb": "pull_sparse_sweep", "sec": 0.1},
+            {"metric": "kmeans_iters_per_sec", "value": 1.0}]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    (tmp_path / "BENCH_local.jsonl").write_text("not json\n")
+    assert check_jsonl.main(["--repo", str(tmp_path)]) == 1
+    (tmp_path / "BENCH_local.jsonl").write_text("")
+    assert check_jsonl.main(["--repo", str(tmp_path)]) == 0
+
+
+def test_benchmark_json_rows_satisfy_the_checker(tmp_path):
+    """The stamp the checker demands is exactly what benchmark_json
+    emits — the two can never drift apart."""
+    from harp_tpu.utils.metrics import benchmark_json
+
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(benchmark_json("fresh", {"iters_per_sec": 1.0}) + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []

@@ -7,6 +7,9 @@ Shapes stay fixed so XLA compiles each (verb, static-arg) pair once.
 
 import jax
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
 from hypothesis import given, settings, strategies as st
 from hypothesis.extra.numpy import arrays
 

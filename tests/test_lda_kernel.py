@@ -173,11 +173,24 @@ def test_count_bounds_pick_fewer_planes_identically():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+# hypothesis is optional in some images: without it only this property
+# test skips — a bare module-level import would fail the whole module's
+# collection and take the deterministic kernel tests above down with it
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:  # pragma: no cover
+    given = None
 
 
-@settings(max_examples=25, deadline=None)
-@given(st.lists(st.integers(0, 2**24 - 1), min_size=1, max_size=32))
+def _property_case(fn):
+    if given is None:  # pragma: no cover
+        return pytest.mark.skip(reason="hypothesis not installed")(fn)
+    return settings(max_examples=25, deadline=None)(
+        given(st.lists(st.integers(0, 2**24 - 1),
+                       min_size=1, max_size=32))(fn))
+
+
+@_property_case
 def test_gather_planes_exact_for_arbitrary_f32_integers(vals):
     """Property form of the plane-exactness claim: ANY integer table the
     f32 count tables can represent (< 2^24) gathers exactly through 3

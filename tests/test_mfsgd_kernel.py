@@ -220,20 +220,31 @@ def test_ml20m_pallas_epoch_lowers_for_tpu(mesh, monkeypatch):
     assert "tpu_custom_call" in text  # the Mosaic kernel is in the program
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+# hypothesis is optional in some images: without it only this property
+# test skips — a bare module-level import would fail the whole module's
+# collection and take the deterministic kernel tests above down with it
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:  # pragma: no cover
+    given = None
 
 
-@settings(max_examples=40, deadline=None)
-@given(
-    nnz=st.integers(1, 300),
-    n_users=st.sampled_from([16, 40, 64]),
-    n_items=st.sampled_from([16, 48]),
-    u_tile=st.sampled_from([8, 16]),
-    entry_cap=st.sampled_from([8, 16, 64]),
-    seed=st.integers(0, 2**31 - 1),
-)
-def test_insert_coverage_entries_properties(nnz, n_users, n_items, u_tile,
-                                            entry_cap, seed):
+def _property_case(fn):
+    if given is None:  # pragma: no cover
+        return pytest.mark.skip(reason="hypothesis not installed")(fn)
+    return settings(max_examples=40, deadline=None)(given(
+        nnz=st.integers(1, 300),
+        n_users=st.sampled_from([16, 40, 64]),
+        n_items=st.sampled_from([16, 48]),
+        u_tile=st.sampled_from([8, 16]),
+        entry_cap=st.sampled_from([8, 16, 64]),
+        seed=st.integers(0, 2**31 - 1),
+    )(fn))
+
+
+@_property_case
+def test_insert_coverage_entries_properties(nnz, n_users, n_items,
+                                            u_tile, entry_cap, seed):
     """The kernel's streaming correctness rests on this host prep: for
     ANY rating set — coverage (every W block appears), contiguity (one
     run per block), value preservation (real ratings survive exactly

@@ -421,6 +421,7 @@ def test_sequential_points_random_slice_partitions(native_lib, tmp_path):
     restarts — reads back exactly the underlying rows (the shared
     SequentialPoints pending-buffer bookkeeping, exercised through both
     the CSV and parquet subclasses)."""
+    pytest.importorskip("hypothesis")  # optional in some images
     from hypothesis import given, settings, strategies as st
 
     from harp_tpu.native.datasource import CSVPoints, ParquetPoints

@@ -1,0 +1,114 @@
+"""`python -m harp_tpu report` — the merged run report (golden fixture)."""
+
+import json
+
+import pytest
+
+import harp_tpu.__main__ as cli
+
+# A deterministic fixture run: one epoch span with a nested ingest span,
+# one comm tag with two sites, three metrics rows.
+FIXTURE_SPANS = [
+    {"kind": "span", "span": "epoch", "path": "epoch", "t0": 0.0,
+     "dur": 2.0, "depth": 0},
+    {"kind": "span", "span": "ingest", "path": "epoch/ingest", "t0": 0.25,
+     "dur": 0.5, "depth": 1},
+]
+FIXTURE_COMMS = [
+    {"kind": "comm", "tag": "kmeans.fit", "executions": 10,
+     "site": "kmeans.py:322", "verb": "allreduce", "axis": "workers",
+     "combiner": "add", "wire_dtype": None, "payload_bytes": 120_400,
+     "calls_per_trace": 1, "leaves": 3},
+    {"kind": "comm", "tag": "kmeans.fit", "executions": 10,
+     "site": "kmeans.py:318", "verb": "push", "axis": "workers",
+     "combiner": "add", "wire_dtype": None, "payload_bytes": 1_024,
+     "calls_per_trace": 1, "leaves": 2},
+]
+FIXTURE_METRICS = [{"t": 0.1, "step": 0, "loss": 2.0},
+                   {"t": 0.2, "step": 1, "loss": 1.0}]
+
+
+@pytest.fixture
+def fixture_run(tmp_path):
+    tele = tmp_path / "run.jsonl"
+    with open(tele, "w") as fh:
+        for row in FIXTURE_SPANS + FIXTURE_COMMS:
+            fh.write(json.dumps(row) + "\n")
+    metrics = tmp_path / "metrics.jsonl"
+    with open(metrics, "w") as fh:
+        for row in FIXTURE_METRICS:
+            fh.write(json.dumps(row) + "\n")
+    return str(tele), str(metrics)
+
+
+GOLDEN = """\
+== harp-tpu run report ==
+comm volume (per-shard wire bytes): 1.16 MiB
+  by verb: allreduce            1.15 MiB
+  by verb: push                 10.00 KiB
+  tag kmeans.fit: 10 execution(s) × 118.58 KiB/exec = 1.16 MiB
+    allreduce            kmeans.py:322            117.58 KiB/exec × 1 call(s) axis=workers op=add
+    push                 kmeans.py:318            1.00 KiB/exec × 1 call(s) axis=workers op=add
+spans (host phases):
+  epoch                    2.0000 s
+    ingest                   0.5000 s
+metrics: 2 row(s)
+  last: {"t": 0.2, "step": 1, "loss": 1.0}"""
+
+
+def test_report_golden(fixture_run, capsys):
+    tele, metrics = fixture_run
+    rc = cli.main(["report", "--telemetry", tele, "--metrics", metrics])
+    assert rc == 0
+    out = capsys.readouterr().out
+    human, machine = out.rsplit("\n", 2)[0], out.strip().splitlines()[-1]
+    assert human == GOLDEN, f"---got---\n{human}\n---want---\n{GOLDEN}"
+    rec = json.loads(machine)
+    assert rec["config"] == "report"
+    assert rec["comm_total_bytes"] == (120_400 + 1_024) * 10
+    assert rec["comm_verbs"] == {"allreduce": 1_204_000, "push": 10_240}
+    assert rec["comm_tags"]["kmeans.fit"]["executions"] == 10
+    assert rec["spans"]["epoch"]["total_s"] == 2.0
+    assert rec["metrics_rows"] == 2
+    assert rec["metrics_last"]["loss"] == 1.0
+    # provenance stamped (the benchmark_json path)
+    for field in ("backend", "date", "commit"):
+        assert field in rec
+
+
+def test_report_json_only(fixture_run, capsys):
+    tele, _ = fixture_run
+    rc = cli.main(["report", "--telemetry", tele, "--json-only"])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["comm_total_bytes"] == 1_214_240
+
+
+def test_report_listed_as_app(capsys):
+    assert cli.main(["--list"]) == 0
+    assert "report" in capsys.readouterr().out
+
+
+def test_report_from_live_export(tmp_path, mesh, capsys):
+    """End-to-end: enable telemetry, run a real collective, export, then
+    report from the file — the HARP_TELEMETRY_OUT workflow."""
+    import numpy as np
+
+    import harp_tpu.utils.telemetry as T
+    from harp_tpu.parallel import collective as C
+
+    path = str(tmp_path / "live.jsonl")
+    with T.scope():
+        with T.span("phase"):
+            op = C.host_op(mesh, C.allgather)
+            with T.ledger.run("g", steps=5):
+                op(np.ones((8, 128), np.float32))
+        T.export(path)
+    rc = cli.main(["report", "--telemetry", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    rec = json.loads(out.strip().splitlines()[-1])
+    per = 128 * 4  # one shard: [1, 128] f32
+    assert rec["comm_verbs"] == {"allgather": per * 5}
+    assert "phase" in rec["spans"]

@@ -6,6 +6,9 @@ contributions directly (associativity Harp's ValCombiner relies on).
 """
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from harp_tpu.parallel.collective import Combiner

@@ -1,0 +1,274 @@
+"""Comm ledger + span tracer (utils/telemetry.py) — the observability spine.
+
+The load-bearing claims: bytes are counted once per *execution*, not once
+per *trace* (jit caching), payloads match hand-computed wire sheets, spans
+nest, and everything is off (and free) by default.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import harp_tpu.utils.telemetry as T
+from harp_tpu.parallel import collective as C
+
+NW = 8  # conftest mesh
+
+
+def _per_shard_bytes(rows, cols=128, itemsize=4):
+    return rows // NW * cols * itemsize
+
+
+def test_disabled_records_nothing(mesh):
+    T.ledger.reset()
+    T.tracer.reset()  # earlier tests' records persist past their scope()
+    assert not T.enabled()
+    op = C.host_op(mesh, C.allreduce)
+    with T.ledger.run("off", steps=1):
+        op(np.ones((NW, 128), np.float32))
+    with T.span("off-span"):
+        pass
+    assert T.ledger.summary() == {}
+    assert T.tracer.records == []
+
+
+def test_ledger_counts_per_execution_not_per_trace(mesh):
+    """Satellite requirement: a jitted allreduce invoked twice executes its
+    traced comm site twice but traces it once — the ledger must report
+    2 × payload, not 1 × (trace undercount) or 3 × (trace+exec blend)."""
+    with T.scope():
+        op = C.host_op(mesh, C.allreduce)
+        x = np.ones((64, 128), np.float32)
+        with T.ledger.run("t", steps=1):
+            op(x)  # traces here
+        with T.ledger.run("t", steps=1):
+            op(x)  # cached executable: no Python runs
+        per = _per_shard_bytes(64)
+        assert T.ledger.bytes_per_execution("t") == per
+        assert T.ledger.executions("t") == 2
+        assert T.ledger.volume("t") == 2 * per
+        (site,) = T.ledger.summary()["t"]["sites"]
+        assert site["verb"] == "allreduce"
+        assert site["combiner"] == "add"
+        assert site["calls_per_trace"] == 1
+
+
+def test_ledger_retrace_does_not_double_count(mesh):
+    """A NEW jit wrapper over the same call site re-traces the same
+    program; the re-trace must overwrite the site's byte sheet, not add
+    to it."""
+    with T.scope():
+        x = np.ones((64, 128), np.float32)
+        for _ in range(2):  # two independent wrappers -> two traces
+            op = C.host_op(mesh, C.allreduce)
+            with T.ledger.run("t", steps=1):
+                op(x)
+        per = _per_shard_bytes(64)
+        assert T.ledger.bytes_per_execution("t") == per
+        assert T.ledger.volume("t") == 2 * per
+
+
+def test_ledger_hand_computed_payloads(mesh):
+    """allreduce / allgather / regroup payloads == hand-computed per-shard
+    wire sheets (f32 [rows, 128] sharded over 8 workers on dim 0)."""
+    rows = NW * NW  # regroup needs rows % nw^2 == 0
+    x = np.ones((rows, 128), np.float32)
+    per = _per_shard_bytes(rows)
+    for verb, out_dim in ((C.allreduce, None), (C.allgather, None),
+                          (C.regroup, 0)):
+        with T.scope():
+            op = C.host_op(mesh, verb, in_dim=0, out_dim=out_dim)
+            with T.ledger.run("t", steps=1):
+                op(x)
+            assert T.ledger.bytes_per_execution("t") == per, verb
+            assert T.ledger.volume("t") == per, verb
+
+
+def test_ledger_quantized_wire_dtype_bytes(mesh):
+    """The quantized verbs account float leaves at the WIRE width: bf16 =
+    2 B/elem, int8 = 1 B/elem (the logical EQuARX-style wire)."""
+    x = np.ones((64, 128), np.float32)
+    elems = 64 // NW * 128
+    for wire, expect in (("bfloat16", 2 * elems), ("int8", elems)):
+        import jax.numpy as jnp
+
+        with T.scope():
+            op = C.host_op(mesh, C.allreduce_quantized,
+                           wire_dtype=getattr(jnp, wire))
+            with T.ledger.run("q", steps=1):
+                op(x)
+            assert T.ledger.bytes_per_execution("q") == expect, wire
+            (site,) = T.ledger.summary()["q"]["sites"]
+            assert site["wire_dtype"] == wire
+
+
+def test_ledger_loop_sites_accumulate_within_one_trace(mesh):
+    """A Python loop hitting the same call site N times within ONE trace
+    is N distinct collectives per execution — they must sum."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def step(x):
+        for _ in range(3):  # same site, three traced collectives
+            x = C.allreduce(x)
+        return x
+
+    with T.scope():
+        fn = jax.jit(mesh.shard_map(step, in_specs=(mesh.spec(0),),
+                                    out_specs=P()))
+        x = np.ones((NW, 128), np.float32)
+        with T.ledger.run("loop", steps=1):
+            fn(x)
+        t = T.ledger.summary()["loop"]
+        assert sum(s["calls_per_trace"] for s in t["sites"]) == 3
+        assert t["bytes_per_execution"] == 3 * _per_shard_bytes(NW)
+
+
+def test_span_nesting_and_depth():
+    import time
+
+    with T.scope():
+        with T.span("parent"):
+            with T.span("child"):
+                time.sleep(0.01)
+        recs = {r["span"]: r for r in T.tracer.records}
+    child, parent = recs["child"], recs["parent"]
+    assert child["depth"] == 1 and parent["depth"] == 0
+    assert child["path"] == "parent/child"
+    # child window inside parent window
+    assert child["t0"] >= parent["t0"]
+    assert child["t0"] + child["dur"] <= parent["t0"] + parent["dur"] + 1e-6
+    # summary merges into the Timer.summary shape
+    s = T.tracer.summary()
+    assert s["parent"]["n"] == 1 and s["parent"]["total_s"] >= 0.01
+
+
+def test_span_records_on_exception():
+    with T.scope():
+        with pytest.raises(RuntimeError):
+            with T.span("boom"):
+                raise RuntimeError("x")
+        assert [r["span"] for r in T.tracer.records] == ["boom"]
+        assert T.tracer._stack == []  # stack unwound
+
+
+def test_export_jsonl_roundtrip(tmp_path, mesh):
+    with T.scope():
+        with T.span("epoch", epoch=0):
+            op = C.host_op(mesh, C.allreduce)
+            with T.ledger.run("rt", steps=4):
+                op(np.ones((NW, 128), np.float32))
+        path = str(tmp_path / "run.jsonl")
+        T.export(path)
+    spans, comms = T.load_jsonl(path)
+    assert [s["span"] for s in spans] == ["epoch"]
+    assert spans[0]["epoch"] == 0
+    (c,) = comms
+    assert c["verb"] == "allreduce" and c["tag"] == "rt"
+    assert c["executions"] == 4
+    assert c["payload_bytes"] == _per_shard_bytes(NW)
+    # every exported line is valid JSON (the check_jsonl contract)
+    for line in open(path):
+        json.loads(line)
+
+
+def test_model_epoch_loops_feed_ledger(mesh):
+    """The wired-through epoch loops: MF-SGD's rotation epoch records
+    rotate traffic under the mfsgd.epochs tag with executions == epochs
+    counted through BOTH train_epoch and train_epochs."""
+    from harp_tpu.models import mfsgd
+
+    u, i, v = mfsgd.synthetic_ratings(64, 48, 500, rank=4, seed=0)
+    cfg = mfsgd.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                            entry_cap=64)
+    with T.scope():
+        model = mfsgd.MFSGD(64, 48, cfg, mesh, seed=0)
+        model.set_ratings(u, i, v)
+        model.train_epoch()       # 1 execution (traces the single-epoch fn)
+        model.train_epochs(2)     # 2 more through the multi-epoch program
+        assert T.ledger.executions("mfsgd.epochs") == 3
+        tag = T.ledger.summary()["mfsgd.epochs"]
+        verbs = {s["verb"] for s in tag["sites"]}
+        assert "rotate" in verbs  # the rotation ring is on the ledger
+        assert tag["bytes_per_execution"] > 0
+        assert tag["total_bytes"] == 3 * tag["bytes_per_execution"]
+        spans = T.tracer.summary()
+        assert spans["mfsgd.epoch"]["n"] == 1
+        assert spans["mfsgd.epochs"]["n"] == 1
+
+
+def test_kmeans_cli_report_matches_hand_computed_bytes(mesh, capsys):
+    """Acceptance: `python -m harp_tpu kmeans` with telemetry enabled
+    emits a run report whose allreduce byte count equals the hand-computed
+    (k·d·4 + k·4 + 4) × iters × executions sheet (sums + counts + inertia
+    per iteration, one invocation ⇒ executions == iters)."""
+    import harp_tpu.__main__ as cli
+
+    n, d, k, iters = 512, 16, 8, 3
+    with T.scope():
+        rc = cli.main(["kmeans", "--n", str(n), "--d", str(d), "--k",
+                       str(k), "--iters", str(iters)])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "== harp-tpu run report ==" in out.err
+    line = [ln for ln in out.out.splitlines()
+            if '"config": "kmeans_telemetry"' in ln]
+    assert len(line) == 1, out.out
+    rec = json.loads(line[0])
+    tag = rec["comm_tags"]["kmeans.fit"]
+    per_iter = k * d * 4 + k * 4 + 4
+    assert tag["bytes_per_execution"] == per_iter
+    assert tag["executions"] == iters
+    assert tag["total_bytes"] == per_iter * iters
+    assert rec["comm_verbs"]["allreduce"] == per_iter * iters
+    # provenance stamped through benchmark_json
+    assert rec["backend"] == "cpu" and "date" in rec and "commit" in rec
+    # the span wired through fit() is in the same report
+    assert "kmeans.fit" in rec["spans"]
+
+
+def test_bench_verb_counts_reps(mesh):
+    """benchmark.bench_verb: 1 warmup + reps timed executions land on the
+    host-side counter; payload is the per-shard input sheet."""
+    from harp_tpu import benchmark as B
+
+    with T.scope():
+        r = B.bench_verb("allreduce", mesh, size_bytes=64 * 1024, reps=3)
+        tag = T.ledger.summary()["bench.allreduce"]
+        assert tag["executions"] == 4  # 1 warmup + 3 timed
+        n_rows = r["bytes"] // (4 * 128)
+        assert tag["bytes_per_execution"] == _per_shard_bytes(n_rows)
+
+
+def test_scope_restores_disabled_state():
+    assert not T.enabled()
+    with T.scope():
+        assert T.enabled()
+    assert not T.enabled()
+
+
+@pytest.mark.slow
+def test_full_lda_run_ledger_and_report(mesh, capsys):
+    """Full multi-epoch LDA through the CLI with telemetry on: the Gibbs
+    sweep's rotation ring and Nk allreduce land on the ledger under
+    lda.epochs with executions == warmup + epochs, and the emitted report
+    carries both span and ledger evidence.  slow: a real (small-shape)
+    multi-epoch model run — tier-1 filters it via -m 'not slow'."""
+    from harp_tpu.models import lda
+
+    with T.scope():
+        lda.main(["--docs", "64", "--vocab", "64", "--topics", "8",
+                  "--tokens-per-doc", "8", "--epochs", "2",
+                  "--d-tile", "8", "--w-tile", "8", "--entry-cap", "32"])
+    out = capsys.readouterr()
+    line = [ln for ln in out.out.splitlines()
+            if '"config": "lda_telemetry"' in ln]
+    assert len(line) == 1, out.out
+    rec = json.loads(line[0])
+    tag = rec["comm_tags"]["lda.epochs"]
+    # benchmark(): 1 warmup sample_epoch + sample_epochs(2)
+    assert tag["executions"] == 3
+    assert {"rotate"} <= set(rec["comm_verbs"])
+    assert tag["total_bytes"] == 3 * tag["bytes_per_execution"] > 0
+    assert rec["spans"]["lda.epochs"]["n"] == 1
