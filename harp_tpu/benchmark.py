@@ -39,6 +39,17 @@ VERBS = {
                        None, lambda nw: 1.0),
     "allreduce_int8": (C.allreduce_quantized, {"wire_dtype": jnp.int8},
                        None, lambda nw: 0.5),
+    # quantized data movement (rotate/regroup have an f32 factor of 1.0,
+    # so the narrow wires are 0.5/0.25 — the bytes the chunked rotation
+    # pipeline puts on the ring per hop under rotate_wire=bf16/int8)
+    "rotate_bf16": (C.rotate_quantized, {"wire_dtype": jnp.bfloat16},
+                    0, lambda nw: 0.5),
+    "rotate_int8": (C.rotate_quantized, {"wire_dtype": jnp.int8},
+                    0, lambda nw: 0.25),
+    "regroup_bf16": (C.regroup_quantized, {"wire_dtype": jnp.bfloat16},
+                     0, lambda nw: 0.5),
+    "regroup_int8": (C.regroup_quantized, {"wire_dtype": jnp.int8},
+                     0, lambda nw: 0.25),
 }
 
 
@@ -47,7 +58,7 @@ def bench_verb(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
     nw = mesh.num_workers
     # regroup (all_to_all) and push (psum_scatter) additionally split each
     # worker's shard by nw, so rows must be a multiple of nw²
-    mult = nw * nw if name in ("regroup", "push") else nw
+    mult = nw * nw if name.startswith(("regroup", "push")) else nw
     n_rows = max(mult, size_bytes // (4 * 128) // mult * mult)
     x = np.random.default_rng(0).normal(size=(n_rows, 128)).astype(np.float32)
     op = C.host_op(mesh, fn, in_dim=0, out_dim=out_dim, **kwargs)

@@ -47,7 +47,8 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.parallel.rotate import resident_half_index
+from harp_tpu.parallel.rotate import (ROTATE_WIRES, resident_chunk_index,
+                                      rotate_pipeline)
 from harp_tpu.models.mfsgd import (
     _ceil_div,
     _dense_bounds,
@@ -55,6 +56,7 @@ from harp_tpu.models.mfsgd import (
     carry_tile_switch,
     partition_ratings,
     partition_ratings_tiles,
+    rotate_chunks_resolved,
 )
 from harp_tpu.utils.timing import device_sync
 
@@ -140,9 +142,12 @@ class LDAConfig:
     # no whole-table copies in the HLO.  The DENSE-stack arm
     # (`lda_carry`, 1.13×) was VETOED by the conditional gate, so the
     # auto default stays off there.
-    # None = "on for the tiled algos" (the knob has no meaning for
-    # scatter/pushpull, and a bool default would make bare
-    # LDAConfig(algo='scatter') unconstructible); an explicit True on a
+    # None = "auto per algo", STORED as None and resolved at READ time by
+    # :func:`carry_db_resolved` (mirrors MFSGDConfig.tiles() /
+    # KMeansConfig._use_pallas — a __post_init__ resolution froze the
+    # auto value, so ``dataclasses.replace(LDAConfig(), algo='scatter')``
+    # raised and ``replace(..., algo='dense')`` silently enabled the
+    # VETOED dense-carry arm; ADVICE r5).  An explicit True on a
     # non-tiled algo still raises.
     carry_db: bool | None = None
     # algo="pallas" only: exact base-256-plane count gathers (ADVICE r3 —
@@ -183,6 +188,18 @@ class LDAConfig:
     # 2026-08-01 with the pallas algo (see sampler above — rbg is where
     # the lda_fast 1.24× comes from).
     rng_impl: str = "rbg"
+    # Rotation pipeline knobs (rotation algos only — pushpull never
+    # rotates).  Same contract as MFSGDConfig: rotate_chunks None = auto
+    # 2 (the historical two-halves schedule, resolved read-time by
+    # mfsgd.rotate_chunks_resolved); rotate_wire "exact" | "bf16" |
+    # "int8" picks the in-flight chunk's ring payload.  The int8 wire
+    # dequantizes counts lossily, so the chain samples against slightly
+    # perturbed word-topic counts — a valid approximate-CGS trade (the
+    # whole parallel sampler is approximate), gated by the
+    # `lda_rotate_int8` log-likelihood flip candidate before it may
+    # become a default.
+    rotate_chunks: int | None = None
+    rotate_wire: str = "exact"
 
     def __post_init__(self):
         if self.ndk_dtype not in ("float32", "int16"):
@@ -208,20 +225,41 @@ class LDAConfig:
                 f"rng_impl must be 'threefry' or 'rbg', got {self.rng_impl!r}")
         if self.pull_cap is not None and self.algo != "pushpull":
             raise ValueError("pull_cap only applies to algo='pushpull'")
-        if self.carry_db is None:
-            # auto: ON for the PALLAS stack only — exactly the verdict
-            # (2026-08-01): `lda_pallas_carry` FLIPPED; `lda_carry`
-            # (the dense-stack arm) was VETOED by the conditional gate,
-            # so a dense config defaulting the carry on would apply an
-            # unauthorized flip.  Structurally OFF for scatter/pushpull.
-            self.carry_db = self.algo == "pallas"
+        # carry_db=None stays None here — :func:`carry_db_resolved` reads
+        # it as "on for the pallas stack only" (exactly the 2026-08-01
+        # verdict: `lda_pallas_carry` FLIPPED, the dense arm `lda_carry`
+        # was VETOED); only an EXPLICIT True is validated
         if self.carry_db and self.algo not in _TILED_ALGOS:
             raise ValueError("carry_db applies to the tiled algos "
                              f"{_TILED_ALGOS}, not algo={self.algo!r}")
+        if self.rotate_chunks is not None and self.rotate_chunks < 1:
+            raise ValueError(
+                f"rotate_chunks must be >= 1, got {self.rotate_chunks}")
+        if self.rotate_wire not in ROTATE_WIRES:
+            raise ValueError(
+                f"rotate_wire must be one of {ROTATE_WIRES}, "
+                f"got {self.rotate_wire!r}")
+        if self.algo == "pushpull" and (self.rotate_chunks is not None
+                                        or self.rotate_wire != "exact"):
+            raise ValueError(
+                "rotate_chunks/rotate_wire apply to the rotation algos; "
+                "algo='pushpull' never rotates (a silently-ignored "
+                "tuning flag wastes benchmark sweeps)")
         if self.pull_cap is not None and self.pull_cap < 1:
             raise ValueError(
                 f"pull_cap must be >= 1, got {self.pull_cap} (0 would "
                 "silently fall back to the full-chunk default)")
+
+
+def carry_db_resolved(cfg: LDAConfig) -> bool:
+    """Resolved doc-tile carry — ``None`` means "on for the pallas stack
+    only" (the 2026-08-01 verdict: `lda_pallas_carry` FLIPPED at 1.33×,
+    the dense arm `lda_carry` was VETOED by the conditional gate, so only
+    the kernel stack may default the carry on).  Read-time resolution
+    (mirroring :func:`harp_tpu.models.mfsgd.tiles`) keeps
+    ``dataclasses.replace(cfg, algo=...)`` tracking the new algo instead
+    of freezing the old algo's resolved value (ADVICE r5)."""
+    return cfg.carry_db if cfg.carry_db is not None else cfg.algo == "pallas"
 
 
 def _cgs_resample(ndk, nwk, nk, z, mask, key, cfg: LDAConfig, vocab_size):
@@ -438,32 +476,35 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
                      count_bounds=(None, None)):
     """Device-view epoch body: every token resampled once.
 
-    Pipelined half-slice schedule identical to MF-SGD's (see
-    harp_tpu.models.mfsgd.make_epoch_fn): compute on one word-slice half
-    while the other is in flight.  The per-step token pass dispatches on
-    ``cfg.algo``: scan over dense tile entries, or over fixed-size scatter
-    chunks (see :func:`_sample_entry` / :func:`_sample_chunk`).
+    Chunked rotation pipeline identical to MF-SGD's (see
+    harp_tpu.models.mfsgd._epoch_device_fn): the word-slice splits into
+    ``rotate_chunks_resolved(cfg)`` sub-slices — compute on the resident
+    chunk while the previously-sampled one is in flight
+    (:func:`rotate_pipeline`; the 2-chunk default is the former bespoke
+    half-slice schedule, and ``cfg.rotate_wire`` narrows the ring
+    payload).  The per-step token pass dispatches on ``cfg.algo``: scan
+    over dense tile entries, or over fixed-size scatter chunks (see
+    :func:`_sample_entry` / :func:`_sample_chunk`).
     """
-    two_n = 2 * mesh.num_workers
+    nc = rotate_chunks_resolved(cfg)
     tiled = cfg.algo in _TILED_ALGOS
     pallas = cfg.algo == "pallas"
+    carry_db = carry_db_resolved(cfg)
 
     def epoch(Ndk, Nwk_slice, Nk, z_grid, *token_args):
         key = token_args[-1][0]
         tokens = token_args[:-1]
-        ib2 = Nwk_slice.shape[0] // 2
-        computing, inflight = Nwk_slice[:ib2], Nwk_slice[ib2:]
         if pallas:
             # the fused kernel is topic-major: transpose once per epoch
-            # (~10 GB/epoch of HBM at enwiki scale — noise vs the epoch)
-            Ndk, computing, inflight = Ndk.T, computing.T, inflight.T
+            # (~10 GB/epoch of HBM at enwiki scale — noise vs the epoch);
+            # the pipeline then chunks (and rotates) along axis 1
+            Ndk, Nwk_slice = Ndk.T, Nwk_slice.T
 
-        def body(carry, t):
-            Ndk, computing, inflight, Nk, z_grid, key = carry
-            received = C.rotate(inflight)  # overlaps with sampling below
-            half_idx = resident_half_index(t)
-            blk = jax.tree.map(lambda a: a[half_idx], tokens)
-            z_blk = z_grid[half_idx]
+        def step(st, computing, t):
+            Ndk, Nk, z_grid, key = st
+            chunk_idx = resident_chunk_index(t, nc)
+            blk = jax.tree.map(lambda a: a[chunk_idx], tokens)
+            z_blk = z_grid[chunk_idx]
             key, sub = jax.random.split(key)
 
             if tiled:
@@ -473,7 +514,7 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
                     entry_keys = lax.bitcast_convert_type(
                         entry_keys, jnp.int32)
 
-                if cfg.carry_db:
+                if carry_db:
                     # Carry the doc tile across its od-run (entries are
                     # od-major): flush/load rides a lax.cond so an
                     # unchanged od pays ZERO doc-tile HBM traffic.  The
@@ -555,16 +596,15 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
                 z_new = z_new.reshape(-1)
             # push/pull residue: topic totals sync via psum of deltas
             Nk = Nk + C.allreduce(dNk)
-            z_grid = z_grid.at[half_idx].set(z_new)
-            return (Ndk, received, computing, Nk, z_grid, key), None
+            z_grid = z_grid.at[chunk_idx].set(z_new)
+            return (Ndk, Nk, z_grid, key), computing
 
-        (Ndk, computing, inflight, Nk, z_grid, key), _ = lax.scan(
-            body, (Ndk, computing, inflight, Nk, z_grid, key),
-            jnp.arange(two_n),
-        )
+        (Ndk, Nk, z_grid, key), Nwk_slice = rotate_pipeline(
+            step, (Ndk, Nk, z_grid, key), Nwk_slice,
+            n_chunks=nc, wire=cfg.rotate_wire,
+            chunk_axis=1 if pallas else 0)
         if pallas:
-            Ndk, computing, inflight = Ndk.T, computing.T, inflight.T
-        Nwk_slice = jnp.concatenate([computing, inflight], axis=0)
+            Ndk, Nwk_slice = Ndk.T, Nwk_slice.T
         return Ndk, Nwk_slice, Nk, z_grid
 
     return epoch
@@ -769,7 +809,7 @@ def epoch_arg_shapes(n_workers, n_docs, vocab_size, cfg: LDAConfig,
       partitioner's NE/C to model a specific corpus.
     """
     n, K = n_workers, cfg.n_topics
-    ns = 2 * n
+    ns = rotate_chunks_resolved(cfg) * n  # chunk-slices (pushpull: unused)
     i32, f32 = np.dtype(np.int32), np.dtype(np.float32)
     ndk_dt = np.dtype(cfg.ndk_dtype)
     keys = ((n, 2), np.dtype(np.uint32))
@@ -818,18 +858,22 @@ class LDA:
         self.cfg = cfg or LDAConfig()
         self.n_docs, self.vocab_size = n_docs, vocab_size
         n = self.mesh.num_workers
+        nc = rotate_chunks_resolved(self.cfg)
+        # rotate_chunks chunk-slices per worker (rotation algos)
+        self._n_slices = nc * n
         if self.cfg.algo in _TILED_ALGOS:
-            self.d_own, self.w_own, self.d_bound, wb2 = _dense_bounds(
-                n_docs, vocab_size, n, 2 * n, self.cfg.d_tile, self.cfg.w_tile)
-            self.w_bound = 2 * wb2
+            self.d_own, self.w_own, self.d_bound, wbc = _dense_bounds(
+                n_docs, vocab_size, n, self._n_slices,
+                self.cfg.d_tile, self.cfg.w_tile)
+            self.w_bound = nc * wbc
         elif self.cfg.algo == "pushpull":
             self.d_bound = self.d_own = -(-n_docs // n)
             # word-topic rows this worker OWNS (row-sharded global table)
             self.w_bound = self.w_own = -(-vocab_size // n)
         else:
             self.d_bound = self.d_own = -(-n_docs // n)
-            self.w_bound = 2 * (-(-vocab_size // (2 * n)))
-            self.w_own = self.w_bound // 2
+            self.w_bound = nc * (-(-vocab_size // self._n_slices))
+            self.w_own = self.w_bound // nc
         # (max doc-topic, max word-topic) static count bounds — derived
         # per corpus in _install_pack (pallas only); (None, None) = the
         # kernel falls back to dtype-based gather plane counts
@@ -891,12 +935,14 @@ class LDA:
         # reuse the MF-SGD grid partitioners: "rating value" carries the
         # initial topic assignment
         z0 = rng.integers(0, K, len(doc_ids)).astype(np.float32)
+        nc = rotate_chunks_resolved(self.cfg)
         if self.cfg.algo in _TILED_ALGOS:
-            ed, ew, ez, od, ow, do, wo, db, wb2 = partition_ratings_tiles(
+            ed, ew, ez, od, ow, do, wo, db, wbc = partition_ratings_tiles(
                 doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
                 self.cfg.d_tile, self.cfg.w_tile, self.cfg.entry_cap,
+                n_slices=self._n_slices,
             )
-            assert (do, wo, db, 2 * wb2) == (
+            assert (do, wo, db, nc * wbc) == (
                 self.d_own, self.w_own, self.d_bound, self.w_bound)
             if self.cfg.algo == "pallas":
                 # kernel chunks C in _PALLAS_C slices: pad entry width up
@@ -917,11 +963,11 @@ class LDA:
             z_grid = pz.reshape(-1)
             tokens = (pd.reshape(-1), pw.reshape(-1), pm.reshape(-1))
         else:
-            bd, bw, bz, bm, db, wb2 = partition_ratings(
+            bd, bw, bz, bm, db, wbc = partition_ratings(
                 doc_ids, word_ids, z0, self.n_docs, self.vocab_size, n,
-                self.cfg.chunk,
+                self.cfg.chunk, n_slices=self._n_slices,
             )
-            assert (db, 2 * wb2) == (self.d_bound, self.w_bound)
+            assert (db, nc * wbc) == (self.d_bound, self.w_bound)
             z_grid = bz.astype(np.int32)
             tokens = (bd, bw, bm)
 
@@ -971,9 +1017,10 @@ class LDA:
     def _global_token_ids(self, tokens):
         """Grid-local → global STORAGE (doc, word) row ids + valid mask.
 
-        Grid row r belongs to worker ``r // (2n)`` (doc range) and word
-        slice ``r % (2n)``.  "Storage" rows: the dense layout pads each
-        range to a tile multiple, so storage row ≠ external id there (use
+        Grid row r belongs to worker ``r // ns`` (doc range) and word
+        slice ``r % ns`` (``ns = rotate_chunks · n`` chunk-slices).
+        "Storage" rows: the dense layout pads each range to a tile
+        multiple, so storage row ≠ external id there (use
         :meth:`doc_topic_table` / :meth:`word_topic_table` for external
         views).
         """
@@ -983,19 +1030,20 @@ class LDA:
             t_pad = pd.shape[0] // n
             gd = pd + (np.arange(n).repeat(t_pad) * self.d_bound)
             return gd, pw, pm > 0  # word ids are already global
-        db, wb2 = self.d_bound, self.w_bound // 2
-        rows = np.arange(n * 2 * n)
+        ns = self._n_slices
+        db, wbc = self.d_bound, self.w_bound // rotate_chunks_resolved(self.cfg)
+        rows = np.arange(n * ns)
         if self.cfg.algo in _TILED_ALGOS:
             ed, ew, od, ow = (np.asarray(a) for a in tokens)
             gm = (ed < self.cfg.d_tile).reshape(-1)
             ld = np.minimum(ed, self.cfg.d_tile - 1) + od[:, :, None]
             lw = np.minimum(ew, self.cfg.w_tile - 1) + ow[:, :, None]
-            gd = (ld + (rows // (2 * n) * db)[:, None, None]).reshape(-1)
-            gw = (lw + (rows % (2 * n) * wb2)[:, None, None]).reshape(-1)
+            gd = (ld + (rows // ns * db)[:, None, None]).reshape(-1)
+            gw = (lw + (rows % ns * wbc)[:, None, None]).reshape(-1)
             return gd, gw, gm
         bd, bw, bm = (np.asarray(a) for a in tokens)
-        gd = (bd + (rows // (2 * n) * db)[:, None]).reshape(-1)
-        gw = (bw + (rows % (2 * n) * wb2)[:, None]).reshape(-1)
+        gd = (bd + (rows // ns * db)[:, None]).reshape(-1)
+        gw = (bw + (rows % ns * wbc)[:, None]).reshape(-1)
         gm = bm.reshape(-1) > 0
         return gd, gw, gm
 
@@ -1010,12 +1058,12 @@ class LDA:
 
     def word_topic_table(self):
         """[vocab_size, K] word-topic counts with storage padding stripped."""
-        n = self.mesh.num_workers
         Nwk = np.asarray(self.Nwk)
         if self.cfg.algo in _TILED_ALGOS:
             K = Nwk.shape[-1]
-            wb2 = self.w_bound // 2
-            Nwk = Nwk.reshape(2 * n, wb2, K)[:, : self.w_own].reshape(-1, K)
+            wbc = self.w_bound // rotate_chunks_resolved(self.cfg)
+            Nwk = Nwk.reshape(self._n_slices, wbc, K)[:, : self.w_own] \
+                .reshape(-1, K)
         return Nwk[: self.vocab_size]
 
     def compile_epochs(self, epochs: int):
@@ -1164,7 +1212,8 @@ def synthetic_corpus(n_docs, vocab_size, n_topics_true, tokens_per_doc, seed=0):
 def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
               entry_cap=None, pull_cap=None, ndk_dtype="float32",
               dedup_pulls=None, sampler=None, rng_impl=None,
-              pallas_exact_gathers=None, carry_db=None):
+              pallas_exact_gathers=None, carry_db=None,
+              rotate_chunks=None, rotate_wire=None):
     """None inherits LDAConfig's defaults; algo-specific knobs raise when
     combined with a non-owning algo (shared contract: mfsgd.algo_kwargs)."""
     # None = "caller didn't say": resolves to the LDAConfig defaults,
@@ -1192,6 +1241,10 @@ def _make_cfg(n_topics, algo="dense", chunk=None, d_tile=None, w_tile=None,
                        "entry_cap": entry_cap, "carry_db": carry_db},
         "pushpull": {"pull_cap": pull_cap, "dedup_pulls": dedup_pulls},
         "pallas": {"pallas_exact_gathers": pallas_exact_gathers},
+        # rotation pipeline knobs: every rotation algo owns them;
+        # pushpull (which never rotates) rejects a non-None value here
+        ("dense", "scatter", "pallas"): {"rotate_chunks": rotate_chunks,
+                                         "rotate_wire": rotate_wire},
     }))
 
 
@@ -1267,6 +1320,11 @@ def _pack_cache_path(pack_cache, cfg: LDAConfig, num_workers, n_docs,
 
     layout = (cfg.algo, cfg.algo == "pallas", cfg.d_tile, cfg.w_tile,
               cfg.entry_cap, cfg.chunk, cfg.ndk_dtype)
+    # rotate_chunks changes n_slices and therefore the whole pack layout;
+    # appended only when non-incumbent so every existing 2-chunk cache
+    # key (675 s enwiki packs) stays valid
+    if rotate_chunks_resolved(cfg) != 2:
+        layout += (rotate_chunks_resolved(cfg),)
     sig = repr((_PACK_VERSION, n_docs, vocab_size, n_topics,
                 tokens_per_doc, seed, num_workers, layout))
     key = hashlib.sha1(sig.encode()).hexdigest()[:16]
@@ -1279,7 +1337,8 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
               algo="dense", d_tile=None, w_tile=None, entry_cap=None,
               pull_cap=None, ndk_dtype="float32", dedup_pulls=None,
               sampler=None, rng_impl=None, pallas_exact_gathers=None,
-              carry_db=None, pack_cache=None):
+              carry_db=None, rotate_chunks=None, rotate_wire=None,
+              pack_cache=None):
     """Tokens/sec/chip on an enwiki-1M-scaled config (graded config #3).
 
     (Full enwiki-1M docs needs a multi-chip pod for the 1M×1k doc-topic
@@ -1296,7 +1355,8 @@ def benchmark(n_docs=100_000, vocab_size=50_000, n_topics=1000,
     mesh = mesh or current_mesh()
     cfg = _make_cfg(n_topics, algo, chunk, d_tile, w_tile, entry_cap,
                     pull_cap, ndk_dtype, dedup_pulls, sampler, rng_impl,
-                    pallas_exact_gathers, carry_db)
+                    pallas_exact_gathers, carry_db, rotate_chunks,
+                    rotate_wire)
     model = LDA(n_docs, vocab_size, cfg, mesh, seed)
     n_tok = n_docs * tokens_per_doc
     d_ids, w_ids = benchmark_corpus(n_docs, vocab_size, tokens_per_doc, seed)
@@ -1394,6 +1454,15 @@ def main(argv=None):
                    help="dense-only: word-topic tile rows (default 512)")
     p.add_argument("--entry-cap", type=int, default=None,
                    help="dense-only: max tokens per tile entry (default 2048)")
+    p.add_argument("--rotate-chunks", type=int, default=None,
+                   help="rotation algos: word-slice chunks per worker in "
+                        "the chunked rotation pipeline (default 2 — the "
+                        "double-buffered two-halves schedule)")
+    p.add_argument("--rotate-wire", choices=["exact", "bf16", "int8"],
+                   default=None,
+                   help="rotation algos: ring payload for in-flight "
+                        "chunks (default exact; bf16/int8 halve/quarter "
+                        "the rotate bytes, one rounding per hop)")
     p.add_argument("--ckpt-dir", default=None,
                    help="sample with checkpoint/resume instead of "
                         "benchmarking; rerunning with the same dir resumes "
@@ -1436,7 +1505,9 @@ def main(argv=None):
                               args.d_tile, args.w_tile, args.entry_cap,
                               args.pull_cap, args.ndk_dtype,
                               False if args.no_dedup_pulls else None,
-                              args.sampler, args.rng_impl))
+                              args.sampler, args.rng_impl,
+                              rotate_chunks=args.rotate_chunks,
+                              rotate_wire=args.rotate_wire))
         model.set_tokens(d_ids, w_ids)
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print(benchmark_json("lda_fit_cli", {
@@ -1451,7 +1522,9 @@ def main(argv=None):
             pull_cap=args.pull_cap, ndk_dtype=args.ndk_dtype,
             dedup_pulls=(False if args.no_dedup_pulls
                          else None), sampler=args.sampler,
-            rng_impl=args.rng_impl)))
+            rng_impl=args.rng_impl,
+            rotate_chunks=args.rotate_chunks,
+            rotate_wire=args.rotate_wire)))
     from harp_tpu.report import maybe_emit
 
     maybe_emit("lda")

@@ -50,7 +50,8 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.parallel.rotate import resident_half_index
+from harp_tpu.parallel.rotate import (ROTATE_WIRES, resident_chunk_index,
+                                      rotate_pipeline)
 from harp_tpu.utils.timing import device_sync
 
 
@@ -113,6 +114,23 @@ class MFSGDConfig:
     # byte saving is hidden behind other traffic) — and the kernel flip
     # supersedes the slot anyway; stays OFF.
     carry_w: bool = False
+    # Rotation pipeline knobs (the chunked double-buffered rotator,
+    # parallel/rotate.py).  rotate_chunks: H sub-slices per worker that
+    # alternate compute/in-flight roles — None = auto (2: the historical
+    # two-halves schedule; the generic pipeline at 2 chunks is
+    # equivalence-pinned against it by tests/test_rotate_chunked.py).
+    # More chunks shrink each ring transfer and expose finer overlap at
+    # the cost of more scan steps — flip candidate `mfsgd_chunked_rotate`
+    # measures 4 on the relay; default stays 2 until flip_decision says
+    # FLIP.  None = auto, resolved at READ time by
+    # :func:`rotate_chunks_resolved` (same contract as :func:`tiles`).
+    rotate_chunks: int | None = None
+    # Ring payload for the in-flight chunk: "exact" (default — bit-exact
+    # f32 ppermute), "bf16" or "int8" (collective.rotate_quantized: one
+    # rounding per hop, ring-size-independent — noise of the same order
+    # as SGD's own stochasticity, but the default stays exact until a
+    # relay measurement flips it).
+    rotate_wire: str = "exact"
 
     def __post_init__(self):
         if self.algo not in ("dense", "scatter", "pallas"):
@@ -123,6 +141,13 @@ class MFSGDConfig:
                 "carry_w applies to algo='dense' only (the pallas kernel "
                 "already keeps W resident across its block runs; scatter "
                 "has no tile slicing to amortize)")
+        if self.rotate_chunks is not None and self.rotate_chunks < 1:
+            raise ValueError(
+                f"rotate_chunks must be >= 1, got {self.rotate_chunks}")
+        if self.rotate_wire not in ROTATE_WIRES:
+            raise ValueError(
+                f"rotate_wire must be one of {ROTATE_WIRES}, "
+                f"got {self.rotate_wire!r}")
 
 
 def tiles(cfg: MFSGDConfig) -> tuple[int, int]:
@@ -139,6 +164,15 @@ def tiles(cfg: MFSGDConfig) -> tuple[int, int]:
             cfg.i_tile if cfg.i_tile is not None else auto)
 
 
+def rotate_chunks_resolved(cfg) -> int:
+    """Resolved rotation chunk count — ``None`` means the incumbent 2
+    (the two-halves schedule both rotation models shipped with).  Read-time
+    resolution (not ``__post_init__``) so ``dataclasses.replace`` keeps the
+    auto default, mirroring :func:`tiles`; shared with
+    :class:`harp_tpu.models.lda.LDAConfig` (same field, same contract)."""
+    return cfg.rotate_chunks if cfg.rotate_chunks is not None else 2
+
+
 # ---------------------------------------------------------------------------
 # Host preprocessing: triples → N×N padded block grid.
 # ---------------------------------------------------------------------------
@@ -148,7 +182,8 @@ def partition_ratings(users, items, vals, n_users, n_items, n_workers, chunk,
     """Partition rating triples into the (user-range × item-slice) grid.
 
     ``n_slices`` defaults to ``2 * n_workers`` — two half-slices per worker,
-    which the pipelined epoch needs to overlap rotation with compute.
+    the incumbent double-buffer depth; the chunked epoch passes
+    ``rotate_chunks * n_workers`` (one slice per rotation chunk).
 
     Returns per-worker arrays ``u[S, B], i[S, B], v[S, B], mask[S, B]`` with
     user/item ids **local** to their range/slice, stacked worker-major so
@@ -471,46 +506,35 @@ _DENSE_ALGOS = ("dense", "pallas")
 def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
     """Build the device-view epoch callable (every rating visited once).
 
-    This is the dymoro pipeline done the XLA way (SURVEY.md §4.3): each
-    worker's H slice is **split into two halves** that alternate roles —
-    while the SGD kernel updates one half, the other (updated on the
-    previous step) is in flight to the ring neighbor.  The ``ppermute`` has
-    no data dependency on the current step's compute, so XLA's async
-    scheduler overlaps transfer with compute; a whole-slice rotation would
-    serialize, because a mutated slice cannot leave before its update
-    finishes (the constraint Harp's Rotator also has, which is why dymoro
-    prefetches *next* slices rather than sending current ones).
-
-    Schedule (n workers, 2n half-slices, 2n steps/epoch): at step t worker
-    w computes half ``2*((w - t//2) % n)`` (t even) or
-    ``2*((w - t//2 - 1) % n) + 1`` (t odd); after 2n steps both halves are
-    back home and every (worker, half) pair has met exactly once.
+    This is the dymoro pipeline done the XLA way (SURVEY.md §4.3), on the
+    generic chunked rotator: each worker's H slice splits into
+    ``rotate_chunks_resolved(cfg)`` sub-slices that alternate compute /
+    in-flight roles inside :func:`rotate_pipeline` — the chunk updated at
+    step t-1 rides a ``ppermute`` with no data dependency on step t's
+    compute, so XLA's async scheduler overlaps transfer with compute,
+    while a whole-slice rotation would serialize (a mutated slice cannot
+    leave before its update finishes — the constraint Harp's Rotator also
+    has, which is why dymoro prefetches *next* slices rather than sending
+    current ones).  The 2-chunk default IS the former bespoke two-halves
+    schedule (n workers, 2n half-slices, 2n steps/epoch; equivalence
+    pinned by the numpy goldens + tests/test_rotate_chunked.py);
+    ``cfg.rotate_wire`` narrows the ring payload.
     """
-    two_n = 2 * mesh.num_workers
+    nc = rotate_chunks_resolved(cfg)
     update = _UPDATERS[cfg.algo]
 
     def epoch(W, H_slice, *blocks):
-        # block arrays arrive as this worker's [2n_half_slices, ...] row;
-        # the resident H rows split into an even (front) and odd (back) half.
-        ib2 = H_slice.shape[0] // 2
-        computing, inflight = H_slice[:ib2], H_slice[ib2:]
+        # block arrays arrive as this worker's [nc·n chunk-slices, ...] row
+        def step(st, chunk, t):
+            W, se, cnt = st
+            block = jax.tree.map(
+                lambda a: a[resident_chunk_index(t, nc)], blocks)
+            W, chunk, dse, dcnt = update(W, chunk, block, cfg)
+            return (W, se + dse, cnt + dcnt), chunk
 
-        def body(carry, t):
-            W, computing, inflight, se, cnt = carry
-            received = C.rotate(inflight)  # overlaps with the update below
-            half_idx = resident_half_index(t)
-            block = jax.tree.map(lambda a: a[half_idx], blocks)
-            W, computing, dse, dcnt = update(W, computing, block, cfg)
-            return (W, received, computing, se + dse, cnt + dcnt), None
-
-        (W, computing, inflight, se, cnt), _ = lax.scan(
-            body,
-            (W, computing, inflight, jnp.float32(0.0), jnp.float32(0.0)),
-            jnp.arange(two_n),
-        )
-        # After 2n steps the even half sits in `computing`, odd in `inflight`,
-        # both back on their home worker.
-        H_slice = jnp.concatenate([computing, inflight], axis=0)
+        (W, se, cnt), H_slice = rotate_pipeline(
+            step, (W, jnp.float32(0.0), jnp.float32(0.0)), H_slice,
+            n_chunks=nc, wire=cfg.rotate_wire)
         # loss partials are per-worker; combine before leaving SPMD (the
         # optional end-of-epoch allreduce-RMSE in Harp's MF-SGD loop)
         se, cnt = C.allreduce((se, cnt))
@@ -573,15 +597,17 @@ class MFSGD:
         self.cfg = cfg or MFSGDConfig()
         self.n_users, self.n_items = n_users, n_items
         n = self.mesh.num_workers
+        nc = rotate_chunks_resolved(self.cfg)
+        # rotate_chunks chunk-slices per worker (pipelined rotation)
+        self._n_slices = nc * n
         if self.cfg.algo in _DENSE_ALGOS:
-            self.u_own, self.i_own, self.u_bound, ib2 = _dense_bounds(
-                n_users, n_items, n, 2 * n, *tiles(self.cfg))
-            self.i_bound = 2 * ib2
+            self.u_own, self.i_own, self.u_bound, ibc = _dense_bounds(
+                n_users, n_items, n, self._n_slices, *tiles(self.cfg))
+            self.i_bound = nc * ibc
         else:
             self.u_bound = self.u_own = _ceil_div(n_users, n)
-            # two half-slices per worker (pipelined rotation) → per-worker rows
-            self.i_bound = 2 * _ceil_div(n_items, 2 * n)
-            self.i_own = self.i_bound // 2
+            self.i_bound = nc * _ceil_div(n_items, self._n_slices)
+            self.i_own = self.i_bound // nc
         k1, k2 = jax.random.split(jax.random.key(seed))
         scale = 1.0 / np.sqrt(self.cfg.rank)
         self.W = self.mesh.shard_array(
@@ -596,10 +622,12 @@ class MFSGD:
 
     def set_ratings(self, users, items, vals):
         n = self.mesh.num_workers
+        nc = rotate_chunks_resolved(self.cfg)
         if self.cfg.algo in _DENSE_ALGOS:
-            eu, ei, ev, ou, oi, uo, io, ub, ib2 = partition_ratings_tiles(
+            eu, ei, ev, ou, oi, uo, io, ub, ibc = partition_ratings_tiles(
                 users, items, vals, self.n_users, self.n_items, n,
                 *tiles(self.cfg), self.cfg.entry_cap,
+                n_slices=self._n_slices,
             )
             assert (uo, io) == (self.u_own, self.i_own)
             if self.cfg.algo == "pallas":
@@ -609,12 +637,12 @@ class MFSGD:
                     eu, ei, ev, ou, oi, ub, tiles(self.cfg)[0])
             blocks = (eu, ei, ev, ou, oi)
         else:
-            bu, bi, bv, bm, ub, ib2 = partition_ratings(
+            bu, bi, bv, bm, ub, ibc = partition_ratings(
                 users, items, vals, self.n_users, self.n_items, n,
-                self.cfg.chunk,
+                self.cfg.chunk, n_slices=self._n_slices,
             )
             blocks = (bu, bi, bv, bm)
-        assert (ub, 2 * ib2) == (self.u_bound, self.i_bound)
+        assert (ub, nc * ibc) == (self.u_bound, self.i_bound)
         self._blocks = tuple(self.mesh.shard_array(a, 0) for a in blocks)
         self._multi_fns.clear()  # compiled executables bind to block shapes
         self.nnz = len(np.asarray(vals))
@@ -709,10 +737,11 @@ class MFSGD:
         W = np.asarray(self.W)
         H = np.asarray(self.H)
         if self.cfg.algo in _DENSE_ALGOS:
+            nc = rotate_chunks_resolved(self.cfg)
             r = W.shape[-1]
             W = W.reshape(n, self.u_bound, r)[:, : self.u_own].reshape(-1, r)
-            ib2 = self.i_bound // 2
-            H = H.reshape(2 * n, ib2, r)[:, : self.i_own].reshape(-1, r)
+            ibc = self.i_bound // nc
+            H = H.reshape(nc * n, ibc, r)[:, : self.i_own].reshape(-1, r)
         return W[: self.n_users], H[: self.n_items]
 
     def predict_rmse(self, users, items, vals):
@@ -761,18 +790,26 @@ def algo_kwargs(algo: str, groups: dict) -> dict:
 def _make_config(rank: int, chunk: int | None, algo: str = "dense",
                  u_tile: int | None = None, i_tile: int | None = None,
                  entry_cap: int | None = None,
-                 carry_w: bool | None = None) -> MFSGDConfig:
+                 carry_w: bool | None = None,
+                 rotate_chunks: int | None = None,
+                 rotate_wire: str | None = None) -> MFSGDConfig:
     return MFSGDConfig(rank=rank, **algo_kwargs(algo, {
         "scatter": {"chunk": chunk},
         _DENSE_ALGOS: {"u_tile": u_tile, "i_tile": i_tile,
                        "entry_cap": entry_cap},
         "dense": {"carry_w": carry_w},
+        # every MF-SGD algo rotates, so the pipeline knobs have no
+        # non-owning algo to reject — they still ride algo_kwargs for
+        # the uniform None-inherits-default contract
+        ("dense", "scatter", "pallas"): {"rotate_chunks": rotate_chunks,
+                                         "rotate_wire": rotate_wire},
     }))
 
 
 def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
               epochs=3, mesh=None, seed=0, chunk=None, algo="dense",
-              u_tile=None, i_tile=None, entry_cap=None, carry_w=None):
+              u_tile=None, i_tile=None, entry_cap=None, carry_w=None,
+              rotate_chunks=None, rotate_wire=None):
     """updates/sec/chip on MovieLens-20M shapes (north-star metric #2).
 
     One 'update' = one rating visit (one (w_u, h_i) SGD update pair),
@@ -787,7 +824,7 @@ def benchmark(n_users=138_493, n_items=26_744, nnz=20_000_000, rank=64,
     """
     mesh = mesh or current_mesh()
     cfg = _make_config(rank, chunk, algo, u_tile, i_tile, entry_cap,
-                       carry_w)
+                       carry_w, rotate_chunks, rotate_wire)
     model = MFSGD(n_users, n_items, cfg, mesh, seed)
     u, i, v = synthetic_ratings(n_users, n_items, nnz, seed=seed)
     t0 = time.perf_counter()
@@ -841,6 +878,15 @@ def main(argv=None):
                    help="dense/pallas: H tile rows (default 512)")
     p.add_argument("--entry-cap", type=int, default=None,
                    help="dense/pallas: max ratings per tile entry (default 2048)")
+    p.add_argument("--rotate-chunks", type=int, default=None,
+                   help="H sub-slices per worker in the chunked rotation "
+                        "pipeline (default 2 — the double-buffered "
+                        "two-halves schedule)")
+    p.add_argument("--rotate-wire", choices=["exact", "bf16", "int8"],
+                   default=None,
+                   help="ring payload for in-flight chunks (default exact; "
+                        "bf16/int8 halve/quarter the rotate bytes with one "
+                        "rounding per hop)")
     p.add_argument("--ckpt-dir", default=None,
                    help="train with checkpoint/resume instead of benchmarking; "
                         "rerunning with the same dir resumes from the latest "
@@ -878,7 +924,9 @@ def main(argv=None):
             u, i, v = synthetic_ratings(n_users, n_items, args.nnz)
         model = MFSGD(n_users, n_items,
                       _make_config(args.rank, args.chunk, args.algo,
-                                   args.u_tile, args.i_tile, args.entry_cap))
+                                   args.u_tile, args.i_tile, args.entry_cap,
+                                   rotate_chunks=args.rotate_chunks,
+                                   rotate_wire=args.rotate_wire))
         model.set_ratings(u, i, v)
         rmses = model.fit(args.epochs, args.ckpt_dir,
                           ckpt_every=args.ckpt_every)
@@ -891,7 +939,9 @@ def main(argv=None):
             args.users or 138_493, args.items or 26_744,
             args.nnz, args.rank, args.epochs, chunk=args.chunk,
             algo=args.algo, u_tile=args.u_tile,
-            i_tile=args.i_tile, entry_cap=args.entry_cap)))
+            i_tile=args.i_tile, entry_cap=args.entry_cap,
+            rotate_chunks=args.rotate_chunks,
+            rotate_wire=args.rotate_wire)))
     from harp_tpu.report import maybe_emit
 
     maybe_emit("mfsgd")

@@ -23,13 +23,15 @@ from harp_tpu.parallel.collective import (
     broadcast,
     reduce,
     regroup,
+    regroup_quantized,
     rotate,
+    rotate_quantized,
     push,
     pull,
     barrier,
 )
 from harp_tpu.parallel.pipeline import pipeline_forward, pipeline_loss_and_grads
-from harp_tpu.parallel.rotate import rotate_pipeline
+from harp_tpu.parallel.rotate import resident_chunk_index, rotate_pipeline
 
 __all__ = [
     "WorkerMesh",
@@ -45,9 +47,12 @@ __all__ = [
     "broadcast",
     "reduce",
     "regroup",
+    "regroup_quantized",
     "rotate",
+    "rotate_quantized",
     "push",
     "pull",
     "barrier",
+    "resident_chunk_index",
     "rotate_pipeline",
 ]

@@ -87,7 +87,8 @@ def _as_combiner(op: "Combiner | str") -> Combiner:
 
 
 # ---------------------------------------------------------------------------
-# The nine verbs (device view — call inside shard_map).
+# The nine Harp verbs + their quantized-wire twins (device view — call
+# inside shard_map).
 # ---------------------------------------------------------------------------
 
 def allreduce(tree: Any, op: "Combiner | str" = Combiner.ADD, *, axis: str = WORKER_AXIS):
@@ -194,6 +195,88 @@ def push_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
     return _quantized_reduce(tree, wire_dtype, axis, verb="push_quantized",
                              reduce_float=scatter,
                              reduce_exact=scatter_exact)
+
+
+def _quantized_move(tree, wire_dtype, axis, move, verb):
+    """Shared engine of :func:`rotate_quantized` / :func:`regroup_quantized`
+    — pure **data movement** on a narrow wire, the EQuARX trade
+    (PAPERS.md arXiv:2506.17615) applied to the permutation collectives.
+
+    Unlike :func:`_quantized_reduce` nothing accumulates over the ring, so
+    both formats round exactly ONCE per call and the error is independent
+    of the ring size: bf16 is one cast each way; int8 uses a worker-shared
+    per-leaf scale (all float leaves' |max| ride ONE stacked ``pmax``, so
+    sender and receiver dequantize with the same replicated scale and no
+    scale rides the wire) with error ≤ ``scale/2 = global_max/254`` per
+    element.  Non-float leaves move exact at their own width.
+    """
+    wd = jnp.dtype(wire_dtype)
+    if wd not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.int8)):
+        raise ValueError(f"unsupported wire_dtype {wire_dtype!r} "
+                         "(use jnp.bfloat16 or jnp.int8)")
+    record_comm(verb, tree, axis=axis, wire_dtype=wd)
+    leaves, treedef = jax.tree.flatten(tree)
+    is_float = [jnp.issubdtype(x.dtype, jnp.floating) for x in leaves]
+
+    amaxes = None
+    if wd == jnp.dtype(jnp.int8) and any(is_float):
+        # one fused collective for every leaf's scale, not one per leaf
+        amax = jnp.stack([jnp.max(jnp.abs(x)).astype(jnp.float32)
+                          for x, f in zip(leaves, is_float) if f])
+        amaxes = iter(lax.pmax(amax, axis))
+
+    out = []
+    for x, f in zip(leaves, is_float):
+        if not f:
+            out.append(move(x))
+        elif wd == jnp.dtype(jnp.bfloat16):
+            out.append(move(x.astype(jnp.bfloat16)).astype(x.dtype))
+        else:
+            q, scale = quantize_to_int8(x, next(amaxes))
+            out.append((move(q).astype(jnp.float32) * scale).astype(x.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def rotate_quantized(tree: Any, shift: int = 1, *,
+                     wire_dtype: Any = jnp.bfloat16,
+                     axis: str = WORKER_AXIS):
+    """:func:`rotate` on a quantized wire — half (bf16) or a quarter (int8)
+    of the ICI/DCN bytes per ring hop for bandwidth-bound model rotation.
+
+    Rotation is pure data movement, so unlike :func:`allreduce_quantized`
+    the error is a SINGLE rounding per call, independent of the ring size
+    (bf16: one cast each way; int8: symmetric quantization against a
+    worker-shared per-leaf ``pmax`` scale, error ≤ ``global_max/254`` per
+    element) — strictly better conditioned than the reduce-side trade.
+    Non-float leaves ride exact.  This is a separate opt-in verb: Harp's
+    rotate contract (and ours) is full-precision by default; the chunked
+    ``rotate_pipeline(wire=...)`` is the intended caller.
+    """
+    def move(x):
+        n = lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis, perm)
+
+    return _quantized_move(tree, wire_dtype, axis, move, "rotate_quantized")
+
+
+def regroup_quantized(tree: Any, *, wire_dtype: Any = jnp.bfloat16,
+                      axis: str = WORKER_AXIS, split_dim: int = 0,
+                      concat_dim: int | None = None):
+    """:func:`regroup` (all-to-all repartition) on a quantized wire.
+
+    Same single-rounding contract as :func:`rotate_quantized` — the
+    shuffle moves data, it never accumulates, and the per-leaf int8 scale
+    is ``pmax``'d over the axis so every (sender, receiver) pair agrees on
+    it without shipping scales.  Non-float leaves ride exact.
+    """
+    cd = split_dim if concat_dim is None else concat_dim
+
+    def move(x):
+        return lax.all_to_all(x, axis, split_axis=split_dim,
+                              concat_axis=cd, tiled=True)
+
+    return _quantized_move(tree, wire_dtype, axis, move, "regroup_quantized")
 
 
 def allgather(tree: Any, *, axis: str = WORKER_AXIS, tiled: bool = True):

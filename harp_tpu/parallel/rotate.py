@@ -1,6 +1,6 @@
-"""Model-rotation pipeline: Harp's dymoro, TPU-native.
+"""Model-rotation pipeline: Harp's dymoro, TPU-native — now chunked.
 
-Reference parity (SURVEY.md §3.1, §4.3): ``edu.iu.dymoro.Rotator`` +
+Reference parity (SURVEY.md §3.1, §3.5, §4.3): ``edu.iu.dymoro.Rotator`` +
 ``Scheduler`` implement Harp's signature optimization — while worker threads
 update the model slice currently resident, the *next* slice is already in
 flight from the ring neighbor, so communication hides behind compute.  A
@@ -9,16 +9,21 @@ timer bounds each compute phase so all workers rotate in lockstep.
 TPU-native version: a ``lax.scan`` whose body runs the compute step on the
 resident slice and then issues the ``ppermute``.  Overlap of transfer with
 compute depends on the data flow: for **read-only** step functions XLA's
-async scheduler overlaps the rotation with the next step's compute (the
-dymoro double-buffer, done by the compiler); for **slice-updating** step
-functions (MF-SGD) the rotation consumes the step's output, so the handoff
-serializes — exactly as it does in Harp, where a mutated partition cannot
-leave before the update finishes.  Apps that want overlap with updates
-should split the slice and rotate the half not being written (see
-``harp_tpu.models.mfsgd``).  Lockstep comes free: SPMD programs advance
-together, so the timer-bounded dynamic scheduling is replaced by fixed work
-per step (SURVEY.md §8 "hard parts" — convergence must be validated per
-app, which the app tests do).
+async scheduler overlaps the rotation with the next step's compute; for
+**slice-updating** step functions (MF-SGD, LDA) a whole-slice rotation
+serializes — a mutated partition cannot leave before the update finishes,
+the constraint Harp's Rotator also has.  The cure is **chunking**
+(``n_chunks > 1``): each worker's slice splits into ``n_chunks`` sub-slices
+that alternate compute / in-flight roles, so the chunk updated at step
+``t-1`` travels the ring while step ``t`` computes on the next one — a
+software double buffer (TACCL's chunked-pipelining observation, PAPERS.md
+arXiv:2111.04867, applied to the rotate collective).  ``n_chunks=2`` is
+exactly the two-halves schedule MF-SGD and LDA used to hand-roll;
+``wire`` selects the ring payload format (``"exact"`` ppermute, or the
+quantized :func:`harp_tpu.parallel.collective.rotate_quantized` wire).
+Lockstep comes free: SPMD programs advance together, so the timer-bounded
+dynamic scheduling is replaced by fixed work per step (SURVEY.md §8 "hard
+parts" — convergence must be validated per app, which the app tests do).
 
 This is structurally the ring-attention ppermute pattern; long-context
 sequence parallelism falls out of the same primitive (see
@@ -30,11 +35,56 @@ from __future__ import annotations
 import math
 from typing import Any, Callable
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from harp_tpu.parallel.mesh import WORKER_AXIS
-from harp_tpu.parallel.collective import rotate
+from harp_tpu.parallel.collective import rotate, rotate_quantized
+
+#: ring payload formats for the pipelined rotation (see rotate_pipeline)
+ROTATE_WIRES = ("exact", "bf16", "int8")
+
+
+def _wire_rotate(wire: str | None, shift: int, axis: str):
+    """Resolve a ``wire`` name to the rotation verb moving in-flight chunks."""
+    if wire in (None, "exact"):
+        return lambda tree: rotate(tree, shift=shift, axis=axis)
+    if wire == "bf16":
+        return lambda tree: rotate_quantized(
+            tree, shift=shift, wire_dtype=jnp.bfloat16, axis=axis)
+    if wire == "int8":
+        return lambda tree: rotate_quantized(
+            tree, shift=shift, wire_dtype=jnp.int8, axis=axis)
+    raise ValueError(
+        f"wire must be one of {ROTATE_WIRES}, got {wire!r}")
+
+
+def _split_chunks(tree: Any, n_chunks: int, axis: int):
+    """Split every leaf's ``axis`` into ``n_chunks`` equal chunks, stacked
+    on a new leading chunk dimension."""
+    def split(x):
+        if x.shape[axis] % n_chunks:
+            raise ValueError(
+                f"model slice dim {axis} of size {x.shape[axis]} does not "
+                f"split into {n_chunks} equal rotation chunks")
+        m = x.shape[axis] // n_chunks
+        shape = x.shape[:axis] + (n_chunks, m) + x.shape[axis + 1:]
+        return jnp.moveaxis(x.reshape(shape), axis, 0)
+
+    return jax.tree.map(split, tree)
+
+
+def _join_chunks(tree: Any, axis: int):
+    """Inverse of :func:`_split_chunks`: merge the leading chunk dimension
+    back into ``axis``."""
+    def join(x):
+        y = jnp.moveaxis(x, 0, axis)
+        return y.reshape(y.shape[:axis]
+                         + (y.shape[axis] * y.shape[axis + 1],)
+                         + y.shape[axis + 2:])
+
+    return jax.tree.map(join, tree)
 
 
 def rotate_pipeline(
@@ -45,76 +95,166 @@ def rotate_pipeline(
     n_steps: int | None = None,
     shift: int = 1,
     axis: str = WORKER_AXIS,
+    n_chunks: int = 1,
+    wire: str = "exact",
+    chunk_axis: int = 0,
 ):
-    """Run ``n_steps`` rotation steps of ``carry = step_fn(carry, slice, t)``.
+    """Run one rotation epoch of ``carry = step_fn(carry, chunk, t)``.
 
-    Each step computes on the resident model slice, then rotates it onward.
-    When ``gcd(shift, num_workers) == 1``, ``n_steps == num_workers`` steps
-    visit every slice on every worker exactly once and leave each slice back
-    home — one full Harp "epoch" of model rotation.  A ``shift`` sharing a
-    factor with the ring size cycles through only ``num_workers/gcd`` slices;
-    the default full-revolution mode rejects it rather than silently
-    training on a subset of the model.
+    ``n_chunks=1`` (default): each step computes on the whole resident
+    slice, then rotates it onward — when ``gcd(shift, num_workers) == 1``,
+    ``n_steps == num_workers`` steps visit every slice on every worker
+    exactly once and leave each slice back home — one full Harp "epoch" of
+    model rotation.  A ``shift`` sharing a factor with the ring size cycles
+    through only ``num_workers/gcd`` slices; the default full-revolution
+    mode rejects it rather than silently training on a subset of the model.
+    With an update-free ``step_fn`` XLA overlaps the transfer with the next
+    step's compute; with updates the handoff serializes (Harp's constraint
+    too).
+
+    ``n_chunks=C > 1``: the slice splits into C equal chunks along
+    ``chunk_axis`` and the epoch becomes ``C * num_workers`` steps of a
+    software double buffer — at step ``t`` the chunk updated at step
+    ``t-1`` is in flight (its ``ppermute`` has no data dependency on this
+    step's compute, so XLA overlaps it) while ``step_fn`` runs on the next
+    resident chunk.  ``C=2`` reproduces the bespoke two-halves schedule
+    bit-for-bit (``resident_half_index``); larger C shrinks each transfer
+    and exposes more overlap slots at the cost of more scan steps.  Apps
+    map step ``t`` to the resident chunk's global index with
+    :func:`resident_chunk_index`.  ``n_steps`` must be left ``None`` (the
+    full revolution) in chunked mode.
+
+    ``wire`` selects the ring payload: ``"exact"`` (default — bit-exact
+    ppermute), ``"bf16"`` or ``"int8"`` (the
+    :func:`~harp_tpu.parallel.collective.rotate_quantized` formats; each
+    hop re-rounds the chunk, so an epoch accumulates at most one rounding
+    per hop a chunk travels).
 
     Args:
-      step_fn: ``(carry, model_slice, step_index) -> (carry, model_slice)``;
-        may update the slice (MF-SGD does) — the updated slice is what
-        rotates onward, exactly like Harp rotating the mutated partition.
+      step_fn: ``(carry, chunk, step_index) -> (carry, chunk)``; may update
+        the chunk (MF-SGD does) — the updated chunk is what rotates onward,
+        exactly like Harp rotating the mutated partition.
       carry: loop state local to the worker (e.g. W factor, rng key, loss).
       model_slice: this worker's resident slice of the global model (pytree).
-      n_steps: defaults to the ring size (one full revolution).
+      n_steps: unchunked mode only — defaults to the ring size (one full
+        revolution).
       shift: ring direction/stride, as in Harp's rotate.
 
     Returns:
-      ``(carry, model_slice)`` after the final step's rotation.
+      ``(carry, model_slice)`` after the final step, chunks reassembled in
+      home order.
 
     Must be called inside ``shard_map`` (device view).
     """
-    if n_steps is None:
-        n_steps = lax.axis_size(axis)
-        if math.gcd(shift % n_steps, n_steps) != 1:
-            raise ValueError(
-                f"shift={shift} shares a factor with the ring size {n_steps}: "
-                f"a full revolution would visit only {n_steps // math.gcd(shift % n_steps, n_steps)} "
-                f"of {n_steps} slices; pass n_steps explicitly if that is intended"
-            )
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    wrotate = _wire_rotate(wire, shift, axis)
+
+    if n_chunks == 1:
+        if n_steps is None:
+            n_steps = lax.axis_size(axis)
+            if math.gcd(shift % n_steps, n_steps) != 1:
+                raise ValueError(
+                    f"shift={shift} shares a factor with the ring size {n_steps}: "
+                    f"a full revolution would visit only {n_steps // math.gcd(shift % n_steps, n_steps)} "
+                    f"of {n_steps} slices; pass n_steps explicitly if that is intended"
+                )
+
+        def body(state, t):
+            c, cur = state
+            c, cur = step_fn(c, cur, t)
+            # Rotation of the (possibly updated) slice. With an update-free
+            # step_fn XLA overlaps this transfer with the next iteration's
+            # compute; with updates it is the serialized handoff Harp also
+            # has — use n_chunks > 1 to overlap through updates.
+            nxt = wrotate(cur)
+            return (c, nxt), None
+
+        (carry, model_slice), _ = lax.scan(
+            body, (carry, model_slice), jnp.arange(n_steps)
+        )
+        return carry, model_slice
+
+    if n_steps is not None:
+        raise ValueError(
+            "chunked mode runs the full revolution (n_chunks * ring size "
+            "steps); n_steps must be None")
+    n = lax.axis_size(axis)
+    if math.gcd(shift % n, n) != 1:
+        raise ValueError(
+            f"shift={shift} shares a factor with the ring size {n}: chunks "
+            "would revisit a worker subset instead of covering the ring")
+
+    buf = _split_chunks(model_slice, n_chunks, chunk_axis)
+    # local chunks 0..C-2 queue up for compute; chunk C-1 starts in flight
+    # (it is computed by workers w+shift .. w+n*shift and lands home on the
+    # last step) — at C=2 this is exactly the former bespoke
+    # computing/inflight half-slice split of mfsgd/lda.
+    queue = jax.tree.map(lambda a: a[:-1], buf)
+    inflight = jax.tree.map(lambda a: a[-1], buf)
 
     def body(state, t):
-        c, cur = state
+        c, q, infl = state
+        received = wrotate(infl)  # no dep on this step's compute: overlaps
+        cur = jax.tree.map(lambda a: a[0], q)
         c, cur = step_fn(c, cur, t)
-        # Rotation of the (possibly updated) slice. With an update-free
-        # step_fn XLA overlaps this transfer with the next iteration's
-        # compute; with updates it is the serialized handoff Harp also has.
-        nxt = rotate(cur, shift=shift, axis=axis)
-        return (c, nxt), None
+        # pop the computed head; the received chunk joins the queue tail
+        # (it computes C-1 steps from now, giving every chunk a period of
+        # exactly C steps per worker hop — full (worker, chunk) coverage)
+        q = jax.tree.map(
+            lambda a, r: jnp.concatenate([a[1:], r[None]], axis=0),
+            q, received)
+        return (c, q, cur), None
 
-    (carry, model_slice), _ = lax.scan(
-        body, (carry, model_slice), jnp.arange(n_steps)
+    (carry, queue, inflight), _ = lax.scan(
+        body, (carry, queue, inflight), jnp.arange(n_chunks * n)
     )
-    return carry, model_slice
+    # after C·n steps home chunk p sits at queue position p (p < C-1) and
+    # chunk C-1 — computed on its home worker at the final step — is the
+    # outgoing `inflight`; reassemble in home order
+    buf = jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b[None]], axis=0), queue, inflight)
+    return carry, _join_chunks(buf, chunk_axis)
+
+
+def resident_chunk_index(t, n_chunks: int, *, shift: int = 1,
+                         axis: str = WORKER_AXIS):
+    """Global index of the chunk this worker computes at step ``t`` of the
+    chunked ``rotate_pipeline`` (``n_chunks * num_workers`` steps/epoch).
+
+    Chunk ``p`` of home worker ``w0`` (global index ``n_chunks*w0 + p``)
+    computes every ``n_chunks`` steps, moving ``shift`` workers per period;
+    the initial in-flight chunk (``p = n_chunks-1``) is one hop ahead.  So
+    worker ``w`` at step ``t`` computes chunk
+    ``n_chunks * ((w - (t // n_chunks + (r == n_chunks-1)) * shift) % n) + r``
+    with ``r = t % n_chunks``.  ``n_chunks=2`` is the historical
+    :func:`resident_half_index` schedule; ``n_chunks=1`` degenerates to
+    :func:`resident_slice_index`.  The agreement between this formula and
+    the pipeline's actual data movement is pinned by
+    tests/test_rotate_chunked.py.
+    """
+    w = lax.axis_index(axis)
+    n = lax.axis_size(axis)
+    r = t % n_chunks
+    ahead = jnp.where(r == n_chunks - 1, 1, 0) if n_chunks > 1 else 0
+    home = (w - (t // n_chunks + ahead) * shift) % n
+    return n_chunks * home + r
 
 
 def resident_half_index(t, *, axis: str = WORKER_AXIS):
     """Half-slice resident on this worker at step ``t`` of the pipelined
-    two-halves-per-worker rotation (the schedule MF-SGD and LDA share).
-
-    With n workers and 2n half-slices alternating compute/in-flight roles,
-    step t computes half ``2*((w - t//2) % n)`` when t is even and
+    two-halves-per-worker rotation — :func:`resident_chunk_index` at
+    ``n_chunks=2``, kept as the named schedule MF-SGD and LDA shipped with
+    (step t computes half ``2*((w - t//2) % n)`` when t is even and
     ``2*((w - t//2 - 1) % n) + 1`` when odd; after 2n steps both halves
-    are home and every (worker, half) pair met exactly once (see
-    mfsgd._epoch_device_fn for the derivation).
+    are home and every (worker, half) pair met exactly once).
     """
-    w = lax.axis_index(axis)
-    n = lax.axis_size(axis)
-    return jnp.where(
-        t % 2 == 0,
-        2 * ((w - t // 2) % n),
-        2 * ((w - t // 2 - 1) % n) + 1,
-    )
+    return resident_chunk_index(t, 2, axis=axis)
 
 
 def resident_slice_index(t, *, shift: int = 1, axis: str = WORKER_AXIS):
-    """Global index of the slice resident on this worker at rotation step t.
+    """Global index of the slice resident on this worker at rotation step t
+    (unchunked pipeline).
 
     Slices start at their owners (slice *i* on worker *i*) and move ``shift``
     workers per step, so at step ``t`` worker ``w`` holds slice

@@ -76,7 +76,10 @@ def _provenance() -> dict:
                 ["git", "rev-parse", "--short", "HEAD"],
                 capture_output=True, text=True, timeout=10,
             ).stdout.strip() or None
-        except OSError:
+        except (OSError, subprocess.SubprocessError):
+            # TimeoutExpired included: a hung git must not crash
+            # benchmark_json at print time and lose an hours-long
+            # measurement (ADVICE r5)
             commit = None
         _PROVENANCE = {
             "date": datetime.date.today().isoformat(),
@@ -107,6 +110,8 @@ def benchmark_json(config: str, result: dict) -> str:
             return v.tolist()
         return v
 
+    # provenance first: a measured result key that collides with a stamp
+    # field (date/backend/n_devices/...) must win over the ambient stamp
     return json.dumps({"config": config,
-                       **{k: _plain(v) for k, v in result.items()},
-                       **_provenance()})
+                       **_provenance(),
+                       **{k: _plain(v) for k, v in result.items()}})

@@ -111,6 +111,7 @@ WORK_MODELS = {
     # upper bound on real DRAM), not an achieved-bandwidth claim — the
     # trace pass, not this model, settles real bytes for those rows
     "mfsgd_carry": _mfsgd_work,
+    "mfsgd_chunked_rotate": _mfsgd_work,
     "lda": _lda_work,
     "lda_carry": _lda_work,
     "lda_exprace": _lda_work,
@@ -120,6 +121,7 @@ WORK_MODELS = {
     "lda_pallas_carry": _lda_work,
     "lda_pallas_hot": _lda_work,
     "lda_pallas_approx_hot": _lda_work,
+    "lda_rotate_int8": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
     "lda_scale_1m_pallas": _lda_work,

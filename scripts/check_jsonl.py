@@ -20,7 +20,12 @@ runnable standalone (``python scripts/check_jsonl.py [--repo DIR]``):
    must comply — "my row has no date, so I look legacy" is not a loophole.
 
 PROFILE_local.jsonl and FLIP_DECISIONS.jsonl rows are trace/decision rows,
-not bench evidence: they get the parse check only.
+not bench evidence: they get the parse check only — plus invariant 3:
+
+3. **CommLedger rows carry a coherent wire dtype** (any file): a
+   ``kind: "comm"`` row for a quantized verb must record ``wire_dtype``
+   in {bfloat16, int8}, and an exact rotate/regroup row must not claim
+   one — the report's bytes-on-wire claims scale by this field.
 """
 
 from __future__ import annotations
@@ -37,6 +42,30 @@ GRANDFATHERED = {"BENCH_local.jsonl": 73}
 
 PARSE_ONLY = ("PROFILE_local.jsonl", "FLIP_DECISIONS.jsonl")
 PROVENANCE_FIELDS = ("backend", "date", "commit")
+
+# CommLedger rows (telemetry exports, teed into committed JSONL by
+# HARP_TELEMETRY runs): the quantized movement/reduce verbs MUST name a
+# narrow wire, the exact rotate/regroup twins must NOT claim one — a
+# wrong wire_dtype silently mis-scales every bytes-on-wire claim the
+# report makes (the whole point of the quantized-rotate telemetry).
+QUANT_WIRES = ("bfloat16", "int8")
+QUANT_VERBS = ("rotate_quantized", "regroup_quantized",
+               "allreduce_quantized", "push_quantized")
+EXACT_MOVE_VERBS = ("rotate", "regroup")
+
+
+def _check_comm_row(name: str, i: int, row: dict) -> list[str]:
+    verb = row.get("verb")
+    wd = row.get("wire_dtype")
+    if verb in QUANT_VERBS and wd not in QUANT_WIRES:
+        return [f"{name}:{i}: comm row verb={verb!r} has "
+                f"wire_dtype={wd!r} — quantized verbs must record one of "
+                f"{QUANT_WIRES}"]
+    if verb in EXACT_MOVE_VERBS and wd:
+        return [f"{name}:{i}: comm row verb={verb!r} claims "
+                f"wire_dtype={wd!r} — the exact verbs have no narrow "
+                "wire; use the *_quantized twin (or drop the field)"]
+    return []
 
 
 def check_file(path: str, grandfathered: int = 0,
@@ -56,6 +85,8 @@ def check_file(path: str, grandfathered: int = 0,
         except ValueError as e:
             errors.append(f"{name}:{i}: unparseable JSON ({e})")
             continue
+        if isinstance(row, dict) and row.get("kind") == "comm":
+            errors += _check_comm_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

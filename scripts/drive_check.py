@@ -711,3 +711,147 @@ assert _r6T.ledger.summary() == {} and _r6T.tracer.records == []
 print(f"telemetry: exec-counted ledger, kmeans sheet {_r6sheet} B/iter, "
       "report round-trip, zero-cost off")
 print(f"DRIVE OK round-20 ({mode})")
+
+# 25. PR 2 (this session): overlap-first rotation through the public
+# surface.  (a) the chunked pipeline at n_chunks=4: the resident-chunk
+# index formula, coverage, and home-placement against a numpy model of
+# the queue schedule;
+from harp_tpu.parallel import resident_chunk_index, rotate_pipeline
+from jax.sharding import PartitionSpec as _P2
+
+_p2nc = 4
+_p2rows = 8  # per worker, divisible by 4
+_p2ids = np.repeat(np.arange(nw * _p2nc, dtype=np.float32),
+                   _p2rows // _p2nc)[:, None]
+
+
+def _p2prog(s):
+    def step(st, cur, t):
+        err, acc = st
+        want = resident_chunk_index(t, _p2nc).astype(jnp.float32)
+        return (err + jnp.abs(cur - want).sum(), acc + cur.sum()), cur
+
+    (err, acc), out = rotate_pipeline(
+        step, (jnp.float32(0.0), jnp.float32(0.0)), s, n_chunks=_p2nc)
+    return jnp.concatenate([err[None, None], acc[None, None], out], 0)
+
+
+_p2out = np.asarray(jax.jit(mesh.shard_map(
+    _p2prog, in_specs=(mesh.spec(0),), out_specs=mesh.spec(0)))(_p2ids))
+_p2out = _p2out.reshape(nw, _p2rows + 2)
+assert (_p2out[:, 0] == 0).all()          # schedule == index formula
+np.testing.assert_allclose(                # every worker saw every chunk
+    _p2out[:, 1], np.full(nw, _p2ids.sum()))
+np.testing.assert_array_equal(             # chunks land home
+    _p2out[:, 2:].reshape(-1), _p2ids.reshape(-1))
+print(f"chunked rotate_pipeline(n_chunks={_p2nc}): schedule, coverage, home")
+
+# (b) quantized data movement: one rounding against the worker-shared
+# scale (vs numpy roll / exact regroup), int leaves exact
+_p2x = np.random.default_rng(21).normal(size=(nw * 4, 16)).astype(np.float32)
+_p2rot = C.host_op(mesh, C.rotate_quantized, in_dim=0, out_dim=0,
+                   wire_dtype=jnp.int8)
+_p2got = np.asarray(_p2rot(_p2x)).reshape(nw, 4, 16)
+_p2exp = np.roll(_p2x.reshape(nw, 4, 16), 1, axis=0)
+assert np.abs(_p2got - _p2exp).max() <= np.abs(_p2x).max() / 254 + 1e-6
+_p2xi = np.arange(nw * nw, dtype=np.int32).reshape(nw * nw, 1)
+_p2rg = C.host_op(mesh, C.regroup_quantized, in_dim=0, out_dim=0,
+                  wire_dtype=jnp.int8)
+_p2rge = C.host_op(mesh, C.regroup, in_dim=0, out_dim=0)
+np.testing.assert_array_equal(np.asarray(_p2rg(_p2xi)),
+                              np.asarray(_p2rge(_p2xi)))
+print("rotate/regroup_quantized int8: single-rounding bound, int exact")
+
+# (c) MF-SGD at rotate_chunks=4 through the public driver vs the numpy
+# replica of the generalized schedule
+from harp_tpu.models import mfsgd as _P2M
+
+_p2rng = np.random.default_rng(23)
+_p2u = _p2rng.integers(0, 8 * nw, 400).astype(np.int32)
+_p2i = _p2rng.integers(0, 6 * nw, 400).astype(np.int32)
+_p2v = _p2rng.normal(size=400).astype(np.float32)
+_p2cfg = _P2M.MFSGDConfig(rank=4, chunk=16, lr=0.02, reg=0.01,
+                          algo="scatter", rotate_chunks=4)
+_p2m = _P2M.MFSGD(8 * nw, 6 * nw, _p2cfg, mesh, seed=3)
+_p2W0, _p2H0 = np.asarray(_p2m.W).copy(), np.asarray(_p2m.H).copy()
+_p2m.set_ratings(_p2u, _p2i, _p2v)
+_p2m.train_epoch()
+_p2bu, _p2bi, _p2bv, _p2bm, _p2ub, _p2ib = _P2M.partition_ratings(
+    _p2u, _p2i, _p2v, 8 * nw, 6 * nw, nw, 16, n_slices=4 * nw)
+_p2ns = 4 * nw
+_p2W, _p2H = _p2W0.copy(), _p2H0.copy()
+_p2bu2 = _p2bu.reshape(nw, _p2ns, -1)
+_p2bi2 = _p2bi.reshape(nw, _p2ns, -1)
+_p2bv2 = _p2bv.reshape(nw, _p2ns, -1)
+_p2bm2 = _p2bm.reshape(nw, _p2ns, -1)
+for _t in range(_p2ns):
+    for _w in range(nw):
+        _r = _t % 4
+        _s = 4 * ((_w - _t // 4 - (1 if _r == 3 else 0)) % nw) + _r
+        _Wv = _p2W[_w * _p2ub:(_w + 1) * _p2ub]
+        _Hv = _p2H[_s * _p2ib:(_s + 1) * _p2ib]
+        _B = _p2bu2.shape[-1]
+        for _lo in range(0, _B, 16):
+            _sl = slice(_lo, _lo + 16)
+            _uu, _ii, _vv, _mm = (_p2bu2[_w, _s, _sl], _p2bi2[_w, _s, _sl],
+                                  _p2bv2[_w, _s, _sl], _p2bm2[_w, _s, _sl])
+            _wu, _hi = _Wv[_uu], _Hv[_ii]
+            _err = _mm * (_vv - (_wu * _hi).sum(-1))
+            _gw = _err[:, None] * _hi - 0.01 * _mm[:, None] * _wu
+            _gh = _err[:, None] * _wu - 0.01 * _mm[:, None] * _hi
+            np.add.at(_Wv, _uu, 0.02 * _gw)
+            np.add.at(_Hv, _ii, 0.02 * _gh)
+np.testing.assert_allclose(np.asarray(_p2m.W), _p2W, rtol=2e-4, atol=2e-5)
+np.testing.assert_allclose(np.asarray(_p2m.H), _p2H, rtol=2e-4, atol=2e-5)
+print("mfsgd rotate_chunks=4 epoch == numpy generalized schedule")
+
+# (d) LDA at rotate_chunks=4: Gibbs count invariants survive the
+# generalized schedule; the CommLedger accounts the int8 rotate wire at
+# exactly 1/4 of the f32 baseline (the report's bytes-on-wire claim)
+from harp_tpu.models.lda import LDA as _P2L
+from harp_tpu.models.lda import LDAConfig as _P2LC
+from harp_tpu.models.lda import synthetic_corpus as _p2corpus
+from harp_tpu.utils import telemetry as _P2T
+
+_p2d, _p2w = _p2corpus(6 * nw, 64, 3, 16, seed=5)
+_p2lm = _P2L(6 * nw, 64, _P2LC(n_topics=6, algo="dense", d_tile=8,
+                               w_tile=8, entry_cap=32, rotate_chunks=4),
+             mesh, seed=0)
+_p2lm.set_tokens(_p2d, _p2w)
+for _ in range(2):
+    _p2lm.sample_epoch()
+assert _p2lm.doc_topic_table().sum() == len(_p2d)
+assert _p2lm.word_topic_table().sum() == len(_p2d)
+np.testing.assert_allclose(_p2lm.word_topic_table().sum(0),
+                           np.asarray(_p2lm.Nk))
+assert np.isfinite(_p2lm.log_likelihood())
+
+
+def _p2rot_bytes(wire):
+    with _P2T.scope(True):
+        _m = _P2M.MFSGD(64, 64, _P2M.MFSGDConfig(
+            rank=8, algo="scatter", chunk=64, rotate_wire=wire), mesh,
+            seed=0)
+        _m.set_ratings(*_P2M.synthetic_ratings(64, 64, 500, seed=0))
+        with _P2T.ledger.run("probe", steps=0):
+            _m._epoch_fn.lower(_m.W, _m.H, *_m._blocks)
+        return sum(s["payload_bytes"]
+                   for s in _P2T.ledger.summary()["probe"]["sites"]
+                   if s["verb"].startswith("rotate"))
+
+
+assert _p2rot_bytes("exact") == 4 * _p2rot_bytes("int8") > 0
+print("lda rotate_chunks=4 invariants; ledger: int8 rotate = 1/4 f32 bytes")
+
+# (e) the new flip candidates fail closed without rows and flip at
+# equal quality >= 1.10x
+for _p2name in ("mfsgd_chunked_rotate", "lda_rotate_int8"):
+    _p2spec = _r4fd.CANDIDATES[_p2name]
+    assert not _r4fd.decide(None, None, _p2spec)["flip"]
+_p2v = _r4fd.decide(
+    {"updates_per_sec_per_chip": 12e6, "rmse_final": 0.366},
+    {"updates_per_sec_per_chip": 10e6, "rmse_final": 0.366},
+    _r4fd.CANDIDATES["mfsgd_chunked_rotate"])
+assert _p2v["flip"]
+print("flip gate: chunked-rotate candidates fail closed / flip at 1.2x")
+print(f"DRIVE OK round-21 ({mode})")

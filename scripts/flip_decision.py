@@ -57,6 +57,14 @@ CANDIDATES = {
         "incumbent": "mfsgd", "metric": "updates_per_sec_per_chip",
         "quality": "rmse_final", "sense": "lower", "rel_tol": 0.02,
         "flips": "MFSGDConfig.carry_w=True"},
+    # PR 2: the chunked rotator at 4 chunks vs the incumbent 2-chunk
+    # schedule, both on the flipped pallas stack.  The visit ORDER
+    # changes (4n shorter steps instead of 2n), so rmse_final gates a
+    # genuinely different-but-equal chain, not a bit-identical one.
+    "mfsgd_chunked_rotate": {
+        "incumbent": "mfsgd_pallas", "metric": "updates_per_sec_per_chip",
+        "quality": "rmse_final", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MFSGDConfig.rotate_chunks=4"},
     "lda_exprace": {
         "incumbent": "lda", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
@@ -97,6 +105,15 @@ CANDIDATES = {
         "incumbent": "lda_pallas", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
         "flips": "LDAConfig.carry_db=True (pallas stack)"},
+    # PR 2: int8 rotate wire vs the exact wire on the SAME default stack
+    # (pallas+carry).  The narrow wire perturbs the word-topic counts a
+    # chunk carries (≤ global_max/254 per element per hop), so the LL
+    # gate is load-bearing here, not a formality — a degraded chain must
+    # refuse the flip no matter the wire-byte saving.
+    "lda_rotate_int8": {
+        "incumbent": "lda_pallas_carry", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.rotate_wire='int8'"},
     "kmeans_int8_fused": {
         "incumbent": "kmeans_int8", "metric": "iters_per_sec",
         "quality": "inertia", "sense": "lower", "rel_tol": 0.01,

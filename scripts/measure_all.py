@@ -46,8 +46,9 @@ def _bench_ingest(smoke: bool, quantize=None):
     # bench.py's kmeans_ingest config measuring the same shapes; the
     # synthetic compute twin is the sweep-only extra.  quantize="int8"
     # is the int8-WIRE twin (half the tunnel bytes on the H2D-bound
-    # path — measured 1.40× on the relay 2026-08-01; lossy, so it stays
-    # a recommendation for wire-bound links, never a silent default)
+    # path — measured 1.55× on the relay 2026-08-01 (102,711 vs 66,373
+    # points/s, BENCH_local); lossy, so it stays a recommendation for
+    # wire-bound links, never a silent default)
     import bench_ingest
 
     return (bench_ingest.run_smoke(quantize=quantize) if smoke
@@ -67,10 +68,11 @@ FIRST_REMEASURE = "kmeans"
 SPRINT_ORDER = [
     # unmeasured candidates (BASELINE.md candidates table)
     "kmeans_int8_fused", "kmeans_stream_int8",
-    "mfsgd_pallas", "mfsgd_carry",
+    "mfsgd_pallas", "mfsgd_carry", "mfsgd_chunked_rotate",
     "lda_pallas", "lda_pallas_approx",
     "lda_pallas_hot", "lda_pallas_approx_hot",
     "lda_pallas_carry", "lda_carry", "lda_exprace", "lda_fast",
+    "lda_rotate_int8",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -144,6 +146,14 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
             algo="pallas",
             # smoke tiles must pass the kernel's TPU gate (128-multiples)
             **(SMOKE["mfsgd_pallas"] if smoke else {})),
+        # PR 2: the chunked double-buffered rotator at 4 chunks/worker on
+        # the flipped pallas stack — finer overlap granularity (quarter
+        # slices in flight) than the incumbent 2-chunk schedule; may flip
+        # MFSGDConfig.rotate_chunks=4 via flip_decision (quality gate:
+        # rmse_final — the visit order changes, the math does not)
+        "mfsgd_chunked_rotate": lambda: mfsgd.benchmark(
+            algo="pallas", rotate_chunks=4,
+            **(SMOKE["mfsgd_pallas"] if smoke else {})),
         "lda": lambda: lda.benchmark(
             **(SMOKE["lda"] if smoke else
                {"pack_cache": BENCH_DATA})),
@@ -205,6 +215,16 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         # amortization from the carry)
         "lda_pallas_carry": lambda: lda.benchmark(
             algo="pallas", carry_db=True,
+            **(SMOKE["lda_pallas"] if smoke else
+               {"pack_cache": BENCH_DATA})),
+        # PR 2: int8 rotate wire on the flipped default stack — quarter
+        # the ring bytes per word-slice hop (collective.rotate_quantized;
+        # one rounding per hop, but counts dequantize lossily so the
+        # chain samples against perturbed word-topic counts — the LL
+        # flip gate decides whether quality holds).  Shares the 2-chunk
+        # pack cache with lda_pallas_carry (wire is not layout)
+        "lda_rotate_int8": lambda: lda.benchmark(
+            algo="pallas", carry_db=True, rotate_wire="int8",
             **(SMOKE["lda_pallas"] if smoke else
                {"pack_cache": BENCH_DATA})),
         "lda_scatter": lambda: lda.benchmark(

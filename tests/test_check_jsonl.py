@@ -60,6 +60,72 @@ def test_non_bench_rows_need_only_parse(tmp_path):
     assert check_jsonl.check_file(str(p), provenance=True) == []
 
 
+def test_comm_row_quantized_verb_must_name_wire(tmp_path):
+    """PR-2 gate: a CommLedger row for a quantized verb without a valid
+    wire_dtype mis-scales every bytes-on-wire claim downstream."""
+    rows = [
+        {"kind": "comm", "verb": "rotate_quantized", "wire_dtype": "int8",
+         "payload_bytes": 64},                               # fine
+        {"kind": "comm", "verb": "rotate_quantized",
+         "payload_bytes": 64},                               # missing wire
+        {"kind": "comm", "verb": "regroup_quantized",
+         "wire_dtype": "float16", "payload_bytes": 64},      # bogus wire
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 2
+    assert ":2:" in errors[0] and "wire_dtype" in errors[0]
+    assert ":3:" in errors[1] and "float16" in errors[1]
+
+
+def test_comm_row_exact_move_verb_must_not_claim_wire(tmp_path):
+    rows = [
+        {"kind": "comm", "verb": "rotate", "payload_bytes": 64},  # fine
+        {"kind": "comm", "verb": "rotate", "wire_dtype": "int8",
+         "payload_bytes": 64},                                    # bogus
+        # allreduce legitimately records no wire (exact by default)
+        {"kind": "comm", "verb": "allreduce", "payload_bytes": 64},
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 1 and ":2:" in errors[0]
+    assert "_quantized twin" in errors[0]
+
+
+def test_comm_rows_checked_even_in_bench_files(tmp_path):
+    """A telemetry export teed into BENCH_local still gets invariant 3."""
+    row = {"kind": "comm", "verb": "regroup_quantized",
+           "payload_bytes": 64}
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(json.dumps(row) + "\n")
+    errors = check_jsonl.check_file(str(p), provenance=True)
+    assert len(errors) == 1 and "wire_dtype" in errors[0]
+
+
+def test_exported_ledger_rows_satisfy_the_checker(tmp_path):
+    """Round-trip: what telemetry.export writes for the quantized and
+    exact movement verbs must pass invariant 3 as-is."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from harp_tpu.utils import telemetry
+
+    with telemetry.scope(True):
+        telemetry.ledger.record("rotate", np.zeros((4, 2), np.float32),
+                                axis="workers")
+        telemetry.ledger.record("rotate_quantized",
+                                np.zeros((4, 2), np.float32),
+                                axis="workers", wire_dtype=jnp.int8)
+        telemetry.ledger.record("regroup_quantized",
+                                np.zeros((4, 2), np.float32),
+                                axis="workers", wire_dtype=jnp.bfloat16)
+        p = tmp_path / "telemetry.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p)) == []
+
+
 def test_cli_exit_codes(tmp_path):
     (tmp_path / "BENCH_local.jsonl").write_text("not json\n")
     assert check_jsonl.main(["--repo", str(tmp_path)]) == 1

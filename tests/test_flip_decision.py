@@ -374,17 +374,19 @@ def test_applied_flips_match_committed_verdicts():
     assert verdicts["kmeans_int8_fused"]
 
     from harp_tpu.models.kmeans import KMeansConfig, _use_pallas
-    from harp_tpu.models.lda import LDAConfig
+    from harp_tpu.models.lda import LDAConfig, carry_db_resolved
     from harp_tpu.models.mfsgd import MFSGDConfig
 
     assert MFSGDConfig().algo == "pallas"
     lcfg = LDAConfig()
     assert (lcfg.algo, lcfg.sampler, lcfg.rng_impl) == (
         "pallas", "exprace", "rbg")
-    assert lcfg.carry_db is True
+    # carry_db resolves at READ time (ADVICE r5): None stays stored, the
+    # resolver applies the verdict — ON for the pallas stack
+    assert carry_db_resolved(lcfg) is True
     assert _use_pallas(KMeansConfig(quantize="int8"))
     # and the VETOED arms stayed un-applied
     assert not verdicts["lda_carry"] and not verdicts["mfsgd_carry"]
-    assert LDAConfig(algo="dense").carry_db is False
+    assert carry_db_resolved(LDAConfig(algo="dense")) is False
     assert MFSGDConfig().carry_w is False
     assert not _use_pallas(KMeansConfig())  # f32 arm: XLA stays
