@@ -386,14 +386,22 @@ def main():
         print(json.dumps(record(error=probe_err)), flush=True)
         raise SystemExit(3)
 
+    # flight recorder (HARP_TELEMETRY=1): each config gets a span plus a
+    # per-config delta of the execution counters in its submetric — a
+    # silent recompile or an extra readback inside a measured config is
+    # visible in the driver record, not re-derived from wall-clock
+    from harp_tpu.utils import flightrec, telemetry
+
     watchdog = HangWatchdog(on_fire=emit_hang_record)  # HARP_BENCH_TIMEOUT
     watchdog.arm("backend init")  # first backend use is inside _configs
     for name, unit, key, thunk in _configs(smoke):
         if only and name not in only:
             continue
         watchdog.arm(f"bench.py {name}")
+        flight_base = flightrec.snapshot() if telemetry.enabled() else None
         try:
-            res = thunk()
+            with telemetry.span(f"bench.{name}"):
+                res = thunk()
         except Exception as e:  # keep measuring the rest
             sub[name] = {"value": 0.0, "unit": unit,
                          "error": f"{type(e).__name__}: {e}"}
@@ -411,6 +419,8 @@ def main():
         sub[name] = {"value": round(value, 2), "unit": unit,
                      "vs_baseline": (None if smoke or base is None else
                                      round(value / base, 4)), **roof}
+        if flight_base is not None:
+            sub[name]["flight"] = flightrec.delta_since(flight_base)
     watchdog.cancel()
     done.set()
     print(json.dumps(record()), flush=True)

@@ -21,7 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import telemetry
+from harp_tpu.utils import flightrec, telemetry
 from harp_tpu.utils.timing import device_sync
 
 VERBS = {
@@ -61,7 +61,12 @@ def bench_verb(name, mesh: WorkerMesh, size_bytes: int, reps: int = 20):
     mult = nw * nw if name.startswith(("regroup", "push")) else nw
     n_rows = max(mult, size_bytes // (4 * 128) // mult * mult)
     x = np.random.default_rng(0).normal(size=(n_rows, 128)).astype(np.float32)
-    op = C.host_op(mesh, fn, in_dim=0, out_dim=out_dim, **kwargs)
+    # flightrec.track: each invocation is one dispatch round trip in the
+    # flight record (reps+1 with the warmup), so the report can show
+    # dispatch overhead next to the achieved GB/s
+    op = flightrec.track(
+        C.host_op(mesh, fn, in_dim=0, out_dim=out_dim, **kwargs),
+        f"bench.{name}")
     # telemetry: the warmup call traces the verb's comm site; the timed
     # loop re-invokes the cached executable reps times — the ledger's
     # execution counter is what turns one traced byte sheet into volume

@@ -24,6 +24,7 @@ from typing import Any
 
 from harp_tpu.parallel import collective
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh, init_distributed
+from harp_tpu.utils import flightrec, telemetry
 from harp_tpu.utils.metrics import MetricsLogger
 from harp_tpu.utils.telemetry import span
 
@@ -90,8 +91,14 @@ class CollectiveApp:
 
     def __init__(self, config: Any = None, mesh: WorkerMesh | None = None,
                  metrics_path: str | None = None,
-                 input_paths: list[str] | None = None, loader=None):
+                 input_paths: list[str] | None = None, loader=None,
+                 budget: dict | None = None):
         self.config = config
+        # execution-discipline budget for the whole map_collective block
+        # (flightrec.budget kwargs, e.g. {"compiles": 3, "readbacks": 1});
+        # enforced warn-mode in run() when telemetry is enabled, so an app
+        # can declare its dispatch discipline without dying mid-run
+        self.budget = budget
         init_distributed()  # no-op on single host (Harp's bootstrap)
         self.mesh = mesh or current_mesh()
         self.metrics = MetricsLogger(metrics_path)
@@ -133,10 +140,18 @@ class CollectiveApp:
             # the file closes on ANY exit path, including mid-iteration
             # exceptions inside map_collective
             with self.metrics, span("map_collective",
-                                    app=type(self).__name__):
+                                    app=type(self).__name__), \
+                    flightrec.budget(**(self.budget or {}), action="warn",
+                                     tag=type(self).__name__):
                 result = self.map_collective()
         finally:
             self.metrics.close()
+        if telemetry.enabled():
+            fs = flightrec.snapshot()
+            log.info("flight record: %d compile(s) %.3fs, H2D %d B, "
+                     "%d dispatch(es), %d readback(s)",
+                     fs["compiles"], fs["compile_s"], fs["h2d_bytes"],
+                     fs["dispatches"], fs["readbacks"])
         log.info("harp-tpu app finished in %.2fs", time.perf_counter() - t0)
         return result
 

@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import prng
 from harp_tpu.utils.timing import device_sync
 
 
@@ -137,7 +138,9 @@ class CCD:
         self.n_users, self.n_items = n_users, n_items
         n = self.mesh.num_workers
         self.u_bound = -(-n_users // n)
-        k1, k2 = jax.random.split(jax.random.key(seed))
+        # raw key bits (utils.prng): a fresh seed must not cost a fresh
+        # (remote) compile -- CLAUDE.md PRNGKey-specialization trap
+        k1, k2 = jax.random.split(jnp.asarray(prng.key_bits(seed)))
         s = 1.0 / np.sqrt(self.cfg.rank)
         self.W = self.mesh.shard_array(np.asarray(
             jax.random.uniform(k1, (self.u_bound * n, self.cfg.rank),

@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import telemetry
+from harp_tpu.utils import flightrec, prng, telemetry
 from harp_tpu.utils.timing import device_sync
 
 
@@ -435,14 +435,16 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         pts = mesh.shard_array(
             np.asarray(points, dtype=np.dtype(jnp.dtype(dtype).name)), 0)
     centroids = jax.device_put(centroids, mesh.replicated())
-    fit_fn = make_fit_fn(mesh, cfg)
+    fit_fn = flightrec.track(make_fit_fn(mesh, cfg), "kmeans.fit")
     # telemetry: the T iterations run inside ONE dispatch, so the traced
-    # per-iteration comm sites execute cfg.iters times per invocation
+    # per-iteration comm sites execute cfg.iters times per invocation;
+    # the flight recorder sees that one dispatch plus exactly two
+    # readbacks (inertia scalar + final centroids)
     with telemetry.span("kmeans.fit", iters=cfg.iters, k=k), \
             telemetry.ledger.run("kmeans.fit", steps=cfg.iters):
         new_c, inertia = fit_fn(pts, centroids)
-        inertia = float(inertia)
-    return np.asarray(new_c), inertia
+        inertia = float(flightrec.readback(inertia))
+        return flightrec.readback(new_c), inertia
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
@@ -460,7 +462,9 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
     def gen(key):
         return jax.random.normal(key, (n // nw, d), dtype=dtype)
 
-    keys = jax.random.split(jax.random.key(seed), nw)
+    # raw key bits (utils.prng): a fresh seed must not cost a fresh
+    # (remote) compile — CLAUDE.md PRNGKey-specialization trap
+    keys = jax.random.split(jnp.asarray(prng.key_bits(seed)), nw)
     points = jax.jit(
         mesh.shard_map(lambda ks: gen(ks[0]), in_specs=(mesh.spec(0),),
                        out_specs=mesh.spec(0))
@@ -479,7 +483,8 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
             quant, in_specs=(mesh.spec(0),),
             out_specs=(mesh.spec(0), P())))(points)
     centroids = jax.device_put(
-        jax.random.normal(jax.random.key(seed + 1), (k, d), dtype=dtype),
+        jax.random.normal(jnp.asarray(prng.key_bits(seed + 1)), (k, d),
+                          dtype=dtype),
         mesh.replicated(),
     )
 
@@ -502,11 +507,11 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
         return lax.fori_loop(0, n_iters, body, (centroids, jnp.float32(0.0)))
 
     pts_spec = ((mesh.spec(0), P()) if quantize == "int8" else mesh.spec(0))
-    run_fn = jax.jit(
+    run_fn = flightrec.track(jax.jit(
         mesh.shard_map(
             run, in_specs=(pts_spec, P(), P()), out_specs=(P(), P()),
         )
-    )
+    ), "kmeans.benchmark")
     # telemetry: n_iters is a traced scalar, so the loop body's comm sites
     # trace once — the host knows the real per-invocation trip count
     with telemetry.ledger.run("kmeans.benchmark", steps=max(warmup, 1)):

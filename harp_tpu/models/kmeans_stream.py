@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import prng
 from harp_tpu.utils.timing import device_sync
 
 from harp_tpu.models.kmeans import (  # shared MXU partials formulation
@@ -826,10 +827,11 @@ def benchmark_streaming(n=100_000_000, d=300, k=1000, iters=3,
     run_fn = make_synthetic_run_fn(mesh, cfg, d, n_chunks)
 
     keys = jax.device_put(
-        jax.random.split(jax.random.key(seed), nw),
+        jax.random.split(jnp.asarray(prng.key_bits(seed)), nw),
         mesh.sharding(mesh.spec(0)))
     centroids = jax.device_put(
-        jax.random.normal(jax.random.key(seed + 1), (k, d), dtype=dtype),
+        jax.random.normal(jnp.asarray(prng.key_bits(seed + 1)), (k, d),
+                          dtype=dtype),
         mesh.replicated())
     _, w_in = run_fn(keys, centroids, jnp.int32(max(warmup, 1)))
     device_sync(w_in)

@@ -58,6 +58,7 @@ from harp_tpu.models.mfsgd import (
     partition_ratings_tiles,
     rotate_chunks_resolved,
 )
+from harp_tpu.utils import flightrec, prng
 from harp_tpu.utils.timing import device_sync
 
 
@@ -878,7 +879,8 @@ class LDA:
         # per corpus in _install_pack (pallas only); (None, None) = the
         # kernel falls back to dtype-based gather plane counts
         self._count_bounds = (None, None)
-        self._epoch_fn = make_epoch_fn(self.mesh, self.cfg, vocab_size)
+        self._epoch_fn = flightrec.track(
+            make_epoch_fn(self.mesh, self.cfg, vocab_size), "lda.epoch")
         self._multi_fns: dict = {}
         self._seed = seed
         self._tokens = None
@@ -902,8 +904,9 @@ class LDA:
                                dedup=self.cfg.dedup_pulls)
         if apply:
             self.cfg.pull_cap = cap
-            self._epoch_fn = make_epoch_fn(self.mesh, self.cfg,
-                                           self.vocab_size)
+            self._epoch_fn = flightrec.track(
+                make_epoch_fn(self.mesh, self.cfg, self.vocab_size),
+                "lda.epoch")
             self._multi_fns.clear()
         return cap
 
@@ -1000,9 +1003,9 @@ class LDA:
             self._count_bounds = (
                 int(np.asarray(pack["Ndk"]).sum(1, dtype=np.int64).max()),
                 int(np.asarray(pack["Nwk"]).sum(1, dtype=np.int64).max()))
-            self._epoch_fn = make_epoch_fn(self.mesh, self.cfg,
-                                           self.vocab_size,
-                                           self._count_bounds)
+            self._epoch_fn = flightrec.track(
+                make_epoch_fn(self.mesh, self.cfg, self.vocab_size,
+                              self._count_bounds), "lda.epoch")
         self.Ndk, self.Nwk = sh(pack["Ndk"], 0), sh(pack["Nwk"], 0)
         self.Nk = jax.device_put(jnp.asarray(pack["Nk"]),
                                  self.mesh.replicated())
@@ -1010,9 +1013,9 @@ class LDA:
         self._tokens = tuple(sh(a, 0) for a in pack["tokens"])
         self._multi_fns.clear()  # compiled programs bind to token shapes
         self.n_tokens = int(pack["n_tokens"])
-        self._keys = np.asarray(
-            jax.random.split(jax.random.PRNGKey(self._seed), n)
-        )
+        # raw key bits (utils.prng): bit-identical to split(PRNGKey(seed))
+        # without the per-seed PRNGKey compile (CLAUDE.md relay trap)
+        self._keys = prng.split_keys(self._seed, n)
 
     def _global_token_ids(self, tokens):
         """Grid-local → global STORAGE (doc, word) row ids + valid mask.
@@ -1084,9 +1087,10 @@ class LDA:
             # steps=0: lowering traces the sweep's comm sites under the
             # execution tag without counting an execution
             with telemetry.ledger.run("lda.epochs", steps=0):
-                fn = self._multi_fns[epochs] = jitted.lower(
-                    self.Ndk, self.Nwk, self.Nk, self.z_grid,
-                    *self._tokens, keys).compile()
+                fn = self._multi_fns[epochs] = flightrec.track(
+                    jitted.lower(
+                        self.Ndk, self.Nwk, self.Nk, self.z_grid,
+                        *self._tokens, keys).compile(), "lda.epochs")
         return fn
 
     def _install_epoch_out(self, out):
@@ -1095,7 +1099,7 @@ class LDA:
             # surface the pull_cap drop count (the "counted, never
             # silently wrong" half of the capacity contract); reading it
             # back doubles as the device sync
-            self.last_dropped = int(np.asarray(out[4]))
+            self.last_dropped = int(flightrec.readback(out[4]))
         else:
             device_sync(self.Nk)
 
@@ -1131,12 +1135,13 @@ class LDA:
             self._install_epoch_out(out)
 
     def _advance_keys(self):
-        # PRNGKey(python_int) specializes on the int — a remote compile per
-        # distinct seed (CLAUDE.md) — so derive the next base seed on host
-        self._keys = np.asarray(
-            jax.random.split(jax.random.PRNGKey(int(self._keys[0][0]) ^ 0x9E37),
-                             self.mesh.num_workers)
-        )
+        # prng.split_keys builds the base key's bits on host — a fresh
+        # derived seed per epoch never costs a (remote) compile, unlike
+        # split(PRNGKey(int)) which specialized per distinct int
+        # (CLAUDE.md relay trap; the bits are identical, so checkpointed
+        # chains resume unchanged)
+        self._keys = prng.split_keys(int(self._keys[0][0]) ^ 0x9E37,
+                                     self.mesh.num_workers)
 
     def fit(self, epochs: int, ckpt_dir: str | None = None, *,
             ckpt_every: int = 5, max_restarts: int = 3, fault=None):

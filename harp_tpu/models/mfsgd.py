@@ -52,7 +52,7 @@ from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.parallel.rotate import (ROTATE_WIRES, resident_chunk_index,
                                       rotate_pipeline)
-from harp_tpu.utils.timing import device_sync
+from harp_tpu.utils import flightrec, prng
 
 
 @dataclasses.dataclass
@@ -608,7 +608,9 @@ class MFSGD:
             self.u_bound = self.u_own = _ceil_div(n_users, n)
             self.i_bound = nc * _ceil_div(n_items, self._n_slices)
             self.i_own = self.i_bound // nc
-        k1, k2 = jax.random.split(jax.random.key(seed))
+        # raw key bits (utils.prng): a fresh seed must not cost a fresh
+        # (remote) compile — CLAUDE.md PRNGKey-specialization trap
+        k1, k2 = jax.random.split(jnp.asarray(prng.key_bits(seed)))
         scale = 1.0 / np.sqrt(self.cfg.rank)
         self.W = self.mesh.shard_array(
             np.asarray(jax.random.uniform(k1, (self.u_bound * n, self.cfg.rank),
@@ -616,7 +618,8 @@ class MFSGD:
         self.H = self.mesh.shard_array(
             np.asarray(jax.random.uniform(k2, (self.i_bound * n, self.cfg.rank),
                                           jnp.float32, 0, scale)), 0)
-        self._epoch_fn = make_epoch_fn(self.mesh, self.cfg)
+        self._epoch_fn = flightrec.track(make_epoch_fn(self.mesh, self.cfg),
+                                         "mfsgd.epoch")
         self._multi_fns: dict[int, Any] = {}
         self._blocks = None
 
@@ -657,8 +660,10 @@ class MFSGD:
                 telemetry.ledger.run("mfsgd.epochs", steps=1):
             self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H,
                                                      *self._blocks)
-            return float(np.sqrt(max(device_sync(se), 0.0)
-                                 / max(device_sync(cnt), 1.0)))
+            # one stacked readback, not one per scalar (readbacks budget)
+            stats = flightrec.readback(jnp.stack([se, cnt]))
+            return float(np.sqrt(max(float(stats[0]), 0.0)
+                                 / max(float(stats[1]), 1.0)))
 
     def compile_epochs(self, epochs: int):
         """AOT-compile the ``epochs``-epoch program WITHOUT running it.
@@ -678,8 +683,9 @@ class MFSGD:
             # steps=0: lowering traces the comm sites (attributed to the
             # same tag the executions count under) without executing them
             with telemetry.ledger.run("mfsgd.epochs", steps=0):
-                fn = self._multi_fns[epochs] = jitted.lower(
-                    self.W, self.H, *self._blocks).compile()
+                fn = self._multi_fns[epochs] = flightrec.track(
+                    jitted.lower(self.W, self.H, *self._blocks).compile(),
+                    "mfsgd.epochs")
         return fn
 
     def train_epochs(self, epochs: int):
@@ -696,7 +702,11 @@ class MFSGD:
         with telemetry.span("mfsgd.epochs", epochs=epochs), \
                 telemetry.ledger.run("mfsgd.epochs", steps=epochs):
             self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
-            ses, cnts = np.asarray(ses), np.asarray(cnts)
+            # ONE stacked readback for all epochs' stats (the ccd.py
+            # idiom) — the flight-recorder budget for this loop pins
+            # readbacks=1 per run, not one per stat array
+            stats = flightrec.readback(jnp.stack([ses, cnts]))
+            ses, cnts = stats[0], stats[1]
         return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
                 for s, c in zip(ses, cnts)]
 

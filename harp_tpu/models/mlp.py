@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import prng
 from harp_tpu.utils.timing import device_sync
 
 
@@ -417,7 +418,7 @@ class MLPTrainer:
         # epoch count) keep reshuffling instead of repeating one order.
         s = seed + 1 + self._shuffle_counter
         self._shuffle_counter += epochs
-        key = np.array([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32)
+        key = prng.key_bits(s)
         self.params, self.opt_state, losses, accs = fn(
             self.params, self.opt_state, xs, ys, key)
         stats = np.asarray(jnp.stack([losses, accs], axis=1))  # one readback

@@ -261,8 +261,10 @@ class RandomForest:
         if self._train_fn is None:
             self._train_fn = make_train_fn(self.mesh, cfg, x.shape[1])
         train = self._train_fn
+        from harp_tpu.utils import prng
+
         keys = np.asarray(
-            jax.random.split(jax.random.PRNGKey(cfg.seed),
+            jax.random.split(jnp.asarray(prng.key_bits(cfg.seed)),
                              nw * self.trees_per_worker)
         ).reshape(nw, self.trees_per_worker, 2)
         self.forest = jax.tree.map(np.asarray, train(

@@ -14,6 +14,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.utils import flightrec, telemetry
 
 
 def bucket_by_destination(dest, payloads, capacity: int, n_dest: int,
@@ -38,6 +41,14 @@ def bucket_by_destination(dest, payloads, capacity: int, n_dest: int,
       dropped_local — scalar count of THIS shard's dropped VALID items.
     """
     n = dest.shape[0]
+    # flight recorder (trace time, static shapes only): the staged
+    # exchange buffers are what the fabric moves — capacity slots ride
+    # the wire whether or not they carry items, so the report can show
+    # how much of the dispatch payload is padding
+    if telemetry.enabled():
+        flightrec.record_bucket(sum(
+            n_dest * capacity * int(np.prod(p.shape[1:], dtype=np.int64))
+            * jnp.dtype(p.dtype).itemsize for p in payloads))
     onehot = jax.nn.one_hot(dest, n_dest, dtype=jnp.int32)     # [n, n_dest]
     if valid is None:
         valid = jnp.ones(n, bool)

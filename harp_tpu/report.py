@@ -66,9 +66,57 @@ def span_summary_from_rows(rows: list[dict]) -> dict:
             for k, v in agg.items()}
 
 
+def compile_summary_from_rows(rows: list[dict]) -> dict:
+    """Rebuild :meth:`CompileWatch.summary`'s shape from exported compile
+    rows (each row is one backend compile with ``dur`` + ``span``)."""
+    if not rows:
+        return {}
+    by_span: dict[str, dict] = {}
+    total = 0.0
+    for r in rows:
+        d = float(r.get("dur") or 0.0)
+        total += d
+        s = by_span.setdefault(r.get("span") or "(no span)",
+                               {"count": 0, "total_s": 0.0})
+        s["count"] += 1
+        s["total_s"] = round(s["total_s"] + d, 6)
+    return {"count": len(rows), "total_s": round(total, 6),
+            "by_span": by_span}
+
+
+def transfer_summary_from_rows(rows: list[dict]) -> dict:
+    """Rebuild :meth:`TransferLedger.summary`'s shape from exported
+    transfer rows (one row per (op, site, span))."""
+    if not rows:
+        return {}
+    out: dict[str, Any] = {"h2d_bytes": 0, "h2d_calls": 0, "d2h_bytes": 0,
+                           "readbacks": 0, "dispatches": 0,
+                           "bucket_bytes": 0, "sites": []}
+    for r in rows:
+        op, b = r.get("op"), int(r.get("bytes") or 0)
+        calls = int(r.get("calls") or 0)
+        if op == "h2d":
+            out["h2d_bytes"] += b
+            out["h2d_calls"] += calls
+        elif op == "readback":
+            out["d2h_bytes"] += b
+            out["readbacks"] += calls
+        elif op == "dispatch":
+            out["dispatches"] += calls
+        elif op == "bucket":
+            out["bucket_bytes"] += b
+        out["sites"].append({k: r.get(k) for k in ("op", "site", "span",
+                                                   "bytes", "calls")})
+    out["sites"].sort(key=lambda s: (-(s["bytes"] or 0), s["op"] or "",
+                                     s["site"] or ""))
+    return out
+
+
 def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               metrics_rows: list[dict] | None = None,
-              top_ops: list | None = None) -> dict:
+              top_ops: list | None = None,
+              compile_info: dict | None = None,
+              transfer_info: dict | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -76,6 +124,14 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
         "comm_tags": comm,
         "spans": spans,
     }
+    # flight-recorder sections (PR 3) only when the run recorded any —
+    # pre-flight-recorder exports keep their exact old report shape
+    if compile_info and compile_info.get("count"):
+        row["compile"] = compile_info
+    if transfer_info and (transfer_info.get("sites")
+                          or any(v for k, v in transfer_info.items()
+                                 if k != "sites")):
+        row["transfer"] = transfer_info
     for t in comm.values():
         execs = max(1, t["executions"])
         for s in t["sites"]:
@@ -127,6 +183,32 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
                                   key=lambda kv: -kv[1]["total_s"]):
                 lines.append(f"  {name:<26s} total {s['total_s']:.4f} s  "
                              f"n={s['n']}  mean {s['mean_s']:.4f} s")
+    comp = row.get("compile")
+    if comp:
+        lines.append(f"compiles (XLA backend): {comp['count']} in "
+                     f"{comp['total_s']:.3f} s")
+        for name, s in sorted(comp.get("by_span", {}).items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            lines.append(f"  {name:<26s} {s['count']} compile(s)  "
+                         f"total {s['total_s']:.3f} s")
+    tr = row.get("transfer")
+    if tr:
+        lines.append(
+            f"transfers (host<->device): "
+            f"H2D {_fmt_bytes(tr.get('h2d_bytes', 0))} in "
+            f"{tr.get('h2d_calls', 0)} call(s); "
+            f"D2H {_fmt_bytes(tr.get('d2h_bytes', 0))} over "
+            f"{tr.get('readbacks', 0)} readback(s); "
+            f"{tr.get('dispatches', 0)} dispatch(es)")
+        if tr.get("bucket_bytes"):
+            lines.append(f"  staged exchange buffers (capacity slots): "
+                         f"{_fmt_bytes(tr['bucket_bytes'])}/trace")
+        for s in tr.get("sites", []):
+            span_note = f"  span={s['span']}" if s.get("span") else ""
+            lines.append(
+                f"  {s['op']:<9s} {s['site'] or '?':<24s} "
+                f"{_fmt_bytes(s['bytes'] or 0)} × {s['calls']} call(s)"
+                f"{span_note}")
     if "metrics_rows" in row:
         lines.append(f"metrics: {row['metrics_rows']} row(s)")
         if row.get("metrics_last"):
@@ -140,9 +222,13 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
+    from harp_tpu.utils import flightrec
+
     comm = telemetry.ledger.summary()
     spans = telemetry.tracer.summary()
-    return (build_row(comm, spans, telemetry.tracer.records),
+    return (build_row(comm, spans, telemetry.tracer.records,
+                      compile_info=flightrec.compile_watch.summary(),
+                      transfer_info=flightrec.transfers.summary()),
             telemetry.tracer.records)
 
 
@@ -192,8 +278,12 @@ def main(argv=None) -> int:
 
     span_rows: list[dict] = []
     comm_rows: list[dict] = []
+    compile_rows: list[dict] = []
+    transfer_rows: list[dict] = []
     if args.telemetry:
-        span_rows, comm_rows = telemetry.load_jsonl(args.telemetry)
+        kinds = telemetry.load_rows(args.telemetry)
+        span_rows, comm_rows = kinds["span"], kinds["comm"]
+        compile_rows, transfer_rows = kinds["compile"], kinds["transfer"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -210,7 +300,9 @@ def main(argv=None) -> int:
 
     row = build_row(comm_summary_from_rows(comm_rows),
                     span_summary_from_rows(span_rows),
-                    span_rows, metrics_rows, top_ops)
+                    span_rows, metrics_rows, top_ops,
+                    compile_info=compile_summary_from_rows(compile_rows),
+                    transfer_info=transfer_summary_from_rows(transfer_rows))
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

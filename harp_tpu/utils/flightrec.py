@@ -1,0 +1,401 @@
+"""Execution flight recorder — compile / transfer / dispatch telemetry
+with enforceable budget guards.
+
+Reference parity (SURVEY.md §6): Harp has no execution-side accounting at
+all — its observability stops at per-iteration wall-clock logs, and even
+harp-tpu's CommLedger (PR 1) only accounts for *collective* bytes.  Yet
+the measured walls on this project are execution-side (CLAUDE.md "Relay
+performance traps"): ~140 ms per silent recompile, a 30-40 MB/s H2D
+ingest tunnel, 20-150 ms per dispatch/readback round trip.  This module
+is the third telemetry spine beside CommLedger/SpanTracer, turning each
+of those traps into a machine-checked invariant that runs on the CPU
+backend with zero hardware:
+
+**CompileWatch** — subscribes to ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event (fired for every
+XLA backend compile, local or relay-remote; graceful no-op when a jax
+version lacks the hook — see ``COMPILE_EVENTS_AVAILABLE``) and records
+count, duration, and the active :class:`~harp_tpu.utils.telemetry.
+SpanTracer` span — so a recompile inside a timed region is *detected*,
+not re-derived by hand from wall-clock anomalies.
+
+**TransferLedger** — counts H2D/D2H bytes and dispatch round trips per
+call site and active span.  The project's transfer entry points feed it:
+``WorkerMesh.shard_array``/``shard_array_local`` (H2D), :func:`readback`
+and ``timing.device_sync`` (blocking D2H round trips), :func:`track`-
+wrapped jitted callables (dispatches), and
+``dispatch.bucket_by_destination`` (trace-time exchange-buffer bytes).
+
+**budget()** — ``with flightrec.budget(compiles=1, readbacks=1): ...``
+snapshots the counters and, on exit, raises :class:`BudgetExceeded`
+(tests) or warns (bench, ``action="warn"``) when a delta exceeds its
+bound.  The CLAUDE.md traps map directly: ``compiles=N`` catches
+PRNGKey-specialization recompiles, ``readbacks=1`` catches per-epoch
+readback loops, ``h2d_bytes=B`` catches re-uploading a resident table.
+
+Everything shares the CommLedger's enable switch (``HARP_TELEMETRY=1`` /
+``telemetry.enable()``) and its **zero-cost when disabled** contract:
+every entry point returns before touching arrays or counters, byte math
+comes from shape/dtype only, and no instrumentation ever adds a device
+dispatch — the traced program is bit-identical with telemetry on or off
+(tested in tests/test_flightrec.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import warnings
+from typing import Any, Callable
+
+from harp_tpu.utils import telemetry
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_PROV_FIELDS = ("backend", "date", "commit")
+
+
+def _call_site() -> str:
+    """Nearest user frame outside this module / the wrapped entry-point
+    modules / jax — same contract as ``telemetry._call_site`` but skipping
+    the transfer wrappers (mesh/timing/dispatch) instead of collective."""
+    import jax
+
+    jax_dir = os.path.dirname(os.path.abspath(jax.__file__))
+    here = os.path.dirname(os.path.abspath(__file__))  # utils/
+    skip_tails = ("parallel/mesh.py", "parallel/dispatch.py")
+    f = sys._getframe(1)
+    while f is not None:
+        fn = os.path.abspath(f.f_code.co_filename)
+        base = os.path.basename(fn)
+        if (not fn.startswith(jax_dir)
+                and not fn.endswith(skip_tails)
+                and os.path.dirname(fn) != here
+                and "contextlib" not in base):
+            return f"{base}:{f.f_lineno}"
+        f = f.f_back
+    return "?:0"
+
+
+# ---------------------------------------------------------------------------
+# CompileWatch
+# ---------------------------------------------------------------------------
+
+class CompileWatch:
+    """Every XLA backend compile, with duration and active span."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.records: list[dict] = []  # {"dur", "span"} per compile
+
+    def on_compile(self, duration: float) -> None:
+        self.count += 1
+        self.total_s += float(duration)
+        self.records.append({"dur": round(float(duration), 6),
+                             "span": telemetry.tracer.current_path()})
+
+    def summary(self) -> dict:
+        """{"count", "total_s", "by_span": {span_path: {count, total_s}}}."""
+        by_span: dict[str, dict] = {}
+        for r in self.records:
+            s = by_span.setdefault(r["span"] or "(no span)",
+                                   {"count": 0, "total_s": 0.0})
+            s["count"] += 1
+            s["total_s"] = round(s["total_s"] + r["dur"], 6)
+        return {"count": self.count, "total_s": round(self.total_s, 6),
+                "by_span": by_span}
+
+    def export_jsonl(self, fh, stamp: dict | None = None) -> None:
+        """One row per compile; ``count``/``total_s`` are CUMULATIVE so
+        scripts/check_jsonl.py can enforce monotonicity (invariant 4)."""
+        cum = 0.0
+        for i, r in enumerate(self.records):
+            cum = round(cum + r["dur"], 6)
+            row = {"kind": "compile", "event": "backend_compile",
+                   "count": i + 1, "dur": r["dur"], "total_s": cum,
+                   "span": r["span"], **(stamp or {})}
+            fh.write(json.dumps(row) + "\n")
+
+
+def _on_monitoring_event(event: str, duration: float, **kw: Any) -> None:
+    # registered once per process; the enabled() check keeps the listener
+    # zero-cost for every un-instrumented run in the same process
+    if event == _BACKEND_COMPILE_EVENT and telemetry.enabled():
+        compile_watch.on_compile(duration)
+
+
+def _install_compile_listener() -> bool:
+    """Subscribe to backend-compile events; False (and every CompileWatch
+    stays silently empty) on a jax without the monitoring hook."""
+    try:
+        import jax.monitoring as monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_monitoring_event)
+        return True
+    except (ImportError, AttributeError):  # pragma: no cover - old jax
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TransferLedger
+# ---------------------------------------------------------------------------
+
+class TransferLedger:
+    """H2D/D2H bytes and dispatch round trips per (op, site, span).
+
+    Ops: ``h2d`` (host→device placement), ``readback`` (blocking
+    device→host fetch — the D2H path in this codebase is always a round
+    trip), ``dispatch`` (one invocation of a :func:`track`-wrapped jitted
+    callable), ``bucket`` (trace-time all_to_all exchange-buffer bytes
+    staged by ``dispatch.bucket_by_destination`` — capacity slots ride
+    the wire whether or not they carry items).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.h2d_bytes = 0
+        self.h2d_calls = 0
+        self.d2h_bytes = 0
+        self.readbacks = 0
+        self.dispatches = 0
+        self.bucket_bytes = 0
+        # (op, site, span) -> {"op","site","span","bytes","calls"}
+        self._sites: dict[tuple, dict] = {}
+
+    def _rec(self, op: str, nbytes: int, site: str | None) -> None:
+        site = site or _call_site()
+        span = telemetry.tracer.current_path()
+        key = (op, site, span)
+        r = self._sites.setdefault(
+            key, {"op": op, "site": site, "span": span, "bytes": 0,
+                  "calls": 0})
+        r["bytes"] += int(nbytes)
+        r["calls"] += 1
+
+    def record_h2d(self, nbytes: int, site: str | None = None) -> None:
+        self.h2d_bytes += int(nbytes)
+        self.h2d_calls += 1
+        self._rec("h2d", nbytes, site)
+
+    def record_readback(self, nbytes: int = 0,
+                        site: str | None = None) -> None:
+        self.d2h_bytes += int(nbytes)
+        self.readbacks += 1
+        self._rec("readback", nbytes, site)
+
+    def record_dispatch(self, site: str | None = None) -> None:
+        self.dispatches += 1
+        self._rec("dispatch", 0, site)
+
+    def record_bucket(self, nbytes: int, site: str | None = None) -> None:
+        self.bucket_bytes += int(nbytes)
+        self._rec("bucket", nbytes, site)
+
+    def summary(self) -> dict:
+        sites = sorted(self._sites.values(),
+                       key=lambda r: (-r["bytes"], r["op"], r["site"]))
+        return {"h2d_bytes": self.h2d_bytes, "h2d_calls": self.h2d_calls,
+                "d2h_bytes": self.d2h_bytes, "readbacks": self.readbacks,
+                "dispatches": self.dispatches,
+                "bucket_bytes": self.bucket_bytes,
+                "sites": [dict(r) for r in sites]}
+
+    def export_jsonl(self, fh, stamp: dict | None = None) -> None:
+        for r in sorted(self._sites.values(),
+                        key=lambda r: (r["op"], r["site"])):
+            fh.write(json.dumps({"kind": "transfer", **r,
+                                 **(stamp or {})}) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Module singletons + zero-cost entry points
+# ---------------------------------------------------------------------------
+
+compile_watch = CompileWatch()
+transfers = TransferLedger()
+COMPILE_EVENTS_AVAILABLE = _install_compile_listener()
+
+
+def reset() -> None:
+    """Clear both collectors (telemetry.scope does this on entry)."""
+    compile_watch.reset()
+    transfers.reset()
+
+
+def record_h2d(nbytes: int, site: str | None = None) -> None:
+    """Hook for host→device placement entry points (mesh.shard_array)."""
+    if telemetry.enabled():
+        transfers.record_h2d(nbytes, site)
+
+
+def record_readback(nbytes: int = 0, site: str | None = None) -> None:
+    """Hook for blocking device→host fetches (timing.device_sync)."""
+    if telemetry.enabled():
+        transfers.record_readback(nbytes, site)
+
+
+def record_bucket(nbytes: int, site: str | None = None) -> None:
+    """Trace-time hook for capacity-bucket staging (parallel.dispatch)."""
+    if telemetry.enabled():
+        transfers.record_bucket(nbytes, site)
+
+
+def readback(x: Any):
+    """``np.asarray(x)`` that counts the D2H round trip — THE instrumented
+    device→host fetch for driver code (zero-cost ``np.asarray`` when
+    telemetry is off)."""
+    import numpy as np
+
+    out = np.asarray(x)
+    if telemetry.enabled():
+        transfers.record_readback(out.nbytes)
+    return out
+
+
+class _Tracked:
+    """:func:`track`'s wrapper — counts one dispatch per call, delegates
+    every other attribute (``lower``, ``trace``, ...) to the wrapped
+    callable so a tracked ``jax.jit`` keeps its full surface."""
+
+    __slots__ = ("__wrapped__", "_label")
+
+    def __init__(self, fn: Callable, label: str):
+        self.__wrapped__ = fn
+        self._label = label
+
+    def __call__(self, *args, **kw):
+        if telemetry.enabled():
+            transfers.record_dispatch(self._label)
+        return self.__wrapped__(*args, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self.__wrapped__, name)
+
+
+def track(fn: Callable, label: str) -> Callable:
+    """Wrap a jitted callable so each invocation counts one dispatch
+    round trip under ``label``.  The wrapper adds one Python ``if`` per
+    call and never touches the arguments — the traced program and its
+    dispatch count are identical with telemetry on or off."""
+    return _Tracked(fn, label)
+
+
+# ---------------------------------------------------------------------------
+# Budget guard
+# ---------------------------------------------------------------------------
+
+class BudgetExceeded(RuntimeError):
+    """A flight-recorder budget was violated (see :func:`budget`)."""
+
+
+_BUDGET_KEYS = ("compiles", "compile_s", "h2d_bytes", "dispatches",
+                "readbacks", "d2h_bytes")
+
+
+def snapshot() -> dict:
+    """Current cumulative counters (the budget guard's baseline; bench.py
+    uses deltas between snapshots for its per-config flight block)."""
+    return {"compiles": compile_watch.count,
+            "compile_s": round(compile_watch.total_s, 6),
+            "h2d_bytes": transfers.h2d_bytes,
+            "dispatches": transfers.dispatches,
+            "readbacks": transfers.readbacks,
+            "d2h_bytes": transfers.d2h_bytes}
+
+
+def delta_since(base: dict) -> dict:
+    now = snapshot()
+    return {k: (round(now[k] - base[k], 6) if k == "compile_s"
+                else now[k] - base[k]) for k in _BUDGET_KEYS}
+
+
+class _BudgetScope:
+    """Yielded by :func:`budget`: ``spent()`` reads the live deltas."""
+
+    def __init__(self, base: dict):
+        self._base = base
+
+    def spent(self) -> dict:
+        return delta_since(self._base)
+
+
+@contextlib.contextmanager
+def budget(compiles: int | None = None, h2d_bytes: int | None = None,
+           dispatches: int | None = None, readbacks: int | None = None,
+           d2h_bytes: int | None = None, *, action: str = "raise",
+           tag: str = ""):
+    """Enforce execution-discipline bounds over a block.
+
+    Each keyword is an inclusive upper bound on that counter's *delta*
+    across the block (None = unbounded).  On violation: ``action="raise"``
+    raises :class:`BudgetExceeded` naming every exceeded counter (the
+    tests' mode); ``action="warn"`` emits a ``RuntimeWarning`` and
+    continues (the bench mode — a relay sprint must record the number,
+    not die).  The CLAUDE.md relay traps map one-to-one:
+
+    - ``compiles=N``: a silent re-trace (e.g. ``PRNGKey(python_int)``
+      baked into a per-step jit) blows the compile count;
+    - ``readbacks=1``: per-epoch readback loops instead of one stacked
+      readback per run;
+    - ``h2d_bytes=B``: re-uploading device-resident data through the
+      30-40 MB/s relay tunnel;
+    - ``dispatches=N``: per-epoch dispatch instead of one scanned program.
+
+    No-op (yields without snapshotting) when telemetry is disabled —
+    enable with ``HARP_TELEMETRY=1`` or ``telemetry.scope()`` first, or
+    the guard guards nothing.  If the block raises, the original
+    exception propagates unchecked.
+    """
+    if not telemetry.enabled():
+        yield None
+        return
+    limits = {"compiles": compiles, "h2d_bytes": h2d_bytes,
+              "dispatches": dispatches, "readbacks": readbacks,
+              "d2h_bytes": d2h_bytes}
+    scope_ = _BudgetScope(snapshot())
+    yield scope_
+    spent = scope_.spent()
+    violations = [
+        f"{name} used {spent[name]} > budget {limit}"
+        for name, limit in limits.items()
+        if limit is not None and spent[name] > limit]
+    if violations:
+        msg = (f"flight-recorder budget exceeded"
+               f"{f' [{tag}]' if tag else ''}: " + "; ".join(violations))
+        if action == "warn":
+            warnings.warn(msg, RuntimeWarning, stacklevel=3)
+        else:
+            raise BudgetExceeded(msg)
+
+
+# ---------------------------------------------------------------------------
+# Export
+# ---------------------------------------------------------------------------
+
+def provenance_stamp() -> dict:
+    """backend/date/commit triple for exported rows — compile/transfer
+    rows are *evidence about a specific backend* (a CPU-sim compile count
+    must never read as relay-compile evidence), so unlike comm/span rows
+    they carry the same stamp scripts/check_jsonl.py demands of bench
+    rows (invariant 4)."""
+    from harp_tpu.utils.metrics import _provenance
+
+    prov = _provenance()
+    return {k: prov.get(k) for k in _PROV_FIELDS}
+
+
+def export_jsonl(fh) -> None:
+    """Append compile + transfer rows (telemetry.export calls this)."""
+    if not compile_watch.records and not transfers._sites:
+        return
+    stamp = provenance_stamp()
+    compile_watch.export_jsonl(fh, stamp)
+    transfers.export_jsonl(fh, stamp)

@@ -74,18 +74,30 @@ def enable(on: bool = True) -> None:
 @contextlib.contextmanager
 def scope(on: bool = True, *, reset: bool = True):
     """Enable (or disable) telemetry within a block, restoring the prior
-    flag on exit; ``reset`` clears both collectors on entry so a test sees
-    only its own records."""
+    flag on exit; ``reset`` clears every collector (ledger, tracer, and
+    the flight recorder) on entry so a test sees only its own records."""
     global _ENABLED
     prev = _ENABLED
     _ENABLED = bool(on)
     if reset:
         ledger.reset()
         tracer.reset()
+        from harp_tpu.utils import flightrec
+
+        flightrec.reset()
     try:
         yield
     finally:
         _ENABLED = prev
+
+
+def budget(**kw):
+    """``with telemetry.budget(compiles=1, readbacks=1): ...`` — the
+    flight recorder's budget guard (see :func:`harp_tpu.utils.flightrec.
+    budget` for the counter semantics and the raise/warn actions)."""
+    from harp_tpu.utils import flightrec
+
+    return flightrec.budget(**kw)
 
 
 def out_path() -> str | None:
@@ -292,6 +304,11 @@ class SpanTracer:
         self._stack: list[str] = []
         self.records: list[dict] = []
 
+    def current_path(self) -> str | None:
+        """The live span path ("epoch/ingest"), or None outside any span —
+        the flight recorder stamps compile/transfer records with this."""
+        return "/".join(self._stack) or None
+
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any):
         """``with span("epoch"): ...`` — records {span, path, t0, dur,
@@ -360,21 +377,37 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 
 def export(path: str) -> None:
-    """Write every collected record (spans + ledger) as one JSONL file —
-    the input format of ``python -m harp_tpu report``."""
+    """Write every collected record (spans + ledger + flight recorder)
+    as one JSONL file — the input format of ``python -m harp_tpu
+    report``."""
+    from harp_tpu.utils import flightrec
+
     with open(path, "w") as fh:
         tracer.export_jsonl(fh)
         ledger.export_jsonl(fh)
+        flightrec.export_jsonl(fh)
 
 
-def load_jsonl(path: str) -> tuple[list[dict], list[dict]]:
-    """Read an :func:`export` file back: (span rows, comm rows)."""
-    spans, comms = [], []
+def load_rows(path: str) -> dict[str, list[dict]]:
+    """Read an :func:`export` file back, keyed by record kind:
+    ``{"span": [...], "comm": [...], "compile": [...], "transfer":
+    [...]}`` (unknown kinds land under ``"comm"`` for backward
+    compatibility with pre-flight-recorder exports, whose only unmarked
+    rows were the ledger's)."""
+    out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
+                                  "transfer": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()
             if not line:
                 continue
             row = json.loads(line)
-            (spans if row.get("kind") == "span" else comms).append(row)
-    return spans, comms
+            kind = row.get("kind")
+            out[kind if kind in out else "comm"].append(row)
+    return out
+
+
+def load_jsonl(path: str) -> tuple[list[dict], list[dict]]:
+    """Back-compat loader: (span rows, comm rows) only."""
+    rows = load_rows(path)
+    return rows["span"], rows["comm"]

@@ -85,9 +85,14 @@ def device_sync(x: Any) -> float:
 
     Reduces one leaf to a scalar and reads it back to the host — a readback
     cannot complete before the producing computation has.  Use this, not
-    ``block_until_ready``, around benchmark timing.
+    ``block_until_ready``, around benchmark timing.  Each call counts as
+    one readback round trip in the flight recorder (the 20-150 ms relay
+    round-trip trap this function exists to bound to one per run).
     """
+    from harp_tpu.utils import flightrec
+
     leaf = jax.tree.leaves(x)[0]
+    flightrec.record_readback(np.dtype(leaf.dtype).itemsize)
     return float(np.asarray(jnp.ravel(leaf)[0]))
 
 

@@ -20,12 +20,22 @@ runnable standalone (``python scripts/check_jsonl.py [--repo DIR]``):
    must comply — "my row has no date, so I look legacy" is not a loophole.
 
 PROFILE_local.jsonl and FLIP_DECISIONS.jsonl rows are trace/decision rows,
-not bench evidence: they get the parse check only — plus invariant 3:
+not bench evidence: they get the parse check only — plus invariants 3/4:
 
 3. **CommLedger rows carry a coherent wire dtype** (any file): a
    ``kind: "comm"`` row for a quantized verb must record ``wire_dtype``
    in {bfloat16, int8}, and an exact rotate/regroup row must not claim
    one — the report's bytes-on-wire claims scale by this field.
+
+4. **Flight-recorder rows are coherent evidence** (any file): a ``kind:
+   "compile"`` / ``kind: "transfer"`` row must parse, carry the
+   backend/date/commit provenance stamp (a CPU-sim compile count must
+   never read as relay evidence — the same inversion guard as check 2),
+   and its counters (count/dur/total_s/bytes/calls) must be non-negative
+   numbers, with a compile row's cumulative ``count``/``total_s``
+   monotone non-decreasing down the file (a decrease means two runs'
+   exports were interleaved — every downstream "N compiles this run"
+   claim would be wrong).
 """
 
 from __future__ import annotations
@@ -68,6 +78,47 @@ def _check_comm_row(name: str, i: int, row: dict) -> list[str]:
     return []
 
 
+FLIGHT_COUNTER_FIELDS = ("count", "dur", "total_s", "bytes", "calls")
+FLIGHT_MONOTONE_FIELDS = ("count", "total_s")  # cumulative per export
+
+
+def _check_flight_row(name: str, i: int, row: dict,
+                      state: dict) -> list[str]:
+    """Invariant 4: compile/transfer rows must be coherent evidence.
+
+    ``state`` carries the previous compile row's cumulative counters so
+    monotonicity is checked per file in line order.
+    """
+    errs: list[str] = []
+    kind = row.get("kind")
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: {kind} row missing provenance field(s) "
+            f"{missing} — export through telemetry.export / "
+            "flightrec.export_jsonl, which stamp them")
+    for k in FLIGHT_COUNTER_FIELDS:
+        v = row.get(k)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0:
+            errs.append(f"{name}:{i}: {kind} row counter {k}={v!r} must "
+                        "be a non-negative number")
+    if kind == "compile":
+        for k in FLIGHT_MONOTONE_FIELDS:
+            v = row.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            last = state.get(k)
+            if last is not None and v < last:
+                errs.append(
+                    f"{name}:{i}: compile row {k}={v} decreased from "
+                    f"{last} — cumulative counters must be monotone "
+                    "(interleaved exports?)")
+            state[k] = v
+    return errs
+
+
 def check_file(path: str, grandfathered: int = 0,
                provenance: bool = False) -> list[str]:
     """Return a list of violation messages (empty = clean)."""
@@ -77,6 +128,7 @@ def check_file(path: str, grandfathered: int = 0,
         lines = open(path).read().splitlines()
     except OSError as e:
         return [f"{name}: unreadable: {e}"]
+    flight_state: dict = {}
     for i, line in enumerate(lines, 1):
         if not line.strip():
             continue
@@ -87,6 +139,9 @@ def check_file(path: str, grandfathered: int = 0,
             continue
         if isinstance(row, dict) and row.get("kind") == "comm":
             errors += _check_comm_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") in ("compile",
+                                                         "transfer"):
+            errors += _check_flight_row(name, i, row, flight_state)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

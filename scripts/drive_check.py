@@ -855,3 +855,101 @@ _p2v = _r4fd.decide(
 assert _p2v["flip"]
 print("flip gate: chunked-rotate candidates fail closed / flip at 1.2x")
 print(f"DRIVE OK round-21 ({mode})")
+
+# --- round 22: execution flight recorder ----------------------------------
+# CompileWatch counts real XLA backend compiles with span attribution, the
+# TransferLedger's counters reproduce hand-computed byte sheets for a real
+# kmeans fit, the budget guard catches the documented relay traps and the
+# shipped loop passes its pinned budget, report + export + checker round-trip.
+import json as _fr_json
+import tempfile as _fr_tmp
+
+from harp_tpu import report as _FRrep
+from harp_tpu.models import kmeans as _FRKM
+from harp_tpu.utils import flightrec as _FR
+from harp_tpu.utils import prng as _FRprng
+from harp_tpu.utils import telemetry as _FRT
+
+assert _FR.COMPILE_EVENTS_AVAILABLE  # this jax has the monitoring hook
+
+# (a) collectors against hand-computed values on a real fit
+_fr_pts = np.random.default_rng(0).normal(size=(32 * nw, 8)).astype(np.float32)
+_FRKM.fit(_fr_pts, k=4, iters=3, mesh=mesh, seed=0)  # warm shared ops
+with _FRT.scope(True):
+    with _FRT.span("fit"):
+        with _FR.budget(compiles=1, dispatches=1, readbacks=2,
+                        h2d_bytes=_fr_pts.nbytes, tag="drive.kmeans"):
+            _fr_c, _fr_inertia = _FRKM.fit(_fr_pts, k=4, iters=3, mesh=mesh,
+                                           seed=0)
+    _fr_row, _fr_spans = _FRrep.live_report()
+    assert _FR.transfers.h2d_bytes == _fr_pts.nbytes      # points, ONCE
+    assert _FR.transfers.dispatches == 1                  # one tracked fit
+    assert _FR.transfers.readbacks == 2                   # inertia + centroids
+    assert _FR.transfers.d2h_bytes == 4 + _fr_c.nbytes
+    assert _FR.compile_watch.count == 1                   # one fresh seed jit
+    assert _FR.compile_watch.summary()["by_span"] == {
+        "fit/kmeans.fit": {"count": 1,
+                           "total_s": _FR.compile_watch.summary()["total_s"]}}
+    assert np.isfinite(_fr_inertia)
+    # the report row carries the same numbers
+    assert _fr_row["compile"]["count"] == 1
+    assert _fr_row["transfer"]["h2d_bytes"] == _fr_pts.nbytes
+    _fr_text = _FRrep.render(_fr_row, _fr_spans)
+    assert "compiles (XLA backend): 1" in _fr_text
+    assert "transfers (host<->device):" in _fr_text
+    # (b) export -> CLI report -> checker, all from one file
+    with _fr_tmp.NamedTemporaryFile("r", suffix=".jsonl") as _fr_fh:
+        _FRT.export(_fr_fh.name)
+        _fr_kinds = _FRT.load_rows(_fr_fh.name)
+        assert _fr_kinds["compile"] and _fr_kinds["transfer"]
+        for _fr_r in _fr_kinds["compile"] + _fr_kinds["transfer"]:
+            assert {"backend", "date", "commit"} <= set(_fr_r)
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__))))
+        import check_jsonl as _fr_cj
+
+        assert _fr_cj.check_file(_fr_fh.name) == []
+        _fr_row2 = _FRrep.build_row(
+            _FRrep.comm_summary_from_rows(_fr_kinds["comm"]),
+            _FRrep.span_summary_from_rows(_fr_kinds["span"]),
+            compile_info=_FRrep.compile_summary_from_rows(
+                _fr_kinds["compile"]),
+            transfer_info=_FRrep.transfer_summary_from_rows(
+                _fr_kinds["transfer"]))
+        assert _fr_row2["compile"]["count"] == _fr_row["compile"]["count"]
+        assert _fr_row2["transfer"]["h2d_bytes"] == _fr_pts.nbytes
+
+# (c) the budget guard CATCHES the relay traps (raise mode)
+with _FRT.scope(True):
+    _fr_f = jax.jit(lambda x: x * 1.01)
+    _fr_x = _fr_f(jnp.ones(8))
+    from harp_tpu.utils.timing import device_sync as _fr_sync
+    try:
+        with _FR.budget(readbacks=1, tag="trap"):
+            for _ in range(3):
+                _fr_x = _fr_f(_fr_x)
+                _fr_sync(_fr_x)  # per-epoch readback loop
+        raise AssertionError("readbacks budget failed to trip")
+    except _FR.BudgetExceeded as _fr_e:
+        assert "readbacks used 3 > budget 1" in str(_fr_e)
+
+# (d) prng.key_bits: bit-exact vs PRNGKey and compile-free across seeds
+for _fr_seed in (0, 7, -3, 2**40 + 1):
+    assert np.array_equal(_FRprng.key_bits(_fr_seed),
+                          np.asarray(jax.random.PRNGKey(_fr_seed)))
+with _FRT.scope(True):
+    _FRprng.split_keys(1, nw)  # warm the shape-keyed split program
+    _fr_n = _FR.compile_watch.count
+    for _fr_seed in range(50, 60):
+        _FRprng.split_keys(_fr_seed, nw)
+    assert _FR.compile_watch.count == _fr_n  # zero per-seed compiles
+
+# (e) zero-cost when off: no counter moves, result identical
+with _FRT.scope(False):
+    _fr_c2, _fr_i2 = _FRKM.fit(_fr_pts, k=4, iters=3, mesh=mesh, seed=0)
+    assert _FR.compile_watch.count == 0 and _FR.transfers.dispatches == 0
+    assert _FR.transfers.h2d_bytes == 0 and _FR.transfers.readbacks == 0
+np.testing.assert_array_equal(_fr_c2, _fr_c)
+print("flight recorder: counters == hand sheet, budget trips trap, "
+      "export/report/checker round-trip, prng compile-free, zero-cost off")
+print(f"DRIVE OK round-22 ({mode})")

@@ -126,6 +126,74 @@ def test_exported_ledger_rows_satisfy_the_checker(tmp_path):
     assert check_jsonl.check_file(str(p)) == []
 
 
+def test_flight_row_must_carry_provenance(tmp_path):
+    """Invariant 4: a compile/transfer row without backend/date/commit is
+    ambiguous evidence — a CPU-sim compile count must never read as relay
+    evidence (the same inversion guard as the bench-row check)."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    rows = [
+        {"kind": "compile", "count": 1, "dur": 0.1, "total_s": 0.1,
+         "span": "epoch", **stamp},                          # fine
+        {"kind": "compile", "count": 2, "dur": 0.1, "total_s": 0.2},
+        {"kind": "transfer", "op": "h2d", "bytes": 64, "calls": 1},
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 2
+    assert ":2:" in errors[0] and "provenance" in errors[0]
+    assert ":3:" in errors[1] and "provenance" in errors[1]
+
+
+def test_flight_row_counters_must_be_nonnegative_numbers(tmp_path):
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    rows = [
+        {"kind": "transfer", "op": "readback", "bytes": -4, "calls": 1,
+         **stamp},
+        {"kind": "compile", "count": "three", "dur": 0.1, "total_s": 0.1,
+         **stamp},
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 2
+    assert "bytes=-4" in errors[0]
+    assert "count='three'" in errors[1]
+
+
+def test_compile_rows_must_be_monotone_within_a_file(tmp_path):
+    """A cumulative compile counter that DECREASES down the file means two
+    runs' exports were interleaved — every "N compiles this run" claim
+    downstream would be wrong."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    rows = [
+        {"kind": "compile", "count": 1, "dur": 0.2, "total_s": 0.2, **stamp},
+        {"kind": "compile", "count": 2, "dur": 0.1, "total_s": 0.3, **stamp},
+        {"kind": "compile", "count": 1, "dur": 0.1, "total_s": 0.1, **stamp},
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 2  # count AND total_s both decreased on row 3
+    assert all(":3:" in e and "monotone" in e for e in errors)
+
+
+def test_exported_flight_rows_satisfy_the_checker(tmp_path):
+    """Round-trip: what flightrec.export_jsonl writes must pass invariant
+    4 as-is (stamped, non-negative, monotone) — even teed into a bench
+    file where provenance checking is on."""
+    from harp_tpu.utils import flightrec, telemetry
+
+    with telemetry.scope(True):
+        flightrec.compile_watch.on_compile(0.25)
+        flightrec.compile_watch.on_compile(0.05)
+        flightrec.record_h2d(1024)
+        flightrec.record_readback(4)
+        p = tmp_path / "BENCH_local.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
 def test_cli_exit_codes(tmp_path):
     (tmp_path / "BENCH_local.jsonl").write_text("not json\n")
     assert check_jsonl.main(["--repo", str(tmp_path)]) == 1

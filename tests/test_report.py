@@ -85,6 +85,64 @@ def test_report_json_only(fixture_run, capsys):
     assert json.loads(lines[0])["comm_total_bytes"] == 1_214_240
 
 
+FIXTURE_COMPILES = [
+    {"kind": "compile", "event": "backend_compile", "count": 1,
+     "dur": 0.14, "total_s": 0.14, "span": "epoch"},
+    {"kind": "compile", "event": "backend_compile", "count": 2,
+     "dur": 0.06, "total_s": 0.2, "span": "epoch"},
+]
+FIXTURE_TRANSFERS = [
+    {"kind": "transfer", "op": "h2d", "site": "kmeans.py:300",
+     "span": "epoch/ingest", "bytes": 25_600_000, "calls": 1},
+    {"kind": "transfer", "op": "readback", "site": "kmeans.py:340",
+     "span": "epoch", "bytes": 4, "calls": 1},
+    {"kind": "transfer", "op": "dispatch", "site": "kmeans.fit",
+     "span": "epoch", "bytes": 0, "calls": 1},
+]
+
+
+def test_report_roundtrips_flight_sections(tmp_path, capsys):
+    """Satellite: a synthetic run carrying compile + transfer + ledger +
+    span records round-trips through the CLI — the merged human report
+    AND the one-line JSON both surface the new sections."""
+    tele = tmp_path / "run.jsonl"
+    with open(tele, "w") as fh:
+        for row in (FIXTURE_SPANS + FIXTURE_COMMS + FIXTURE_COMPILES
+                    + FIXTURE_TRANSFERS):
+            fh.write(json.dumps(row) + "\n")
+    rc = cli.main(["report", "--telemetry", str(tele)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    human, machine = out.rsplit("\n", 2)[0], out.strip().splitlines()[-1]
+    # human report: both new sections, with span attribution
+    assert "compiles (XLA backend): 2 in 0.200 s" in human
+    assert "transfers (host<->device): H2D 24.41 MiB in 1 call(s); " \
+           "D2H 4 B over 1 readback(s); 1 dispatch(es)" in human
+    assert "h2d       kmeans.py:300" in human
+    # pre-flight sections still render alongside
+    assert "comm volume" in human and "spans (host phases):" in human
+    # machine row: the same numbers, merged into the one JSON line
+    rec = json.loads(machine)
+    assert rec["compile"]["count"] == 2
+    assert rec["compile"]["total_s"] == 0.2
+    assert rec["compile"]["by_span"]["epoch"]["count"] == 2
+    assert rec["transfer"]["h2d_bytes"] == 25_600_000
+    assert rec["transfer"]["readbacks"] == 1
+    assert rec["transfer"]["dispatches"] == 1
+    assert len(rec["transfer"]["sites"]) == 3
+    assert rec["comm_total_bytes"] == 1_214_240  # comm section unaffected
+
+
+def test_report_without_flight_rows_keeps_old_shape(fixture_run, capsys):
+    """Pre-flight-recorder exports keep their exact old report shape: no
+    compile/transfer keys appear when the run recorded none."""
+    tele, _ = fixture_run
+    rc = cli.main(["report", "--telemetry", tele, "--json-only"])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert "compile" not in rec and "transfer" not in rec
+
+
 def test_report_listed_as_app(capsys):
     assert cli.main(["--list"]) == 0
     assert "report" in capsys.readouterr().out
