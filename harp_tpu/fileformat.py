@@ -27,6 +27,19 @@ import numpy as np
 from harp_tpu.native import datasource
 
 
+def _record_skew(phase: str, work, *, unit: str,
+                 padded_total: int | None = None, units=None) -> None:
+    """Ingest-side skew record (utils/skew.py): per-shard real rows /
+    nonzeros / bytes and the padding fraction, at partition time — host
+    arithmetic over arrays the splitter already built.  Lazy import +
+    enabled() gate keep the readers zero-cost when telemetry is off."""
+    from harp_tpu.utils import skew, telemetry
+
+    if telemetry.enabled():
+        skew.record_partition(phase, work, unit=unit,
+                              padded_total=padded_total, units=units)
+
+
 def list_files(pattern_or_dir: str) -> list[str]:
     """Expand a glob pattern or directory into a sorted file list."""
     if os.path.isdir(pattern_or_dir):
@@ -59,6 +72,15 @@ def multi_file_splits(paths: Sequence[str], num_workers: int,
     else:
         for i, p in enumerate(paths):
             splits[i % num_workers].append(p)
+    from harp_tpu.utils import telemetry
+
+    if telemetry.enabled():
+        # movable units = whole files: suggest_rebalance can then emit a
+        # whole-file plan that schedule.apply_rebalance replays
+        units = [[(p, os.path.getsize(p)) for p in s] for s in splits]
+        _record_skew("fileformat.multi_file_splits",
+                     [sum(sz for _, sz in u) for u in units],
+                     unit="bytes", units=units)
     return splits
 
 
@@ -113,6 +135,8 @@ def load_sharded_csv(pattern_or_paths, num_workers: int,
               for s in shards]
     counts = np.asarray([s.shape[0] for s in shards], np.int64)
     rows_pad = int(counts.max())
+    _record_skew("fileformat.load_sharded_csv", counts, unit="rows",
+                 padded_total=num_workers * rows_pad)
     stacked = np.concatenate([_pad_rows(s, rows_pad) for s in shards], axis=0)
     if pad_value != 0.0:
         for w, c in enumerate(counts):
@@ -149,6 +173,8 @@ def load_sharded_triples(pattern_or_paths, num_workers: int):
     nnz_pad = int(counts.max())
     if nnz_pad == 0:
         raise ValueError("all splits empty")
+    _record_skew("fileformat.load_sharded_triples", counts,
+                 unit="nonzeros", padded_total=num_workers * nnz_pad)
 
     def pad1(a, fill):
         out = np.full(nnz_pad, fill, a.dtype)

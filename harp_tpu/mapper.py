@@ -147,6 +147,16 @@ class CollectiveApp:
         finally:
             self.metrics.close()
         if telemetry.enabled():
+            import jax
+
+            from harp_tpu.utils import skew
+
+            # the multiprocess (Gloo/DCN) path's host-phase skew stamp:
+            # each process records ITS wall-clock for the superstep, so a
+            # merged report can attribute a straggling host (utils/skew.py)
+            skew.record_host("map_collective", jax.process_index(),
+                             time.perf_counter() - t0,
+                             n_workers=jax.process_count())
             fs = flightrec.snapshot()
             log.info("flight record: %d compile(s) %.3fs, H2D %d B, "
                      "%d dispatch(es), %d readback(s)",

@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import flightrec, prng, telemetry
+from harp_tpu.utils import flightrec, prng, skew, telemetry
 from harp_tpu.utils.timing import device_sync
 
 
@@ -354,12 +354,23 @@ def make_fit_fn(mesh: WorkerMesh, cfg: KMeansConfig):
             c, _ = state
             return kmeans_step(points, c, cfg, x2=x2)
 
-        return lax.fori_loop(0, cfg.iters, body, (centroids, jnp.float32(0.0)))
+        centroids, inertia = lax.fori_loop(
+            0, cfg.iters, body, (centroids, jnp.float32(0.0)))
+        # per-worker active-row count folded NEXT TO the inertia — the
+        # skew spine's execution counter (utils/skew.py) rides the same
+        # [nw, 2] stats readback; no collective is added (the
+        # out-sharding concatenates), so the hand-computed comm byte
+        # sheet (tests/test_telemetry.py) and the pinned flight budgets
+        # (compiles=1, dispatches=1, readbacks=2) are untouched
+        rows = (points[0] if cfg.quantize == "int8" else points).shape[0]
+        stats = jnp.stack([jnp.float32(rows), inertia])[None]  # [1, 2]
+        return centroids, stats
 
     pts_spec = ((mesh.spec(0), P()) if cfg.quantize == "int8"
                 else mesh.spec(0))  # (q shards, replicated col scales)
     return jax.jit(
-        mesh.shard_map(run, in_specs=(pts_spec, P()), out_specs=(P(), P()))
+        mesh.shard_map(run, in_specs=(pts_spec, P()),
+                       out_specs=(P(), mesh.spec(0)))
     )
 
 
@@ -442,8 +453,12 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     # readbacks (inertia scalar + final centroids)
     with telemetry.span("kmeans.fit", iters=cfg.iters, k=k), \
             telemetry.ledger.run("kmeans.fit", steps=cfg.iters):
-        new_c, inertia = fit_fn(pts, centroids)
-        inertia = float(flightrec.readback(inertia))
+        t0 = time.perf_counter()
+        new_c, stats = fit_fn(pts, centroids)
+        st = flightrec.readback(stats)  # [nw, 2]: per-worker rows, inertia
+        inertia = float(st[0, 1])
+        skew.record_execution("kmeans.fit", st[:, 0], unit="points",
+                              wall_s=time.perf_counter() - t0)
         return flightrec.readback(new_c), inertia
 
 

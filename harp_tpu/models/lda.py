@@ -58,8 +58,7 @@ from harp_tpu.models.mfsgd import (
     partition_ratings_tiles,
     rotate_chunks_resolved,
 )
-from harp_tpu.utils import flightrec, prng
-from harp_tpu.utils.timing import device_sync
+from harp_tpu.utils import flightrec, prng, skew
 
 
 @dataclasses.dataclass
@@ -495,6 +494,15 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
     def epoch(Ndk, Nwk_slice, Nk, z_grid, *token_args):
         key = token_args[-1][0]
         tokens = token_args[:-1]
+        # per-worker tokens touched this sweep — the skew spine's
+        # execution counter (utils/skew.py), folded into the epoch
+        # outputs so the driver's ONE readback carries it (flight
+        # budgets stay 1 dispatch / 1 readback, tests/test_flightrec.py).
+        # Unconditional: a telemetry-gated output would make the traced
+        # program differ with the flag (zero-cost contract).
+        valid = ((tokens[0] < cfg.d_tile) if tiled
+                 else (tokens[2] > 0)).sum()
+        work_w = C.allgather(valid.astype(jnp.float32)[None])
         if pallas:
             # the fused kernel is topic-major: transpose once per epoch
             # (~10 GB/epoch of HBM at enwiki scale — noise vs the epoch);
@@ -606,7 +614,7 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
             chunk_axis=1 if pallas else 0)
         if pallas:
             Ndk, Nwk_slice = Ndk.T, Nwk_slice.T
-        return Ndk, Nwk_slice, Nk, z_grid
+        return Ndk, Nwk_slice, Nk, z_grid, work_w
 
     return epoch
 
@@ -637,7 +645,10 @@ def _pushpull_epoch_device_fn(mesh: WorkerMesh, cfg: LDAConfig,
             body, (Ndk, Nwk_shard, Nk, jnp.int32(0)),
             (d.reshape(nchunk, c), w.reshape(nchunk, c),
              m.reshape(nchunk, c), z.reshape(nchunk, c), chunk_keys))
-        return Ndk, Nwk_shard, Nk, z_new.reshape(-1), drop
+        # per-worker valid tokens (the skew execution counter; drops are
+        # reported separately and already globally summed)
+        work_w = C.allgather(jnp.sum(m > 0).astype(jnp.float32)[None])
+        return Ndk, Nwk_shard, Nk, z_new.reshape(-1), drop, work_w
 
     return epoch
 
@@ -655,9 +666,10 @@ def _n_token_args(cfg: LDAConfig) -> int:
 
 
 def _epoch_out_specs(mesh, cfg):
-    """Pushpull epochs also return the global drop counter (replicated)."""
+    """Pushpull epochs also return the global drop counter (replicated);
+    every algo appends the replicated per-worker work vector (skew)."""
     base = (mesh.spec(0), mesh.spec(0), P(), mesh.spec(0))
-    return base + ((P(),) if cfg.algo == "pushpull" else ())
+    return base + ((P(),) if cfg.algo == "pushpull" else ()) + (P(),)
 
 
 def make_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
@@ -698,14 +710,18 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: LDAConfig, vocab_size: int,
         base = jax.random.wrap_key_data(token_args[-1][0])
 
         def body(carry, e):
-            st, drop = carry[:4], carry[4:]
+            st = carry[:4]
             k = jax.random.key_data(jax.random.fold_in(base, e))[None]
             out = inner(*st, *tokens, k)
             if pp:  # accumulate the drop counter across sweeps
-                out = out[:4] + (drop[0] + out[4],)
+                out = out[:4] + (carry[4] + out[4], out[5])
             return out, None
 
-        init = (Ndk, Nwk_slice, Nk, z_grid) + ((jnp.int32(0),) if pp else ())
+        # trailing zeros: the per-worker work vector's carry slot (the
+        # per-sweep counts are identical, so the last sweep's suffice)
+        init = (Ndk, Nwk_slice, Nk, z_grid) \
+            + ((jnp.int32(0),) if pp else ()) \
+            + (jnp.zeros((mesh.num_workers,), jnp.float32),)
         out, _ = lax.scan(body, init, jnp.arange(epochs))
         return out
 
@@ -887,6 +903,9 @@ class LDA:
         # pushpull only: TOKENS skipped by pull_cap capacity drops in the
         # most recent sample_epoch/sample_epochs call (0 = none skipped)
         self.last_dropped = 0
+        # per-worker tokens touched in the most recent sweep (numpy [nw];
+        # the skew spine's execution counter — see utils/skew.py)
+        self.last_work = None
 
     def suggest_pull_cap(self, apply=False):
         """Exact zero-drop ``pull_cap`` for the LOADED corpus (pushpull
@@ -1006,6 +1025,15 @@ class LDA:
             self._epoch_fn = flightrec.track(
                 make_epoch_fn(self.mesh, self.cfg, self.vocab_size,
                               self._count_bounds), "lda.epoch")
+        from harp_tpu.utils import telemetry
+
+        if telemetry.enabled():
+            # ingest-side skew record (host arithmetic over the pack —
+            # also fires for cached packs, which skip pack_tokens)
+            _, _, gm = self._global_token_ids(pack["tokens"])
+            per = gm.reshape(n, -1).sum(1)
+            skew.record_partition("lda.partition", per, unit="tokens",
+                                  padded_total=gm.size)
         self.Ndk, self.Nwk = sh(pack["Ndk"], 0), sh(pack["Nwk"], 0)
         self.Nk = jax.device_put(jnp.asarray(pack["Nk"]),
                                  self.mesh.replicated())
@@ -1096,12 +1124,17 @@ class LDA:
     def _install_epoch_out(self, out):
         self.Ndk, self.Nwk, self.Nk, self.z_grid = out[:4]
         if self.cfg.algo == "pushpull":
-            # surface the pull_cap drop count (the "counted, never
-            # silently wrong" half of the capacity contract); reading it
-            # back doubles as the device sync
-            self.last_dropped = int(flightrec.readback(out[4]))
+            # drop counter (the "counted, never silently wrong" half of
+            # the capacity contract) + per-worker work vector in ONE
+            # stacked readback; reading it back doubles as the device sync
+            stats = flightrec.readback(jnp.concatenate(
+                [out[4].reshape(1).astype(jnp.float32), out[5]]))
+            self.last_dropped = int(stats[0])
+            self.last_work = np.asarray(stats[1:])
         else:
-            device_sync(self.Nk)
+            # the per-worker work vector rides the epoch outputs; reading
+            # it back IS the device sync (replaces the old Nk scalar sync)
+            self.last_work = np.asarray(flightrec.readback(out[4]))
 
     def sample_epochs(self, epochs: int):
         """Run ``epochs`` Gibbs sweeps as one device program (one dispatch,
@@ -1114,10 +1147,14 @@ class LDA:
         # the scan body's traced comm sites execute once per Gibbs sweep
         with telemetry.span("lda.epochs", epochs=epochs), \
                 telemetry.ledger.run("lda.epochs", steps=epochs):
+            t0 = time.perf_counter()
             out = fn(self.Ndk, self.Nwk, self.Nk, self.z_grid,
                      *self._tokens, keys)
             self._advance_keys()
             self._install_epoch_out(out)
+            skew.record_execution("lda.epochs", self.last_work,
+                                  unit="tokens",
+                                  wall_s=time.perf_counter() - t0)
 
     def sample_epoch(self):
         if self._tokens is None:
@@ -1127,12 +1164,16 @@ class LDA:
         keys = self.mesh.shard_array(self._keys, 0)
         with telemetry.span("lda.epoch"), \
                 telemetry.ledger.run("lda.epochs", steps=1):
+            t0 = time.perf_counter()
             out = self._epoch_fn(
                 self.Ndk, self.Nwk, self.Nk, self.z_grid, *self._tokens,
                 keys
             )
             self._advance_keys()
             self._install_epoch_out(out)
+            skew.record_execution("lda.epochs", self.last_work,
+                                  unit="tokens",
+                                  wall_s=time.perf_counter() - t0)
 
     def _advance_keys(self):
         # prng.split_keys builds the base key's bits on host — a fresh

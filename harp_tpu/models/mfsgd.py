@@ -52,7 +52,7 @@ from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
 from harp_tpu.parallel.rotate import (ROTATE_WIRES, resident_chunk_index,
                                       rotate_pipeline)
-from harp_tpu.utils import flightrec, prng
+from harp_tpu.utils import flightrec, prng, skew
 
 
 @dataclasses.dataclass
@@ -535,10 +535,15 @@ def _epoch_device_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
         (W, se, cnt), H_slice = rotate_pipeline(
             step, (W, jnp.float32(0.0), jnp.float32(0.0)), H_slice,
             n_chunks=nc, wire=cfg.rotate_wire)
+        # per-worker visited-rating count BEFORE the psum — the skew
+        # spine's execution counter (utils/skew.py), folded into the
+        # epoch outputs so the driver's ONE stacked readback carries it
+        # (flight budgets unchanged, tests/test_flightrec.py).
+        work_w = C.allgather(cnt[None])
         # loss partials are per-worker; combine before leaving SPMD (the
         # optional end-of-epoch allreduce-RMSE in Harp's MF-SGD loop)
         se, cnt = C.allreduce((se, cnt))
-        return W, H_slice, se, cnt
+        return W, H_slice, se, cnt, work_w
 
     return epoch
 
@@ -553,7 +558,7 @@ def make_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig):
         mesh.shard_map(
             _epoch_device_fn(mesh, cfg),
             in_specs=(mesh.spec(0),) * (2 + _n_block_args(cfg)),
-            out_specs=(mesh.spec(0), mesh.spec(0), P(), P()),
+            out_specs=(mesh.spec(0), mesh.spec(0), P(), P(), P()),
         )
     )
 
@@ -572,18 +577,19 @@ def make_multi_epoch_fn(mesh: WorkerMesh, cfg: MFSGDConfig, epochs: int):
     def many(W, H_slice, *blocks):
         def body(carry, _):
             W, H = carry
-            W, H, se, cnt = inner(W, H, *blocks)
-            return (W, H), (se, cnt)
+            W, H, se, cnt, work = inner(W, H, *blocks)
+            return (W, H), (se, cnt, work)
 
-        (W, H_slice), (ses, cnts) = lax.scan(
+        (W, H_slice), (ses, cnts, works) = lax.scan(
             body, (W, H_slice), None, length=epochs)
-        return W, H_slice, ses, cnts
+        # per-sweep work vectors are identical — the last one suffices
+        return W, H_slice, ses, cnts, works[-1]
 
     return jax.jit(
         mesh.shard_map(
             many,
             in_specs=(mesh.spec(0),) * (2 + _n_block_args(cfg)),
-            out_specs=(mesh.spec(0), mesh.spec(0), P(), P()),
+            out_specs=(mesh.spec(0), mesh.spec(0), P(), P(), P()),
         )
     )
 
@@ -624,6 +630,8 @@ class MFSGD:
         self._blocks = None
 
     def set_ratings(self, users, items, vals):
+        from harp_tpu.utils import telemetry
+
         n = self.mesh.num_workers
         nc = rotate_chunks_resolved(self.cfg)
         if self.cfg.algo in _DENSE_ALGOS:
@@ -633,6 +641,13 @@ class MFSGD:
                 n_slices=self._n_slices,
             )
             assert (uo, io) == (self.u_own, self.i_own)
+            if telemetry.enabled():
+                # ingest skew record from the REAL ratings (before the
+                # pallas coverage entries, which carry no rating mass)
+                valid = eu < tiles(self.cfg)[0]
+                skew.record_partition(
+                    "mfsgd.partition", valid.reshape(n, -1).sum(1),
+                    unit="ratings", padded_total=valid.size)
             if self.cfg.algo == "pallas":
                 from harp_tpu.ops.mfsgd_kernel import insert_coverage_entries
 
@@ -644,6 +659,10 @@ class MFSGD:
                 users, items, vals, self.n_users, self.n_items, n,
                 self.cfg.chunk, n_slices=self._n_slices,
             )
+            if telemetry.enabled():
+                skew.record_partition(
+                    "mfsgd.partition", (bm > 0).reshape(n, -1).sum(1),
+                    unit="ratings", padded_total=bm.size)
             blocks = (bu, bi, bv, bm)
         assert (ub, nc * ibc) == (self.u_bound, self.i_bound)
         self._blocks = tuple(self.mesh.shard_array(a, 0) for a in blocks)
@@ -658,10 +677,16 @@ class MFSGD:
 
         with telemetry.span("mfsgd.epoch"), \
                 telemetry.ledger.run("mfsgd.epochs", steps=1):
-            self.W, self.H, se, cnt = self._epoch_fn(self.W, self.H,
-                                                     *self._blocks)
-            # one stacked readback, not one per scalar (readbacks budget)
-            stats = flightrec.readback(jnp.stack([se, cnt]))
+            t0 = time.perf_counter()
+            self.W, self.H, se, cnt, work_w = self._epoch_fn(
+                self.W, self.H, *self._blocks)
+            # one stacked readback, not one per scalar (readbacks
+            # budget); the per-worker work vector rides the same fetch
+            stats = flightrec.readback(
+                jnp.concatenate([jnp.stack([se, cnt]), work_w]))
+            skew.record_execution("mfsgd.epochs", stats[2:],
+                                  unit="ratings",
+                                  wall_s=time.perf_counter() - t0)
             return float(np.sqrt(max(float(stats[0]), 0.0)
                                  / max(float(stats[1]), 1.0)))
 
@@ -701,12 +726,19 @@ class MFSGD:
         # the scan body's traced comm sites execute once per epoch
         with telemetry.span("mfsgd.epochs", epochs=epochs), \
                 telemetry.ledger.run("mfsgd.epochs", steps=epochs):
-            self.W, self.H, ses, cnts = fn(self.W, self.H, *self._blocks)
+            t0 = time.perf_counter()
+            self.W, self.H, ses, cnts, work_w = fn(self.W, self.H,
+                                                   *self._blocks)
             # ONE stacked readback for all epochs' stats (the ccd.py
             # idiom) — the flight-recorder budget for this loop pins
-            # readbacks=1 per run, not one per stat array
-            stats = flightrec.readback(jnp.stack([ses, cnts]))
-            ses, cnts = stats[0], stats[1]
+            # readbacks=1 per run, not one per stat array; the
+            # per-worker work vector rides the same fetch (skew spine)
+            stats = flightrec.readback(
+                jnp.concatenate([ses, cnts, work_w]))
+            skew.record_execution("mfsgd.epochs", stats[2 * epochs:],
+                                  unit="ratings",
+                                  wall_s=time.perf_counter() - t0)
+            ses, cnts = stats[:epochs], stats[epochs:2 * epochs]
         return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
                 for s, c in zip(ses, cnts)]
 

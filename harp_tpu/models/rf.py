@@ -256,6 +256,14 @@ class RandomForest:
                 f"[{y.min()}, {y.max()}] — set RFConfig(n_classes=...)")
         n = (x.shape[0] // nw) * nw
         x, y = x[:n], y[:n]
+        from harp_tpu.utils import skew, telemetry
+
+        if telemetry.enabled():
+            # ingest skew record (utils/skew.py): rows shard evenly by
+            # construction (the truncation above), so this pins the
+            # balanced baseline the report compares other phases against
+            skew.record_partition("rf.partition", np.full(nw, n // nw),
+                                  unit="rows", padded_total=n)
         self.edges = quantile_bins(x, cfg.n_bins)
         bins = binize(x, self.edges)
         if self._train_fn is None:

@@ -416,6 +416,18 @@ def count_template(edges, n_vertices, cfg: SubgraphConfig,
         nbr = np.concatenate([nbr, np.zeros((n_pad - n_vertices, cfg.max_degree), np.int32)])
         msk = np.concatenate([msk, np.zeros((n_pad - n_vertices, cfg.max_degree), np.float32)])
 
+    from harp_tpu.utils import skew, telemetry
+
+    if telemetry.enabled():
+        # ingest skew record (utils/skew.py): real adjacency entries per
+        # vertex-partition worker vs its padded slots — powerlaw graphs
+        # are exactly where "one worker holds the hub" shows up
+        loc = n_pad // nw
+        skew.record_partition(
+            "subgraph.partition",
+            msk.reshape(nw, loc * cfg.max_degree).sum(1),
+            unit="edges", padded_total=msk.size)
+
     nbr_d = mesh.shard_array(nbr, 0)
     msk_d = mesh.shard_array(msk, 0)
     if cfg.overflow_algo == "onehot":

@@ -3,9 +3,12 @@
 Reference parity (SURVEY.md §6): Harp observability ends at grepping YARN
 container logs; harp-tpu's pieces each emit structured records — the
 CommLedger (collective bytes per call site, :mod:`harp_tpu.utils.telemetry`),
-the SpanTracer (nested host phases), :class:`harp_tpu.utils.metrics.
-MetricsLogger` (per-iteration JSONL), and :func:`harp_tpu.utils.profiling.
-op_breakdown` (per-op device time from an XLA trace).  This module merges
+the SpanTracer (nested host phases), the flight recorder
+(:mod:`harp_tpu.utils.flightrec` — compiles/transfers), the SkewLedger
+(:mod:`harp_tpu.utils.skew` — per-worker load), :class:`harp_tpu.utils.
+metrics.MetricsLogger` (per-iteration JSONL), and :func:`harp_tpu.utils.
+profiling.op_breakdown` (per-op device time from an XLA trace).  This
+module merges
 them into ONE human-readable run report plus ONE machine-readable JSON line
 (printed through :func:`harp_tpu.utils.metrics.benchmark_json`, so the
 backend/date/commit provenance stamp rides along like every bench row).
@@ -112,11 +115,26 @@ def transfer_summary_from_rows(rows: list[dict]) -> dict:
     return out
 
 
+def skew_summary_from_rows(rows: list[dict]) -> dict:
+    """Rebuild :meth:`harp_tpu.utils.skew.SkewLedger.summary`'s shape
+    from exported ``kind: "skew"`` rows (one row per phase)."""
+    out: dict[str, dict] = {}
+    for r in rows:
+        phase = r.get("phase", "?")
+        out[phase] = {k: r.get(k) for k in (
+            "source", "unit", "work", "total", "n_workers",
+            "max_mean_ratio", "wasted_frac", "wasted_chip_s",
+            "padding_frac", "wall_s", "runs") if r.get(k) is not None}
+    return dict(sorted(out.items(),
+                       key=lambda kv: -(kv[1].get("max_mean_ratio") or 0)))
+
+
 def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               metrics_rows: list[dict] | None = None,
               top_ops: list | None = None,
               compile_info: dict | None = None,
-              transfer_info: dict | None = None) -> dict:
+              transfer_info: dict | None = None,
+              skew_info: dict | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -132,6 +150,9 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
                           or any(v for k, v in transfer_info.items()
                                  if k != "sites")):
         row["transfer"] = transfer_info
+    # skew section (PR 4) only when the run recorded per-worker loads
+    if skew_info:
+        row["skew"] = skew_info
     for t in comm.values():
         execs = max(1, t["executions"])
         for s in t["sites"]:
@@ -209,6 +230,35 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
                 f"  {s['op']:<9s} {s['site'] or '?':<24s} "
                 f"{_fmt_bytes(s['bytes'] or 0)} × {s['calls']} call(s)"
                 f"{span_note}")
+    sk = row.get("skew")
+    if sk:
+        lines.append("skew (per-worker load; most imbalanced first):")
+        for phase, s in sk.items():
+            ratio = s.get("max_mean_ratio")
+            head = (f"  {phase} [{s.get('unit', '?')}, "
+                    f"{s.get('source', '?')}]: total {s.get('total', 0):g} "
+                    f"over {s.get('n_workers', '?')} worker(s)")
+            if ratio is not None:
+                head += f", max/mean {ratio:.2f}x"
+            if s.get("wasted_frac") is not None:
+                head += f", est. waste {100.0 * s['wasted_frac']:.1f}%"
+            if s.get("wasted_chip_s") is not None:
+                head += f" (~{s['wasted_chip_s']:.4f} chip-s)"
+            if s.get("padding_frac") is not None:
+                head += f", padding {100.0 * s['padding_frac']:.1f}%"
+            lines.append(head)
+            work = s.get("work") or []
+            if work and len(work) <= 16:
+                mx = max(work) or 1.0
+                for w, v in enumerate(work):
+                    bar = "#" * max(1 if v > 0 else 0,
+                                    round(24.0 * v / mx))
+                    lines.append(f"    w{w:<3d} {bar:<24s} {v:g}")
+            elif work:  # wide meshes: summarize instead of 100 bars
+                arr = sorted(work)
+                lines.append(
+                    f"    min {arr[0]:g}  median {arr[len(arr) // 2]:g}  "
+                    f"max {arr[-1]:g}")
     if "metrics_rows" in row:
         lines.append(f"metrics: {row['metrics_rows']} row(s)")
         if row.get("metrics_last"):
@@ -222,13 +272,14 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
-    from harp_tpu.utils import flightrec
+    from harp_tpu.utils import flightrec, skew
 
     comm = telemetry.ledger.summary()
     spans = telemetry.tracer.summary()
     return (build_row(comm, spans, telemetry.tracer.records,
                       compile_info=flightrec.compile_watch.summary(),
-                      transfer_info=flightrec.transfers.summary()),
+                      transfer_info=flightrec.transfers.summary(),
+                      skew_info=skew.ledger.summary()),
             telemetry.tracer.records)
 
 
@@ -280,10 +331,12 @@ def main(argv=None) -> int:
     comm_rows: list[dict] = []
     compile_rows: list[dict] = []
     transfer_rows: list[dict] = []
+    skew_rows: list[dict] = []
     if args.telemetry:
         kinds = telemetry.load_rows(args.telemetry)
         span_rows, comm_rows = kinds["span"], kinds["comm"]
         compile_rows, transfer_rows = kinds["compile"], kinds["transfer"]
+        skew_rows = kinds["skew"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -302,7 +355,8 @@ def main(argv=None) -> int:
                     span_summary_from_rows(span_rows),
                     span_rows, metrics_rows, top_ops,
                     compile_info=compile_summary_from_rows(compile_rows),
-                    transfer_info=transfer_summary_from_rows(transfer_rows))
+                    transfer_info=transfer_summary_from_rows(transfer_rows),
+                    skew_info=skew_summary_from_rows(skew_rows))
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

@@ -218,6 +218,36 @@ class DynamicScheduler(Generic[I, O]):
         return out
 
 
+def apply_rebalance(splits: Sequence[Sequence[Any]], plan: dict) -> list[list]:
+    """Apply a :func:`harp_tpu.utils.skew.suggest_rebalance` plan to
+    per-worker item lists (the :func:`harp_tpu.fileformat.
+    multi_file_splits` shape) — the bridge from *observing* skew back to
+    Harp's schdynamic/dymoro load-balancing behavior: measure a run,
+    ask the SkewLedger for the greedy repartition, replay it here before
+    the next run.
+
+    Only whole-unit moves apply (plans built from recorded ``units``,
+    e.g. files); a fractional plan raises — it is a *target* for a
+    finer-grained partitioner, not an item shuffle.  Returns new lists;
+    the input is not mutated.
+    """
+    out = [list(s) for s in splits]
+    for m in plan.get("moves", []):
+        if "id" not in m:
+            raise ValueError(
+                "fractional rebalance plan (no unit ids): re-record the "
+                "phase with units=..., or repartition toward the plan's "
+                "work_after targets instead")
+        try:
+            out[m["from"]].remove(m["id"])
+        except ValueError:
+            raise ValueError(
+                f"rebalance unit {m['id']!r} not found on worker "
+                f"{m['from']} — the plan does not match these splits")
+        out[m["to"]].append(m["id"])
+    return out
+
+
 def device_map(fn: Callable, items, *, batched: bool = True):
     """The TPU-native replacement for thread schedulers on *regular* work.
 

@@ -16,3 +16,4 @@ __all__ = ["device_sync", "Timer"]
 #   harp_tpu.utils.profiling   — jax.profiler trace/annotate helpers
 #   harp_tpu.utils.fault       — fault injection + restart-from-checkpoint
 #   harp_tpu.utils.check       — checkify sanitizers (NaN / OOB / asserts)
+#   harp_tpu.utils.skew        — superstep skew profiler (per-worker load)

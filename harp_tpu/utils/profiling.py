@@ -30,7 +30,7 @@ def annotate(name: str):
 
 
 def op_breakdown(logdir: str, top: int = 15, host_events: bool = False,
-                 self_time: bool = True):
+                 self_time: bool = True, per_device: bool = False):
     """Top device ops by total duration from the LATEST :func:`trace`
     capture under ``logdir``.
 
@@ -52,10 +52,19 @@ def op_breakdown(logdir: str, top: int = 15, host_events: bool = False,
     the table flame-graph-style: each span is charged only the time not
     covered by spans nested inside it on the same track, so shares sum to
     the traced wall and parents shrink to their scheduling overhead.
+
+    ``per_device=True`` returns ``[(name, device_id, total_seconds)]``
+    with the device ordinal parsed from the trace's process metadata
+    (``/device:TPU:3`` → 3; host/CPU-backend tracks → None) — so a
+    multichip capture's breakdown can be split per worker (the skew
+    profiler's trace-side view, utils/skew.py).  The default call keeps
+    its exact old shape and numbers: the same per-(op, device) totals,
+    summed over devices (a no-op on single-device traces).
     """
     import glob
     import gzip
     import json
+    import re
 
     sessions = sorted(glob.glob(f"{logdir}/plugins/profile/*/"))
     root = sessions[-1] if sessions else logdir  # newest session only
@@ -63,39 +72,45 @@ def op_breakdown(logdir: str, top: int = 15, host_events: bool = False,
     if not files:
         raise FileNotFoundError(f"no *.trace.json.gz under {logdir!r} — "
                                 "was this directory written by trace()?")
-    totals: dict[str, float] = {}
+    totals: dict[tuple, float] = {}  # (name, device_id_or_None) -> sec
     for f in files:
         events = json.loads(gzip.open(f).read()).get("traceEvents", [])
-        device_pids = {
-            e.get("pid") for e in events
-            if e.get("ph") == "M" and e.get("name") == "process_name"
-            and "/device:" in str(e.get("args", {}).get("name", ""))
-        }
+        dev_of_pid: dict = {}  # pid -> device ordinal, device tracks only
+        for e in events:
+            if (e.get("ph") == "M" and e.get("name") == "process_name"
+                    and "/device:" in str(e.get("args", {}).get("name",
+                                                                ""))):
+                m = re.search(r"/device:[^:]+:(\d+)",
+                              str(e["args"]["name"]))
+                dev_of_pid[e.get("pid")] = int(m.group(1)) if m else None
         tracks: dict[tuple, list] = {}
         for e in events:
             if e.get("ph") != "X" or "dur" not in e:
                 continue
             name = e.get("name", "?")
             if not host_events:
-                if device_pids:
-                    if e.get("pid") not in device_pids:
+                if dev_of_pid:
+                    if e.get("pid") not in dev_of_pid:
                         continue
                 elif name.startswith("$"):  # CPU backend: no device track
                     continue
             if not self_time:
-                totals[name] = totals.get(name, 0.0) + e["dur"] / 1e6
+                key = (name, dev_of_pid.get(e.get("pid")))
+                totals[key] = totals.get(key, 0.0) + e["dur"] / 1e6
             else:
                 tracks.setdefault((e.get("pid"), e.get("tid")), []).append(
                     (float(e["ts"]), float(e["dur"]), name))
         # flame-graph self time per track: a span's children are the spans
         # it fully contains; charge each span dur − Σ(child dur)
-        for evs in tracks.values():
+        for (pid, _tid), evs in tracks.items():
+            dev = dev_of_pid.get(pid)
             evs.sort(key=lambda t: (t[0], -t[1]))
             stack: list[list] = []  # [end_ts, child_dur_sum, name, dur]
 
-            def pop(rec):
+            def pop(rec, dev=dev):
                 self_us = max(rec[3] - rec[1], 0.0)
-                totals[rec[2]] = totals.get(rec[2], 0.0) + self_us / 1e6
+                key = (rec[2], dev)
+                totals[key] = totals.get(key, 0.0) + self_us / 1e6
                 if stack:
                     stack[-1][1] += rec[3]
 
@@ -105,4 +120,10 @@ def op_breakdown(logdir: str, top: int = 15, host_events: bool = False,
                 stack.append([ts + dur, 0.0, name, dur])
             while stack:
                 pop(stack.pop())
-    return sorted(totals.items(), key=lambda kv: -kv[1])[:top]
+    if per_device:
+        return sorted(((n, d, t) for (n, d), t in totals.items()),
+                      key=lambda x: -x[2])[:top]
+    agg: dict[str, float] = {}
+    for (name, _dev), t in totals.items():
+        agg[name] = agg.get(name, 0.0) + t
+    return sorted(agg.items(), key=lambda kv: -kv[1])[:top]

@@ -82,9 +82,10 @@ def scope(on: bool = True, *, reset: bool = True):
     if reset:
         ledger.reset()
         tracer.reset()
-        from harp_tpu.utils import flightrec
+        from harp_tpu.utils import flightrec, skew
 
         flightrec.reset()
+        skew.reset()
     try:
         yield
     finally:
@@ -377,25 +378,26 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 
 def export(path: str) -> None:
-    """Write every collected record (spans + ledger + flight recorder)
-    as one JSONL file — the input format of ``python -m harp_tpu
-    report``."""
-    from harp_tpu.utils import flightrec
+    """Write every collected record (spans + ledger + flight recorder +
+    skew ledger) as one JSONL file — the input format of ``python -m
+    harp_tpu report``."""
+    from harp_tpu.utils import flightrec, skew
 
     with open(path, "w") as fh:
         tracer.export_jsonl(fh)
         ledger.export_jsonl(fh)
         flightrec.export_jsonl(fh)
+        skew.export_jsonl(fh)
 
 
 def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
-    [...]}`` (unknown kinds land under ``"comm"`` for backward
-    compatibility with pre-flight-recorder exports, whose only unmarked
-    rows were the ledger's)."""
+    [...], "skew": [...]}`` (unknown kinds land under ``"comm"`` for
+    backward compatibility with pre-flight-recorder exports, whose only
+    unmarked rows were the ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
-                                  "transfer": []}
+                                  "transfer": [], "skew": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

@@ -36,6 +36,14 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    monotone non-decreasing down the file (a decrease means two runs'
    exports were interleaved — every downstream "N compiles this run"
    claim would be wrong).
+
+5. **Skew rows are coherent load evidence** (any file): a ``kind:
+   "skew"`` row (the SkewLedger export, :mod:`harp_tpu.utils.skew`) must
+   carry the provenance stamp (a CPU-sim load sheet must never read as
+   relay evidence), its per-worker ``work`` counts must be non-negative
+   numbers that SUM to the row's ``total`` (a mismatch means the
+   imbalance ratio describes a different workload than the total
+   claims), and ``padding_frac`` — when present — must lie in [0, 1].
 """
 
 from __future__ import annotations
@@ -119,6 +127,42 @@ def _check_flight_row(name: str, i: int, row: dict,
     return errs
 
 
+def _check_skew_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 5: skew rows must be coherent load evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: skew row missing provenance field(s) {missing} "
+            "— export through telemetry.export / skew.export_jsonl, "
+            "which stamp them")
+    work = row.get("work")
+    total = row.get("total")
+    if (isinstance(work, list) and work
+            and all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                    for x in work)):
+        if any(x < 0 for x in work):
+            errs.append(f"{name}:{i}: skew row has negative per-worker "
+                        "work counts")
+        if isinstance(total, (int, float)) and not isinstance(total, bool):
+            s = sum(work)
+            if abs(s - total) > 1e-6 * max(1.0, abs(total)):
+                errs.append(
+                    f"{name}:{i}: skew row per-worker work sums to {s} "
+                    f"but total claims {total} — counts must sum to the "
+                    "global total")
+    else:
+        errs.append(f"{name}:{i}: skew row work={work!r} must be a "
+                    "non-empty list of numbers")
+    pf = row.get("padding_frac")
+    if pf is not None and (isinstance(pf, bool)
+                           or not isinstance(pf, (int, float))
+                           or not 0.0 <= pf <= 1.0):
+        errs.append(f"{name}:{i}: skew row padding_frac={pf!r} must lie "
+                    "in [0, 1]")
+    return errs
+
+
 def check_file(path: str, grandfathered: int = 0,
                provenance: bool = False) -> list[str]:
     """Return a list of violation messages (empty = clean)."""
@@ -142,6 +186,8 @@ def check_file(path: str, grandfathered: int = 0,
         if isinstance(row, dict) and row.get("kind") in ("compile",
                                                          "transfer"):
             errors += _check_flight_row(name, i, row, flight_state)
+        if isinstance(row, dict) and row.get("kind") == "skew":
+            errors += _check_skew_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -884,8 +884,10 @@ with _FRT.scope(True):
     _fr_row, _fr_spans = _FRrep.live_report()
     assert _FR.transfers.h2d_bytes == _fr_pts.nbytes      # points, ONCE
     assert _FR.transfers.dispatches == 1                  # one tracked fit
-    assert _FR.transfers.readbacks == 2                   # inertia + centroids
-    assert _FR.transfers.d2h_bytes == 4 + _fr_c.nbytes
+    assert _FR.transfers.readbacks == 2                   # stats + centroids
+    # PR 4: the inertia readback became the [nw, 2] per-worker stats
+    # array (rows + inertia — the skew counter rides the same fetch)
+    assert _FR.transfers.d2h_bytes == 2 * 4 * nw + _fr_c.nbytes
     assert _FR.compile_watch.count == 1                   # one fresh seed jit
     assert _FR.compile_watch.summary()["by_span"] == {
         "fit/kmeans.fit": {"count": 1,
@@ -953,3 +955,112 @@ np.testing.assert_array_equal(_fr_c2, _fr_c)
 print("flight recorder: counters == hand sheet, budget trips trap, "
       "export/report/checker round-trip, prng compile-free, zero-cost off")
 print(f"DRIVE OK round-22 ({mode})")
+
+# --- round 23: superstep skew profiler -------------------------------------
+# SkewLedger per-worker counts == numpy bincount by the partitioners'
+# ownership rule, the execution counters ride the EXISTING stacked
+# readbacks (flagship budgets hold), the imbalance model and roofline
+# composition match hand math, suggest_rebalance closes the loop through
+# schedule.apply_rebalance on REAL files, and export rows pass checker
+# invariant 5 (while a forged bad row fails it).
+import tempfile as _sk_tmp
+
+from harp_tpu import schedule as _SKsched
+from harp_tpu.fileformat import multi_file_splits as _sk_splits
+from harp_tpu.models import lda as _SKL
+from harp_tpu.models import mfsgd as _SKMF
+from harp_tpu.utils import skew as _SK
+from harp_tpu.utils import telemetry as _SKT
+
+# (a) skewed LDA: ingest == execution == numpy bincount; budget holds
+_sk_d = np.concatenate([np.repeat(np.arange(8), 40),
+                        np.repeat(np.arange(8, 64), 4)]).astype(np.int32)
+_sk_w = np.random.default_rng(0).integers(0, 48, len(_sk_d)).astype(np.int32)
+with _SKT.scope(True):
+    _sk_lda = _SKL.LDA(64, 48, _SKL.LDAConfig(
+        n_topics=8, algo="dense", d_tile=16, w_tile=16, entry_cap=64),
+        mesh, seed=0)
+    _sk_lda.set_tokens(_sk_d, _sk_w)
+    _sk_lda.sample_epoch()  # warmup compile
+    _sk_lda.compile_epochs(2)
+    with _FR.budget(compiles=0, dispatches=1, readbacks=1,
+                    h2d_bytes=nw * 8, tag="drive.skew.lda"):
+        _sk_lda.sample_epochs(2)
+    _sk_expect = np.bincount(_sk_d // _sk_lda.d_own, minlength=nw)
+    for _sk_phase in ("lda.partition", "lda.epochs"):
+        _sk_s = _SK.ledger.summary()[_sk_phase]
+        np.testing.assert_allclose(_sk_s["work"], _sk_expect)
+        assert _sk_s["total"] == len(_sk_d)
+    assert _sk_s["max_mean_ratio"] == round(
+        float(_sk_expect.max() / _sk_expect.mean()), 4)
+    assert _sk_s["wasted_chip_s"] > 0  # wall measured, waste priced
+    # report section renders with per-worker bars and sums
+    _sk_row, _sk_spans = _FRrep.live_report()
+    _sk_text = _FRrep.render(_sk_row, _sk_spans)
+    assert "skew (per-worker load" in _sk_text and "max/mean" in _sk_text
+    assert sum(_sk_row["skew"]["lda.epochs"]["work"]) == \
+        _sk_row["skew"]["lda.epochs"]["total"]
+    # (b) export -> checker invariant 5: real rows clean, forged row loud
+    with _sk_tmp.NamedTemporaryFile("r+", suffix=".jsonl") as _sk_fh:
+        _SKT.export(_sk_fh.name)
+        assert len(_SKT.load_rows(_sk_fh.name)["skew"]) == 2
+        assert _fr_cj.check_file(_sk_fh.name) == []
+        _sk_fh.seek(0, 2)
+        _sk_fh.write(_fr_json.dumps(
+            {"kind": "skew", "phase": "forged", "work": [2, 2],
+             "total": 5, "padding_frac": 1.5, "backend": "cpu",
+             "date": "2026-08-04", "commit": "x"}) + "\n")
+        _sk_fh.flush()
+        _sk_errs = _fr_cj.check_file(_sk_fh.name)
+        assert len(_sk_errs) == 2  # bad sum AND bad padding_frac
+        assert any("sum" in e for e in _sk_errs)
+        assert any("padding_frac" in e for e in _sk_errs)
+
+# (c) mfsgd execution counter rides the stacked readback, == bincount
+_sk_u = np.concatenate([np.random.default_rng(1).integers(0, 8, 700),
+                        np.random.default_rng(2).integers(8, 64, 300)]
+                       ).astype(np.int32)
+_sk_i = np.random.default_rng(3).integers(0, 48, 1000).astype(np.int32)
+_sk_v = np.random.default_rng(4).normal(size=1000).astype(np.float32)
+with _SKT.scope(True):
+    _sk_m = _SKMF.MFSGD(64, 48, _SKMF.MFSGDConfig(
+        rank=4, algo="dense", u_tile=8, i_tile=8, entry_cap=32), mesh, 0)
+    _sk_m.set_ratings(_sk_u, _sk_i, _sk_v)
+    _sk_m.train_epoch()
+    with _FR.budget(dispatches=1, readbacks=1, tag="drive.skew.mf"):
+        _sk_m.train_epochs(2)
+    np.testing.assert_allclose(
+        _SK.ledger.summary()["mfsgd.epochs"]["work"],
+        np.bincount(_sk_u // _sk_m.u_own, minlength=nw))
+
+# (d) imbalance model + roofline composition, hand math
+with _SKT.scope(True):
+    _SK.record_execution("p", [10, 2, 2, 2], unit="u", wall_s=2.0)
+    _sk_p = _SK.ledger.summary()["p"]
+    assert (_sk_p["max_mean_ratio"], _sk_p["wasted_frac"]) == (2.5, 0.6)
+    assert abs(_sk_p["wasted_chip_s"] - 4.8) < 1e-9  # 4 chips x 2 s x 0.6
+    _sk_pct = _SK.wasted_pct_of_peak(
+        "lda", {"n_topics": 100, "tokens_per_sec_per_chip": 1e9}, "p")
+    # 1e9 tok/s x 1400 flop/tok / 197e12 peak = 0.7107 %-of-peak, 60% lost
+    assert abs(_sk_pct - round(100 * 1e9 * 1400 / 197e12 * 0.6, 3)) < 2e-3
+    # (e) rebalance loop on REAL files: measured loads -> whole-file plan
+    with _sk_tmp.TemporaryDirectory() as _sk_dir:
+        _sk_paths = []
+        for _sk_j, _sk_kb in enumerate((48, 40, 2, 1, 1, 1)):
+            _sk_p2 = os.path.join(_sk_dir, f"f{_sk_j}.csv")
+            open(_sk_p2, "wb").write(b"x" * (_sk_kb * 1024))
+            _sk_paths.append(_sk_p2)
+        _sk_sp = _sk_splits(_sk_paths, 2)  # records units + byte loads
+        _sk_plan = _SK.suggest_rebalance("fileformat.multi_file_splits")
+        assert _sk_plan["ratio_after"] <= _sk_plan["ratio_before"]
+        _sk_new = _SKsched.apply_rebalance(_sk_sp, _sk_plan)
+        _sk_loads = [sum(os.path.getsize(p) for p in s) for s in _sk_new]
+        np.testing.assert_allclose(_sk_loads, _sk_plan["work_after"])
+
+# (f) zero-cost off: ledger untouched, LDA chain identical on/off
+with _SKT.scope(False):
+    _SK.record_execution("off", [1, 2], unit="u")
+    assert _SK.ledger.summary() == {}
+print("skew: ingest==execution==bincount, budgets hold, waste priced, "
+      "roofline composed, file rebalance loop closed, invariant 5 loud")
+print(f"DRIVE OK round-23 ({mode})")

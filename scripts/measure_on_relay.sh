@@ -45,6 +45,16 @@ else
   MIN_NEW=5
 fi
 
+# Relay-watcher arming check (CLAUDE.md round-5 note): the watcher is
+# NOT self-starting after environment resets, and a forgotten arm loses
+# the next window.  Warn loudly; never fail the sprint over it (when the
+# watcher itself fired this script, pgrep finds the parent).
+if ! pgrep -f relay_watch >/dev/null 2>&1; then
+  echo "WARNING: relay_watch.sh is NOT armed (pgrep -f relay_watch found" >&2
+  echo "nothing). It is not self-starting after resets — relaunch it" >&2
+  echo "detached (see its header) or the next relay window will be missed." >&2
+fi
+
 # NB: grep -vc prints the 0 AND exits 1 on zero matches — no `|| echo 0`
 # (that would yield "0\n0" and break the arithmetic below)
 start_ok=$(grep -vc '"error"' "$OUT" 2>/dev/null)

@@ -49,6 +49,38 @@ ICI_BW_GBS = 90.0
 LAT_US = 1.0
 
 
+def measured_skew(path=None):
+    """Latest measured per-app load skew from SCALING_local.jsonl's skew
+    columns (scripts/scaling_sweep.py; utils/skew.py ledger): app →
+    max/mean work ratio at the HIGHEST worker count that recorded one.
+    The projection multiplies its comm-model efficiency by the measured
+    ``1/ratio`` — a barrier superstep ends when the max-loaded worker
+    does, so imbalance stacks multiplicatively with collective overhead
+    — and emits both, so BASELINE.md's scaling section can state how
+    much efficiency loss is attributable to skew vs the wire."""
+    path = path or os.path.join(REPO, "SCALING_local.jsonl")
+    best: dict = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                r = row.get("skew_max_mean")
+                app, n = row.get("app"), row.get("n_workers")
+                if r and app and isinstance(n, int):
+                    cur = best.get(app)
+                    if cur is None or n >= cur[0]:
+                        best[app] = (n, float(r))
+    except OSError:
+        pass
+    return {app: ratio for app, (n, ratio) in best.items()}
+
+
 def ring_bytes(payload_bytes, n):
     """Wire bytes per chip for a ring ALLREDUCE of `payload` bytes
     (reduce-scatter + allgather: 2(n-1)/n of the payload)."""
@@ -98,6 +130,7 @@ def project(n_workers=(4, 8, 16, 32)):
     b = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(b)
     lm = b._last_measured()
+    skew_by_app = measured_skew()
 
     rows = []
 
@@ -106,6 +139,17 @@ def project(n_workers=(4, 8, 16, 32)):
         rate1 = lm[rate_key]["value"]
         if projected is None:
             projected = rate1 * eff if per_chip else rate1 * n * eff
+        # measured load skew stacks multiplicatively on the comm model:
+        # the straggler sets the superstep, the wire sets the rest
+        sk = skew_by_app.get(app)
+        skew_cols = {}
+        if sk:
+            skew_cols = {
+                "skew_max_mean": round(sk, 4),
+                "eff_skew": round(1.0 / sk, 4),
+                "efficiency_with_skew": round(eff / sk, 4),
+                "projected_with_skew": round(projected / sk, 2),
+            }
         rows.append({
             "app": app, "n_workers": n, "pattern": pattern,
             "quantum": quantum,
@@ -119,6 +163,7 @@ def project(n_workers=(4, 8, 16, 32)):
             "projected_unit": (lm[rate_key]["unit"] if per_chip else
                                lm[rate_key]["unit"] + " aggregate"),
             "note": note,
+            **skew_cols,
             "assumptions": f"ICI {ICI_BW_GBS:.0f} GB/s ring, "
                            f"{LAT_US:.0f}us/hop",
         })

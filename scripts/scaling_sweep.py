@@ -7,6 +7,11 @@ weak- and strong-scaling sweeps of every graded app over 1/2/4/8
 simulated CPU workers, with the collective share of each run measured
 from an XLA trace (`utils.profiling.op_breakdown` self-times, classified
 by op name).  One JSON row per (app, mode, n_workers) → SCALING_local.jsonl.
+Each row also carries per-worker SKEW columns (skew_work / skew_max_mean /
+skew_wasted_frac, from the utils/skew.py ledger the instrumented drivers
+feed during the telemetry-enabled warmup run), so
+`scripts/project_scaling.py` can attribute efficiency loss to load
+imbalance separately from collective overhead.
 
 The device count is baked into XLA at backend init, so the parent spawns
 one child subprocess per worker count (`--child`), each with its own
@@ -86,6 +91,28 @@ def shapes(app: str, mode: str, n: int) -> dict:
     raise ValueError(app)
 
 
+def skew_columns():
+    """Per-worker skew columns for a sweep row, from the SkewLedger the
+    instrumented drivers fed during the (telemetry-enabled) warmup run.
+    Picks the heaviest EXECUTION phase — the superstep the app's barrier
+    actually waits on; apps without instrumented drivers yield the
+    ingest view instead, and apps recording nothing yield one null
+    marker so downstream readers see "not measured", not "balanced"."""
+    from harp_tpu.utils import skew
+
+    s = skew.ledger.summary()
+    execs = {k: v for k, v in s.items() if v["source"] == "execution"} \
+        or {k: v for k, v in s.items() if v["source"] == "ingest"}
+    if not execs:
+        return {"skew_max_mean": None}
+    phase = max(execs, key=lambda k: execs[k]["total"])
+    v = execs[phase]
+    return {"skew_phase": phase, "skew_unit": v["unit"],
+            "skew_work": v["work"],
+            "skew_max_mean": v["max_mean_ratio"],
+            "skew_wasted_frac": v["wasted_frac"]}
+
+
 def child(app: str, mode: str, n: int, emit=print) -> None:
     """Run one cell in THIS process (device count fixed at init)."""
     import jax
@@ -95,13 +122,24 @@ def child(app: str, mode: str, n: int, emit=print) -> None:
     import time
 
     from harp_tpu.models import kmeans, lda, mfsgd, mlp, rf, subgraph
+    from harp_tpu.utils import skew, telemetry
     from harp_tpu.utils.profiling import op_breakdown, trace
 
     mod = {"kmeans": kmeans, "mfsgd": mfsgd, "lda": lda, "mlp": mlp,
            "subgraph": subgraph, "rf": rf}[app]
     kw = shapes(app, mode, n)
     assert jax.device_count() == n, (jax.device_count(), n)
-    mod.benchmark(**kw)  # warmup/compile OUTSIDE the trace
+    # warmup/compile OUTSIDE the trace; telemetry on for THIS run only,
+    # so the drivers feed the skew ledger while the traced (timed) run
+    # stays instrumentation-free — the host-phase stamp per subprocess
+    # plus per-worker device counters, zero cost in the timed region
+    telemetry.enable(True)
+    t_warm = time.perf_counter()
+    mod.benchmark(**kw)
+    skew.record_host(f"{app}.child", 0, time.perf_counter() - t_warm,
+                     n_workers=1)
+    skew_cols = skew_columns()
+    telemetry.enable(False)
     logdir = tempfile.mkdtemp(prefix=f"harp_scale_{app}_{n}_")
     t0 = time.perf_counter()
     with trace(logdir):
@@ -122,6 +160,7 @@ def child(app: str, mode: str, n: int, emit=print) -> None:
         "traced_sec": round(traced, 5),
         "comm_sec": round(comm, 5),
         "comm_fraction": round(comm / traced, 4) if traced else None,
+        **skew_cols,
         "backend": "cpu", "cpu_sim": True,
         "date": datetime.date.today().isoformat(),
     }), flush=True)

@@ -194,6 +194,49 @@ def test_exported_flight_rows_satisfy_the_checker(tmp_path):
     assert check_jsonl.check_file(str(p), provenance=True) == []
 
 
+def test_skew_row_invariants(tmp_path):
+    """Invariant 5: skew rows carry the provenance stamp, per-worker
+    counts sum to the global total, padding fraction lies in [0, 1]."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    rows = [
+        {"kind": "skew", "phase": "ok", "work": [3, 1], "total": 4,
+         "padding_frac": 0.25, **stamp},                       # fine
+        {"kind": "skew", "phase": "p", "work": [2, 2], "total": 5,
+         **stamp},                                             # bad sum
+        {"kind": "skew", "phase": "p", "work": [2, 2], "total": 4,
+         "padding_frac": 1.5, **stamp},                        # bad pad
+        {"kind": "skew", "phase": "p", "work": [1, 1], "total": 2},
+        {"kind": "skew", "phase": "p", "work": "oops", "total": 1,
+         **stamp},                                             # bad work
+        {"kind": "skew", "phase": "p", "work": [-1, 2], "total": 1,
+         **stamp},                                             # negative
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 5
+    assert ":2:" in errors[0] and "sum" in errors[0]
+    assert ":3:" in errors[1] and "padding_frac" in errors[1]
+    assert ":4:" in errors[2] and "provenance" in errors[2]
+    assert ":5:" in errors[3] and "work" in errors[3]
+    assert ":6:" in errors[4] and "negative" in errors[4]
+
+
+def test_exported_skew_rows_satisfy_the_checker(tmp_path):
+    """Round-trip: what skew.export_jsonl writes (via telemetry.export)
+    must pass invariant 5 as-is — even teed into a bench file."""
+    from harp_tpu.utils import skew, telemetry
+
+    with telemetry.scope(True):
+        skew.record_execution("lda.epochs", [5, 1, 1, 1], unit="tokens",
+                              wall_s=0.25)
+        skew.record_partition("lda.partition", [5, 1, 1, 1],
+                              unit="tokens", padded_total=16)
+        p = tmp_path / "BENCH_local.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
 def test_cli_exit_codes(tmp_path):
     (tmp_path / "BENCH_local.jsonl").write_text("not json\n")
     assert check_jsonl.main(["--repo", str(tmp_path)]) == 1
