@@ -33,6 +33,8 @@ APPS = {
     "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
     "report": ("harp_tpu.report",
                "merged run report: comm ledger + spans + metrics + top ops"),
+    "lint": ("harp_tpu.analysis.cli",
+             "harplint: static relay-burner analysis (AST + jaxpr + Mosaic)"),
 }
 
 

@@ -1,0 +1,234 @@
+"""``python -m harp_tpu lint`` — the harplint front door.
+
+Runs the three analysis layers (AST lints / jaxpr detectors / Mosaic
+kernel audit), applies the committed allowlist, prints a human report
+plus ONE provenance-stamped machine line (``kind: "lint"``, printed
+through :func:`harp_tpu.utils.metrics.benchmark_json` so it carries the
+same backend/date/commit stamp as every bench row —
+``scripts/check_jsonl.py`` invariant 6 validates the shape), and exits
+non-zero when any unallowlisted violation remains.
+
+Fixture mode for tests / pre-commit checks of a single file:
+
+- positional ``paths`` restrict the AST layer to those files;
+- ``--audit-module FILE`` imports a Python file and sweeps its
+  ``HARPLINT_DRIVERS`` (jaxpr layer) / ``HARPLINT_KERNELS`` (Mosaic
+  layer) dicts — the hook the seeded-fixture tests drive the traced
+  layers through.
+
+Either option skips the repo-wide default sweeps, so the exit code
+reflects only the requested targets.
+
+The jax-touching layers force the CPU backend (8 simulated workers)
+before first backend use — the axon site config pins ``JAX_PLATFORMS``
+to the TPU relay, and a *linter* must never touch (or hang on) the
+relay; see CLAUDE.md "Environment gotchas".
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+from harp_tpu.analysis import RULES, Violation, rule_ids
+from harp_tpu.analysis import allowlist as allowlist_mod
+from harp_tpu.analysis.astlints import iter_python_files, lint_paths
+from harp_tpu.analysis.jaxpr_checks import (DEFAULT_CONST_BYTES,
+                                            analyze_program)
+
+
+def repo_root() -> str:
+    import harp_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        harp_tpu.__file__)))
+
+
+def _force_cpu_backend() -> None:
+    """CPU, 8 simulated workers — BEFORE first backend use (no-op when a
+    harness like tests/conftest.py already initialized the backend)."""
+    import jax
+
+    try:
+        if "--xla_force_host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                       + " --xla_force_host_platform_"
+                                         "device_count=8")
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - backend already initialized
+        pass
+
+
+def _load_audit_module(path: str):
+    import importlib.util
+
+    name = f"_harplint_fixture_{os.path.basename(path).removesuffix('.py')}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_jaxpr_layer(builders: dict, threshold: int) -> list[Violation]:
+    out: list[Violation] = []
+    for name in sorted(builders):
+        target = f"driver:{name}"
+        try:
+            fn, args = builders[name]()
+        except Exception as e:  # noqa: BLE001 - a broken builder is loud
+            out.append(Violation("HL101", target, 0,
+                                 f"driver builder failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(analyze_program(fn, args, target, threshold))
+    return out
+
+
+def run_mosaic_layer(builders: dict | None) -> list[Violation]:
+    from harp_tpu.analysis.mosaic_audit import audit_kernel, audit_registry
+
+    if builders is None:
+        return audit_registry()
+    out: list[Violation] = []
+    for name in sorted(builders):
+        try:
+            fn, args = builders[name]()
+        except Exception as e:  # noqa: BLE001
+            out.append(Violation("HL201", f"kernel:{name}", 0,
+                                 f"kernel builder failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(audit_kernel(name, fn, args))
+    return out
+
+
+def render(kept: list[Violation], suppressed: list[Violation],
+           stale: list[dict], scanned: int) -> str:
+    lines = ["== harplint report =="]
+    by_rule: dict[str, list[Violation]] = {}
+    for v in kept:
+        by_rule.setdefault(v.rule, []).append(v)
+    for rid in sorted(by_rule):
+        rule = RULES.get(rid)
+        title = rule.title if rule else "(unregistered rule)"
+        lines.append(f"{rid} {title} — {len(by_rule[rid])} violation(s)")
+        for v in by_rule[rid]:
+            lines.append("  " + v.format().replace("\n", "\n  "))
+    lines.append(f"{scanned} file(s) scanned; {len(kept)} violation(s), "
+                 f"{len(suppressed)} allowlisted")
+    for e in stale:
+        lines.append(f"STALE allowlist entry: {e['rule']} {e['path']} "
+                     f"({e['reason']}) matched nothing — remove it")
+    lines.append("harplint: " + ("FAILED" if kept else "clean"))
+    return "\n".join(lines)
+
+
+def build_row(kept, suppressed, stale, scanned) -> dict:
+    per_rule = Counter(v.rule for v in kept)
+    per_file = Counter(v.path for v in kept)
+    return {
+        "kind": "lint",
+        "rules": rule_ids(),
+        "files_scanned": scanned,
+        "violations": len(kept),
+        "allowlisted": len(suppressed),
+        "stale_allowlist": len(stale),
+        "per_rule": dict(sorted(per_rule.items())),
+        "per_file": dict(sorted(per_file.items())),
+        "clean": not kept,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m harp_tpu lint",
+        description="static relay-burner analysis (AST lints + jaxpr "
+                    "detectors + Mosaic kernel audit)")
+    p.add_argument("paths", nargs="*",
+                   help="restrict the AST layer to these files "
+                        "(repo-relative or absolute); skips the default "
+                        "repo-wide sweeps")
+    p.add_argument("--layer", choices=("ast", "jaxpr", "mosaic", "all"),
+                   default="all")
+    p.add_argument("--json", action="store_true",
+                   help="print only the machine-readable line")
+    p.add_argument("--audit-module", action="append", default=[],
+                   metavar="FILE",
+                   help="sweep FILE's HARPLINT_DRIVERS / HARPLINT_KERNELS "
+                        "instead of the repo registries (fixture mode)")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist TOML (default: analysis/allowlist.toml)")
+    p.add_argument("--no-allowlist", action="store_true")
+    p.add_argument("--const-threshold-mb", type=float, default=None,
+                   help="HL102 closed-over-constant threshold (default "
+                        f"{DEFAULT_CONST_BYTES >> 20} MiB)")
+    args = p.parse_args(argv)
+
+    repo = repo_root()
+    # unconditional: even an AST-only run prints a provenance-stamped
+    # line (jax.default_backend()), which must never touch the relay
+    _force_cpu_backend()
+    fixture_mode = bool(args.paths or args.audit_module)
+    threshold = (int(args.const_threshold_mb * (1 << 20))
+                 if args.const_threshold_mb is not None
+                 else DEFAULT_CONST_BYTES)
+
+    violations: list[Violation] = []
+    scanned = 0
+
+    if args.layer in ("ast", "all"):
+        if args.paths:
+            rels = [os.path.relpath(os.path.abspath(x), repo)
+                    .replace(os.sep, "/") for x in args.paths]
+            violations += lint_paths(repo, rels)
+            scanned += len(rels)
+        elif not fixture_mode:
+            rels = list(iter_python_files(repo))
+            violations += lint_paths(repo, rels)
+            scanned += len(rels)
+
+    fixture_drivers: dict = {}
+    fixture_kernels: dict = {}
+    for mod_path in args.audit_module:
+        mod = _load_audit_module(mod_path)
+        fixture_drivers.update(getattr(mod, "HARPLINT_DRIVERS", {}))
+        fixture_kernels.update(getattr(mod, "HARPLINT_KERNELS", {}))
+
+    if args.layer in ("jaxpr", "all"):
+        if fixture_mode:
+            if fixture_drivers:
+                violations += run_jaxpr_layer(fixture_drivers, threshold)
+        else:
+            _force_cpu_backend()
+            from harp_tpu.analysis.drivers import DRIVERS
+
+            violations += run_jaxpr_layer(DRIVERS, threshold)
+
+    if args.layer in ("mosaic", "all"):
+        if fixture_mode:
+            if fixture_kernels:
+                violations += run_mosaic_layer(fixture_kernels)
+        else:
+            _force_cpu_backend()
+            violations += run_mosaic_layer(None)
+
+    entries = [] if args.no_allowlist else allowlist_mod.load(args.allowlist)
+    kept, suppressed, stale = allowlist_mod.apply(violations, entries)
+    # stale entries only mean something on a full repo run
+    if fixture_mode:
+        stale = []
+
+    row = build_row(kept, suppressed, stale, scanned)
+    from harp_tpu.utils.metrics import benchmark_json
+
+    if not args.json:
+        print(render(kept, suppressed, stale, scanned))
+    print(benchmark_json("lint", row), flush=True)
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Registered driver programs for the Layer-2 jaxpr sweep.
+
+The copy-trap / literal detectors (:mod:`harp_tpu.analysis.jaxpr_checks`)
+need *traced programs* to walk.  This registry builds the flagship driver
+programs at small proven shapes on the active (CPU-forced) backend —
+mirroring how the lowering tests pin them — so ``python -m harp_tpu
+lint`` sweeps real epoch programs, not just synthetic fixtures:
+
+- ``kmeans.fit`` — the full T-iteration Lloyd program (fori_loop body:
+  the dense one-hot pattern, no gathers);
+- ``ring_attention`` — the rotate-scan K/V pipeline (a scan that carries
+  and *reads* buffers every step: the structural cousin of the LDA trap
+  that must stay clean);
+- ``mfsgd.epoch`` — the rotation epoch with dynamic_update_slice'd
+  factor tables: the closest in-tree relative of the pre-fix LDA
+  copy-trap, pinned clean.
+
+Builders return ``(traced_fn_or_fn, args)``; args may be concrete arrays
+or sharded ``ShapeDtypeStruct``s.  Each runs in a couple hundred ms on
+the 8-sim-worker CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+DRIVERS: dict[str, Callable[[], tuple[Callable, tuple[Any, ...]]]] = {}
+
+
+def register_driver(name: str):
+    def deco(build):
+        DRIVERS[name] = build
+        return build
+    return deco
+
+
+def _mesh():
+    from harp_tpu.parallel.mesh import WorkerMesh
+
+    return WorkerMesh()
+
+
+@register_driver("kmeans.fit")
+def _kmeans_fit():
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.kmeans import KMeansConfig, make_fit_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_fit_fn(mesh, KMeansConfig(k=8, iters=2))
+    pts = jax.ShapeDtypeStruct((16 * nw, 32), jnp.float32,
+                               sharding=mesh.sharding(mesh.spec(0)))
+    cents = jax.ShapeDtypeStruct((8, 32), jnp.float32,
+                                 sharding=mesh.replicated())
+    return fn, (pts, cents)
+
+
+@register_driver("ring_attention")
+def _ring_attention():
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.ring_attention import make_ring_attention_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_ring_attention_fn(mesh, causal=True)
+    qkv = jax.ShapeDtypeStruct((2, 8 * nw, 4, 16), jnp.float32,
+                               sharding=mesh.sharding(mesh.spec(1, ndim=4)))
+    return fn, (qkv, qkv, qkv)
+
+
+@register_driver("mfsgd.epoch")
+def _mfsgd_epoch():
+    from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    users, items, vals = synthetic_ratings(8 * nw, 16 * nw, 64 * nw,
+                                           rank=4)
+    model = MFSGD(8 * nw, 16 * nw, MFSGDConfig(rank=4, algo="dense"),
+                  mesh=mesh)
+    model.set_ratings(users, items, vals)
+    # the tracked epoch program + the device operands set_ratings staged
+    return model._epoch_fn, (model.W, model.H) + model._blocks

@@ -1,0 +1,148 @@
+"""Layer 3 — Mosaic kernel audit: no hardware, two complementary checks.
+
+**Cross-platform lowering** (HL201): each kernel in
+:mod:`harp_tpu.ops.kernel_registry` is traced and lowered with
+``lowering_platforms=("tpu",)`` on the CPU backend — the full
+Pallas→Mosaic pass (block-shape rules, missing primitives, unsupported
+casts) that caught three relay-burners on 2026-07-31 without a chip.
+
+**Silicon-limit jaxpr checks** (HL202/HL203/HL204): the REAL toolchain
+enforces rules the local Mosaic pass does not — ``pltpu.prng_seed``
+accepts at most TWO seed words on silicon (the 2026-08-01 in-window
+failure: 3 words lowered fine locally, failed the relay compile), Mosaic
+has no uint32→f32 cast, and block dim −2 must be a multiple of 8 or the
+full array dim.  These are checked by walking the traced jaxpr's
+``pallas_call`` eqns directly, so they fire even where local lowering
+stays green.
+
+Both run over the same trace, so one registry sweep audits everything.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from harp_tpu.analysis import Violation
+
+_MAX_PRNG_SEED_WORDS = 2  # silicon limit, 2026-08-01
+
+
+def _walk_jaxprs(jaxpr):
+    """Yield (eqn, enclosing_jaxpr) for every eqn at any nesting depth."""
+    for eqn in jaxpr.eqns:
+        yield eqn, jaxpr
+        for v in eqn.params.values():
+            core = getattr(v, "jaxpr", None)
+            if core is not None and hasattr(core, "eqns"):
+                yield from _walk_jaxprs(core)
+            elif hasattr(v, "eqns"):
+                yield from _walk_jaxprs(v)
+
+
+def _block_shape(bm) -> tuple:
+    shape = getattr(bm, "block_shape", ()) or ()
+    return tuple(d if isinstance(d, int) else None for d in shape)
+
+
+def check_kernel_jaxpr(closed_jaxpr, target: str) -> list[Violation]:
+    """HL202/HL203/HL204 over one traced program's pallas_call eqns."""
+    out: list[Violation] = []
+    for eqn, _ in _walk_jaxprs(closed_jaxpr.jaxpr):
+        name = eqn.primitive.name
+        if name == "prng_seed" and len(eqn.invars) > _MAX_PRNG_SEED_WORDS:
+            out.append(Violation(
+                "HL202", target, 0,
+                f"pltpu.prng_seed called with {len(eqn.invars)} seed "
+                f"words — the real TPU toolchain accepts at most "
+                f"{_MAX_PRNG_SEED_WORDS} ('Setting seed with more than 2 "
+                "values is not supported', silicon 2026-08-01); fold "
+                "extra stream ids into a word with an odd-constant "
+                "multiply + xor"))
+        if name == "convert_element_type":
+            import jax.numpy as jnp
+
+            src = getattr(eqn.invars[0], "aval", None)
+            dst = eqn.params.get("new_dtype")
+            if (src is not None and dst is not None
+                    and jnp.dtype(src.dtype) == jnp.dtype(jnp.uint32)
+                    and jnp.issubdtype(jnp.dtype(dst), jnp.floating)):
+                out.append(Violation(
+                    "HL203", target, 0,
+                    "uint32→float cast — Mosaic has no such lowering on "
+                    "TPU; shift_right_logical on int32 instead (see "
+                    "ops/lda_kernel.py's prng-bits→uniform idiom)"))
+        if name == "pallas_call":
+            out.extend(_check_block_shapes(eqn, target))
+    return out
+
+
+def _check_block_shapes(eqn, target: str) -> list[Violation]:
+    out: list[Violation] = []
+    gm = eqn.params.get("grid_mapping")
+    mappings = getattr(gm, "block_mappings", ()) if gm is not None else ()
+    for bm in mappings:
+        bs = _block_shape(bm)
+        if len(bs) < 2 or bs[-2] is None:
+            continue
+        arr = getattr(getattr(bm, "array_shape_dtype", None), "shape", None)
+        full = arr[-2] if arr is not None and len(arr) >= 2 else None
+        if bs[-2] % 8 != 0 and bs[-2] != full:
+            origin = getattr(bm, "origin", "?")
+            out.append(Violation(
+                "HL204", target, 0,
+                f"pallas block_shape {bs} for {origin}: dim -2 = "
+                f"{bs[-2]} is neither a multiple of 8 (sublanes) nor "
+                f"the full array dim ({full}) — fails the real Mosaic "
+                "layout rules"))
+    return out
+
+
+def audit_kernel(name: str, fn, args) -> list[Violation]:
+    """Trace + silicon checks + full Mosaic lowering for one kernel."""
+    import jax
+
+    target = f"kernel:{name}"
+    try:
+        traced = jax.jit(fn).trace(*args)
+    except Exception as e:  # noqa: BLE001 - any trace failure is a finding
+        return [Violation("HL201", target, 0,
+                          f"kernel failed to trace: {type(e).__name__}: "
+                          f"{e}")]
+    out = check_kernel_jaxpr(traced.jaxpr, target)
+    try:
+        text = traced.lower(lowering_platforms=("tpu",)).as_text()
+        if "tpu_custom_call" not in text:
+            out.append(Violation(
+                "HL201", target, 0,
+                "lowered program contains no tpu_custom_call — the "
+                "Pallas kernel fell out of the compiled path (interpret "
+                "mode leaked in?)"))
+    except Exception as e:  # noqa: BLE001
+        out.append(Violation(
+            "HL201", target, 0,
+            f"Pallas→Mosaic lowering failed on the CPU backend: "
+            f"{type(e).__name__}: {e}"))
+    return out
+
+
+def audit_registry(names: list[str] | None = None) -> list[Violation]:
+    """Audit every registered kernel (or the named subset)."""
+    from harp_tpu.ops.kernel_registry import KERNELS
+
+    out: list[Violation] = []
+    for name in sorted(KERNELS if names is None else names):
+        try:
+            fn, args = KERNELS[name]()
+        except Exception as e:  # noqa: BLE001 - a broken builder is loud
+            out.append(Violation("HL201", f"kernel:{name}", 0,
+                                 f"kernel builder failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(audit_kernel(name, fn, args))
+    return out
+
+
+def registered_kernels() -> list[str]:
+    from harp_tpu.ops.kernel_registry import KERNELS
+
+    return sorted(KERNELS)

@@ -1,0 +1,94 @@
+"""harplint rule registry — every trap gets an id, a layer, and its story.
+
+Reference parity (SURVEY.md §6 has no analogue — Harp shipped no static
+analysis at all; correctness discipline lived in code review): the rules
+below are the CLAUDE.md "Relay performance traps" / "Environment gotchas"
+folklore turned into machine-enforced invariants.  Each rule names the
+trap it prevents so a violation message teaches the fix instead of just
+rejecting the diff; MIGRATING.md "Running the linter" maps ids to the
+original trap prose.
+
+Three layers (see the sibling modules):
+
+- ``HL0xx`` — source AST lints (:mod:`harp_tpu.analysis.astlints`; pure
+  ``ast``, no jax import, fast enough for tier-1);
+- ``HL1xx`` — jaxpr analyzers (:mod:`harp_tpu.analysis.jaxpr_checks`;
+  trace on the CPU backend, zero hardware);
+- ``HL2xx`` — Mosaic kernel audit (:mod:`harp_tpu.analysis.mosaic_audit`;
+  cross-platform lowering plus jaxpr checks for the silicon limits local
+  lowering does NOT enforce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    layer: str          # "ast" | "jaxpr" | "mosaic"
+    title: str
+    trap: str           # the CLAUDE.md trap this rule machine-checks
+
+
+RULES: dict[str, Rule] = {r.id: r for r in [
+    Rule("HL000", "ast", "unparseable Python source",
+         "a file the AST lints cannot parse is a file no rule protects — "
+         "fix the syntax error first"),
+    Rule("HL001", "ast", "raw XLA collective outside the verb layer",
+         "collectives must go through harp_tpu.parallel.collective verbs "
+         "(CLAUDE.md conventions) — a raw lax.p*/all_gather/all_to_all "
+         "call is invisible to the CommLedger, so bytes-on-wire claims "
+         "and the quantized-wire audit silently under-count"),
+    Rule("HL002", "ast", "jax.random.PRNGKey in library/driver code",
+         "PRNGKey(python_int) specializes the traced program on the seed "
+         "— every new seed is a fresh ~140 ms remote compile; use "
+         "utils.prng.key_bits / split_keys (raw uint32[2] via numpy)"),
+    Rule("HL003", "ast", "jnp.asarray on host numpy data in ingest paths",
+         "jnp.asarray(big_numpy) can ship the array as a compile-time "
+         "literal (HTTP 413 on >~50 MB over the relay); use "
+         "jax.device_put / mesh.shard_array, the counted ingest entry "
+         "points"),
+    Rule("HL004", "ast", "jitted driver callable not flight-tracked",
+         "a jax.jit program dispatched from a driver loop without "
+         "flightrec.track (or a telemetry.budget around the loop) is "
+         "invisible to the dispatch/readback budgets — the 20-150 ms "
+         "round-trip trap returns as soon as someone loops it"),
+    Rule("HL005", "ast", "perf claim without date + chip provenance",
+         "perf claims must carry measured numbers with date + chip in "
+         "the docstring (CLAUDE.md conventions; see models/kmeans.py for "
+         "the form) — an undated number cannot be re-audited after a "
+         "toolchain or default flip"),
+    Rule("HL101", "jaxpr", "scan-carry gather+DUS copy trap",
+         "gathering from a scan-carried table the body also "
+         "dynamic_update_slice's makes XLA copy the WHOLE table every "
+         "iteration (cost LDA 20 s of a 29 s epoch) — dynamic_slice the "
+         "tile first, gather tile-locally"),
+    Rule("HL102", "jaxpr", "oversized closed-over constant",
+         "a large array baked into the jaxpr as a compile-time constant "
+         "ships with the program over the relay (HTTP 413 >~50 MB) and "
+         "recompiles when it changes — pass it as an argument via "
+         "device_put/shard_array"),
+    Rule("HL201", "mosaic", "kernel fails Pallas→Mosaic lowering",
+         "every registered Pallas kernel must lower via "
+         ".trace(...).lower(lowering_platforms=('tpu',)) on the CPU "
+         "backend — the no-hardware check that caught three relay "
+         "burners on 2026-07-31"),
+    Rule("HL202", "mosaic", "pltpu.prng_seed with >2 seed words",
+         "the real TPU toolchain accepts at most TWO seed words (silicon "
+         "failure 2026-08-01; local lowering does NOT enforce it) — fold "
+         "extra stream ids into a word with an odd-constant multiply + "
+         "xor"),
+    Rule("HL203", "mosaic", "uint32→float cast inside a kernel",
+         "Mosaic has no uint32→f32 cast — shift_right_logical on int32 "
+         "instead (the prng-bits→uniform idiom in ops/lda_kernel.py)"),
+    Rule("HL204", "mosaic", "block dim -2 not sublane-aligned",
+         "a block shape whose second-to-last dim is neither a multiple "
+         "of 8 nor the full array dim fails the real Mosaic layout rules "
+         "— pad or retile (CLAUDE.md Mosaic limits)"),
+]}
+
+
+def rule_ids() -> list[str]:
+    return sorted(RULES)

@@ -94,7 +94,8 @@ def _partials_block(points, centroids, c2, mask=None):
     Everything routes through the MXU: the score matrix comes from
     ``x @ cᵀ`` and the per-cluster sums from ``one_hotᵀ @ x`` — no scatter,
     no gather (both are pathological on TPU; measured 180 ms/iter vs
-    5.7 ms/iter fused on the 1M×300 k=100 config).  ||x||² is dropped from
+    5.7 ms/iter fused on the 1M×300 k=100 config, 2026-07-29, 1× v5e).
+    ||x||² is dropped from
     the argmin (assignment-invariant) and re-added only to the inertia.
 
     ``mask`` (optional [b], 0/1): rows with mask 0 contribute nothing —
@@ -480,10 +481,10 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
     # raw key bits (utils.prng): a fresh seed must not cost a fresh
     # (remote) compile — CLAUDE.md PRNGKey-specialization trap
     keys = jax.random.split(jnp.asarray(prng.key_bits(seed)), nw)
-    points = jax.jit(
+    points = flightrec.track(jax.jit(
         mesh.shard_map(lambda ks: gen(ks[0]), in_specs=(mesh.spec(0),),
                        out_specs=mesh.spec(0))
-    )(keys)
+    ), "kmeans.datagen")(keys)
     if quantize == "int8":
         if n // nw > _INT8_SUM_ROW_LIMIT:
             raise ValueError(
@@ -494,9 +495,9 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
             amax = C.allreduce(jnp.abs(x).max(0), C.Combiner.MAX)
             return C.quantize_to_int8(x, amax)  # scale [d] broadcasts
 
-        points = jax.jit(mesh.shard_map(
+        points = flightrec.track(jax.jit(mesh.shard_map(
             quant, in_specs=(mesh.spec(0),),
-            out_specs=(mesh.spec(0), P())))(points)
+            out_specs=(mesh.spec(0), P()))), "kmeans.quantize")(points)
     centroids = jax.device_put(
         jax.random.normal(jnp.asarray(prng.key_bits(seed + 1)), (k, d),
                           dtype=dtype),

@@ -731,7 +731,7 @@ def make_synthetic_run_fn(mesh: WorkerMesh, cfg: StreamConfig, d: int,
     # device-side int8 twin: the synthetic stream is N(0,1) per feature,
     # so a STATIC 5σ amax covers all but ~3e-7 of draws (clipped) — no
     # calibration pass, same _amax_to_scales rule as the ingest path
-    col_scale = (jnp.asarray(_amax_to_scales(np.full(d, 5.0, np.float32)))
+    col_scale = (jax.device_put(_amax_to_scales(np.full(d, 5.0, np.float32)))
                  if cfg.quantize == "int8" else None)
     if cfg.quantize == "int8":
         # same exact-int32 accumulation guard as every host int8 path

@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import prng
+from harp_tpu.utils import flightrec, prng
 from harp_tpu.utils.timing import device_sync
 
 
@@ -175,8 +175,10 @@ def _zero1_grad_shard(grads, cfg: MLPConfig, nw: int, pad: int):
         return C.push_quantized(jnp.pad(flat_g, (0, pad)),
                                 wire_dtype=jnp.bfloat16) / nw
     leaves = jax.tree.leaves(grads)
-    amax = lax.pmax(jnp.stack([jnp.max(jnp.abs(g)).astype(jnp.float32)
-                               for g in leaves]), C.WORKER_AXIS)
+    # MAX-allreduce through the verb layer (one stacked collective for
+    # every leaf's scale), so the ledger sees the scale exchange too
+    amax = C.allreduce(jnp.stack([jnp.max(jnp.abs(g)).astype(jnp.float32)
+                                  for g in leaves]), C.Combiner.MAX)
     qs, scale_segs = [], []
     for i, g in enumerate(leaves):
         q, scale = quantize_to_int8(g.reshape(-1), amax[i])
@@ -364,7 +366,8 @@ class MLPTrainer:
         self.opt_state, self._opt_specs = _opt_state_setup(
             self.mesh, self.cfg, tx, self.params)
         self._step, _ = make_train_step(self.mesh, self.cfg)
-        self._forward = jax.jit(lambda p, v: forward(p, v, self.cfg))
+        self._forward = flightrec.track(
+            jax.jit(lambda p, v: forward(p, v, self.cfg)), "mlp.forward")
         self._epoch_fns: dict = {}
         self._shuffle_counter = 0
 
@@ -503,7 +506,9 @@ class MLPTrainer:
         return history
 
     def predict(self, x):
-        xs = jnp.asarray(np.asarray(x, np.float32))
+        # device_put, not jnp.asarray: host data must ride the counted
+        # H2D path, never risk baking in as a compile-time literal (HL003)
+        xs = jax.device_put(np.asarray(x, np.float32))
         return np.asarray(self._forward(self.params, xs))
 
     def accuracy(self, x, y):
@@ -582,8 +587,9 @@ class TPMLPTrainer:
         self._batch_sharding = NamedSharding(self.mesh, P(data_ax))
         # same body as the DP trainer; GSPMD inserts the collectives, so
         # the combine step is the identity
-        self._step = jax.jit(_step_body(tx, self.cfg, lambda t: t),
-                             donate_argnums=(0, 1))
+        self._step = flightrec.track(
+            jax.jit(_step_body(tx, self.cfg, lambda t: t),
+                    donate_argnums=(0, 1)), "mlp.tp_step")
 
     def train_batch(self, x, y):
         """x: [b, features], y: [b]; b must be divisible by the data axis."""

@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import flightrec
 
 
 @dataclasses.dataclass
@@ -286,11 +287,13 @@ class RandomForest:
         if self.forest is None:
             raise RuntimeError("call fit() before predict()")
         if self._predict_fn is None:
-            self._predict_fn = jax.jit(
+            self._predict_fn = flightrec.track(jax.jit(
                 lambda forest, bins: predict_forest(
                     forest, bins, self.cfg.max_depth, self.cfg.n_classes)
-            )
-        bins = jnp.asarray(binize(np.asarray(x, np.float32), self.edges))
+            ), "rf.predict")
+        # device_put, not jnp.asarray: host bins ride the counted H2D
+        # path instead of risking a compile-time literal (HL003)
+        bins = jax.device_put(binize(np.asarray(x, np.float32), self.edges))
         return np.asarray(self._predict_fn(
             jax.tree.map(jnp.asarray, self.forest), bins))
 

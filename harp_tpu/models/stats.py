@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import flightrec
 
 
 def _spmd(mesh, fn, n_in=1, out_spec=None):
@@ -237,9 +238,9 @@ def tsqr(x, mesh: WorkerMesh | None = None):
         q2_block = jax.lax.dynamic_slice_in_dim(q2, me * d, d, 0)
         return q1 @ q2_block, r
 
-    q, r = jax.jit(mesh.shard_map(
+    q, r = flightrec.track(jax.jit(mesh.shard_map(
         prog, in_specs=(mesh.spec(0),), out_specs=(mesh.spec(0), P()),
-    ))(xd)
+    )), "stats.tsqr")(xd)
     return np.asarray(q)[:n], np.asarray(r)
 
 
@@ -320,10 +321,10 @@ def als(users, items, vals, n_users, n_items, rank=16, reg=0.1, iters=10,
         cnt = C.allreduce(um.sum())
         return W, H, jnp.sqrt(se / jnp.maximum(cnt, 1))
 
-    fn = jax.jit(mesh.shard_map(
+    fn = flightrec.track(jax.jit(mesh.shard_map(
         epoch, in_specs=(P(), mesh.spec(0), mesh.spec(0), mesh.spec(0)),
         out_specs=(mesh.spec(0), P(), P()),
-    ))
+    )), "stats.als")
     Hd = jax.device_put(jnp.asarray(H), mesh.replicated())
     hist = []
     for _ in range(iters):

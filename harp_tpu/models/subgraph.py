@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import flightrec
 
 
 # ---------------------------------------------------------------------------
@@ -222,11 +223,11 @@ def make_colorful_count_fn(tpl, k, mesh: WorkerMesh,
         )(colors_shard)
         return C.allreduce(rooted)  # [trial_chunk], replicated
 
-    fn = jax.jit(mesh.shard_map(
+    fn = flightrec.track(jax.jit(mesh.shard_map(
         prog,
         in_specs=(mesh.spec(0),) * (2 + n_ovf_args) + (mesh.spec(1),),
         out_specs=P(),
-    ))
+    )), "subgraph.count")
     _FN_CACHE[cache_key] = fn
     return fn
 

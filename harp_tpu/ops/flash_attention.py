@@ -75,8 +75,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         m_prev = m_ref[:, 0]                              # [bq]
         m_blk = masked.max(axis=1)
         m_new = jnp.maximum(m_prev, m_blk)
-        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_new), 0.0)
-        p = jnp.exp(jnp.where(jnp.isfinite(masked),
+        # `> -inf`, not isfinite: Mosaic has no is_finite lowering (caught
+        # by the HL201 kernel audit — this kernel had only ever compiled
+        # in interpret mode), and the accumulators' only non-finite value
+        # is the -inf init / fully-masked score, so the guards are
+        # equivalent
+        alpha = jnp.where(m_prev > -jnp.inf, jnp.exp(m_prev - m_new), 0.0)
+        p = jnp.exp(jnp.where(masked > -jnp.inf,
                               masked - m_new[:, None], -jnp.inf))
         l_new = l_ref[:, 0] * alpha + p.sum(axis=1)
         pv = jax.lax.dot_general(

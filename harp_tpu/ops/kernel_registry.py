@@ -1,0 +1,116 @@
+"""Registry of every Pallas kernel in ``ops/`` — the Mosaic audit's input.
+
+Reference parity note (SURVEY.md §3.2): Harp's native compute kernels
+lived behind DAAL's JNI boundary with no enumeration — auditing them
+meant reading C++.  Here each kernel registers a **builder** returning
+``(fn, args)`` at a small proven shape with ``interpret=False``, so
+:mod:`harp_tpu.analysis.mosaic_audit` can (a) run the full Pallas→Mosaic
+lowering via ``.trace(...).lower(lowering_platforms=("tpu",))`` on the
+CPU backend and (b) walk the traced jaxpr for the silicon limits local
+lowering does NOT enforce (≤2 ``prng_seed`` words, sublane-aligned block
+dims, no uint32→f32 cast).  Shapes mirror the smallest cases already
+pinned by the kernel test files, so an audit failure means the kernel
+changed, not the harness.
+
+Builders are lazy (imports inside) — registering costs nothing until an
+audit actually runs, and the registry module itself imports without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+# name -> zero-arg builder returning (fn, args_tuple)
+KERNELS: dict[str, Callable[[], tuple[Callable, tuple[Any, ...]]]] = {}
+
+
+def register_kernel(name: str):
+    def deco(build):
+        KERNELS[name] = build
+        return build
+    return deco
+
+
+@register_kernel("kmeans.partials")
+def _kmeans_f32():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.kmeans_kernel import kmeans_partials
+
+    fn = functools.partial(kmeans_partials, interpret=False)
+    return fn, (jnp.zeros((128, 256), jnp.float32),
+                jnp.zeros((8, 256), jnp.float32))
+
+
+@register_kernel("kmeans.partials_int8")
+def _kmeans_int8():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.kmeans_kernel import kmeans_partials_int8
+
+    fn = functools.partial(kmeans_partials_int8, interpret=False)
+    return fn, (jnp.zeros((128, 256), jnp.int8),
+                jnp.zeros((8, 256), jnp.int8),
+                jnp.zeros(8, jnp.float32),
+                jnp.zeros(8, jnp.float32),
+                jnp.ones(256, jnp.float32))
+
+
+@register_kernel("lda.cgs_entry_update")
+def _lda_cgs():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.lda_kernel import cgs_entry_update
+
+    # compiled path (interpret=False): exercises the REAL pltpu.prng_seed
+    # / prng_random_bits lowering the silicon checks exist for
+    fn = functools.partial(cgs_entry_update, alpha=0.5, beta=0.1,
+                           vbeta=12.8, interpret=False)
+    K, DR, WR, C = 64, 128, 128, 256
+    return fn, (jnp.zeros((K, DR), jnp.float32),
+                jnp.zeros((K, WR), jnp.float32),
+                jnp.zeros(K, jnp.float32),
+                jnp.zeros(C, jnp.int32),
+                jnp.full((C,), DR, jnp.int32),
+                jnp.full((C,), WR, jnp.int32),
+                jnp.zeros(2, jnp.int32))
+
+
+@register_kernel("mfsgd.sgd_tile_update")
+def _mfsgd_tile():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.mfsgd_kernel import sgd_tile_update
+
+    # the 8-worker-sim smoke tiling pinned in tests/test_mfsgd_kernel.py
+    R, UB, IB, NE, C, tile = 64, 2048, 13440, 8, 2048, 256
+    fn = functools.partial(sgd_tile_update, lr=0.01, reg=0.05,
+                           u_tile=tile, i_tile=tile, interpret=False)
+    return fn, (jnp.zeros((R, UB), jnp.float32),
+                jnp.zeros((R, IB), jnp.float32),
+                jnp.zeros((NE, C), jnp.int32),
+                jnp.zeros((NE, C), jnp.int32),
+                jnp.zeros((NE, C), jnp.float32),
+                jnp.zeros(NE, jnp.int32),
+                jnp.zeros(NE, jnp.int32))
+
+
+@register_kernel("flash_attention")
+def _flash():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.flash_attention import flash_attention
+
+    fn = functools.partial(flash_attention, causal=True, interpret=False)
+    q = jnp.zeros((2, 256, 128), jnp.float32)
+    return fn, (q, q, q)

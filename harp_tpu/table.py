@@ -488,12 +488,14 @@ def regroup_by_key(keys, values, *, capacity: int, axis: str = WORKER_AXIS):
 def pull_rows(global_shard, row_ids, *, axis: str = WORKER_AXIS):
     """Fetch specific rows of a row-sharded global table into local storage.
 
-    O(table) wire: all_gathers the WHOLE table then takes rows — simple
-    and fast when the table fits HBM anyway.  For model tables larger
+    O(table) wire: pulls the WHOLE table then takes rows — simple and
+    fast when the table fits HBM anyway.  For model tables larger
     than one chip's HBM (or when touched rows ≪ table), use
     :func:`pull_rows_sparse`.
     """
-    full = jax.lax.all_gather(global_shard, axis, tiled=True)
+    from harp_tpu.parallel.collective import pull as _pull
+
+    full = _pull(global_shard, axis=axis)
     return jnp.take(full, row_ids, axis=0)
 
 
@@ -503,10 +505,12 @@ def push_rows(global_shard, row_ids, deltas, *, axis: str = WORKER_AXIS):
     O(table) wire (dense psum_scatter over the full key space); the
     O(pushed rows) form is :func:`push_rows_sparse`.
     """
+    from harp_tpu.parallel.collective import push as _push
+
     n_total = global_shard.shape[0] * jax.lax.axis_size(axis)
     dense = jnp.zeros((n_total,) + global_shard.shape[1:], deltas.dtype)
     dense = dense.at[row_ids].add(deltas)
-    return global_shard + jax.lax.psum_scatter(dense, axis, scatter_dimension=0, tiled=True)
+    return global_shard + _push(dense, axis=axis)
 
 
 # ---------------------------------------------------------------------------

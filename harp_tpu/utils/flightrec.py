@@ -5,8 +5,9 @@ Reference parity (SURVEY.md §6): Harp has no execution-side accounting at
 all — its observability stops at per-iteration wall-clock logs, and even
 harp-tpu's CommLedger (PR 1) only accounts for *collective* bytes.  Yet
 the measured walls on this project are execution-side (CLAUDE.md "Relay
-performance traps"): ~140 ms per silent recompile, a 30-40 MB/s H2D
-ingest tunnel, 20-150 ms per dispatch/readback round trip.  This module
+performance traps", all measured 2026-07-30 on the relay-attached v5e):
+~140 ms per silent recompile, a 30-40 MB/s H2D ingest tunnel, 20-150 ms
+per dispatch/readback round trip.  This module
 is the third telemetry spine beside CommLedger/SpanTracer, turning each
 of those traps into a machine-checked invariant that runs on the CPU
 backend with zero hardware:
@@ -339,7 +340,8 @@ def budget(compiles: int | None = None, h2d_bytes: int | None = None,
     raises :class:`BudgetExceeded` naming every exceeded counter (the
     tests' mode); ``action="warn"`` emits a ``RuntimeWarning`` and
     continues (the bench mode — a relay sprint must record the number,
-    not die).  The CLAUDE.md relay traps map one-to-one:
+    not die).  The CLAUDE.md relay traps (measured 2026-07-30, v5e) map
+    one-to-one:
 
     - ``compiles=N``: a silent re-trace (e.g. ``PRNGKey(python_int)``
       baked into a per-step jit) blows the compile count;

@@ -44,6 +44,15 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    numbers that SUM to the row's ``total`` (a mismatch means the
    imbalance ratio describes a different workload than the total
    claims), and ``padding_frac`` — when present — must lie in [0, 1].
+
+6. **Lint rows are coherent analysis evidence** (any file): a ``kind:
+   "lint"`` row (``python -m harp_tpu lint``) must carry the provenance
+   stamp (a lint verdict is about a specific commit — an unstamped
+   "clean" can certify the wrong tree), every rule id it mentions (in
+   ``rules`` or as a ``per_rule`` key) must come from the registered set
+   (``KNOWN_LINT_RULES`` — kept in sync with
+   ``harp_tpu.analysis.rules`` by tests/test_lint.py), and the
+   per-file/per-rule violation counts must be non-negative integers.
 """
 
 from __future__ import annotations
@@ -163,6 +172,43 @@ def _check_skew_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the registered harplint rule ids, FROZEN here so this script stays
+# standalone (no harp_tpu import); tests/test_lint.py asserts equality
+# with harp_tpu.analysis.rules.rule_ids() so drift fails tier-1
+KNOWN_LINT_RULES = ("HL000", "HL001", "HL002", "HL003", "HL004", "HL005",
+                    "HL101", "HL102", "HL201", "HL202", "HL203", "HL204")
+LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
+                     "stale_allowlist")
+
+
+def _check_lint_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 6: lint rows must be coherent analysis evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: lint row missing provenance field(s) {missing} "
+            "— print it through harp_tpu.analysis.cli (benchmark_json "
+            "stamps them)")
+    mentioned = list(row.get("rules") or []) + list(row.get("per_rule")
+                                                   or {})
+    unknown = sorted({r for r in mentioned if r not in KNOWN_LINT_RULES})
+    if unknown:
+        errs.append(
+            f"{name}:{i}: lint row mentions unregistered rule id(s) "
+            f"{unknown} — ids must come from harp_tpu.analysis.rules "
+            "(update KNOWN_LINT_RULES in the same commit as the "
+            "registry)")
+    counts = dict(row.get("per_file") or {})
+    counts.update(row.get("per_rule") or {})
+    counts.update({k: row[k] for k in LINT_COUNT_FIELDS if k in row})
+    for key, v in counts.items():
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"{name}:{i}: lint row count {key}={v!r} must be "
+                        "a non-negative integer")
+    return errs
+
+
 def check_file(path: str, grandfathered: int = 0,
                provenance: bool = False) -> list[str]:
     """Return a list of violation messages (empty = clean)."""
@@ -188,6 +234,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_flight_row(name, i, row, flight_state)
         if isinstance(row, dict) and row.get("kind") == "skew":
             errors += _check_skew_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "lint":
+            errors += _check_lint_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

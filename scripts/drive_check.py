@@ -1064,3 +1064,141 @@ with _SKT.scope(False):
 print("skew: ingest==execution==bincount, budgets hold, waste priced, "
       "roofline composed, file rebalance loop closed, invariant 5 loud")
 print(f"DRIVE OK round-23 ({mode})")
+
+# ===========================================================================
+# Round 24 — harplint: static relay-burner analysis (PR 5).
+# Drives the linter as a CONSUMER: seeded violations in every layer must
+# exit non-zero, the repo at HEAD must be clean, the rerouted table verbs
+# must match numpy AND become visible to the CommLedger (the point of
+# HL001), and the flash_attention is_finite fix must keep numerics.
+# ===========================================================================
+import json as _hl_json
+import tempfile as _hl_tmp
+
+from harp_tpu.analysis import cli as _HLC
+from harp_tpu.analysis import rule_ids as _hl_rule_ids
+from harp_tpu.analysis.astlints import lint_source as _hl_lint
+from harp_tpu.analysis.jaxpr_checks import find_scan_copy_traps as _hl_scan
+from harp_tpu.analysis.mosaic_audit import (audit_registry as _hl_audit,
+                                            check_kernel_jaxpr as _hl_kchk)
+from jax import lax as _hl_lax
+
+# (a) one seeded Layer-1 violation per rule id, via the public lint_source
+for _hl_src, _hl_want in (
+        ("from jax import lax\ndef f(x): return lax.psum(x, 'w')\n",
+         "HL001"),
+        ("import jax\ndef f(s): return jax.random.PRNGKey(s)\n", "HL002"),
+        ("import jax.numpy as jnp, numpy as np\n"
+         "def f(x): return jnp.asarray(np.asarray(x))\n", "HL003"),
+        ("import jax\ndef f():\n    s = jax.jit(lambda x: x)\n"
+         "    return s\n", "HL004"),
+        ('def f():\n    """Hits 9.9M tok/s."""\n', "HL005")):
+    _hl_got = {v.rule for v in _hl_lint("harp_tpu/models/fake.py", _hl_src)}
+    assert _hl_got == {_hl_want}, (_hl_want, _hl_got)
+
+# (b) the pre-fix LDA copy trap flags; the tile-local fixed form is clean
+def _hl_bad(tbl, i, u):
+    def body(t, x):
+        vals = jnp.take(t, x[0], axis=0)
+        return _hl_lax.dynamic_update_slice(t, x[1], (x[0][0], 0)), vals.sum()
+    return _hl_lax.scan(body, tbl, (i, u))
+
+def _hl_good(tbl, i, u):
+    def body(t, x):
+        tile = _hl_lax.dynamic_slice(t, (0, 0), (4, t.shape[1]))
+        vals = jnp.take(tile, x[0] % 4, axis=0)
+        return _hl_lax.dynamic_update_slice(t, x[1], (x[0][0], 0)), vals.sum()
+    return _hl_lax.scan(body, tbl, (i, u))
+
+_hl_args = (jnp.zeros((16, 8)), jnp.zeros((3, 2), jnp.int32),
+            jnp.zeros((3, 1, 8)))
+assert [v.rule for v in _hl_scan(
+    jax.jit(_hl_bad).trace(*_hl_args).jaxpr, "d")] == ["HL101"]
+assert _hl_scan(jax.jit(_hl_good).trace(*_hl_args).jaxpr, "d") == []
+
+# (c) Mosaic: the 2026-08-01 3-seed-word silicon failure flags from the
+# jaxpr alone (no hardware), and the whole ops/ registry audits clean —
+# including flash_attention, whose is_finite this audit caught
+from jax.experimental import pallas as _hl_pl
+from jax.experimental.pallas import tpu as _hl_pltpu
+
+def _hl_seed3(seed):
+    def kern(seed_ref, o_ref):
+        _hl_pltpu.prng_seed(seed_ref[0], seed_ref[1], seed_ref[2])
+        bits = _hl_pltpu.prng_random_bits(o_ref.shape)
+        o_ref[...] = _hl_lax.shift_right_logical(bits, 8).astype(jnp.float32)
+    return _hl_pl.pallas_call(
+        kern, in_specs=[_hl_pl.BlockSpec(memory_space=_hl_pltpu.SMEM)],
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(seed)
+
+_hl_vs = _hl_kchk(jax.jit(_hl_seed3).trace(jnp.zeros(3, jnp.int32)).jaxpr,
+                  "toy")
+assert "HL202" in {v.rule for v in _hl_vs}
+assert _hl_audit() == []
+
+# flash_attention numerics after the > -inf fix: == reference, causal+window
+from harp_tpu.ops.flash_attention import (flash_attention as _hl_fa,
+                                          reference_attention as _hl_ref)
+_hl_rng = np.random.default_rng(24)
+_hl_q, _hl_k, _hl_v = (jnp.asarray(
+    _hl_rng.normal(size=(2, 64, 16)).astype(np.float32)) for _ in range(3))
+for _hl_kw in ({"causal": True}, {"causal": True, "window": 8}):
+    np.testing.assert_allclose(
+        np.asarray(_hl_fa(_hl_q, _hl_k, _hl_v, block_q=32, block_k=32,
+                          interpret=True, **_hl_kw)),
+        np.asarray(_hl_ref(_hl_q, _hl_k, _hl_v, **_hl_kw)),
+        rtol=2e-5, atol=2e-5)
+
+# (d) rerouted table verbs: == numpy golden AND now on the CommLedger
+from harp_tpu import table as _hl_table
+from harp_tpu.utils import telemetry as _HLT
+
+_hl_shard = _hl_rng.normal(size=(16, 4)).astype(np.float32)   # 2 rows/worker
+_hl_ids = np.array([0, 5, 11, 3], np.int32)
+_hl_deltas = _hl_rng.normal(size=(4, 4)).astype(np.float32)
+with _HLT.scope(True):
+    _hl_pull = jax.jit(mesh.shard_map(
+        lambda g: _hl_table.pull_rows(g, jnp.asarray(_hl_ids)),
+        in_specs=(mesh.spec(0),), out_specs=mesh.spec(0)))
+    _hl_got = np.asarray(_hl_pull(mesh.shard_array(_hl_shard, 0)))
+    np.testing.assert_allclose(_hl_got[:4], _hl_shard[_hl_ids], rtol=1e-6)
+    _hl_push = jax.jit(mesh.shard_map(
+        lambda g, d: _hl_table.push_rows(g, jnp.asarray(_hl_ids), d),
+        in_specs=(mesh.spec(0), None), out_specs=mesh.spec(0)))
+    _hl_after = np.asarray(_hl_push(mesh.shard_array(_hl_shard, 0),
+                                    jax.device_put(_hl_deltas)))
+    _hl_gold = _hl_shard.copy()
+    np.add.at(_hl_gold, _hl_ids, _hl_deltas)   # every worker pushes once...
+    _hl_gold = _hl_shard + (_hl_gold - _hl_shard) * mesh.num_workers
+    np.testing.assert_allclose(_hl_after, _hl_gold, rtol=1e-5)
+    _hl_verbs = {s["verb"] for t in _HLT.ledger.summary().values()
+                 for s in t["sites"]}
+    assert {"pull", "push"} <= _hl_verbs, _hl_verbs   # HL001's whole point
+
+# (e) the lint CLI at HEAD: exit 0, clean, stamped line that satisfies
+# check_jsonl invariant 6; a seeded file exits 1
+import io as _hl_io
+import contextlib as _hl_ctx
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import check_jsonl as _hl_cj
+
+_hl_buf = _hl_io.StringIO()
+with _hl_ctx.redirect_stdout(_hl_buf):
+    _hl_rc = _HLC.main(["--json"])
+_hl_row = _hl_json.loads(_hl_buf.getvalue().strip().splitlines()[-1])
+assert _hl_rc == 0 and _hl_row["clean"] is True
+assert _hl_cj._check_lint_row("drive", 1, _hl_row) == []
+assert tuple(_hl_rule_ids()) == _hl_cj.KNOWN_LINT_RULES
+with _hl_tmp.TemporaryDirectory() as _hl_dir:
+    _hl_bad_py = os.path.join(_hl_dir, "bad.py")
+    open(_hl_bad_py, "w").write(
+        "import jax\ndef f(s): return jax.random.PRNGKey(s)\n")
+    with _hl_ctx.redirect_stdout(_hl_io.StringIO()):
+        assert _HLC.main([_hl_bad_py, "--json"]) == 1
+
+print("harplint: 5 AST rules seeded+tripped, copy trap pinned both ways, "
+      "3-word prng_seed flagged sans hardware, registry+repo clean at "
+      "HEAD, rerouted pull/push == numpy and on the ledger, CLI exit "
+      "codes + invariant 6 round-trip")
+print(f"DRIVE OK round-24 ({mode})")

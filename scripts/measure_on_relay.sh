@@ -60,6 +60,23 @@ fi
 start_ok=$(grep -vc '"error"' "$OUT" 2>/dev/null)
 start_ok=${start_ok:-0}
 
+# harplint preflight: a sprint must never launch with a known
+# relay-burner in the tree (copy traps, per-seed recompiles, >2-word
+# prng_seed kernels — the silicon failures the linter encodes).  Runs on
+# the CPU backend in a couple of seconds; in rehearsal it HARD-FAILS
+# (certifying a dirty tree defeats the rehearsal), in a live window it
+# warns and continues — the scarce relay must still be measured, and the
+# lint verdict is in the log for the post-sprint commit to act on.
+echo "== harplint preflight (python -m harp_tpu lint --json) =="
+if ! python -m harp_tpu lint --json; then
+  if [ -n "$REHEARSE" ]; then
+    echo "[rehearse] harplint FAILED — rehearsal NOT certified" >&2
+    exit 1
+  fi
+  echo "WARNING: harplint FAILED — sprint continues, but fix the" >&2
+  echo "violations (or allowlist with justification) before committing" >&2
+fi
+
 if [ -z "$REHEARSE" ]; then
   echo "== probing relay (45 s bound) =="
   if ! timeout 45 python -c "import jax; print(jax.devices())"; then

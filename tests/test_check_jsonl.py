@@ -252,3 +252,37 @@ def test_benchmark_json_rows_satisfy_the_checker(tmp_path):
     p = tmp_path / "BENCH_local.jsonl"
     p.write_text(benchmark_json("fresh", {"iters_per_sec": 1.0}) + "\n")
     assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_lint_row_invariants(tmp_path):
+    """Invariant 6: lint rows must be stamped, use registered rule ids,
+    and carry non-negative integer counts."""
+    rows = [
+        # missing provenance entirely
+        {"kind": "lint", "violations": 0, "per_rule": {}},
+        # unregistered rule id in per_rule
+        {"kind": "lint", "backend": "cpu", "date": "2026-08-04",
+         "commit": "abc", "per_rule": {"HL999": 1}},
+        # negative per-file count
+        {"kind": "lint", "backend": "cpu", "date": "2026-08-04",
+         "commit": "abc", "per_file": {"a.py": -1}},
+    ]
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 3
+    assert ":1:" in errors[0] and "provenance" in errors[0]
+    assert ":2:" in errors[1] and "HL999" in errors[1]
+    assert ":3:" in errors[2] and "negative" in errors[2]
+
+
+def test_lint_cli_row_satisfies_the_checker(tmp_path, capsys):
+    """Round-trip: the line `python -m harp_tpu lint --json` prints must
+    pass invariant 6 as-is — even teed into a bench file."""
+    from harp_tpu.analysis import cli as lint_cli
+
+    lint_cli.main(["--json", "--layer", "ast"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(line + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []

@@ -1,0 +1,410 @@
+"""harplint (harp_tpu/analysis) — golden fixtures for every layer.
+
+One synthetic module per Layer-1 rule that must trip it, the pre-fix LDA
+scan-carry gather+DUS pattern pinned as a Layer-2 positive (and the
+fixed tile-local form as a negative), a 3-seed-word ``prng_seed`` toy
+kernel the Mosaic audit must flag WITHOUT hardware, and the repo-wide
+tier-1 gate: zero unallowlisted violations at HEAD.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from harp_tpu.analysis import rule_ids  # noqa: E402
+from harp_tpu.analysis import allowlist as allowlist_mod  # noqa: E402
+from harp_tpu.analysis.astlints import lint_source  # noqa: E402
+from harp_tpu.analysis.jaxpr_checks import (  # noqa: E402
+    find_large_constants, find_scan_copy_traps)
+from harp_tpu.analysis.mosaic_audit import (  # noqa: E402
+    audit_kernel, check_kernel_jaxpr)
+from harp_tpu.analysis import cli  # noqa: E402
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# Layer 1 — one synthetic module per rule
+# ---------------------------------------------------------------------------
+
+def test_hl001_raw_collective_trips():
+    src = textwrap.dedent("""
+        from jax import lax
+        def step(x):
+            return lax.psum(x, "workers")
+    """)
+    vs = lint_source("harp_tpu/models/fake.py", src)
+    assert _rules(vs) == ["HL001"]
+
+
+def test_hl001_exempt_inside_verb_layer():
+    src = "from jax import lax\ndef f(x):\n    return lax.psum(x, 'w')\n"
+    assert lint_source("harp_tpu/parallel/collective.py", src) == []
+    assert lint_source("harp_tpu/parallel/rotate.py", src) == []
+
+
+def test_hl001_axis_queries_stay_legal():
+    src = ("from jax import lax\n"
+           "def f():\n"
+           "    return lax.axis_index('w') + lax.axis_size('w')\n")
+    assert lint_source("harp_tpu/models/fake.py", src) == []
+
+
+def test_hl002_prngkey_trips():
+    src = ("import jax\n"
+           "def seed_me(s):\n"
+           "    return jax.random.PRNGKey(s)\n")
+    vs = lint_source("harp_tpu/models/fake.py", src)
+    assert _rules(vs) == ["HL002"]
+    # the helper that wraps the trap is exempt
+    assert lint_source("harp_tpu/utils/prng.py", src) == []
+
+
+def test_hl003_asarray_on_numpy_trips():
+    src = ("import jax.numpy as jnp, numpy as np\n"
+           "def ingest(x):\n"
+           "    return jnp.asarray(np.asarray(x, np.float32))\n")
+    vs = lint_source("harp_tpu/models/fake.py", src)
+    assert _rules(vs) == ["HL003"]
+
+
+def test_hl003_device_put_wrapper_is_clean():
+    src = ("import jax, jax.numpy as jnp, numpy as np\n"
+           "def ingest(x):\n"
+           "    return jax.device_put(jnp.asarray(np.asarray(x)))\n")
+    assert lint_source("harp_tpu/models/fake.py", src) == []
+
+
+def test_hl004_untracked_jit_trips_only_in_models():
+    src = ("import jax\n"
+           "def driver():\n"
+           "    step = jax.jit(lambda x: x)\n"
+           "    return step\n")
+    assert _rules(lint_source("harp_tpu/models/fake.py", src)) == ["HL004"]
+    assert lint_source("harp_tpu/utils/fake.py", src) == []
+
+
+def test_hl004_factory_return_and_track_are_clean():
+    src = ("import jax\n"
+           "from harp_tpu.utils import flightrec\n"
+           "def make_step_fn():\n"
+           "    return jax.jit(lambda x: x)\n"
+           "def driver():\n"
+           "    return flightrec.track(jax.jit(lambda x: x), 'd.step')\n")
+    assert lint_source("harp_tpu/models/fake.py", src) == []
+
+
+def test_hl005_undated_perf_claim_trips():
+    src = ('def fast():\n'
+           '    """Runs at 246.5M ups/s on the graded shape."""\n')
+    vs = lint_source("harp_tpu/models/fake.py", src)
+    assert _rules(vs) == ["HL005"]
+    # date + chip in the documented form passes
+    src_ok = ('def fast():\n'
+              '    """246.5M ups/s (2026-08-01, 1x v5e)."""\n')
+    assert lint_source("harp_tpu/models/fake.py", src_ok) == []
+
+
+def test_hl000_syntax_error_is_loud():
+    assert _rules(lint_source("harp_tpu/models/fake.py",
+                              "def broken(:\n")) == ["HL000"]
+
+
+# ---------------------------------------------------------------------------
+# Layer 2 — the LDA copy-trap regression, pinned
+# ---------------------------------------------------------------------------
+
+def _prefix_lda_pattern(table, idxs, upds):
+    """The PRE-FIX shape of the LDA epoch: the scan body gathers from the
+    carried table AND dynamic_update_slice's it (cost 20 s of a 29 s
+    epoch before the tile-local fix)."""
+
+    def body(tbl, x):
+        i, u = x
+        vals = jnp.take(tbl, i, axis=0)              # gather from carry
+        tbl = lax.dynamic_update_slice(tbl, u, (i[0], 0))
+        return tbl, vals.sum()
+
+    return lax.scan(body, table, (idxs, upds))
+
+
+def _fixed_lda_pattern(table, idxs, upds):
+    """The FIXED form: dynamic_slice the tile first, gather tile-locally
+    — the gather operand is the slice result, not the carry."""
+
+    def body(tbl, x):
+        i, u = x
+        tile = lax.dynamic_slice(tbl, (0, 0), (4, tbl.shape[1]))
+        vals = jnp.take(tile, i % 4, axis=0)
+        tbl = lax.dynamic_update_slice(tbl, u, (i[0], 0))
+        return tbl, vals.sum()
+
+    return lax.scan(body, table, (idxs, upds))
+
+
+_SCAN_ARGS = (jnp.zeros((16, 8)), jnp.zeros((3, 2), jnp.int32),
+              jnp.zeros((3, 1, 8)))
+
+
+def test_scan_copy_trap_positive():
+    closed = jax.jit(_prefix_lda_pattern).trace(*_SCAN_ARGS).jaxpr
+    vs = find_scan_copy_traps(closed, "fixture")
+    assert _rules(vs) == ["HL101"]
+    assert "copy the whole" in vs[0].message.lower()
+
+
+def test_scan_copy_trap_fixed_form_negative():
+    closed = jax.jit(_fixed_lda_pattern).trace(*_SCAN_ARGS).jaxpr
+    assert find_scan_copy_traps(closed, "fixture") == []
+
+
+def test_scan_copy_trap_sees_fori_loop():
+    def bad_fori(table, idxs, upds):
+        def body(t, tbl):
+            vals = jnp.take(tbl, idxs[t], axis=0)
+            return lax.dynamic_update_slice(
+                tbl, upds[t] + vals.sum(), (idxs[t][0], 0))
+        return lax.fori_loop(0, 3, body, table)
+
+    closed = jax.jit(bad_fori).trace(*_SCAN_ARGS).jaxpr
+    assert _rules(find_scan_copy_traps(closed, "f")) == ["HL101"]
+
+
+def test_large_constant_detector():
+    big = np.ones((1 << 18,), np.float32)            # 1 MiB exactly
+
+    def closes_over(x):
+        return x + jnp.asarray(big)
+
+    closed = jax.jit(closes_over).trace(jnp.zeros(1 << 18)).jaxpr
+    # over a small threshold: flagged; at the default 1 MiB: exactly at
+    # the boundary (not >), so clean
+    assert _rules(find_large_constants(closed, "f", 1 << 16)) == ["HL102"]
+    assert find_large_constants(closed, "f", 1 << 20) == []
+
+
+def test_driver_registry_is_clean():
+    """The registered flagship driver programs (kmeans fit, ring
+    attention, mfsgd epoch) carry no copy trap and no oversized
+    literal."""
+    from harp_tpu.analysis.drivers import DRIVERS
+    from harp_tpu.analysis.jaxpr_checks import analyze_program
+
+    assert set(DRIVERS) >= {"kmeans.fit", "ring_attention", "mfsgd.epoch"}
+    for name, build in DRIVERS.items():
+        fn, args = build()
+        assert analyze_program(fn, args, f"driver:{name}") == []
+
+
+# ---------------------------------------------------------------------------
+# Layer 3 — Mosaic audit, no hardware
+# ---------------------------------------------------------------------------
+
+def _toy_seed_kernel(n_words: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kern(seed_ref, o_ref):
+        pltpu.prng_seed(*(seed_ref[i] for i in range(n_words)))
+        bits = pltpu.prng_random_bits(o_ref.shape)
+        o_ref[...] = lax.shift_right_logical(bits, 8).astype(jnp.float32)
+
+    def f(seed):
+        # seed words ride SMEM so seed_ref[i] reads scalars, as the real
+        # lda kernel's scalar-prefetch grid does
+        return pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32)
+        )(seed)
+
+    return f, (jnp.zeros(max(n_words, 1), jnp.int32),)
+
+
+def test_mosaic_audit_flags_3_seed_words():
+    """The 2026-08-01 in-window silicon failure, caught on CPU: a 3-word
+    prng_seed must trip HL202 from the jaxpr alone."""
+    fn, args = _toy_seed_kernel(3)
+    closed = jax.jit(fn).trace(*args).jaxpr
+    vs = check_kernel_jaxpr(closed, "kernel:toy3")
+    assert "HL202" in _rules(vs)
+    assert "2 " in vs[0].message or "TWO" in vs[0].message
+
+
+def test_mosaic_audit_2_seed_words_clean():
+    fn, args = _toy_seed_kernel(2)
+    vs = audit_kernel("toy2", fn, args)
+    assert vs == [], [v.message for v in vs]
+
+
+def test_mosaic_audit_flags_uint32_float_cast():
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...].astype(jnp.float32)
+
+    def f(x):
+        return pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32)
+        )(x)
+
+    vs = audit_kernel("toyu32", f, (jnp.zeros((8, 128), jnp.uint32),))
+    # the silicon limit local lowering does NOT enforce: HL203 must fire
+    # even though the local Mosaic pass stays green
+    assert "HL203" in _rules(vs)
+
+
+def test_mosaic_audit_flags_unaligned_block_dim():
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def f(x):
+        return pl.pallas_call(
+            kern, grid=(4,),
+            in_specs=[pl.BlockSpec((4, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((4, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32))(x)
+
+    vs = audit_kernel("toyblk", f, (jnp.zeros((16, 128), jnp.float32),))
+    assert "HL204" in _rules(vs)
+
+
+def test_kernel_registry_audit_is_clean():
+    """Every registered ops/ kernel lowers for TPU on this CPU host and
+    passes the silicon-limit checks (the audit that caught
+    flash_attention's is_finite, which had only ever run in interpret
+    mode)."""
+    from harp_tpu.analysis.mosaic_audit import audit_registry, \
+        registered_kernels
+
+    assert set(registered_kernels()) >= {
+        "kmeans.partials", "kmeans.partials_int8", "lda.cgs_entry_update",
+        "mfsgd.sgd_tile_update", "flash_attention"}
+    vs = audit_registry()
+    assert vs == [], [v.format() for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# Allowlist + registry + CLI
+# ---------------------------------------------------------------------------
+
+def test_allowlist_requires_reason(tmp_path):
+    p = tmp_path / "allow.toml"
+    p.write_text('[[allow]]\nrule = "HL001"\npath = "x.py"\n')
+    with pytest.raises(allowlist_mod.AllowlistError):
+        allowlist_mod.load(str(p))
+
+
+def test_allowlist_suppresses_and_reports_stale(tmp_path):
+    from harp_tpu.analysis import Violation
+
+    p = tmp_path / "allow.toml"
+    p.write_text(textwrap.dedent("""
+        [[allow]]
+        rule = "HL001"
+        path = "a.py"
+        reason = "legit"
+        [[allow]]
+        rule = "HL002"
+        path = "never.py"
+        reason = "stale"
+    """))
+    entries = allowlist_mod.load(str(p))
+    vs = [Violation("HL001", "a.py", 1, "m"),
+          Violation("HL001", "b.py", 1, "m")]
+    kept, suppressed, stale = allowlist_mod.apply(vs, entries)
+    assert [v.path for v in kept] == ["b.py"]
+    assert [v.path for v in suppressed] == ["a.py"]
+    assert [e["path"] for e in stale] == ["never.py"]
+
+
+def test_check_jsonl_rule_set_in_sync():
+    """scripts/check_jsonl.py invariant 6 hardcodes the rule ids (the
+    script stays standalone); drift from the registry fails here."""
+    import check_jsonl
+
+    assert tuple(rule_ids()) == check_jsonl.KNOWN_LINT_RULES
+
+
+def test_cli_fixture_path_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text("import jax\n"
+                   "def f(s):\n"
+                   "    return jax.random.PRNGKey(s)\n")
+    rc = cli.main([str(bad), "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(out)
+    assert rc == 1
+    assert row["kind"] == "lint" and row["violations"] == 1
+    assert row["per_rule"] == {"HL002": 1}
+    # provenance stamp rides the line (check_jsonl invariant 6)
+    assert all(k in row for k in ("backend", "date", "commit"))
+
+
+def test_cli_audit_module_trips_jaxpr_and_mosaic_layers(tmp_path, capsys):
+    fixture = tmp_path / "fixture_mod.py"
+    fixture.write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _bad_scan():
+            def f(table, idxs, upds):
+                def body(tbl, x):
+                    i, u = x
+                    vals = jnp.take(tbl, i, axis=0)
+                    tbl = lax.dynamic_update_slice(tbl, u, (i[0], 0))
+                    return tbl, vals.sum()
+                return lax.scan(body, table, (idxs, upds))
+            return f, (jnp.zeros((16, 8)), jnp.zeros((3, 2), jnp.int32),
+                       jnp.zeros((3, 1, 8)))
+
+        def _bad_kernel():
+            def kern(seed_ref, o_ref):
+                pltpu.prng_seed(seed_ref[0], seed_ref[1], seed_ref[2])
+                bits = pltpu.prng_random_bits(o_ref.shape)
+                o_ref[...] = lax.shift_right_logical(
+                    bits, 8).astype(jnp.float32)
+            def f(seed):
+                return pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct(
+                    (8, 128), jnp.float32))(seed)
+            return f, (jnp.zeros(3, jnp.int32),)
+
+        HARPLINT_DRIVERS = {"bad_scan": _bad_scan}
+        HARPLINT_KERNELS = {"bad_seed": _bad_kernel}
+    """))
+    rc = cli.main(["--audit-module", str(fixture), "--json"])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert "HL101" in row["per_rule"] and "HL202" in row["per_rule"]
+
+
+def test_cli_repo_run_is_clean(capsys):
+    """THE tier-1 gate: zero unallowlisted violations at HEAD, all three
+    layers, and the machine line passes check_jsonl invariant 6."""
+    import check_jsonl
+
+    rc = cli.main(["--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(out)
+    assert rc == 0, row
+    assert row["clean"] is True and row["violations"] == 0
+    assert row["stale_allowlist"] == 0
+    assert check_jsonl._check_lint_row("stdout", 1, row) == []
