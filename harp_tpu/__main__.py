@@ -30,6 +30,8 @@ APPS = {
     "wdamds": ("harp_tpu.models.wdamds", "WDA-MDS / SMACOF embedding"),
     "stats": ("harp_tpu.models.stats",
               "classic analytics: pca/cov/moments/naive/linreg/ridge/qr/svd/als"),
+    "serve": ("harp_tpu.serve.server",
+              "persistent-mesh inference server (JSONL over stdio)"),
     "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
     "report": ("harp_tpu.report",
                "merged run report: comm ledger + spans + metrics + top ops"),

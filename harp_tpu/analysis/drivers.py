@@ -72,6 +72,39 @@ def _ring_attention():
     return fn, (qkv, qkv, qkv)
 
 
+@register_driver("serve.kmeans_assign")
+def _serve_kmeans_assign():
+    """The serving step for kmeans at one ladder rung — the steady-state
+    program the budget guard pins; registered so HL101/HL102 sweep the
+    serve path like every other driver."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import KMeansAssign
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    eng = KMeansAssign(KMeansAssign.synthetic_state(rng, k=8, d=32), mesh)
+    return eng.jitted(), eng.trace_args(8)
+
+
+@register_driver("serve.mfsgd_topk")
+def _serve_mfsgd_topk():
+    """The sharded-H top-k recommendation step (local top-k + one pull
+    merge) — the serve path's model-parallel program."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import MFSGDTopK
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    rng = np.random.default_rng(0)
+    eng = MFSGDTopK(
+        MFSGDTopK.synthetic_state(rng, n_users=16 * nw,
+                                  n_items=8 * nw, rank=8),
+        mesh, topk=4)
+    return eng.jitted(), eng.trace_args(8)
+
+
 @register_driver("mfsgd.epoch")
 def _mfsgd_epoch():
     from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings

@@ -1,0 +1,133 @@
+"""Request micro-batcher — a fixed ladder of padded batch shapes.
+
+A serving loop that traces a fresh program per request size would pay the
+~140 ms remote-compile trap on every novel batch (CLAUDE.md relay traps);
+one that pads everything to the maximum batch would waste most of its
+compute on padding at low load.  The ladder is the standard middle
+ground: requests coalesce into the smallest rung that fits, so the
+steady state only ever dispatches |ladder| distinct shapes — all of them
+AOT-compiled at startup — and the padding fraction is bounded by the
+ladder's geometry (see :meth:`ShapeLadder.bucket`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator, Sequence
+
+DEFAULT_LADDER = (1, 8, 64, 512)
+
+
+class ShapeLadder:
+    """The sorted set of batch sizes the server compiles for."""
+
+    def __init__(self, rungs: Sequence[int] = DEFAULT_LADDER):
+        rungs = sorted(set(int(r) for r in rungs))
+        if not rungs or rungs[0] < 1:
+            raise ValueError(f"ladder rungs must be >= 1, got {rungs}")
+        self.rungs = tuple(rungs)
+
+    @property
+    def max_rung(self) -> int:
+        return self.rungs[-1]
+
+    def bucket(self, n: int) -> int:
+        """Smallest rung >= n (n must fit under the max rung).
+
+        Minimality bounds the padding: for the chosen rung ``s`` with
+        predecessor ``p``, ``n > p`` so ``(s - n)/s < 1 - p/s`` — e.g.
+        7/8 worst-case for the default 1/8/64/512 ladder, and exactly 0
+        whenever ``n`` lands on a rung.
+        """
+        if n < 1:
+            raise ValueError(f"batch of {n} rows")
+        for r in self.rungs:
+            if r >= n:
+                return r
+        raise ValueError(
+            f"{n} rows exceeds the max ladder rung {self.max_rung} — "
+            "split before bucketing (MicroBatcher.batches does)")
+
+    def split(self, n: int) -> list[int]:
+        """Row counts per batch for ``n`` queued rows: full max-size
+        batches first, then one ragged tail batch (padded to its rung)."""
+        out = [self.max_rung] * (n // self.max_rung)
+        if n % self.max_rung:
+            out.append(n % self.max_rung)
+        return out
+
+
+@dataclasses.dataclass
+class Batch:
+    """One padded batch: ``requests`` is [(request, row_lo, row_hi)] —
+    the slice of each request's rows that landed in this batch."""
+
+    rung: int                    # padded row count (the compiled shape)
+    rows: int                    # real rows (<= rung)
+    requests: list[tuple[Any, int, int]]
+
+    @property
+    def padding_frac(self) -> float:
+        return (self.rung - self.rows) / self.rung
+
+
+class MicroBatcher:
+    """Coalesce queued (request, n_rows) pairs into ladder-shaped batches.
+
+    Requests are answered in arrival order; a request larger than the max
+    rung spans several batches (the per-request ``(lo, hi)`` row slices
+    let the server reassemble it).  The batcher never holds work back:
+    :meth:`batches` drains the whole queue, greedily filling max-rung
+    batches and padding only the final ragged one — under sustained load
+    padding tends to zero, at one queued single-row request the batch is
+    the 1-rung (zero padding again).
+    """
+
+    def __init__(self, ladder: ShapeLadder | Sequence[int] = DEFAULT_LADDER):
+        self.ladder = (ladder if isinstance(ladder, ShapeLadder)
+                       else ShapeLadder(ladder))
+        self._queue: list[tuple[Any, int]] = []
+        # running padding accounting (the skew spine's padding_frac idiom)
+        self.padded_rows = 0
+        self.real_rows = 0
+
+    def put(self, request: Any, n_rows: int) -> None:
+        if n_rows < 1:
+            raise ValueError(f"request with {n_rows} rows")
+        self._queue.append((request, int(n_rows)))
+
+    def __len__(self) -> int:
+        return sum(n for _, n in self._queue)
+
+    def batches(self) -> Iterator[Batch]:
+        """Drain the queue into ladder-shaped batches (arrival order)."""
+        queue, self._queue = self._queue, []
+        pending: list[tuple[Any, int, int]] = []  # (request, lo, hi)
+        pending_rows = 0
+
+        def flush() -> Batch:
+            nonlocal pending, pending_rows
+            rung = self.ladder.bucket(pending_rows)
+            b = Batch(rung=rung, rows=pending_rows, requests=pending)
+            self.real_rows += pending_rows
+            self.padded_rows += rung - pending_rows
+            pending, pending_rows = [], 0
+            return b
+
+        for req, n in queue:
+            taken = 0
+            while taken < n:
+                room = self.ladder.max_rung - pending_rows
+                take = min(n - taken, room)
+                pending.append((req, taken, taken + take))
+                pending_rows += take
+                taken += take
+                if pending_rows == self.ladder.max_rung:
+                    yield flush()
+        if pending_rows:
+            yield flush()
+
+    def padding_frac(self) -> float:
+        """Cumulative padded / dispatched rows (0.0 before any batch)."""
+        total = self.real_rows + self.padded_rows
+        return self.padded_rows / total if total else 0.0

@@ -1,0 +1,151 @@
+"""Serving benchmark — qps + latency percentiles as ``kind:"serve"`` rows.
+
+Self-contained (synthetic state + synthetic requests), so it runs on the
+relay without a checkpoint on disk — the ``serve_kmeans`` /
+``serve_mfsgd_topk`` configs in scripts/measure_all.py and the
+``python -m harp_tpu serve <app> --bench`` CLI both route here.  The
+emitted row is validated by scripts/check_jsonl.py invariant 7: latency
+percentiles monotone (p50 ≤ p95 ≤ p99), qps > 0, and — the serving
+loop's whole point — ``steady_compiles == 0`` (the CompileWatch delta
+over the timed region; a row claiming serve throughput while silently
+recompiling per batch must fail the checker, not enter BASELINE.md).
+
+Latency accounting: requests are issued in bursts (the micro-batcher
+sees a real queue, not one request at a time); a request's latency is
+the time from its burst's submission to the completion of the batch
+that produced its last row — queueing plus service, the number a client
+would observe.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+
+import numpy as np
+
+from harp_tpu.serve.engines import ENGINES
+from harp_tpu.serve.server import Server
+from harp_tpu.utils import flightrec, telemetry
+
+DEFAULT_LADDER = (1, 8, 64, 512)
+
+
+def benchmark(app: str = "kmeans", n_requests: int = 256,
+              rows_per_request: int = 1, burst: int = 64,
+              ladder=DEFAULT_LADDER, mesh=None, seed: int = 0,
+              state_shape: dict | None = None, topk: int = 10,
+              cache_dir: str | None = None) -> dict:
+    """Serve ``n_requests`` synthetic requests; return the bench row.
+
+    ``state_shape`` forwards to the engine's ``synthetic_state`` (e.g.
+    ``{"n_users": 138_493, "n_items": 26_744, "rank": 64}`` for the
+    ML-20M-shaped mfsgd config).  ``cache_dir=None`` uses a fresh temp
+    dir, so the AOT cache path (compile → persist → it's a cold start)
+    is exercised without polluting a real cache.
+    """
+    from harp_tpu.parallel.mesh import current_mesh
+
+    if app not in ENGINES:
+        raise ValueError(f"unknown serve app {app!r}")
+    mesh = mesh or current_mesh()
+    rng = np.random.default_rng(seed)
+    state = ENGINES[app].synthetic_state(rng, **(state_shape or {}))
+    engine_opts = {"topk": topk} if app == "mfsgd" else {}
+
+    tmp = None
+    if cache_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="harp_serve_aot_")
+        cache_dir = tmp.name
+    try:
+        srv = Server(app, state=state, mesh=mesh, ladder=ladder,
+                     cache_dir=cache_dir, budget_action="warn",
+                     engine_opts=engine_opts)
+        # telemetry ON (without resetting ambient collectors: bench.py /
+        # measure_all deltas over the same counters must stay monotone)
+        # so CompileWatch evidence backs the steady_compiles claim
+        with telemetry.scope(True, reset=False):
+            t0 = time.perf_counter()
+            info = srv.startup()
+            startup_s = time.perf_counter() - t0
+
+            reqs = [srv.engine.synthetic_request(rng, rows_per_request)
+                    for _ in range(n_requests)]
+            # warmup burst: first dispatch of every executable off-clock
+            warm = [srv.engine.synthetic_request(rng, rows_per_request)
+                    for _ in range(min(burst, 8))]
+            srv.process(warm)
+
+            srv.steady.reset()
+            base = flightrec.snapshot()
+            latencies_ms: list[float] = []
+            t0 = time.perf_counter()
+            for lo in range(0, n_requests, burst):
+                chunk = reqs[lo:lo + burst]
+                responses = srv.process(chunk)
+                bad = [r for r in responses if r and "error" in r]
+                if bad:
+                    raise RuntimeError(f"serve bench request failed: "
+                                       f"{bad[0]['error']}")
+                latencies_ms.extend(_request_latencies_ms(srv, chunk))
+            wall = time.perf_counter() - t0
+            steady = flightrec.delta_since(base)
+        p50, p95, p99 = np.percentile(latencies_ms, [50, 95, 99])
+        return {
+            "kind": "serve", "app": app,
+            "qps": n_requests / wall,
+            "rows_per_sec": n_requests * rows_per_request / wall,
+            "p50_ms": round(float(p50), 4),
+            "p95_ms": round(float(p95), 4),
+            "p99_ms": round(float(p99), 4),
+            "steady_compiles": steady["compiles"],
+            "steady_dispatches": steady["dispatches"],
+            "steady_readbacks": steady["readbacks"],
+            "budget_violations": srv.steady.violations,
+            "batches": srv.steady.batches,
+            "padding_frac": round(srv.batcher.padding_frac(), 6),
+            "startup_sec": round(startup_s, 4),
+            "startup_compiles": info["compiles"],
+            "cache_hits": info["cache_hits"],
+            "cache_misses": info["cache_misses"],
+            "n_requests": n_requests,
+            "rows_per_request": rows_per_request,
+            "burst": burst,
+            "ladder": list(srv.ladder.rungs),
+            "num_workers": mesh.num_workers,
+        }
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def _request_latencies_ms(srv: Server, chunk: list[dict]) -> list[float]:
+    """Per-request latency for one processed burst: completion time of
+    the LAST batch that carried any of the request's rows."""
+    if not srv.last_batch_times:
+        return [0.0] * len(chunk)
+    # rows are batched in arrival order; walk batches assigning requests
+    done_at: list[float] = []
+    rows_left = []
+    for req in chunk:
+        key = srv.engine.REQUEST_KEY
+        val = req.get(key, req.get("x", []))
+        rows_left.append(max(1, len(val)))
+    it = iter(srv.last_batch_times)
+    _, avail, t_done = next(it)
+    for n in rows_left:
+        while n > 0:
+            take = min(n, avail)
+            n -= take
+            avail -= take
+            if n > 0 and avail == 0:
+                _, avail, t_done = next(it)
+        done_at.append(t_done)
+        if avail == 0:
+            nxt = next(it, None)
+            if nxt is None:
+                # trailing requests (shouldn't happen) share the last time
+                avail = 1 << 30
+            else:
+                _, avail, t_done = nxt
+    return [t * 1e3 for t in done_at]

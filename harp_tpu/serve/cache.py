@@ -1,0 +1,156 @@
+"""AOT executable cache — compile once, restart warm.
+
+The flight recorder measured ~140 ms per XLA backend compile over the
+relay (CLAUDE.md traps, 2026-07-30); a server with a 4-rung ladder and
+several apps pays that cold-start cost on every restart unless the
+compiled artifact outlives the process.  This cache persists each
+``jit(...).trace(...).lower().compile()`` result to disk via
+``jax.experimental.serialize_executable`` and loads it back with
+``deserialize_and_load`` — which performs NO backend compile (pinned by
+tests/test_serve.py with CompileWatch), so a warm restart answers its
+first request with zero compiles.
+
+Keys bind the artifact to everything that could invalidate it:
+
+- ``jax.__version__`` (serialized executables are not stable across
+  releases),
+- the topology (platform + device kinds + device count — an executable
+  compiled for 8 sim-CPU devices must not load on a v5e),
+- the batch shape signature (every input aval, so model shapes AND the
+  ladder rung participate),
+- a code fingerprint (sha1 over the serve package sources plus the
+  engine's model module — a changed step function must miss, never
+  silently serve stale code).
+
+Entries are atomic-rename pickle files (the _save_pack discipline from
+models/lda.py: the sprint environment routinely kills processes
+mid-write, and a truncated entry must never poison later restarts).
+A corrupt or stale entry falls back to a fresh compile — the cache can
+lose, never lie.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import warnings
+
+import jax
+
+
+def _topology_tag() -> str:
+    devs = jax.devices()
+    kinds = sorted({d.device_kind for d in devs})
+    return f"{jax.default_backend()}:{len(devs)}:{','.join(kinds)}"
+
+
+def code_fingerprint(extra_modules: tuple = ()) -> str:
+    """sha1 over the serve package sources (+ any engine model modules):
+    the executable is a compilation of this code, so the key must change
+    when it does."""
+    import harp_tpu.serve as pkg
+
+    h = hashlib.sha1()
+    paths = []
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    for fn in sorted(os.listdir(pkg_dir)):
+        if fn.endswith(".py"):
+            paths.append(os.path.join(pkg_dir, fn))
+    for mod in extra_modules:
+        f = getattr(mod, "__file__", None)
+        if f and f.endswith(".py"):
+            paths.append(f)
+    for p in paths:
+        with open(p, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def _aval_sig(args) -> str:
+    parts = []
+    for a in jax.tree.leaves(args):
+        shape = tuple(getattr(a, "shape", ()))
+        dtype = getattr(a, "dtype", None)
+        parts.append(f"{shape}/{dtype}")
+    return ";".join(parts)
+
+
+class ExecutableCache:
+    """Disk-backed cache of serialized XLA executables.
+
+    ``get_or_compile(name, jitted, args)`` returns a loaded executable:
+    on a hit it deserializes (0 compiles); on a miss it compiles, then
+    persists.  ``hits``/``misses`` count per instance so server startup
+    can report cache effectiveness next to the CompileWatch delta.
+    """
+
+    def __init__(self, cache_dir: str, fingerprint: str | None = None):
+        self.cache_dir = os.path.abspath(cache_dir)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.fingerprint = fingerprint or code_fingerprint()
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, name: str, args) -> str:
+        sig = "|".join([name, jax.__version__, _topology_tag(),
+                        self.fingerprint, _aval_sig(args)])
+        return hashlib.sha1(sig.encode()).hexdigest()[:24]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"aot_{key}.pkl")
+
+    def load(self, name: str, args):
+        """The cached executable for (name, arg shapes), or None."""
+        from jax.experimental import serialize_executable
+
+        path = self._path(self._key(name, args))
+        try:
+            with open(path, "rb") as fh:
+                payload = pickle.load(fh)
+            ser, in_tree, out_tree = payload
+            exe = serialize_executable.deserialize_and_load(
+                ser, in_tree, out_tree)
+        except (OSError, EOFError, pickle.UnpicklingError, ValueError,
+                TypeError) as e:
+            if os.path.exists(path):
+                warnings.warn(
+                    f"serve cache entry {os.path.basename(path)} "
+                    f"unreadable ({type(e).__name__}: {e}) — recompiling",
+                    RuntimeWarning)
+            return None
+        self.hits += 1
+        return exe
+
+    def compile_and_store(self, name: str, jitted, args):
+        from jax.experimental import serialize_executable
+
+        with warnings.catch_warnings():
+            # CPU XLA cannot honor buffer donation and warns per compile;
+            # the donation is real on TPU (the double-buffer contract) and
+            # harmlessly ignored on the sim backend
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            exe = jitted.trace(*args).lower().compile()
+        self.misses += 1
+        payload = serialize_executable.serialize(exe)
+        path = self._path(self._key(name, args))
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                pickle.dump(payload, fh)
+            os.replace(tmp, path)
+        except (OSError, pickle.PicklingError) as e:
+            warnings.warn(f"serve cache write failed ({e}) — executable "
+                          "stays in-memory only", RuntimeWarning)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return exe
+
+    def get_or_compile(self, name: str, jitted, args):
+        exe = self.load(name, args)
+        if exe is None:
+            exe = self.compile_and_store(name, jitted, args)
+        return exe

@@ -1,0 +1,627 @@
+"""Per-app inference engines — the batched predict step programs.
+
+Each engine owns three things for one trained app:
+
+- **state**: which arrays a checkpoint must provide (host-validated with
+  numpy only — the warm-restart contract forbids any device math outside
+  the cached executables, or startup would compile), and where each
+  lives on the resident mesh (replicated, or sharded for the model-
+  parallel engines);
+- **step**: the jitted batched-inference program at one ladder rung.
+  Every step folds its outputs into ONE device array so the serving
+  loop's ``readbacks=1`` budget holds, and takes the batch input as its
+  LAST argument with ``donate_argnums`` set — the in-flight batch buffer
+  is donated back to XLA so double-buffered batches reuse it (honored
+  on TPU; the CPU sim ignores donation with a suppressed warning);
+- **protocol**: how request JSON rows become the padded input array and
+  how the stacked output array becomes per-row results.
+
+State layout conventions (what :mod:`harp_tpu.utils.checkpoint` should
+hold — MIGRATING.md "Serving a trained model" shows the export snippet
+per app):
+
+==========  ==========================================================
+app         required checkpoint keys
+==========  ==========================================================
+``kmeans``  ``centroids`` [k, d]
+``mfsgd``   ``W`` [n_users, r], ``H`` [n_items, r] (stripped factors,
+            i.e. ``MFSGD.factors()`` output — not the padded device
+            layout the training checkpoint holds)
+``lda``     ``Nwk`` [vocab, K] word-topic counts (``Nk`` optional,
+            recomputed when absent)
+``mlp``     ``params`` (the trainer's layer list of ``{"w", "b"}``)
+``rf``      ``feats``/``thresh``/``leaves`` (the allgathered forest) +
+            ``edges`` (the quantile bin edges)
+``svm``     ``w`` [d], ``b`` scalar
+==========  ==========================================================
+
+Trainer fit-checkpoints that already contain these keys (mlp's
+``fit_ckpt``, lda's ``fit``) load directly; extra keys are ignored.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from harp_tpu.parallel.mesh import WorkerMesh
+
+_F32 = np.float32
+
+
+def _require(state: dict, keys: tuple, app: str) -> None:
+    missing = [k for k in keys if k not in state]
+    if missing:
+        raise KeyError(
+            f"serve[{app}]: checkpoint state is missing {missing} "
+            f"(has {sorted(state)}) — see harp_tpu/serve/engines.py for "
+            "the per-app state layout")
+
+
+def _np(x, dtype=None):
+    a = np.asarray(x)
+    return a.astype(dtype) if dtype is not None and a.dtype != dtype else a
+
+
+class Engine:
+    """Base: replicated state, ``x`` rows as f32 feature vectors."""
+
+    app = "?"
+    #: request key carrying the rows (list-of-lists unless overridden)
+    REQUEST_KEY = "x"
+
+    def fingerprint_modules(self) -> tuple:
+        """Model modules whose source joins the cache fingerprint (the
+        engines that call into models/ must recompile when it changes)."""
+        return ()
+
+    def __init__(self, state: dict, mesh: WorkerMesh):
+        self.mesh = mesh
+        self._dev_state: tuple | None = None
+        self._load(dict(state))
+
+    # -- subclass surface --------------------------------------------------
+    def _load(self, state: dict) -> None:
+        raise NotImplementedError
+
+    def _step_fn(self):
+        """The batched step: ``fn(*state_args, x) -> stacked out``."""
+        raise NotImplementedError
+
+    def _input_cols(self) -> tuple[int, ...]:
+        """Trailing input dims (input is [batch, *cols])."""
+        raise NotImplementedError
+
+    def _input_dtype(self):
+        return _F32
+
+    def output_rows(self, out: np.ndarray, n_rows: int) -> list:
+        raise NotImplementedError
+
+    # -- shared machinery --------------------------------------------------
+    def jitted(self):
+        import jax
+
+        fn = self._step_fn()
+        n_state = len(self.state_args())
+        return jax.jit(fn, donate_argnums=(n_state,))
+
+    def state_args(self) -> tuple:
+        """Resident device arrays, placed once (replicated by default)."""
+        import jax
+
+        if self._dev_state is None:
+            self._dev_state = tuple(
+                jax.device_put(a, self.mesh.replicated())
+                for a in self._host_state())
+        return self._dev_state
+
+    def _host_state(self) -> tuple:
+        raise NotImplementedError
+
+    def trace_args(self, rung: int) -> tuple:
+        """ShapeDtypeStructs for AOT trace at one ladder rung."""
+        import jax
+
+        state = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                 sharding=self.mesh.replicated())
+            for a in self._host_state())
+        x = jax.ShapeDtypeStruct((rung,) + self._input_cols(),
+                                 np.dtype(self._input_dtype()),
+                                 sharding=self.mesh.replicated())
+        return state + (x,)
+
+    def rows_from_request(self, req: dict) -> np.ndarray:
+        if self.REQUEST_KEY not in req:
+            raise ValueError(
+                f"serve[{self.app}]: request needs {self.REQUEST_KEY!r}")
+        rows = _np(req[self.REQUEST_KEY], self._input_dtype())
+        want = (None,) + self._input_cols()
+        if rows.ndim != len(want) or rows.shape[1:] != want[1:]:
+            raise ValueError(
+                f"serve[{self.app}]: rows shaped {rows.shape}, expected "
+                f"[n, {', '.join(str(c) for c in want[1:])}]")
+        return rows
+
+    def make_input(self, rows: np.ndarray, rung: int) -> np.ndarray:
+        """Pad the real rows up to the rung with zeros (row 0 semantics
+        are harmless in every engine; padded outputs are sliced off)."""
+        if rows.shape[0] == rung:
+            return np.ascontiguousarray(rows)
+        pad = np.zeros((rung - rows.shape[0],) + rows.shape[1:],
+                       rows.dtype)
+        return np.concatenate([rows, pad], axis=0)
+
+    def put_input(self, arr: np.ndarray):
+        import jax
+
+        return jax.device_put(arr, self.mesh.replicated())
+
+    # -- bench/test helpers ------------------------------------------------
+    @classmethod
+    def synthetic_state(cls, rng: np.random.Generator, **shape) -> dict:
+        raise NotImplementedError
+
+    def synthetic_request(self, rng: np.random.Generator,
+                          n_rows: int) -> dict:
+        raise NotImplementedError
+
+
+class KMeansAssign(Engine):
+    """Nearest-centroid assignment — the serving half of edu.iu.kmeans.
+
+    Same MXU decomposition as training (models/kmeans.py): the argmin
+    drops the assignment-invariant row norms, so the score matrix is one
+    ``x @ centroidsᵀ`` dot per batch.
+    """
+
+    app = "kmeans"
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("centroids",), self.app)
+        self.centroids = _np(state["centroids"], _F32)
+        if self.centroids.ndim != 2:
+            raise ValueError("centroids must be [k, d]")
+        self.k, self.d = self.centroids.shape
+
+    def _host_state(self):
+        return (self.centroids,)
+
+    def _input_cols(self):
+        return (self.d,)
+
+    def _step_fn(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def step(centroids, x):
+            dots = lax.dot_general(
+                x, centroids.T, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            c2 = (centroids.astype(jnp.float32) ** 2).sum(-1)
+            return jnp.argmin(c2[None, :] - 2.0 * dots,
+                              axis=1).astype(jnp.int32)
+
+        return step
+
+    def output_rows(self, out, n_rows):
+        return [int(c) for c in out[:n_rows]]
+
+    @classmethod
+    def synthetic_state(cls, rng, k=16, d=32, **_):
+        return {"centroids": rng.normal(size=(k, d)).astype(_F32)}
+
+    def synthetic_request(self, rng, n_rows):
+        return {"x": rng.normal(size=(n_rows, self.d)).astype(
+            _F32).tolist()}
+
+
+class MFSGDTopK(Engine):
+    """Dot-product top-k recommendation over rotated MF factors.
+
+    Model-parallel on the resident mesh: ``H`` shards over workers (the
+    item axis), each worker scores its slice and keeps a local top-k,
+    and one ``pull`` (allgather) merges the per-worker candidates into
+    the exact global top-k — the wire carries [nw, batch, k] candidate
+    pairs instead of the full [batch, n_items] score matrix.
+    """
+
+    app = "mfsgd"
+    REQUEST_KEY = "users"
+
+    def __init__(self, state: dict, mesh: WorkerMesh, topk: int = 10):
+        self.topk = int(topk)
+        super().__init__(state, mesh)
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("W", "H"), self.app)
+        self.W = _np(state["W"], _F32)
+        H = _np(state["H"], _F32)
+        if self.W.ndim != 2 or H.ndim != 2 or self.W.shape[1] != H.shape[1]:
+            raise ValueError("W/H must be [n, r] with matching rank")
+        self.n_users, self.rank = self.W.shape
+        self.n_items = H.shape[0]
+        self.topk = min(self.topk, self.n_items)
+        nw = self.mesh.num_workers
+        ipw = -(-self.n_items // nw)
+        pad = nw * ipw - self.n_items
+        self.H_padded = (np.concatenate(
+            [H, np.zeros((pad, self.rank), _F32)]) if pad else H)
+        self.items_per_worker = ipw
+
+    def _host_state(self):
+        return (self.W, self.H_padded)
+
+    def state_args(self):
+        import jax
+
+        if self._dev_state is None:
+            self._dev_state = (
+                jax.device_put(self.W, self.mesh.replicated()),
+                jax.device_put(self.H_padded,
+                               self.mesh.sharding(self.mesh.spec(0))),
+            )
+        return self._dev_state
+
+    def trace_args(self, rung: int):
+        import jax
+
+        return (
+            jax.ShapeDtypeStruct(self.W.shape, np.dtype(_F32),
+                                 sharding=self.mesh.replicated()),
+            jax.ShapeDtypeStruct(self.H_padded.shape, np.dtype(_F32),
+                                 sharding=self.mesh.sharding(
+                                     self.mesh.spec(0))),
+            jax.ShapeDtypeStruct((rung,), np.dtype(np.int32),
+                                 sharding=self.mesh.replicated()),
+        )
+
+    def _input_cols(self):
+        return ()
+
+    def _input_dtype(self):
+        return np.int32
+
+    def _step_fn(self):
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from harp_tpu.parallel import collective as C
+        from harp_tpu.parallel.mesh import worker_id
+
+        kk = self.topk
+        ipw = self.items_per_worker
+        n_items = self.n_items
+        k_local = min(kk, ipw)
+
+        def prog(W, H_loc, users):
+            w = W[users]                                   # [b, r]
+            scores = lax.dot_general(
+                w, H_loc.T, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [b, ipw]
+            gid = (worker_id() * ipw
+                   + lax.broadcasted_iota(jnp.int32, scores.shape, 1))
+            scores = jnp.where(gid < n_items, scores, -jnp.inf)
+            s_loc, i_loc = lax.top_k(scores, k_local)      # [b, k_local]
+            id_loc = jnp.take_along_axis(gid, i_loc, axis=1)
+            # merge: every worker pulls all candidates, takes the exact
+            # global top-k over nw*k_local (replicated result)
+            s_all, id_all = C.allgather(
+                (s_loc[None], id_loc.astype(jnp.float32)[None]),
+                tiled=False)                               # [nw, 1, b, k]
+            b = s_loc.shape[0]
+            s_all = jnp.moveaxis(s_all[:, 0], 0, 1).reshape(b, -1)
+            id_all = jnp.moveaxis(id_all[:, 0], 0, 1).reshape(b, -1)
+            s_top, pick = lax.top_k(s_all, kk)             # [b, kk]
+            id_top = jnp.take_along_axis(id_all, pick, axis=1)
+            return jnp.concatenate([id_top, s_top], axis=1)  # [b, 2*kk]
+
+        return self.mesh.shard_map(
+            prog,
+            in_specs=(P(), self.mesh.spec(0), P()),
+            out_specs=P(),
+        )
+
+    def rows_from_request(self, req: dict) -> np.ndarray:
+        if "users" not in req:
+            raise ValueError("serve[mfsgd]: request needs 'users'")
+        users = _np(req["users"], np.int32)
+        if users.ndim != 1:
+            raise ValueError("serve[mfsgd]: 'users' must be a flat list")
+        if users.size and (users.min() < 0 or users.max() >= self.n_users):
+            raise ValueError(
+                f"serve[mfsgd]: user ids must lie in [0, {self.n_users})")
+        return users
+
+    def output_rows(self, out, n_rows):
+        kk = self.topk
+        ids = out[:n_rows, :kk].astype(np.int64)
+        scores = out[:n_rows, kk:]
+        return [{"items": r_ids.tolist(),
+                 "scores": [round(float(s), 6) for s in r_s]}
+                for r_ids, r_s in zip(ids, scores)]
+
+    @classmethod
+    def synthetic_state(cls, rng, n_users=512, n_items=256, rank=16, **_):
+        return {"W": rng.normal(size=(n_users, rank)).astype(_F32),
+                "H": rng.normal(size=(n_items, rank)).astype(_F32)}
+
+    def synthetic_request(self, rng, n_rows):
+        return {"users": rng.integers(0, self.n_users,
+                                      n_rows).astype(int).tolist()}
+
+
+class LDAInfer(Engine):
+    """Fold-in topic inference from trained word-topic counts.
+
+    Requests carry bag-of-words count vectors over the training vocab;
+    the step runs a fixed number of EM iterations of the standard
+    fold-in (phi held fixed, per-doc theta re-estimated) — two MXU
+    matmuls per iteration, no per-token work.
+    """
+
+    app = "lda"
+
+    def __init__(self, state: dict, mesh: WorkerMesh, em_iters: int = 16,
+                 beta: float = 0.01, alpha: float = 0.0):
+        self.em_iters = int(em_iters)
+        self.beta = float(beta)
+        self.alpha = float(alpha)
+        super().__init__(state, mesh)
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("Nwk",), self.app)
+        Nwk = _np(state["Nwk"], _F32)
+        if Nwk.ndim != 2:
+            raise ValueError("Nwk must be [vocab, K]")
+        self.vocab_size, self.n_topics = Nwk.shape
+        Nk = (_np(state["Nk"], _F32) if "Nk" in state else Nwk.sum(0))
+        # phi[w, k] = p(w | k), smoothed exactly as training's sampler
+        self.phi = ((Nwk + self.beta)
+                    / (Nk[None, :] + self.vocab_size * self.beta)
+                    ).astype(_F32)
+
+    def _host_state(self):
+        return (self.phi,)
+
+    def _input_cols(self):
+        return (self.vocab_size,)
+
+    def _step_fn(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        K = self.n_topics
+        iters = self.em_iters
+        alpha = self.alpha
+
+        def step(phi, x):
+            theta = jnp.full((x.shape[0], K), 1.0 / K, jnp.float32)
+
+            def body(_, theta):
+                denom = lax.dot_general(
+                    theta, phi.T, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)     # [b, V]
+                r = x / jnp.maximum(denom, 1e-30)
+                theta = theta * lax.dot_general(
+                    r, phi, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) + alpha
+                return theta / jnp.maximum(
+                    theta.sum(-1, keepdims=True), 1e-30)
+
+            return lax.fori_loop(0, iters, body, theta)
+
+        return step
+
+    def output_rows(self, out, n_rows):
+        return [{"theta": [round(float(t), 6) for t in row]}
+                for row in out[:n_rows]]
+
+    @classmethod
+    def synthetic_state(cls, rng, vocab_size=128, n_topics=8, **_):
+        return {"Nwk": rng.integers(
+            0, 50, (vocab_size, n_topics)).astype(_F32)}
+
+    def synthetic_request(self, rng, n_rows):
+        return {"x": rng.integers(
+            0, 4, (n_rows, self.vocab_size)).astype(_F32).tolist()}
+
+
+class MLPPredict(Engine):
+    """Forward pass through the trained DP MLP (logits + argmax class)."""
+
+    app = "mlp"
+
+    def fingerprint_modules(self):
+        from harp_tpu.models import mlp
+
+        return (mlp,)
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("params",), self.app)
+        params = state["params"]
+        if isinstance(params, dict):  # orbax may restore a list as a dict
+            params = [params[k] for k in sorted(params, key=_int_if_digit)]
+        self.params = [{"w": _np(l["w"], _F32), "b": _np(l["b"], _F32)}
+                       for l in params]
+        self.d_in = self.params[0]["w"].shape[0]
+        self.n_classes = self.params[-1]["w"].shape[1]
+
+    def _host_state(self):
+        out = []
+        for layer in self.params:
+            out += [layer["w"], layer["b"]]
+        return tuple(out)
+
+    def _input_cols(self):
+        return (self.d_in,)
+
+    def _step_fn(self):
+        from harp_tpu.models.mlp import MLPConfig, forward
+
+        sizes = [self.d_in] + [l["w"].shape[1] for l in self.params]
+        cfg = MLPConfig(sizes=tuple(sizes))
+        n_layers = len(self.params)
+
+        def step(*args):
+            flat, x = args[:-1], args[-1]
+            params = [{"w": flat[2 * i], "b": flat[2 * i + 1]}
+                      for i in range(n_layers)]
+            return forward(params, x, cfg)                 # [b, classes]
+
+        return step
+
+    def output_rows(self, out, n_rows):
+        out = out[:n_rows]
+        return [{"class": int(np.argmax(row)),
+                 "logits": [round(float(v), 6) for v in row]}
+                for row in out]
+
+    @classmethod
+    def synthetic_state(cls, rng, sizes=(32, 16, 4), **_):
+        params = []
+        for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+            params.append({
+                "w": (rng.normal(size=(fan_in, fan_out))
+                      * np.sqrt(2.0 / fan_in)).astype(_F32),
+                "b": np.zeros((fan_out,), _F32)})
+        return {"params": params}
+
+    def synthetic_request(self, rng, n_rows):
+        return {"x": rng.normal(size=(n_rows, self.d_in)).astype(
+            _F32).tolist()}
+
+
+def _int_if_digit(k):
+    return (0, int(k)) if str(k).isdigit() else (1, str(k))
+
+
+class RFPredict(Engine):
+    """Majority-vote forest prediction (host binize + device routing)."""
+
+    app = "rf"
+
+    def fingerprint_modules(self):
+        from harp_tpu.models import rf
+
+        return (rf,)
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("feats", "thresh", "leaves", "edges"), self.app)
+        self.feats = _np(state["feats"], np.int32)
+        self.thresh = _np(state["thresh"], np.int32)
+        self.leaves = _np(state["leaves"], np.int32)
+        self.edges = _np(state["edges"], _F32)
+        inner = self.feats.shape[1]
+        self.max_depth = int(np.log2(inner + 1))
+        if 2 ** self.max_depth - 1 != inner:
+            raise ValueError(f"feats width {inner} is not 2^d - 1")
+        self.n_classes = int(state.get("n_classes",
+                                       int(self.leaves.max()) + 1))
+        self.n_features = self.edges.shape[0]
+
+    def _host_state(self):
+        return (self.feats, self.thresh, self.leaves)
+
+    def _input_cols(self):
+        return (self.n_features,)
+
+    def _input_dtype(self):
+        return np.int32
+
+    def _step_fn(self):
+        from harp_tpu.models.rf import predict_forest
+
+        max_depth, n_classes = self.max_depth, self.n_classes
+
+        def step(feats, thresh, leaves, bins):
+            return predict_forest((feats, thresh, leaves), bins,
+                                  max_depth, n_classes)
+
+        return step
+
+    def rows_from_request(self, req: dict) -> np.ndarray:
+        from harp_tpu.models.rf import binize
+
+        if "x" not in req:
+            raise ValueError("serve[rf]: request needs 'x'")
+        x = _np(req["x"], _F32)
+        if x.ndim != 2 or x.shape[1] != self.n_features:
+            raise ValueError(
+                f"serve[rf]: rows shaped {x.shape}, expected "
+                f"[n, {self.n_features}]")
+        return binize(x, self.edges)
+
+    def output_rows(self, out, n_rows):
+        return [int(c) for c in out[:n_rows]]
+
+    @classmethod
+    def synthetic_state(cls, rng, n_trees=4, max_depth=3, n_features=8,
+                        n_bins=16, n_classes=2, **_):
+        inner = 2 ** max_depth - 1
+        return {
+            "feats": rng.integers(0, n_features,
+                                  (n_trees, inner)).astype(np.int32),
+            "thresh": rng.integers(0, n_bins - 1,
+                                   (n_trees, inner)).astype(np.int32),
+            "leaves": rng.integers(0, n_classes,
+                                   (n_trees, 2 ** max_depth)
+                                   ).astype(np.int32),
+            "edges": np.sort(rng.normal(size=(n_features, n_bins - 1)),
+                             axis=1).astype(_F32),
+            "n_classes": np.int64(n_classes),
+        }
+
+    def synthetic_request(self, rng, n_rows):
+        return {"x": rng.normal(size=(n_rows, self.n_features)).astype(
+            _F32).tolist()}
+
+
+class SVMPredict(Engine):
+    """Linear decision function; label is the host-side sign."""
+
+    app = "svm"
+
+    def _load(self, state: dict) -> None:
+        _require(state, ("w", "b"), self.app)
+        self.w = _np(state["w"], _F32).reshape(-1)
+        self.b = _F32(np.asarray(state["b"]).reshape(()))
+        self.d = self.w.shape[0]
+
+    def _host_state(self):
+        return (self.w, np.asarray(self.b, _F32))
+
+    def _input_cols(self):
+        return (self.d,)
+
+    def _step_fn(self):
+        def step(w, b, x):
+            return x @ w + b                                # [b]
+
+        return step
+
+    def output_rows(self, out, n_rows):
+        return [{"score": round(float(s), 6),
+                 "label": 1 if s >= 0 else -1} for s in out[:n_rows]]
+
+    @classmethod
+    def synthetic_state(cls, rng, d=32, **_):
+        return {"w": rng.normal(size=d).astype(_F32), "b": _F32(0.1)}
+
+    def synthetic_request(self, rng, n_rows):
+        return {"x": rng.normal(size=(n_rows, self.d)).astype(
+            _F32).tolist()}
+
+
+ENGINES: dict[str, type[Engine]] = {
+    e.app: e for e in (KMeansAssign, MFSGDTopK, LDAInfer, MLPPredict,
+                       RFPredict, SVMPredict)}
+
+
+def make_engine(app: str, state: dict, mesh: WorkerMesh,
+                **opts) -> Engine:
+    if app not in ENGINES:
+        raise ValueError(
+            f"no serve engine for {app!r}; choose from {sorted(ENGINES)}")
+    return ENGINES[app](state, mesh, **opts)

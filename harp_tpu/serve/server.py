@@ -1,0 +1,351 @@
+"""The ``harp serve`` server — persistent mesh, JSONL over stdio.
+
+Reference parity: none (ROADMAP "harp serve"; Harp is batch fit-and-exit
+— PARITY.md serving row).  Lifecycle:
+
+1. **startup** — load the newest checkpoint
+   (:meth:`~harp_tpu.utils.checkpoint.CheckpointManager.restore_latest`),
+   place the engine's model state on the resident mesh, and obtain one
+   executable per ladder rung through the AOT cache
+   (:mod:`harp_tpu.serve.cache`) — on a warm restart every rung is a
+   cache hit and startup performs ZERO XLA compiles;
+2. **steady state** — drain queued requests through the micro-batcher
+   (:mod:`harp_tpu.serve.batcher`); every batch runs under the
+   flight-recorder steady-state guard (``compiles=0, dispatches=1,
+   readbacks=1`` — :class:`harp_tpu.utils.flightrec.SteadyState`), so
+   the relay traps are enforced invariants of the loop, not advice.
+   While batch *t* executes, batch *t+1*'s padded input is staged onto
+   the device (the donate-argnums double buffer: the step donates its
+   batch buffer, so XLA can reuse it for the next staging on TPU).
+
+The request protocol is line-delimited JSON on stdin/stdout — no
+network stack, so the whole server is testable (and benchmarkable) in
+process:
+
+- request: ``{"id": <any>, "x": [[...], ...]}`` (``"users"`` for
+  mfsgd); rows beyond the max ladder rung span several batches;
+- response: ``{"id": <same>, "result": [<one entry per row>]}`` in
+  request order, or ``{"id": ..., "error": "..."}``;
+- control: ``{"cmd": "stats"}`` emits a stats line, ``{"cmd": "quit"}``
+  (or EOF) shuts down.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Sequence
+
+import numpy as np
+
+from harp_tpu.serve.batcher import DEFAULT_LADDER, MicroBatcher, ShapeLadder
+from harp_tpu.serve.cache import ExecutableCache, code_fingerprint
+from harp_tpu.serve.engines import make_engine
+from harp_tpu.utils import flightrec, telemetry
+
+
+class Server:
+    """One app's inference server on a resident mesh.
+
+    ``state`` (a checkpoint pytree) or ``ckpt`` (a CheckpointManager
+    root; newest step restored) must be given.  ``cache_dir=None``
+    disables persistence (every startup compiles); with a directory the
+    AOT cache makes warm restarts compile-free.  ``budget_action`` is
+    "raise" (tests) or "warn" (production/bench: record, don't die).
+    """
+
+    def __init__(self, app: str, state: dict | None = None, *,
+                 ckpt: str | None = None, mesh=None,
+                 ladder: Sequence[int] = DEFAULT_LADDER,
+                 cache_dir: str | None = None,
+                 budget_action: str = "raise", engine_opts: dict | None = None):
+        from harp_tpu.parallel.mesh import current_mesh
+
+        if state is None:
+            if ckpt is None:
+                raise ValueError("Server needs state= or ckpt=")
+            from harp_tpu.utils.checkpoint import CheckpointManager
+
+            self.ckpt_step, state = CheckpointManager(ckpt).restore_latest()
+        else:
+            self.ckpt_step = None
+        self.app = app
+        self.mesh = mesh or current_mesh()
+        self.engine = make_engine(app, state, self.mesh,
+                                  **(engine_opts or {}))
+        self.ladder = (ladder if isinstance(ladder, ShapeLadder)
+                       else ShapeLadder(ladder))
+        self.batcher = MicroBatcher(self.ladder)
+        self.cache = (ExecutableCache(
+            cache_dir,
+            code_fingerprint(self.engine.fingerprint_modules()))
+            if cache_dir else None)
+        self.steady = flightrec.SteadyState(
+            compiles=0, dispatches=1, readbacks=1,
+            action=budget_action, tag=f"serve.{app}")
+        self._exec: dict[int, object] = {}
+        self.requests_served = 0
+        self.rows_served = 0
+        self.last_batch_times: list[tuple[int, int, float]] = []
+
+    # -- startup -----------------------------------------------------------
+    def startup(self) -> dict:
+        """Place state + obtain every rung's executable (AOT cache first).
+
+        Returns ``{"rungs", "cache_hits", "cache_misses", "compiles"}``;
+        ``compiles`` is the CompileWatch delta across startup (needs
+        telemetry enabled; None otherwise) — on a warm restart it is 0.
+        """
+        base = flightrec.snapshot() if telemetry.enabled() else None
+        self.engine.state_args()  # resident placement (device_put only)
+        jitted = self.engine.jitted()
+        for rung in self.ladder.rungs:
+            args = self.engine.trace_args(rung)
+            name = f"{self.app}"
+            if self.cache is not None:
+                exe = self.cache.get_or_compile(name, jitted, args)
+            else:
+                exe = self.cache_less_compile(jitted, args)
+            self._exec[rung] = flightrec.track(
+                exe, f"serve.{self.app}.b{rung}")
+        self.steady.reset()
+        return {
+            "rungs": list(self.ladder.rungs),
+            "cache_hits": self.cache.hits if self.cache else 0,
+            "cache_misses": self.cache.misses if self.cache else 0,
+            "compiles": (flightrec.delta_since(base)["compiles"]
+                         if base is not None else None),
+        }
+
+    @staticmethod
+    def cache_less_compile(jitted, args):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return jitted.trace(*args).lower().compile()
+
+    # -- steady state ------------------------------------------------------
+    def _stage(self, batch, rows_by_slot: dict):
+        parts = [rows_by_slot[slot][lo:hi]
+                 for slot, lo, hi in batch.requests]
+        rows = (np.concatenate(parts, axis=0) if len(parts) > 1
+                else parts[0])
+        return self.engine.put_input(
+            self.engine.make_input(rows, batch.rung))
+
+    def process(self, requests: list[dict]) -> list[dict]:
+        """Answer a burst of requests (arrival order preserved)."""
+        if not self._exec:
+            raise RuntimeError("call startup() before process()")
+        t0 = time.perf_counter()
+        responses: list[dict | None] = [None] * len(requests)
+        rows_by_slot: dict[int, np.ndarray] = {}
+        out_segs: dict[int, list[np.ndarray]] = {}
+        for slot, req in enumerate(requests):
+            if not isinstance(req, dict):
+                responses[slot] = {"id": None,
+                                   "error": "request must be a JSON object"}
+                continue
+            try:
+                rows = self.engine.rows_from_request(req)
+                if rows.shape[0] == 0:
+                    responses[slot] = {"id": req.get("id"), "result": []}
+                    continue
+            except (ValueError, KeyError, TypeError) as e:
+                responses[slot] = {"id": req.get("id"), "error": str(e)}
+                continue
+            rows_by_slot[slot] = rows
+            out_segs[slot] = []
+            self.batcher.put(slot, rows.shape[0])
+
+        batches = list(self.batcher.batches())
+        self.last_batch_times = []
+        state_args = self.engine.state_args()
+        staged = self._stage(batches[0], rows_by_slot) if batches else None
+        for i, batch in enumerate(batches):
+            with self.steady.batch():
+                out_dev = self._exec[batch.rung](*state_args, staged)
+                # double buffer: stage batch i+1 while i is in flight
+                staged = (self._stage(batches[i + 1], rows_by_slot)
+                          if i + 1 < len(batches) else None)
+                out = flightrec.readback(out_dev)
+            self.last_batch_times.append(
+                (batch.rung, batch.rows, time.perf_counter() - t0))
+            cursor = 0
+            for slot, lo, hi in batch.requests:
+                out_segs[slot].append(out[cursor:cursor + (hi - lo)])
+                cursor += hi - lo
+            self.rows_served += batch.rows
+
+        for slot, segs in out_segs.items():
+            full = (np.concatenate(segs, axis=0) if len(segs) > 1
+                    else segs[0])
+            n = rows_by_slot[slot].shape[0]
+            responses[slot] = {
+                "id": requests[slot].get("id"),
+                "result": self.engine.output_rows(full, n)}
+        self.requests_served += sum(r is not None and "result" in r
+                                    for r in responses)
+        return responses  # type: ignore[return-value]
+
+    def stats(self) -> dict:
+        return {
+            "kind": "serve_stats", "app": self.app,
+            "requests_served": self.requests_served,
+            "rows_served": self.rows_served,
+            "padding_frac": round(self.batcher.padding_frac(), 6),
+            "steady": self.steady.summary(),
+        }
+
+    # -- stdio loop --------------------------------------------------------
+    def serve_stdio(self, stdin: IO, stdout: IO) -> int:
+        """Blocking JSONL loop; returns the number of requests answered.
+
+        Consecutive already-available lines coalesce into one burst (so
+        the micro-batcher sees the real queue depth, not one request at
+        a time); a line arriving alone is its own burst — the 1-rung.
+        """
+        while True:
+            lines = _read_burst(stdin)
+            if not lines:
+                return self.requests_served
+            burst: list[dict] = []
+            for line in lines:
+                try:
+                    req = json.loads(line)
+                except ValueError:
+                    # flush first: responses must come out in input order
+                    self._flush(burst, stdout)
+                    burst = []
+                    stdout.write(json.dumps(
+                        {"id": None, "error": "unparseable JSON"}) + "\n")
+                    continue
+                cmd = req.get("cmd") if isinstance(req, dict) else None
+                if cmd == "quit":
+                    self._flush(burst, stdout)
+                    stdout.flush()
+                    return self.requests_served
+                if cmd == "stats":
+                    self._flush(burst, stdout)
+                    burst = []
+                    stdout.write(json.dumps(self.stats()) + "\n")
+                    continue
+                burst.append(req)
+            self._flush(burst, stdout)
+            stdout.flush()
+
+    def _flush(self, burst: list[dict], stdout: IO) -> None:
+        if burst:
+            for resp in self.process(burst):
+                stdout.write(json.dumps(resp) + "\n")
+
+
+def _read_burst(stdin: IO) -> list[str]:
+    """One blocking readline, then every line already available (select
+    on real files; plain greedy reads on in-memory streams, which never
+    block).  Empty list = EOF."""
+    line = stdin.readline()
+    if not line:
+        return []
+    lines = [line]
+    try:
+        fd = stdin.fileno()
+    except (OSError, ValueError, AttributeError):
+        fd = None
+    if fd is None:
+        while True:  # StringIO etc.: reads never block, drain to EOF
+            nxt = stdin.readline()
+            if not nxt:
+                break
+            lines.append(nxt)
+        return [ln for ln in lines if ln.strip()]
+    import select
+
+    while True:
+        ready, _, _ = select.select([stdin], [], [], 0)
+        if not ready:
+            break
+        nxt = stdin.readline()
+        if not nxt:
+            break
+        lines.append(nxt)
+    return [ln for ln in lines if ln.strip()]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from harp_tpu.serve.engines import ENGINES
+
+    p = argparse.ArgumentParser(
+        prog="python -m harp_tpu serve",
+        description="persistent-mesh inference server (JSONL over stdio)")
+    p.add_argument("app", choices=sorted(ENGINES))
+    p.add_argument("--ckpt", default=None,
+                   help="checkpoint root (CheckpointManager layout); "
+                        "newest step is restored")
+    p.add_argument("--cache-dir", default=None,
+                   help="AOT executable cache directory (default: "
+                        "<ckpt>/.aot_cache; omit both for no persistence)")
+    p.add_argument("--ladder", default=None,
+                   help="comma-separated batch rungs (default 1,8,64,512)")
+    p.add_argument("--topk", type=int, default=10,
+                   help="mfsgd: recommendations per user")
+    p.add_argument("--em-iters", type=int, default=16,
+                   help="lda: fold-in EM iterations")
+    p.add_argument("--bench", action="store_true",
+                   help="measure qps + latency percentiles on synthetic "
+                        "state/requests and print ONE provenance-stamped "
+                        'kind:"serve" JSON row instead of serving stdio')
+    p.add_argument("--requests", type=int, default=256,
+                   help="--bench: number of synthetic requests")
+    p.add_argument("--rows-per-request", type=int, default=1)
+    p.add_argument("--platform", choices=["cpu"], default=None,
+                   help="force the CPU backend (the axon site pin would "
+                        "otherwise route to the TPU relay — CLAUDE.md)")
+    args = p.parse_args(argv)
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    ladder = (tuple(int(r) for r in args.ladder.split(","))
+              if args.ladder else DEFAULT_LADDER)
+
+    if args.bench:
+        from harp_tpu.serve.bench import benchmark
+        from harp_tpu.utils.metrics import benchmark_json
+
+        res = benchmark(app=args.app, n_requests=args.requests,
+                        rows_per_request=args.rows_per_request,
+                        ladder=ladder)
+        print(benchmark_json(f"serve_{args.app}", res))
+        return 0
+
+    if args.ckpt is None:
+        p.error("--ckpt is required (or use --bench)")
+    engine_opts = {}
+    if args.app == "mfsgd":
+        engine_opts["topk"] = args.topk
+    if args.app == "lda":
+        engine_opts["em_iters"] = args.em_iters
+    cache_dir = args.cache_dir
+    if cache_dir is None and args.ckpt:
+        import os
+
+        cache_dir = os.path.join(args.ckpt, ".aot_cache")
+    srv = Server(args.app, ckpt=args.ckpt, ladder=ladder,
+                 cache_dir=cache_dir, budget_action="warn",
+                 engine_opts=engine_opts)
+    info = srv.startup()
+    print(json.dumps({"kind": "serve_ready", "app": args.app,
+                      "step": srv.ckpt_step, **info}),
+          file=sys.stderr, flush=True)
+    srv.serve_stdio(sys.stdin, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

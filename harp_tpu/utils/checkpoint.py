@@ -64,6 +64,13 @@ class CheckpointManager:
             shutil.rmtree(self._path(old), ignore_errors=True)
         return path
 
+    def restore_latest(self) -> tuple[int, Any]:
+        """(newest step, state) — the ``harp serve`` load path: a server
+        wants "the newest trained model under this root" without
+        enumerating steps itself.  Raises FileNotFoundError when the
+        root holds no checkpoints (same contract as :meth:`restore`)."""
+        return self.restore(None)
+
     def restore(self, step: int | None = None) -> tuple[int, Any]:
         """Restore (step, state); latest if step is None."""
         if step is None:

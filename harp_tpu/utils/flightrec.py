@@ -378,6 +378,80 @@ def budget(compiles: int | None = None, h2d_bytes: int | None = None,
             raise BudgetExceeded(msg)
 
 
+class SteadyState:
+    """Per-batch budget for long-lived loops (the ``harp serve`` guard).
+
+    :func:`budget` guards one block; a serving loop needs the same bound
+    re-applied to every batch forever, plus an account of how the steady
+    state actually spent — so the bench row can *prove* "0 compiles in
+    steady state" rather than assert it.  Usage::
+
+        steady = flightrec.SteadyState(compiles=0, dispatches=1,
+                                       readbacks=1, tag="serve.kmeans")
+        for batch in batches:
+            with steady.batch():
+                out = exe(*state, x)        # 1 tracked dispatch
+                res = flightrec.readback(out)  # 1 stacked readback
+        steady.summary()  # {"batches", "violations", + counter deltas}
+
+    ``action="raise"`` (default) raises :class:`BudgetExceeded` on the
+    offending batch (tests); ``action="warn"`` warns and keeps serving,
+    counting the violation (production — a server must not die because
+    one batch recompiled, but the row must say it happened).  Like
+    :func:`budget`, a batch is a no-op while telemetry is disabled.
+    """
+
+    def __init__(self, compiles: int | None = 0,
+                 dispatches: int | None = 1, readbacks: int | None = 1,
+                 h2d_bytes: int | None = None,
+                 d2h_bytes: int | None = None, *,
+                 action: str = "raise", tag: str = "steady"):
+        self.limits = {"compiles": compiles, "dispatches": dispatches,
+                       "readbacks": readbacks, "h2d_bytes": h2d_bytes,
+                       "d2h_bytes": d2h_bytes}
+        self.action = action
+        self.tag = tag
+        self.reset()
+
+    def reset(self) -> None:
+        """Start a fresh steady-state window (server startup calls this
+        so startup compiles never count against the steady summary)."""
+        self.batches = 0
+        self.violations = 0
+        self._base = snapshot() if telemetry.enabled() else None
+
+    @contextlib.contextmanager
+    def batch(self):
+        if not telemetry.enabled():
+            yield None
+            return
+        if self._base is None:  # telemetry enabled after construction
+            self._base = snapshot()
+        base = snapshot()
+        yield None
+        spent = delta_since(base)
+        self.batches += 1
+        over = [f"{k} used {spent[k]} > budget {v}"
+                for k, v in self.limits.items()
+                if v is not None and spent[k] > v]
+        if over:
+            self.violations += 1
+            msg = (f"steady-state budget exceeded [{self.tag}] batch "
+                   f"{self.batches}: " + "; ".join(over))
+            if self.action == "warn":
+                warnings.warn(msg, RuntimeWarning, stacklevel=3)
+            else:
+                raise BudgetExceeded(msg)
+
+    def summary(self) -> dict:
+        """Batch/violation counts + cumulative counter deltas since
+        :meth:`reset` (deltas absent when telemetry never enabled)."""
+        out = {"batches": self.batches, "violations": self.violations}
+        if self._base is not None:
+            out.update(delta_since(self._base))
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Export
 # ---------------------------------------------------------------------------

@@ -30,6 +30,15 @@ SMOKE = {
     "lda_scatter": {"n_docs": 256, "vocab_size": 128, "n_topics": 8,
                     "tokens_per_doc": 16, "epochs": 1, "chunk": 256},
     "mlp": {"n": 4096, "batch": 512, "steps": 5},
+    # serving (PR 6): tiny ladder + state, seconds on the CPU sim; the
+    # state_shape kwargs feed the engines' synthetic_state
+    "serve_kmeans": {"n_requests": 48, "rows_per_request": 2,
+                     "burst": 16, "ladder": (1, 8, 32),
+                     "state_shape": {"k": 16, "d": 32}},
+    "serve_mfsgd_topk": {"n_requests": 48, "rows_per_request": 2,
+                         "burst": 16, "ladder": (1, 8, 32),
+                         "state_shape": {"n_users": 256, "n_items": 128,
+                                         "rank": 8}},
     "subgraph": {"n_vertices": 2000, "avg_degree": 4},
     "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2},
 }

@@ -53,6 +53,16 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    (``KNOWN_LINT_RULES`` — kept in sync with
    ``harp_tpu.analysis.rules`` by tests/test_lint.py), and the
    per-file/per-rule violation counts must be non-negative integers.
+
+7. **Serve rows are coherent serving evidence** (any file): a ``kind:
+   "serve"`` row (``harp_tpu.serve.bench`` / ``serve <app> --bench``)
+   must carry the provenance stamp, its latency percentiles must be
+   non-negative and monotone (``p50_ms <= p95_ms <= p99_ms`` — crossed
+   percentiles mean the latency sample was mangled), ``qps`` must be a
+   positive number, and ``steady_compiles`` must be EXACTLY 0 — the
+   serving loop's whole contract is that the steady state never
+   recompiles, so a row that measured throughput while silently
+   compiling per batch is not serving evidence at all.
 """
 
 from __future__ import annotations
@@ -209,6 +219,47 @@ def _check_lint_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+SERVE_PCTL_FIELDS = ("p50_ms", "p95_ms", "p99_ms")
+
+
+def _check_serve_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 7: serve rows must be coherent serving evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: serve row missing provenance field(s) "
+            f"{missing} — print it through "
+            "harp_tpu.utils.metrics.benchmark_json")
+    pctls = []
+    for k in SERVE_PCTL_FIELDS:
+        v = row.get(k)
+        if (isinstance(v, bool) or not isinstance(v, (int, float))
+                or v < 0):
+            errs.append(f"{name}:{i}: serve row {k}={v!r} must be a "
+                        "non-negative number")
+            pctls = None
+            break
+        pctls.append(v)
+    if pctls is not None and not (pctls[0] <= pctls[1] <= pctls[2]):
+        errs.append(
+            f"{name}:{i}: serve row percentiles p50={pctls[0]} "
+            f"p95={pctls[1]} p99={pctls[2]} are not monotone — the "
+            "latency sample was mangled")
+    qps = row.get("qps")
+    if isinstance(qps, bool) or not isinstance(qps, (int, float)) \
+            or qps <= 0:
+        errs.append(f"{name}:{i}: serve row qps={qps!r} must be a "
+                    "positive number")
+    sc = row.get("steady_compiles")
+    if isinstance(sc, bool) or not isinstance(sc, int) or sc != 0:
+        errs.append(
+            f"{name}:{i}: serve row steady_compiles={sc!r} must be "
+            "exactly 0 — a serving loop that compiles in steady state "
+            "violates its own contract (flightrec.SteadyState)")
+    return errs
+
+
 def check_file(path: str, grandfathered: int = 0,
                provenance: bool = False) -> list[str]:
     """Return a list of violation messages (empty = clean)."""
@@ -236,6 +287,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_skew_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "lint":
             errors += _check_lint_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "serve":
+            errors += _check_serve_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -1202,3 +1202,102 @@ print("harplint: 5 AST rules seeded+tripped, copy trap pinned both ways, "
       "HEAD, rerouted pull/push == numpy and on the ledger, CLI exit "
       "codes + invariant 6 round-trip")
 print(f"DRIVE OK round-24 ({mode})")
+
+# ---------------------------------------------------------------------------
+# Round 25 — harp serve: persistent-mesh inference (PR 6)
+# Drives the PUBLIC serve surface end to end: checkpoint →
+# restore_latest → Server startup (AOT cache cold, then warm with ZERO
+# compiles after jax.clear_caches), ladder batching, the steady-state
+# budget's exact dispatch/readback accounting, kmeans/mfsgd answers vs
+# straight-line numpy, the stdio JSONL protocol, and a bench row through
+# check_jsonl invariant 7.
+# ---------------------------------------------------------------------------
+import tempfile as _sv_tmp
+import io as _sv_io
+import json as _sv_json
+
+from harp_tpu.serve import Server as _SvServer
+from harp_tpu.serve.bench import benchmark as _sv_bench
+from harp_tpu.utils import flightrec as _sv_fr, telemetry as _sv_tel
+from harp_tpu.utils.checkpoint import CheckpointManager as _SvCkpt
+from harp_tpu.utils.metrics import benchmark_json as _sv_bjson
+import check_jsonl as _sv_cj
+
+_sv_rng = np.random.default_rng(25)
+with _sv_tmp.TemporaryDirectory() as _sv_dir:
+    # checkpoint → newest step wins through restore_latest
+    _sv_mgr = _SvCkpt(os.path.join(_sv_dir, "ckpt"))
+    _sv_c_old = _sv_rng.normal(size=(6, 12)).astype(np.float32)
+    _sv_c = _sv_rng.normal(size=(6, 12)).astype(np.float32)
+    _sv_mgr.save(1, {"centroids": _sv_c_old})
+    _sv_mgr.save(4, {"centroids": _sv_c})
+    assert _sv_mgr.restore_latest()[0] == 4
+
+    _sv_cache = os.path.join(_sv_dir, "aot")
+    with _sv_tel.scope(True):
+        _sv_srv = _SvServer("kmeans", ckpt=os.path.join(_sv_dir, "ckpt"),
+                            mesh=mesh, ladder=(1, 8, 32),
+                            cache_dir=_sv_cache)
+        _sv_cold = _sv_srv.startup()
+        assert _sv_cold["cache_misses"] == 3 and _sv_cold["compiles"] >= 3
+        # steady state: 70 rows over a (1,8,32) ladder → 32+32+8-pad
+        _sv_x = _sv_rng.normal(size=(70, 12)).astype(np.float32)
+        _sv_base = _sv_fr.snapshot()
+        (_sv_resp,) = _sv_srv.process([{"id": 0, "x": _sv_x.tolist()}])
+        _sv_spent = _sv_fr.delta_since(_sv_base)
+        assert _sv_srv.steady.batches == 3 and _sv_srv.steady.violations == 0
+        assert (_sv_spent["compiles"], _sv_spent["dispatches"],
+                _sv_spent["readbacks"]) == (0, 3, 3)
+        _sv_ref = np.argmin(
+            ((_sv_x[:, None, :] - _sv_c[None]) ** 2).sum(-1), axis=1)
+        assert _sv_resp["result"] == _sv_ref.tolist()
+
+    # warm restart: in-memory jit caches dropped, disk cache must serve
+    jax.clear_caches()
+    with _sv_tel.scope(True):
+        _sv_srv2 = _SvServer("kmeans", ckpt=os.path.join(_sv_dir, "ckpt"),
+                             mesh=mesh, ladder=(1, 8, 32),
+                             cache_dir=_sv_cache)
+        _sv_warm = _sv_srv2.startup()
+        assert _sv_warm == {"rungs": [1, 8, 32], "cache_hits": 3,
+                            "cache_misses": 0, "compiles": 0}, _sv_warm
+        # stdio protocol round trip on the warm server
+        _sv_in = _sv_io.StringIO(
+            _sv_json.dumps({"id": "q", "x": _sv_x[:3].tolist()}) + "\n"
+            + _sv_json.dumps({"cmd": "quit"}) + "\n")
+        _sv_out = _sv_io.StringIO()
+        _sv_srv2.serve_stdio(_sv_in, _sv_out)
+        (_sv_line,) = _sv_out.getvalue().splitlines()
+        assert _sv_json.loads(_sv_line)["result"] == _sv_ref[:3].tolist()
+        assert _sv_fr.compile_watch.count == 0  # still zero post-serve
+
+# mfsgd top-k: sharded H + pull merge == numpy argsort (49 items ⇒ the
+# worker padding must not leak phantom items)
+from harp_tpu.serve.engines import ENGINES as _SvEngines
+_sv_st = _SvEngines["mfsgd"].synthetic_state(_sv_rng, n_users=40,
+                                             n_items=49, rank=8)
+with _sv_tmp.TemporaryDirectory() as _sv_dir2:
+    _sv_m = _SvServer("mfsgd", state=_sv_st, mesh=mesh, ladder=(1, 8),
+                      cache_dir=_sv_dir2, engine_opts={"topk": 5})
+    _sv_m.startup()
+    (_sv_r,) = _sv_m.process([{"id": 1, "users": [0, 17, 39]}])
+    for _sv_row, _sv_u in zip(_sv_r["result"], [0, 17, 39]):
+        _sv_sc = _sv_st["W"][_sv_u] @ _sv_st["H"].T
+        assert _sv_row["items"] == np.argsort(-_sv_sc)[:5].tolist()
+
+# bench row → provenance stamp → invariant 7 clean
+_sv_res = _sv_bench(app="kmeans", n_requests=12, rows_per_request=1,
+                    burst=4, ladder=(1, 8), mesh=mesh,
+                    state_shape={"k": 4, "d": 8})
+assert _sv_res["steady_compiles"] == 0 and _sv_res["qps"] > 0
+_sv_rowd = _sv_json.loads(_sv_bjson("serve_kmeans", _sv_res))
+assert _sv_cj._check_serve_row("drive", 1, _sv_rowd) == []
+# and the checker is LOUD on a row that compiled in steady state
+assert _sv_cj._check_serve_row("drive", 1,
+                               {**_sv_rowd, "steady_compiles": 2})
+
+print("serve: restore_latest → cold AOT cache → warm restart 0 compiles, "
+      "steady batches exact (0 compiles / 1 dispatch / 1 readback each), "
+      "kmeans+sharded-topk == numpy, stdio round trip, bench row through "
+      "invariant 7 both ways")
+print(f"DRIVE OK round-25 ({mode})")

@@ -118,6 +118,18 @@ def test_checkpoint_restore_missing(tmp_path):
         CheckpointManager(str(tmp_path / "empty")).restore()
 
 
+def test_checkpoint_restore_latest(tmp_path):
+    """The serve load path: newest step without the caller enumerating
+    steps; empty root fails loudly like restore()."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    for s in (2, 11, 7):
+        mgr.save(s, {"v": np.float32(s)})
+    step, state = mgr.restore_latest()
+    assert step == 11 and float(state["v"]) == 11.0
+    with pytest.raises(FileNotFoundError):
+        CheckpointManager(str(tmp_path / "none")).restore_latest()
+
+
 def test_parse_into():
     @dataclasses.dataclass
     class Cfg:

@@ -276,6 +276,51 @@ def test_lint_row_invariants(tmp_path):
     assert ":3:" in errors[2] and "negative" in errors[2]
 
 
+def test_serve_row_invariants(tmp_path):
+    """Invariant 7: serve rows must be stamped, percentiles monotone,
+    qps positive, and steady_compiles exactly 0 — a serving-throughput
+    claim that silently recompiled per batch is not serving evidence."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    rows = [
+        {"kind": "serve", "app": "kmeans", "qps": 100.0, "p50_ms": 1.0,
+         "p95_ms": 2.0, "p99_ms": 3.0, "steady_compiles": 0,
+         **stamp},                                          # fine
+        {"kind": "serve", "qps": 100.0, "p50_ms": 1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0, "steady_compiles": 0},              # unstamped
+        {"kind": "serve", "qps": 100.0, "p50_ms": 2.5, "p95_ms": 2.0,
+         "p99_ms": 3.0, "steady_compiles": 0, **stamp},     # crossed
+        {"kind": "serve", "qps": 0.0, "p50_ms": 1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0, "steady_compiles": 0, **stamp},     # qps <= 0
+        {"kind": "serve", "qps": 100.0, "p50_ms": 1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0, "steady_compiles": 2, **stamp},     # compiled!
+        {"kind": "serve", "qps": 100.0, "p50_ms": -1.0, "p95_ms": 2.0,
+         "p99_ms": 3.0, "steady_compiles": 0, **stamp},     # negative
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 5
+    assert ":2:" in errors[0] and "provenance" in errors[0]
+    assert ":3:" in errors[1] and "monotone" in errors[1]
+    assert ":4:" in errors[2] and "qps" in errors[2]
+    assert ":5:" in errors[3] and "steady_compiles" in errors[3]
+    assert ":6:" in errors[4] and "p50_ms" in errors[4]
+
+
+def test_serve_bench_row_satisfies_the_checker(tmp_path, mesh):
+    """Round-trip: what serve.bench emits through benchmark_json must
+    pass invariant 7 as-is — even teed into a bench file."""
+    from harp_tpu.serve.bench import benchmark
+    from harp_tpu.utils.metrics import benchmark_json
+
+    res = benchmark(app="kmeans", n_requests=12, rows_per_request=1,
+                    burst=4, ladder=(1, 8),
+                    state_shape={"k": 4, "d": 8})
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(benchmark_json("serve_kmeans", res) + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
 def test_lint_cli_row_satisfies_the_checker(tmp_path, capsys):
     """Round-trip: the line `python -m harp_tpu lint --json` prints must
     pass invariant 6 as-is — even teed into a bench file."""

@@ -304,6 +304,9 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
     for mod in (kmeans, lda, mfsgd, mlp, rf, subgraph):
         stubbed(mod, "benchmark")
     stubbed(kmeans_stream, "benchmark_streaming")
+    from harp_tpu.serve import bench as serve_bench
+
+    stubbed(serve_bench, "benchmark")
     monkeypatch.setattr(ma, "_bench_ingest",
                         lambda smoke, quantize=None: {"stub": 1.0})
     monkeypatch.setattr(roofline, "annotate", lambda name, res: res)

@@ -1,0 +1,435 @@
+"""harp serve — micro-batcher, AOT executable cache, engines, server.
+
+The acceptance gates of the serving subsystem, all on the 8-sim-worker
+CPU mesh (no relay):
+
+- shape-ladder bucketing is minimal (padding bounded), ragged tails pad
+  to their rung, oversized requests span batches and reassemble;
+- the steady-state loop holds ``compiles=0, dispatches=1, readbacks=1``
+  per batch for kmeans-assign AND mfsgd-topk (the budget pin);
+- a warm restart against a populated executable cache performs ZERO XLA
+  compiles before serving its first request (CompileWatch-proven);
+- engine outputs match numpy references;
+- the stdio JSONL protocol round-trips end-to-end, checkpoint included.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from harp_tpu.serve.batcher import MicroBatcher, ShapeLadder
+from harp_tpu.serve.engines import ENGINES
+from harp_tpu.serve.server import Server
+from harp_tpu.utils import flightrec, telemetry
+
+
+# ---------------------------------------------------------------------------
+# ShapeLadder / MicroBatcher (pure host, no jax)
+# ---------------------------------------------------------------------------
+
+def test_ladder_bucket_is_minimal_rung():
+    lad = ShapeLadder((1, 8, 64, 512))
+    assert lad.bucket(1) == 1
+    assert lad.bucket(2) == 8
+    assert lad.bucket(8) == 8
+    assert lad.bucket(9) == 64
+    assert lad.bucket(512) == 512
+    with pytest.raises(ValueError):
+        lad.bucket(513)
+    with pytest.raises(ValueError):
+        lad.bucket(0)
+
+
+def test_ladder_padding_fraction_bounded():
+    # minimality bound: (rung - n)/rung < 1 - prev_rung/rung for every n
+    lad = ShapeLadder((1, 8, 64, 512))
+    rungs = (0,) + lad.rungs
+    for n in range(1, 513):
+        s = lad.bucket(n)
+        prev = max(r for r in rungs if r < s)
+        assert (s - n) / s < 1 - prev / s + 1e-12
+
+
+def test_batcher_coalesces_and_pads_ragged_tail():
+    mb = MicroBatcher((1, 8, 32))
+    for i in range(5):
+        mb.put(i, 9)  # 45 rows queued
+    batches = list(mb.batches())
+    assert [b.rung for b in batches] == [32, 32]
+    assert [b.rows for b in batches] == [32, 13]
+    assert batches[1].padding_frac == pytest.approx((32 - 13) / 32)
+    # every row of every request landed exactly once, in order
+    seen = {i: 0 for i in range(5)}
+    for b in batches:
+        for req, lo, hi in b.requests:
+            assert hi > lo
+            assert lo == seen[req]  # contiguous, in-order slices
+            seen[req] = hi
+    assert all(v == 9 for v in seen.values())
+    assert mb.padding_frac() == pytest.approx((64 - 45) / 64)
+
+
+def test_batcher_single_request_takes_smallest_rung():
+    mb = MicroBatcher((1, 8, 64))
+    mb.put("a", 1)
+    (b,) = list(mb.batches())
+    assert b.rung == 1 and b.rows == 1 and b.padding_frac == 0.0
+
+
+def test_batcher_request_larger_than_max_rung_spans_batches():
+    mb = MicroBatcher((1, 8, 32))
+    mb.put("big", 70)
+    batches = list(mb.batches())
+    assert [b.rung for b in batches] == [32, 32, 8]
+    assert [b.rows for b in batches] == [32, 32, 6]
+    slices = [(lo, hi) for b in batches for _, lo, hi in b.requests]
+    assert slices == [(0, 32), (32, 64), (64, 70)]
+
+
+# ---------------------------------------------------------------------------
+# flightrec.SteadyState (the serving-loop guard)
+# ---------------------------------------------------------------------------
+
+def test_steady_state_raises_on_violation(mesh):
+    with telemetry.scope(True):
+        steady = flightrec.SteadyState(compiles=0, dispatches=0,
+                                       readbacks=1, tag="t")
+        with pytest.raises(flightrec.BudgetExceeded, match="dispatches"):
+            with steady.batch():
+                flightrec.transfers.record_dispatch("site")
+        assert steady.violations == 1
+
+
+def test_steady_state_warn_mode_counts_and_continues(mesh):
+    with telemetry.scope(True):
+        steady = flightrec.SteadyState(dispatches=0, action="warn",
+                                       tag="t")
+        with pytest.warns(RuntimeWarning, match="steady-state budget"):
+            with steady.batch():
+                flightrec.transfers.record_dispatch("site")
+        with steady.batch():
+            pass
+        s = steady.summary()
+        assert s["batches"] == 2 and s["violations"] == 1
+
+
+def test_steady_state_noop_when_disabled(mesh):
+    steady = flightrec.SteadyState(dispatches=0)
+    with telemetry.scope(False):
+        with steady.batch():
+            pass
+    assert steady.batches == 0
+
+
+# ---------------------------------------------------------------------------
+# Engines vs numpy references
+# ---------------------------------------------------------------------------
+
+def _server(app, state, mesh, tmp_path, ladder=(1, 8, 64), **opts):
+    srv = Server(app, state=state, mesh=mesh, ladder=ladder,
+                 cache_dir=str(tmp_path / f"aot_{app}"),
+                 engine_opts=opts or None)
+    srv.startup()
+    return srv
+
+
+def test_kmeans_assign_matches_numpy(mesh, tmp_path):
+    rng = np.random.default_rng(0)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=16, d=32)
+    srv = _server("kmeans", state, mesh, tmp_path)
+    x = rng.normal(size=(11, 32)).astype(np.float32)
+    (resp,) = srv.process([{"id": 7, "x": x.tolist()}])
+    ref = np.argmin(((x[:, None, :] - state["centroids"][None]) ** 2
+                     ).sum(-1), axis=1)
+    assert resp["id"] == 7 and resp["result"] == ref.tolist()
+
+
+def test_mfsgd_topk_matches_numpy(mesh, tmp_path):
+    rng = np.random.default_rng(1)
+    # n_items deliberately NOT divisible by 8 workers: the padded shard
+    # must never leak a phantom item into the top-k
+    state = ENGINES["mfsgd"].synthetic_state(rng, n_users=64, n_items=50,
+                                             rank=8)
+    srv = _server("mfsgd", state, mesh, tmp_path, topk=5)
+    users = [0, 13, 49, 63]
+    (resp,) = srv.process([{"id": 1, "users": users}])
+    W, H = state["W"], state["H"]
+    for row, u in zip(resp["result"], users):
+        scores = W[u] @ H.T
+        ref = np.argsort(-scores)[:5]
+        assert row["items"] == ref.tolist()
+        np.testing.assert_allclose(row["scores"], scores[ref], rtol=1e-4)
+
+
+def test_lda_infer_recovers_dominant_topic(mesh, tmp_path):
+    # peaked synthetic phi: topic t owns vocab band t — a doc drawn from
+    # one band must fold in to that topic
+    V, K = 64, 4
+    Nwk = np.full((V, K), 0.1, np.float32)
+    band = V // K
+    for t in range(K):
+        Nwk[t * band:(t + 1) * band, t] = 100.0
+    srv = _server("lda", {"Nwk": Nwk}, mesh, tmp_path)
+    x = np.zeros((2, V), np.float32)
+    x[0, 2 * band:3 * band] = 5.0   # topic 2 words
+    x[1, 0:band] = 3.0              # topic 0 words
+    (resp,) = srv.process([{"id": 0, "x": x.tolist()}])
+    thetas = np.asarray([r["theta"] for r in resp["result"]])
+    np.testing.assert_allclose(thetas.sum(1), 1.0, atol=1e-3)
+    assert thetas[0].argmax() == 2 and thetas[1].argmax() == 0
+
+
+def test_mlp_rf_svm_predict_roundtrip(mesh, tmp_path):
+    rng = np.random.default_rng(2)
+    for app in ("mlp", "rf", "svm"):
+        state = ENGINES[app].synthetic_state(rng)
+        srv = _server(app, state, mesh, tmp_path, ladder=(1, 8))
+        req = srv.engine.synthetic_request(rng, 5)
+        (resp,) = srv.process([{"id": app, **req}])
+        assert resp["id"] == app and len(resp["result"]) == 5
+    # svm label is the sign of the score
+    assert all(r["label"] == (1 if r["score"] >= 0 else -1)
+               for r in resp["result"])
+
+
+def test_engine_rejects_bad_state_and_bad_rows(mesh, tmp_path):
+    rng = np.random.default_rng(3)
+    with pytest.raises(KeyError, match="centroids"):
+        ENGINES["kmeans"]({"wrong": 1}, mesh)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+    srv = _server("kmeans", state, mesh, tmp_path, ladder=(1, 8))
+    resp = srv.process([
+        {"id": 0, "x": [[0.0] * 8]},          # fine
+        {"id": 1, "x": [[0.0] * 5]},          # wrong width
+        {"id": 2},                            # missing key
+    ])
+    assert "result" in resp[0]
+    assert "error" in resp[1] and "error" in resp[2]
+
+
+def test_oversized_request_reassembles_across_batches(mesh, tmp_path):
+    rng = np.random.default_rng(4)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=8, d=16)
+    srv = _server("kmeans", state, mesh, tmp_path, ladder=(1, 8, 32))
+    x = rng.normal(size=(70, 16)).astype(np.float32)
+    (resp,) = srv.process([{"id": 0, "x": x.tolist()}])
+    ref = np.argmin((((x[:, None, :] - state["centroids"][None]) ** 2)
+                     ).sum(-1), axis=1)
+    assert resp["result"] == ref.tolist()
+    assert [r for r, _, _ in srv.last_batch_times] == [32, 32, 8]
+
+
+# ---------------------------------------------------------------------------
+# THE budget pin: steady state at compiles=0, dispatches=1, readbacks=1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("app", ["kmeans", "mfsgd"])
+def test_steady_state_budget_pin(app, mesh, tmp_path):
+    rng = np.random.default_rng(5)
+    state = ENGINES[app].synthetic_state(rng)
+    with telemetry.scope(True):
+        srv = _server(app, state, mesh, tmp_path, ladder=(1, 8, 64))
+        # warm every rung once (first dispatch may e.g. transfer consts)
+        srv.process([srv.engine.synthetic_request(rng, n)
+                     for n in (1, 8, 64)])
+        srv.steady.reset()
+        base = flightrec.snapshot()
+        reqs = [srv.engine.synthetic_request(rng, 3) for _ in range(12)]
+        srv.process(reqs)  # 36 rows → batches of 8-rung/64-rung shapes
+        spent = flightrec.delta_since(base)
+        n_batches = srv.steady.batches
+        assert n_batches >= 1
+        # EXACT accounting, not just under-budget: one dispatch and one
+        # stacked readback per batch, zero compiles in steady state
+        assert spent["compiles"] == 0
+        assert spent["dispatches"] == n_batches
+        assert spent["readbacks"] == n_batches
+        assert srv.steady.violations == 0
+
+
+def test_budget_violation_is_loud_in_raise_mode(mesh, tmp_path):
+    rng = np.random.default_rng(6)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+    with telemetry.scope(True):
+        srv = _server("kmeans", state, mesh, tmp_path, ladder=(1, 8))
+        # sabotage: an extra tracked dispatch inside the batch scope must
+        # trip the dispatches=1 budget (the per-epoch-dispatch trap)
+        real_exec = srv._exec[1]
+
+        def noisy(*args):
+            flightrec.transfers.record_dispatch("extra")
+            return real_exec(*args)
+
+        srv._exec[1] = noisy
+        with pytest.raises(flightrec.BudgetExceeded, match="dispatches"):
+            srv.process([srv.engine.synthetic_request(rng, 1)])
+
+
+# ---------------------------------------------------------------------------
+# AOT executable cache: warm restart compiles NOTHING
+# ---------------------------------------------------------------------------
+
+def test_warm_restart_performs_zero_compiles(mesh, tmp_path):
+    import jax
+
+    rng = np.random.default_rng(7)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=8, d=16)
+    cache_dir = str(tmp_path / "aot")
+    ladder = (1, 8)
+    req = {"id": 0, "x": rng.normal(size=(3, 16)).astype(
+        np.float32).tolist()}
+    with telemetry.scope(True):
+        srv = Server("kmeans", state=state, mesh=mesh, ladder=ladder,
+                     cache_dir=cache_dir)
+        cold = srv.startup()
+        assert cold["cache_misses"] == len(ladder)
+        assert cold["compiles"] >= len(ladder)
+        (ref,) = srv.process([req])
+
+    # fresh process stand-in: drop jax's in-memory caches so any compile
+    # on the second startup would be OBSERVED by CompileWatch, then
+    # prove there isn't one
+    jax.clear_caches()
+    with telemetry.scope(True):
+        srv2 = Server("kmeans", state=state, mesh=mesh, ladder=ladder,
+                      cache_dir=cache_dir)
+        warm = srv2.startup()
+        assert warm["cache_hits"] == len(ladder)
+        assert warm["cache_misses"] == 0
+        assert warm["compiles"] == 0  # THE acceptance criterion
+        (resp,) = srv2.process([req])
+        assert resp["result"] == ref["result"]
+        # and the first responses stayed compile-free too
+        assert flightrec.compile_watch.count == 0
+
+
+def test_corrupt_cache_entry_falls_back_to_compile(mesh, tmp_path):
+    import os
+
+    rng = np.random.default_rng(8)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+    cache_dir = str(tmp_path / "aot")
+    srv = Server("kmeans", state=state, mesh=mesh, ladder=(1,),
+                 cache_dir=cache_dir)
+    srv.startup()
+    (entry,) = [f for f in os.listdir(cache_dir) if f.endswith(".pkl")]
+    with open(os.path.join(cache_dir, entry), "wb") as fh:
+        fh.write(b"not a pickle")
+    srv2 = Server("kmeans", state=state, mesh=mesh, ladder=(1,),
+                  cache_dir=cache_dir)
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        info = srv2.startup()
+    assert info["cache_misses"] == 1  # recompiled, didn't crash
+    (resp,) = srv2.process([{"id": 0, "x": [[0.0] * 8]}])
+    assert "result" in resp
+
+
+def test_cache_key_changes_with_fingerprint(mesh, tmp_path):
+    from harp_tpu.serve.cache import ExecutableCache
+
+    rng = np.random.default_rng(9)
+    eng = ENGINES["kmeans"](
+        ENGINES["kmeans"].synthetic_state(rng, k=4, d=8), mesh)
+    a = ExecutableCache(str(tmp_path / "c"), fingerprint="aaaa")
+    b = ExecutableCache(str(tmp_path / "c"), fingerprint="bbbb")
+    args = eng.trace_args(1)
+    assert a._key("kmeans", args) != b._key("kmeans", args)
+    # and with the rung: shapes participate
+    assert a._key("kmeans", args) != a._key("kmeans", eng.trace_args(8))
+
+
+# ---------------------------------------------------------------------------
+# stdio protocol + CLI end-to-end
+# ---------------------------------------------------------------------------
+
+def test_stdio_roundtrip_with_stats_and_quit(mesh, tmp_path):
+    rng = np.random.default_rng(10)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=8, d=16)
+    srv = _server("kmeans", state, mesh, tmp_path, ladder=(1, 8))
+    x = rng.normal(size=(2, 16)).astype(np.float32)
+    stdin = io.StringIO("\n".join([
+        json.dumps({"id": "a", "x": x.tolist()}),
+        "this is not json",
+        json.dumps({"cmd": "stats"}),
+        json.dumps({"id": "b", "x": x[:1].tolist()}),
+        json.dumps({"cmd": "quit"}),
+    ]) + "\n")
+    out = io.StringIO()
+    served = srv.serve_stdio(stdin, out)
+    lines = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert served == 2
+    assert lines[0]["id"] == "a" and len(lines[0]["result"]) == 2
+    assert lines[1]["error"] == "unparseable JSON"
+    assert lines[2]["kind"] == "serve_stats"
+    assert lines[3]["id"] == "b" and len(lines[3]["result"]) == 1
+
+
+def test_cli_serves_from_checkpoint_end_to_end(mesh, tmp_path,
+                                               monkeypatch, capsys):
+    """THE acceptance walkthrough: train-ish state → CheckpointManager →
+    ``python -m harp_tpu serve kmeans --ckpt ...`` → JSONL in, JSONL out
+    (restore_latest picks the newest step)."""
+    import sys
+
+    import harp_tpu.__main__ as cli
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    rng = np.random.default_rng(11)
+    stale = {"centroids": rng.normal(size=(4, 8)).astype(np.float32)}
+    fresh = {"centroids": rng.normal(size=(4, 8)).astype(np.float32)}
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, stale)
+    mgr.save(5, fresh)  # the newest step must win
+
+    x = rng.normal(size=(3, 8)).astype(np.float32)
+    monkeypatch.setattr(sys, "stdin", io.StringIO(
+        json.dumps({"id": 0, "x": x.tolist()}) + "\n"
+        + json.dumps({"cmd": "quit"}) + "\n"))
+    rc = cli.main(["serve", "kmeans", "--ckpt", str(tmp_path / "ckpt"),
+                   "--ladder", "1,8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    (resp,) = [json.loads(ln) for ln in out.splitlines()]
+    ref = np.argmin(((x[:, None, :] - fresh["centroids"][None]) ** 2
+                     ).sum(-1), axis=1)
+    assert resp["result"] == ref.tolist()
+
+
+def test_cli_bench_emits_valid_serve_row(mesh, capsys):
+    import os
+    import sys
+
+    import harp_tpu.__main__ as cli
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import check_jsonl
+
+    rc = cli.main(["serve", "kmeans", "--bench", "--requests", "24",
+                   "--rows-per-request", "2", "--ladder", "1,8"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(line)
+    assert row["config"] == "serve_kmeans" and row["kind"] == "serve"
+    assert row["qps"] > 0 and row["steady_compiles"] == 0
+    assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+
+
+def test_serve_bench_mfsgd_row(mesh):
+    from harp_tpu.serve.bench import benchmark
+
+    res = benchmark(app="mfsgd", n_requests=24, rows_per_request=2,
+                    burst=8, ladder=(1, 8),
+                    state_shape={"n_users": 64, "n_items": 48,
+                                 "rank": 8}, topk=4)
+    assert res["kind"] == "serve" and res["app"] == "mfsgd"
+    assert res["steady_compiles"] == 0 and res["budget_violations"] == 0
+    assert res["p50_ms"] <= res["p95_ms"] <= res["p99_ms"]
+    assert res["cache_misses"] == 2 and res["cache_hits"] == 0
+
+
+def test_server_requires_state_or_ckpt(mesh):
+    with pytest.raises(ValueError, match="state= or ckpt="):
+        Server("kmeans")
