@@ -18,6 +18,12 @@ Keys bind the artifact to everything that could invalidate it:
   compiled for 8 sim-CPU devices must not load on a v5e),
 - the batch shape signature (every input aval, so model shapes AND the
   ladder rung participate),
+- the program name the caller passes, which the server builds from the
+  app plus the engine's ``cache_tag()`` — options that are baked into
+  the compiled program as constants (mfsgd's ``topk``, lda's
+  ``em_iters``/``alpha``) shape the executable without changing any
+  aval, so they must key separately or a restart with different flags
+  would silently serve the old program,
 - a code fingerprint (sha1 over the serve package sources plus the
   engine's model module — a changed step function must miss, never
   silently serve stale code).
@@ -48,7 +54,11 @@ def _topology_tag() -> str:
 def code_fingerprint(extra_modules: tuple = ()) -> str:
     """sha1 over the serve package sources (+ any engine model modules):
     the executable is a compilation of this code, so the key must change
-    when it does."""
+    when it does.  The parallel layer is always included — the sharded
+    step programs compile through shard_map and the collective verbs, so
+    a semantic change there must also miss."""
+    import harp_tpu.parallel.collective as _coll
+    import harp_tpu.parallel.mesh as _mesh
     import harp_tpu.serve as pkg
 
     h = hashlib.sha1()
@@ -57,7 +67,7 @@ def code_fingerprint(extra_modules: tuple = ()) -> str:
     for fn in sorted(os.listdir(pkg_dir)):
         if fn.endswith(".py"):
             paths.append(os.path.join(pkg_dir, fn))
-    for mod in extra_modules:
+    for mod in (_coll, _mesh) + tuple(extra_modules):
         f = getattr(mod, "__file__", None)
         if f and f.endswith(".py"):
             paths.append(f)
@@ -111,8 +121,10 @@ class ExecutableCache:
             ser, in_tree, out_tree = payload
             exe = serialize_executable.deserialize_and_load(
                 ser, in_tree, out_tree)
-        except (OSError, EOFError, pickle.UnpicklingError, ValueError,
-                TypeError) as e:
+        except Exception as e:  # noqa: BLE001 — any bad entry (truncated
+            # pickle, jaxlib XlaRuntimeError on a payload the key didn't
+            # invalidate, ...) must degrade to a fresh compile: the cache
+            # can lose, never lie — and never crash startup
             if os.path.exists(path):
                 warnings.warn(
                     f"serve cache entry {os.path.basename(path)} "

@@ -74,6 +74,13 @@ class Engine:
         engines that call into models/ must recompile when it changes)."""
         return ()
 
+    def cache_tag(self) -> str:
+        """Engine options baked into the compiled program as constants
+        (not visible in any input aval) — joins the AOT cache key via
+        the program name, so ``--topk 20`` never hits a ``--topk 10``
+        executable.  Empty when the step has no such options."""
+        return ""
+
     def __init__(self, state: dict, mesh: WorkerMesh):
         self.mesh = mesh
         self._dev_state: tuple | None = None
@@ -233,6 +240,11 @@ class MFSGDTopK(Engine):
         self.topk = int(topk)
         super().__init__(state, mesh)
 
+    def cache_tag(self) -> str:
+        # n_items too: it masks the padded tail as a program constant,
+        # and 255 vs 256 items pad to the same H_padded aval on 8 workers
+        return f"topk={self.topk},n_items={self.n_items}"
+
     def _load(self, state: dict) -> None:
         _require(state, ("W", "H"), self.app)
         self.W = _np(state["W"], _F32)
@@ -369,6 +381,11 @@ class LDAInfer(Engine):
         self.beta = float(beta)
         self.alpha = float(alpha)
         super().__init__(state, mesh)
+
+    def cache_tag(self) -> str:
+        # beta is absent on purpose: it only smooths phi host-side, and
+        # phi is an input aval — not a constant of the program
+        return f"em={self.em_iters},a={self.alpha}"
 
     def _load(self, state: dict) -> None:
         _require(state, ("Nwk",), self.app)

@@ -100,9 +100,10 @@ class Server:
         base = flightrec.snapshot() if telemetry.enabled() else None
         self.engine.state_args()  # resident placement (device_put only)
         jitted = self.engine.jitted()
+        tag = self.engine.cache_tag()
+        name = f"{self.app}[{tag}]" if tag else self.app
         for rung in self.ladder.rungs:
             args = self.engine.trace_args(rung)
-            name = f"{self.app}"
             if self.cache is not None:
                 exe = self.cache.get_or_compile(name, jitted, args)
             else:
@@ -208,8 +209,9 @@ class Server:
         the micro-batcher sees the real queue depth, not one request at
         a time); a line arriving alone is its own burst — the 1-rung.
         """
+        reader = _BurstReader(stdin)
         while True:
-            lines = _read_burst(stdin)
+            lines = reader.read_burst()
             if not lines:
                 return self.requests_served
             burst: list[dict] = []
@@ -243,36 +245,58 @@ class Server:
                 stdout.write(json.dumps(resp) + "\n")
 
 
-def _read_burst(stdin: IO) -> list[str]:
-    """One blocking readline, then every line already available (select
-    on real files; plain greedy reads on in-memory streams, which never
-    block).  Empty list = EOF."""
-    line = stdin.readline()
-    if not line:
-        return []
-    lines = [line]
-    try:
-        fd = stdin.fileno()
-    except (OSError, ValueError, AttributeError):
-        fd = None
-    if fd is None:
-        while True:  # StringIO etc.: reads never block, drain to EOF
-            nxt = stdin.readline()
-            if not nxt:
-                break
-            lines.append(nxt)
-        return [ln for ln in lines if ln.strip()]
-    import select
+class _BurstReader:
+    """Burst reads: one blocking line, then every line already available.
 
-    while True:
-        ready, _, _ = select.select([stdin], [], [], 0)
-        if not ready:
-            break
-        nxt = stdin.readline()
-        if not nxt:
-            break
-        lines.append(nxt)
-    return [ln for ln in lines if ln.strip()]
+    Real files are read with ``os.read`` on the raw fd plus our own line
+    splitting, NOT text-layer ``readline`` — a TextIOWrapper buffers
+    whole chunks internally, so lines it has already pulled off the pipe
+    don't make the fd selectable and a select()-gated readline loop
+    would push them into the NEXT burst, under-batching the real queue
+    depth.  The byte buffer lives on the reader so a partial trailing
+    line carries over to the next burst.  In-memory streams (no fileno)
+    fall back to greedy readline, which never blocks.  Empty list = EOF.
+    """
+
+    def __init__(self, stdin: IO):
+        self.stdin = stdin
+        try:
+            self.fd = stdin.fileno()
+        except (OSError, ValueError, AttributeError):
+            self.fd = None
+        self._buf = b""
+
+    def read_burst(self) -> list[str]:
+        if self.fd is None:
+            lines = []
+            while True:  # StringIO etc.: reads never block, drain to EOF
+                nxt = self.stdin.readline()
+                if not nxt:
+                    break
+                lines.append(nxt)
+            return [ln for ln in lines if ln.strip()]
+        import os
+        import select
+
+        lines: list[str] = []
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                lines.append(self._buf[:nl + 1].decode("utf-8", "replace"))
+                self._buf = self._buf[nl + 1:]
+                continue
+            if lines:  # burst started: only take bytes already available
+                ready, _, _ = select.select([self.fd], [], [], 0)
+                if not ready:
+                    break
+            chunk = os.read(self.fd, 65536)  # blocks only for line one
+            if not chunk:
+                if self._buf:  # EOF terminates a final unterminated line
+                    lines.append(self._buf.decode("utf-8", "replace"))
+                    self._buf = b""
+                break
+            self._buf += chunk
+        return [ln for ln in lines if ln.strip()]
 
 
 def main(argv=None) -> int:

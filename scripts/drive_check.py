@@ -1301,3 +1301,93 @@ print("serve: restore_latest → cold AOT cache → warm restart 0 compiles, "
       "kmeans+sharded-topk == numpy, stdio round trip, bench row through "
       "invariant 7 both ways")
 print(f"DRIVE OK round-25 ({mode})")
+
+# ---------------------------------------------------------------------------
+# Round 26 — serve review fixes (PR 6 follow-up): option-keyed AOT cache,
+# any-exception cache fallback, parallel sources in the fingerprint, and
+# raw-fd burst reads that see past TextIOWrapper buffering.
+# ---------------------------------------------------------------------------
+import hashlib as _rv_hash
+import warnings as _rv_warn
+
+from harp_tpu.serve.cache import code_fingerprint as _rv_fp
+
+# (a) engine options are program constants, not avals: a restart with a
+# different --topk must MISS and answer with the new k (numpy-checked)
+with _sv_tmp.TemporaryDirectory() as _rv_dir:
+    _rv_st = _SvEngines["mfsgd"].synthetic_state(_sv_rng, n_users=40,
+                                                 n_items=49, rank=8)
+    _rv_a = _SvServer("mfsgd", state=_rv_st, mesh=mesh, ladder=(4,),
+                      cache_dir=_rv_dir, engine_opts={"topk": 5})
+    _rv_a.startup()
+    _rv_b = _SvServer("mfsgd", state=_rv_st, mesh=mesh, ladder=(4,),
+                      cache_dir=_rv_dir, engine_opts={"topk": 7})
+    _rv_info = _rv_b.startup()
+    assert (_rv_info["cache_hits"], _rv_info["cache_misses"]) == (0, 1)
+    (_rv_r7,) = _rv_b.process([{"id": 0, "users": [3, 21]}])
+    for _rv_row, _rv_u in zip(_rv_r7["result"], [3, 21]):
+        _rv_sc = _rv_st["W"][_rv_u] @ _rv_st["H"].T
+        assert _rv_row["items"] == np.argsort(-_rv_sc)[:7].tolist()
+    _rv_c = _SvServer("mfsgd", state=_rv_st, mesh=mesh, ladder=(4,),
+                      cache_dir=_rv_dir, engine_opts={"topk": 5})
+    assert _rv_c.startup()["cache_hits"] == 1  # tag keys, doesn't disable
+
+    # (b) ANY deserialize exception degrades to a fresh compile
+    from jax.experimental import serialize_executable as _rv_se
+    _rv_orig = _rv_se.deserialize_and_load
+
+    def _rv_boom(*a, **k):
+        raise RuntimeError("xla rejected the payload")
+
+    _rv_se.deserialize_and_load = _rv_boom
+    try:
+        with _rv_warn.catch_warnings(record=True) as _rv_caught:
+            _rv_warn.simplefilter("always")
+            _rv_d = _SvServer("mfsgd", state=_rv_st, mesh=mesh,
+                              ladder=(4,), cache_dir=_rv_dir,
+                              engine_opts={"topk": 5})
+            _rv_dinfo = _rv_d.startup()
+    finally:
+        _rv_se.deserialize_and_load = _rv_orig
+    assert _rv_dinfo["cache_misses"] == 1
+    assert any("unreadable" in str(w.message) for w in _rv_caught)
+print("serve cache: --topk restart misses + answers new k, same-opts "
+      "hits, arbitrary deserialize error recompiles")
+
+# (c) the fingerprint hashes the parallel layer too (shard_map +
+# collective verbs compile into the mfsgd program) — replicate the sha1
+# by hand to prove which sources participate
+import harp_tpu.parallel.collective as _rv_coll
+import harp_tpu.parallel.mesh as _rv_mesh
+import harp_tpu.serve as _rv_pkg
+
+_rv_h = _rv_hash.sha1()
+_rv_pdir = os.path.dirname(os.path.abspath(_rv_pkg.__file__))
+_rv_paths = [os.path.join(_rv_pdir, f) for f in sorted(os.listdir(_rv_pdir))
+             if f.endswith(".py")]
+_rv_paths += [_rv_coll.__file__, _rv_mesh.__file__]
+for _rv_p in _rv_paths:
+    _rv_h.update(open(_rv_p, "rb").read())
+assert _rv_fp() == _rv_h.hexdigest()[:16]
+print("serve fingerprint covers serve/* + parallel/collective + mesh")
+
+# (d) burst reader: lines a TextIOWrapper would hold internally (fd not
+# selectable) land in the CURRENT burst; partial lines carry over
+from harp_tpu.serve.server import _BurstReader as _RvBurst
+
+_rv_r, _rv_w = os.pipe()
+_rv_stdin = os.fdopen(_rv_r, "r")
+try:
+    os.write(_rv_w, b'{"id": 1}\n{"id": 2}\n{"id": 3')
+    _rv_reader = _RvBurst(_rv_stdin)
+    assert [_sv_json.loads(x)["id"]
+            for x in _rv_reader.read_burst()] == [1, 2]
+    os.write(_rv_w, b'}\n')
+    assert [_sv_json.loads(x)["id"]
+            for x in _rv_reader.read_burst()] == [3]
+    os.close(_rv_w)
+    assert _rv_reader.read_burst() == []
+finally:
+    _rv_stdin.close()
+print("burst reader: queued lines in-burst, partial line carries, EOF")
+print(f"DRIVE OK round-26 ({mode})")

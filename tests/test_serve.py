@@ -340,6 +340,69 @@ def test_cache_key_changes_with_fingerprint(mesh, tmp_path):
     assert a._key("kmeans", args) != a._key("kmeans", eng.trace_args(8))
 
 
+def test_cache_misses_when_engine_options_change(mesh, tmp_path):
+    """Options baked into the program as constants (mfsgd topk, lda
+    em_iters/alpha) shape NO input aval — a restart with different flags
+    must miss, never serve the other option's executable."""
+    rng = np.random.default_rng(21)
+    state = ENGINES["mfsgd"].synthetic_state(rng, n_users=64, n_items=48,
+                                             rank=8)
+    cache_dir = str(tmp_path / "aot")
+    req = {"id": 0, "users": [1, 2, 3]}
+    srv5 = Server("mfsgd", state=state, mesh=mesh, ladder=(4,),
+                  cache_dir=cache_dir, engine_opts={"topk": 5})
+    srv5.startup()
+    (r5,) = srv5.process([req])
+    assert all(len(row["items"]) == 5 for row in r5["result"])
+
+    srv7 = Server("mfsgd", state=state, mesh=mesh, ladder=(4,),
+                  cache_dir=cache_dir, engine_opts={"topk": 7})
+    info = srv7.startup()
+    assert info["cache_hits"] == 0 and info["cache_misses"] == 1
+    (r7,) = srv7.process([req])
+    assert all(len(row["items"]) == 7 for row in r7["result"])
+
+    # same options again: hit (the tag keys, it doesn't disable caching)
+    srv5b = Server("mfsgd", state=state, mesh=mesh, ladder=(4,),
+                   cache_dir=cache_dir, engine_opts={"topk": 5})
+    assert srv5b.startup()["cache_hits"] == 1
+
+    # lda's constants tag too (em_iters is the fori_loop trip count)
+    lda_state = ENGINES["lda"].synthetic_state(rng, vocab_size=32,
+                                               n_topics=4)
+    tags = {ENGINES["lda"](lda_state, mesh, em_iters=k).cache_tag()
+            for k in (4, 8)}
+    assert len(tags) == 2
+
+
+def test_cache_load_survives_arbitrary_deserialize_errors(
+        mesh, tmp_path, monkeypatch):
+    """'The cache can lose, never lie' covers exception types the key
+    didn't anticipate (e.g. jaxlib XlaRuntimeError) — any bad entry must
+    degrade to a fresh compile, not crash startup."""
+    from jax.experimental import serialize_executable
+
+    rng = np.random.default_rng(22)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+    cache_dir = str(tmp_path / "aot")
+    Server("kmeans", state=state, mesh=mesh, ladder=(1,),
+           cache_dir=cache_dir).startup()
+
+    def boom(*a, **k):
+        raise RuntimeError("xla runtime rejected the payload")
+
+    monkeypatch.setattr(serialize_executable, "deserialize_and_load",
+                        boom)
+    srv2 = Server("kmeans", state=state, mesh=mesh, ladder=(1,),
+                  cache_dir=cache_dir)
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        info = srv2.startup()
+    assert info["cache_misses"] == 1
+    monkeypatch.undo()
+    (resp,) = srv2.process([{"id": 0, "x": [[0.0] * 8]}])
+    assert "result" in resp
+
+
 # ---------------------------------------------------------------------------
 # stdio protocol + CLI end-to-end
 # ---------------------------------------------------------------------------
@@ -364,6 +427,30 @@ def test_stdio_roundtrip_with_stats_and_quit(mesh, tmp_path):
     assert lines[1]["error"] == "unparseable JSON"
     assert lines[2]["kind"] == "serve_stats"
     assert lines[3]["id"] == "b" and len(lines[3]["result"]) == 1
+
+
+def test_burst_reader_sees_past_text_layer_buffering():
+    """Queued lines a TextIOWrapper would have buffered internally (where
+    select on the fd can't see them) must land in the CURRENT burst, and
+    a partial trailing line must carry over to the next one."""
+    import os
+
+    from harp_tpu.serve.server import _BurstReader
+
+    r, w = os.pipe()
+    stdin = os.fdopen(r, "r")  # the buffered text wrapper main() gets
+    try:
+        os.write(w, b'{"id": 1}\n{"id": 2}\n{"id": 3}\n{"id": 4')
+        reader = _BurstReader(stdin)
+        burst = reader.read_burst()
+        assert [json.loads(ln)["id"] for ln in burst] == [1, 2, 3]
+        os.write(w, b'}\n')  # the partial line completes
+        assert [json.loads(ln)["id"]
+                for ln in reader.read_burst()] == [4]
+        os.close(w)
+        assert reader.read_burst() == []  # EOF
+    finally:
+        stdin.close()
 
 
 def test_cli_serves_from_checkpoint_end_to_end(mesh, tmp_path,
