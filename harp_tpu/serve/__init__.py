@@ -22,11 +22,20 @@ invariants* of the steady state here, not advice:
 - the AOT executable cache (:mod:`harp_tpu.serve.cache`) persists
   compiled executables to disk keyed by (jax version, topology, shape,
   code fingerprint), so a warm restart performs ZERO XLA compiles before
-  its first response (CompileWatch-proven in tests/test_serve.py).
+  its first response (CompileWatch-proven in tests/test_serve.py);
+- the continuous plane (:class:`~harp_tpu.serve.server.
+  ContinuousRunner` over :class:`~harp_tpu.serve.batcher.
+  ContinuousScheduler`, fronted by asyncio TCP in
+  :mod:`harp_tpu.serve.transport`) admits requests WHILE batches are in
+  flight and dispatches batch t+1 before batch t's readback, so the
+  mesh never drains between bursts — same budgets, proven EXACT by
+  ``SteadyState.verify_exact``.
 """
 
-from harp_tpu.serve.batcher import MicroBatcher, ShapeLadder
+from harp_tpu.serve.batcher import (ContinuousScheduler, MicroBatcher,
+                                    ShapeLadder)
 from harp_tpu.serve.cache import ExecutableCache
-from harp_tpu.serve.server import Server
+from harp_tpu.serve.server import ContinuousRunner, Server
 
-__all__ = ["MicroBatcher", "ShapeLadder", "ExecutableCache", "Server"]
+__all__ = ["ContinuousScheduler", "ContinuousRunner", "MicroBatcher",
+           "ShapeLadder", "ExecutableCache", "Server"]

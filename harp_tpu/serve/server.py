@@ -9,18 +9,32 @@ Reference parity: none (ROADMAP "harp serve"; Harp is batch fit-and-exit
    executable per ladder rung through the AOT cache
    (:mod:`harp_tpu.serve.cache`) — on a warm restart every rung is a
    cache hit and startup performs ZERO XLA compiles;
-2. **steady state** — drain queued requests through the micro-batcher
-   (:mod:`harp_tpu.serve.batcher`); every batch runs under the
-   flight-recorder steady-state guard (``compiles=0, dispatches=1,
-   readbacks=1`` — :class:`harp_tpu.utils.flightrec.SteadyState`), so
-   the relay traps are enforced invariants of the loop, not advice.
-   While batch *t* executes, batch *t+1*'s padded input is staged onto
-   the device (the donate-argnums double buffer: the step donates its
+2. **steady state** — two request planes share the cached executables:
+
+   - *burst* (:meth:`Server.process` / :meth:`Server.serve_stdio`, the
+     PR-6 plane): a burst is admitted, drained to completion through
+     the micro-batcher, and only then is the next burst admitted;
+   - *continuous* (:class:`ContinuousRunner`, this PR): requests are
+     admitted into the :class:`~harp_tpu.serve.batcher.
+     ContinuousScheduler` WHILE device batches are in flight, and the
+     dispatcher launches batch t+1 as soon as batch t's dispatch
+     returns — before t's readback — so admission, staging and compute
+     overlap and the mesh never drains between bursts (the serving-
+     plane analogue of PR 2's chunked-rotate overlap).
+
+   Either way every scheduler window runs under the flight-recorder
+   steady-state guard (``compiles=0, dispatches<=1, readbacks<=1`` —
+   :class:`harp_tpu.utils.flightrec.SteadyState`; the continuous loop
+   additionally proves EXACT totals via ``verify_exact``), so the
+   relay traps are enforced invariants of the loop, not advice.  While
+   batch *t* executes, batch *t+1*'s padded input is staged onto the
+   device (the donate-argnums double buffer: the step donates its
    batch buffer, so XLA can reuse it for the next staging on TPU).
 
-The request protocol is line-delimited JSON on stdin/stdout — no
-network stack, so the whole server is testable (and benchmarkable) in
-process:
+The request protocol is line-delimited JSON — over stdin/stdout (no
+network stack, so the whole server is testable and benchmarkable in
+process) or over asyncio TCP with per-connection response routing
+(:mod:`harp_tpu.serve.transport`, ``--tcp PORT``):
 
 - request: ``{"id": <any>, "x": [[...], ...]}`` (``"users"`` for
   mfsgd); rows beyond the max ladder rung span several batches;
@@ -32,14 +46,16 @@ process:
 
 from __future__ import annotations
 
+import collections
 import json
 import sys
 import time
-from typing import IO, Sequence
+from typing import IO, Any, Callable, Sequence
 
 import numpy as np
 
-from harp_tpu.serve.batcher import DEFAULT_LADDER, MicroBatcher, ShapeLadder
+from harp_tpu.serve.batcher import (DEFAULT_LADDER, ContinuousScheduler,
+                                    MicroBatcher, ShapeLadder)
 from harp_tpu.serve.cache import ExecutableCache, code_fingerprint
 from harp_tpu.serve.engines import make_engine
 from harp_tpu.utils import flightrec, telemetry
@@ -201,6 +217,17 @@ class Server:
             "steady": self.steady.summary(),
         }
 
+    def make_runner(self, *, max_queue_delay_s: float = 0.005,
+                    rung_policy: str = "adaptive", depth: int = 2,
+                    clock: Callable[[], float] = time.perf_counter
+                    ) -> "ContinuousRunner":
+        """A continuous request plane over this server's executables."""
+        if not self._exec:
+            raise RuntimeError("call startup() before make_runner()")
+        return ContinuousRunner(self, max_queue_delay_s=max_queue_delay_s,
+                                rung_policy=rung_policy, depth=depth,
+                                clock=clock)
+
     # -- stdio loop --------------------------------------------------------
     def serve_stdio(self, stdin: IO, stdout: IO) -> int:
         """Blocking JSONL loop; returns the number of requests answered.
@@ -243,6 +270,162 @@ class Server:
         if burst:
             for resp in self.process(burst):
                 stdout.write(json.dumps(resp) + "\n")
+
+
+class ContinuousRunner:
+    """Admit-while-in-flight dispatcher — the continuous request plane.
+
+    Owns one :class:`~harp_tpu.serve.batcher.ContinuousScheduler` and a
+    bounded pipeline of in-flight device batches (``depth``, default 2:
+    the donated-buffer double buffer).  The driving loop is three verbs:
+
+    - :meth:`submit` admits a request at its arrival time (legal at any
+      moment — between :meth:`step` calls of an active pipeline);
+    - :meth:`step` performs ONE scheduler-window action: dispatch the
+      next batch when the policy says go and the pipeline has room,
+      else read back the oldest in-flight batch, else nothing.  Batch
+      t+1 therefore dispatches right after batch t's dispatch returns,
+      BEFORE t's readback — on hardware with async dispatch the mesh
+      never drains while the host admits/stages/formats;
+    - completed responses come back from :meth:`step` as ``(key,
+      response)`` pairs, in admission order (FIFO rows through FIFO
+      batches — per-connection ordering is the transport's for free).
+
+    Every window runs under the server's :class:`~harp_tpu.utils.
+    flightrec.SteadyState` budget (``compiles=0, dispatches<=1,
+    readbacks<=1``), and :meth:`verify_exact` proves the run's totals
+    were exactly one dispatch + one readback per batch.  ``clock`` is
+    injected so tests and the sustained-load bench drive the policy on
+    a deterministic timeline.
+    """
+
+    def __init__(self, server: Server, *,
+                 max_queue_delay_s: float = 0.005,
+                 rung_policy: str = "adaptive", depth: int = 2,
+                 clock: Callable[[], float] = time.perf_counter):
+        if depth < 1:
+            raise ValueError(f"pipeline depth {depth} must be >= 1")
+        self.srv = server
+        self.sched = ContinuousScheduler(
+            server.ladder, max_queue_delay_s=max_queue_delay_s,
+            rung_policy=rung_policy)
+        self.depth = int(depth)
+        self.clock = clock
+        self._in_flight: collections.deque = collections.deque()
+        # key -> {"req", "rows", "segs"} for admitted-not-answered work
+        self._asm: dict[Any, dict] = {}
+        self.dispatched = 0
+        self.completed = 0
+        self.latencies_ms: collections.deque = collections.deque(
+            maxlen=4096)
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, key: Any, req: Any,
+               now: float | None = None) -> list[tuple[Any, dict]]:
+        """Admit one request; returns immediately-answerable responses
+        (malformed / empty requests), else [] with the rows queued."""
+        now = self.clock() if now is None else now
+        if not isinstance(req, dict):
+            return [(key, {"id": None,
+                           "error": "request must be a JSON object"})]
+        try:
+            rows = self.srv.engine.rows_from_request(req)
+        except (ValueError, KeyError, TypeError) as e:
+            return [(key, {"id": req.get("id"), "error": str(e)})]
+        if rows.shape[0] == 0:
+            return [(key, {"id": req.get("id"), "result": []})]
+        if key in self._asm:
+            raise ValueError(f"request key {key!r} already in flight")
+        self._asm[key] = {"req": req, "rows": rows, "segs": [],
+                          "arrival": now}
+        self.sched.put(key, rows.shape[0], now)
+        return []
+
+    # -- the scheduler window ----------------------------------------------
+    def pending(self) -> int:
+        """Admitted-not-answered requests (queued or in flight)."""
+        return len(self._asm)
+
+    def next_deadline(self) -> float | None:
+        return self.sched.next_deadline()
+
+    def step(self, now: float | None = None) -> list[tuple[Any, dict]]:
+        """One window: dispatch if the policy fires and the pipeline has
+        room, else read back the oldest in-flight batch.  Returns the
+        responses completed by this window ([] for a dispatch window or
+        an idle call)."""
+        now = self.clock() if now is None else now
+        idle = not self._in_flight
+        if (len(self._in_flight) < self.depth
+                and self.sched.ready(now, idle)):
+            with self.srv.steady.batch():
+                batch = self.sched.next_batch(now)
+                staged = self.srv._stage(
+                    batch, {key: self._asm[key]["rows"]
+                            for key, _, _ in batch.requests})
+                out_dev = self.srv._exec[batch.rung](
+                    *self.srv.engine.state_args(), staged)
+                self._in_flight.append((batch, out_dev))
+            self.dispatched += 1
+            self.srv.rows_served += batch.rows
+            return []
+        if self._in_flight:
+            with self.srv.steady.batch():
+                batch, out_dev = self._in_flight.popleft()
+                out = flightrec.readback(out_dev)
+            return self._complete(batch, out, now)
+        return []
+
+    def _complete(self, batch, out: np.ndarray,
+                  now: float) -> list[tuple[Any, dict]]:
+        responses: list[tuple[Any, dict]] = []
+        cursor = 0
+        for key, lo, hi in batch.requests:
+            a = self._asm[key]
+            a["segs"].append(out[cursor:cursor + (hi - lo)])
+            cursor += hi - lo
+            if hi == a["rows"].shape[0]:  # final segment (FIFO rows)
+                segs = a["segs"]
+                full = (np.concatenate(segs, axis=0) if len(segs) > 1
+                        else segs[0])
+                responses.append((key, {
+                    "id": a["req"].get("id"),
+                    "result": self.srv.engine.output_rows(
+                        full, hi)}))
+                self.latencies_ms.append((now - a["arrival"]) * 1e3)
+                del self._asm[key]
+                self.completed += 1
+                self.srv.requests_served += 1
+        return responses
+
+    def drain(self, now: float | None = None) -> list[tuple[Any, dict]]:
+        """Run windows until nothing is queued or in flight (shutdown /
+        end-of-trace flush)."""
+        out: list[tuple[Any, dict]] = []
+        while self._asm or self._in_flight:
+            out.extend(self.step(now))
+        return out
+
+    def verify_exact(self, *, compiles: int = 0) -> dict:
+        """Prove the run's totals: exactly one dispatch + one readback
+        per dispatched batch (see ``SteadyState.verify_exact``)."""
+        return self.srv.steady.verify_exact(self.dispatched,
+                                            compiles=compiles)
+
+    def stats(self) -> dict:
+        lat = sorted(self.latencies_ms)
+
+        def pct(p):
+            return round(lat[min(len(lat) - 1,
+                                 int(p / 100 * len(lat)))], 3) if lat \
+                else None
+
+        return {"mode": "continuous", "dispatched": self.dispatched,
+                "completed": self.completed,
+                "queued_rows": len(self.sched),
+                "in_flight": len(self._in_flight),
+                "padding_frac": round(self.sched.padding_frac(), 6),
+                "p50_ms": pct(50), "p99_ms": pct(99)}
 
 
 class _BurstReader:
@@ -324,9 +507,38 @@ def main(argv=None) -> int:
                    help="measure qps + latency percentiles on synthetic "
                         "state/requests and print ONE provenance-stamped "
                         'kind:"serve" JSON row instead of serving stdio')
+    p.add_argument("--sustained", action="store_true",
+                   help="--bench variant: sustained-load A/B on one "
+                        "seeded arrival trace — burst-drain vs the "
+                        "continuous plane (offered vs achieved qps, "
+                        "queue-depth percentiles, arrival->response "
+                        "latency)")
     p.add_argument("--requests", type=int, default=256,
                    help="--bench: number of synthetic requests")
     p.add_argument("--rows-per-request", type=int, default=1)
+    p.add_argument("--offered-qps", type=float, default=None,
+                   help="--sustained: arrival rate; default calibrates "
+                        "burst capacity and offers 2x it")
+    p.add_argument("--burst-admit", type=int, default=64,
+                   help="--sustained: burst-plane admission quantum "
+                        "(PR 6's bench burst size / the stdio pipe "
+                        "window)")
+    p.add_argument("--tcp", type=int, default=None, metavar="PORT",
+                   help="serve the JSONL protocol over asyncio TCP on "
+                        "this port with the CONTINUOUS plane (stdio "
+                        "stays burst-drained); port 0 picks a free one")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="--tcp bind address")
+    p.add_argument("--max-queue-delay-ms", type=float, default=5.0,
+                   help="continuous plane: flush deadline — a queued "
+                        "row never waits longer for a fuller rung "
+                        "(measured: ~one 512-rung batch time; see "
+                        "ContinuousScheduler)")
+    p.add_argument("--rung-policy", choices=["adaptive", "greedy"],
+                   default="adaptive",
+                   help="continuous plane: adaptive holds work while "
+                        "in flight to fill larger rungs; greedy "
+                        "dispatches immediately at the minimal rung")
     p.add_argument("--platform", choices=["cpu"], default=None,
                    help="force the CPU backend (the axon site pin would "
                         "otherwise route to the TPU relay — CLAUDE.md)")
@@ -338,14 +550,24 @@ def main(argv=None) -> int:
     ladder = (tuple(int(r) for r in args.ladder.split(","))
               if args.ladder else DEFAULT_LADDER)
 
-    if args.bench:
-        from harp_tpu.serve.bench import benchmark
+    if args.bench or args.sustained:
+        from harp_tpu.serve.bench import benchmark, benchmark_sustained
         from harp_tpu.utils.metrics import benchmark_json
 
-        res = benchmark(app=args.app, n_requests=args.requests,
-                        rows_per_request=args.rows_per_request,
-                        ladder=ladder)
-        print(benchmark_json(f"serve_{args.app}", res))
+        if args.sustained:
+            res = benchmark_sustained(
+                app=args.app, n_requests=args.requests,
+                rows_per_request=args.rows_per_request, ladder=ladder,
+                offered_qps=args.offered_qps,
+                burst_admit=args.burst_admit,
+                max_queue_delay_ms=args.max_queue_delay_ms,
+                rung_policy=args.rung_policy)
+            print(benchmark_json(f"serve_{args.app}_sustained", res))
+        else:
+            res = benchmark(app=args.app, n_requests=args.requests,
+                            rows_per_request=args.rows_per_request,
+                            ladder=ladder)
+            print(benchmark_json(f"serve_{args.app}", res))
         return 0
 
     if args.ckpt is None:
@@ -367,6 +589,13 @@ def main(argv=None) -> int:
     print(json.dumps({"kind": "serve_ready", "app": args.app,
                       "step": srv.ckpt_step, **info}),
           file=sys.stderr, flush=True)
+    if args.tcp is not None:
+        from harp_tpu.serve.transport import serve_forever
+
+        serve_forever(srv, args.host, args.tcp,
+                      max_queue_delay_s=args.max_queue_delay_ms / 1e3,
+                      rung_policy=args.rung_policy)
+        return 0
     srv.serve_stdio(sys.stdin, sys.stdout)
     return 0
 

@@ -451,6 +451,38 @@ class SteadyState:
             out.update(delta_since(self._base))
         return out
 
+    def verify_exact(self, batches: int, *, compiles: int = 0) -> dict:
+        """Overlap-mode exact accounting: the continuous serving loop
+        dispatches batch t+1 before it reads batch t back, so one
+        :meth:`batch` window no longer pairs a dispatch with ITS
+        readback — the per-window budget still bounds each window, but
+        only the totals can prove the pipeline stayed exact.  Asserts
+        that since :meth:`reset` the loop spent EXACTLY one dispatch
+        and one readback per dispatched batch and exactly ``compiles``
+        compiles: over-spending is the classic trap, and UNDER-spending
+        means work bypassed the tracked executables (equally wrong —
+        an untracked dispatch is invisible to every budget).  Returns
+        the spent dict; raises/warns per the instance ``action``.
+        No-op ({} returned) when telemetry never enabled.
+        """
+        if self._base is None:
+            return {}
+        spent = delta_since(self._base)
+        wrong = [f"{k} spent {spent[k]} != exactly {want}"
+                 for k, want in (("compiles", compiles),
+                                 ("dispatches", batches),
+                                 ("readbacks", batches))
+                 if spent[k] != want]
+        if wrong:
+            self.violations += 1
+            msg = (f"steady-state exact accounting failed [{self.tag}] "
+                   f"over {batches} batches: " + "; ".join(wrong))
+            if self.action == "warn":
+                warnings.warn(msg, RuntimeWarning, stacklevel=2)
+            else:
+                raise BudgetExceeded(msg)
+        return spent
+
 
 # ---------------------------------------------------------------------------
 # Export

@@ -39,6 +39,19 @@ SMOKE = {
                          "burst": 16, "ladder": (1, 8, 32),
                          "state_shape": {"n_users": 256, "n_items": 128,
                                          "rank": 8}},
+    # sustained continuous-batching A/B (PR 7): n_requests must exceed
+    # the max rung or the backlog can never fill a max-rung batch and
+    # the A/B reads ~1.0x at any truth (measured: 256 requests on the
+    # 512 ladder gave 0.96x; 2048 gave 1.78x) — the smoke ladder tops
+    # at 32 so 96 requests keep the same property in seconds
+    "serve_kmeans_sustained": {"n_requests": 96, "rows_per_request": 1,
+                               "burst_admit": 8, "ladder": (1, 8, 32),
+                               "state_shape": {"k": 16, "d": 32}},
+    "serve_mfsgd_sustained": {"n_requests": 96, "rows_per_request": 1,
+                              "burst_admit": 8, "ladder": (1, 8, 32),
+                              "state_shape": {"n_users": 256,
+                                              "n_items": 128,
+                                              "rank": 8}},
     "subgraph": {"n_vertices": 2000, "avg_degree": 4},
     "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2},
 }

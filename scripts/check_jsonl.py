@@ -62,7 +62,16 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    positive number, and ``steady_compiles`` must be EXACTLY 0 — the
    serving loop's whole contract is that the steady state never
    recompiles, so a row that measured throughput while silently
-   compiling per batch is not serving evidence at all.
+   compiling per batch is not serving evidence at all.  SUSTAINED serve
+   rows (the continuous-batching A/B, ``serve.bench.benchmark_
+   sustained`` — recognizable by ``offered_qps``/``achieved_qps`` or
+   ``mode == "sustained"``) additionally must satisfy ``offered_qps >=
+   achieved_qps > 0`` (achieved above offered means the latency origin
+   was not the arrival trace — the burst-submit dishonesty this mode
+   exists to fix) and carry non-negative queue-depth percentiles
+   (``qdepth_p50``/``qdepth_p95``/``qdepth_p99``): a sustained row
+   without queue evidence cannot support any claim about the
+   padding-vs-latency tradeoff its knobs encode.
 """
 
 from __future__ import annotations
@@ -257,6 +266,36 @@ def _check_serve_row(name: str, i: int, row: dict) -> list[str]:
             f"{name}:{i}: serve row steady_compiles={sc!r} must be "
             "exactly 0 — a serving loop that compiles in steady state "
             "violates its own contract (flightrec.SteadyState)")
+    if ("offered_qps" in row or "achieved_qps" in row
+            or row.get("mode") == "sustained"):
+        errs += _check_sustained_serve_row(name, i, row)
+    return errs
+
+
+SERVE_QDEPTH_FIELDS = ("qdepth_p50", "qdepth_p95", "qdepth_p99")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_sustained_serve_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 7, sustained extension (continuous-batching rows)."""
+    errs: list[str] = []
+    off, ach = row.get("offered_qps"), row.get("achieved_qps")
+    if not _num(off) or not _num(ach) or ach <= 0 or off < ach:
+        errs.append(
+            f"{name}:{i}: sustained serve row needs offered_qps >= "
+            f"achieved_qps > 0, got offered={off!r} achieved={ach!r} — "
+            "achieved above offered means latency was not measured "
+            "from the arrival trace")
+    for k in SERVE_QDEPTH_FIELDS:
+        v = row.get(k)
+        if not _num(v) or v < 0:
+            errs.append(
+                f"{name}:{i}: sustained serve row {k}={v!r} must be a "
+                "non-negative number — queue-depth evidence is what "
+                "grades the padding-vs-latency knobs")
     return errs
 
 

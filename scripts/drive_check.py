@@ -1391,3 +1391,91 @@ finally:
     _rv_stdin.close()
 print("burst reader: queued lines in-burst, partial line carries, EOF")
 print(f"DRIVE OK round-26 ({mode})")
+
+# ---------------------------------------------------------------------------
+# Round 27 — continuous serving (PR 7): the asyncio TCP front end over a
+# REAL socket (concurrent connections, per-connection order, interleaved
+# clients, stats/quit/shutdown), the admit-while-in-flight scheduler's
+# exact steady accounting, and the sustained-load A/B row through the
+# extended invariant 7 — all without a relay.
+# ---------------------------------------------------------------------------
+import socket as _ct_socket
+import threading as _ct_threading
+
+from harp_tpu.serve.bench import benchmark_sustained as _ct_sus
+from harp_tpu.serve.transport import TCPFrontEnd as _CtFE
+
+_ct_rng = np.random.default_rng(27)
+_ct_state = _SvEngines["kmeans"].synthetic_state(_ct_rng, k=8, d=16)
+with _sv_tmp.TemporaryDirectory() as _ct_dir:
+    _ct_srv = _SvServer("kmeans", state=_ct_state, mesh=mesh,
+                        ladder=(1, 8, 32), cache_dir=_ct_dir,
+                        budget_action="warn")
+    _ct_srv.startup()
+    _ct_fe = _CtFE(_ct_srv, port=0,
+                   max_queue_delay_s=0.002).start_in_thread()
+    _ct_cent = _ct_state["centroids"]
+
+    def _ct_client(nm, out):
+        s = _ct_socket.create_connection(("127.0.0.1", _ct_fe.port),
+                                         timeout=120)
+        f = s.makefile("rw")
+        xs = [_ct_rng.normal(size=(1 + i % 4, 16)).astype(np.float32)
+              for i in range(16)]
+        for i, x in enumerate(xs):  # all 16 in flight at once
+            f.write(_sv_json.dumps({"id": f"{nm}-{i}",
+                                    "x": x.tolist()}) + "\n")
+        f.flush()
+        got = [_sv_json.loads(f.readline()) for _ in xs]
+        f.write(_sv_json.dumps({"cmd": "stats"}) + "\n")
+        f.flush()
+        st = _sv_json.loads(f.readline())
+        assert st["kind"] == "serve_stats" and "continuous" in st
+        f.write(_sv_json.dumps({"cmd": "quit"}) + "\n")
+        f.flush()
+        assert f.readline() == ""  # server closed after the drain
+        s.close()
+        out[nm] = (xs, got)
+
+    _ct_out = {}
+    _ct_threads = [_ct_threading.Thread(target=_ct_client,
+                                        args=(nm, _ct_out))
+                   for nm in ("c1", "c2", "c3")]
+    for _t in _ct_threads:
+        _t.start()
+    for _t in _ct_threads:
+        _t.join(240)
+    assert set(_ct_out) == {"c1", "c2", "c3"}
+    for _nm, (_xs, _got) in _ct_out.items():
+        assert [r["id"] for r in _got] == [f"{_nm}-{i}"
+                                           for i in range(16)]
+        for _r, _x in zip(_got, _xs):  # routed to the right conn, exact
+            _ref = np.argmin(((_x[:, None, :] - _ct_cent[None]) ** 2
+                              ).sum(-1), 1)
+            assert _r["result"] == _ref.tolist()
+    # runner totals are EXACT: one dispatch + one readback per batch
+    _ct_fe.runner.verify_exact()
+    _ct_fe.shutdown()
+    _ct_fe.join(120)
+print("tcp front end: 3 interleaved clients x 16 requests routed + "
+      "ordered per connection, stats/quit/shutdown, exact accounting")
+
+# sustained A/B: one seeded trace, both planes, extended invariant 7
+_ct_res = _ct_sus(app="kmeans", n_requests=96, rows_per_request=1,
+                  burst_admit=8, ladder=(1, 8, 32), mesh=mesh,
+                  state_shape={"k": 8, "d": 16})
+assert _ct_res["offered_qps"] >= _ct_res["achieved_qps"] > 0
+assert _ct_res["steady_compiles"] == 0
+assert _ct_res["steady_dispatches"] == _ct_res["batches"] == \
+    _ct_res["steady_readbacks"]
+_ct_row = _sv_json.loads(_sv_bjson("serve_kmeans_sustained", _ct_res))
+assert _sv_cj._check_serve_row("drive", 1, _ct_row) == []
+assert _sv_cj._check_serve_row(  # forged: queue evidence stripped
+    "drive", 1, {k: v for k, v in _ct_row.items()
+                 if k != "qdepth_p95"})
+assert _sv_cj._check_serve_row(  # forged: achieved above offered
+    "drive", 1, {**_ct_row, "achieved_qps": _ct_row["offered_qps"] + 1})
+print(f"sustained A/B: {_ct_res['qps_ratio_vs_burst']}x vs burst at "
+      f"p99 {_ct_res['p99_ms']:.1f} vs {_ct_res['burst_p99_ms']:.1f} ms, "
+      "row passes extended invariant 7, forgeries loud")
+print(f"DRIVE OK round-27 ({mode})")

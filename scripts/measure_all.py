@@ -79,6 +79,12 @@ SPRINT_ORDER = [
     # at the graded state shapes); check_jsonl invariant 7 refuses any
     # row whose steady state compiled
     "serve_kmeans", "serve_mfsgd_topk",
+    # PR 7: sustained continuous-batching A/B (burst-drain vs
+    # admit-while-in-flight on one seeded arrival trace) — the first
+    # relay window yields the TPU qps_ratio_vs_burst + queue-depth
+    # verdicts; invariant 7's sustained extension refuses rows without
+    # offered>=achieved and queue evidence
+    "serve_kmeans_sustained", "serve_mfsgd_sustained",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -253,6 +259,22 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
             app="mfsgd", topk=10,
             **(SMOKE["serve_mfsgd_topk"] if smoke else
                {"n_requests": 2048, "rows_per_request": 1,
+                "state_shape": {"n_users": 138_493, "n_items": 26_744,
+                                "rank": 64}})),
+        # PR 7: sustained-load A/B at the same graded state shapes —
+        # single-row requests on one seeded trace offered at 2× the
+        # calibrated burst capacity (both planes saturated, so policy
+        # not arrival luck decides), 4096 requests so the backlog can
+        # fill 512-rungs (see the bench_common smoke comment)
+        "serve_kmeans_sustained": lambda: serve_bench.benchmark_sustained(
+            app="kmeans",
+            **(SMOKE["serve_kmeans_sustained"] if smoke else
+               {"n_requests": 4096, "rows_per_request": 1,
+                "state_shape": {"k": 100, "d": 300}})),
+        "serve_mfsgd_sustained": lambda: serve_bench.benchmark_sustained(
+            app="mfsgd", topk=10,
+            **(SMOKE["serve_mfsgd_sustained"] if smoke else
+               {"n_requests": 4096, "rows_per_request": 1,
                 "state_shape": {"n_users": 138_493, "n_items": 26_744,
                                 "rank": 64}})),
         # ladder configs AFTER the default-shape flip pairs: the

@@ -307,6 +307,56 @@ def test_serve_row_invariants(tmp_path):
     assert ":6:" in errors[4] and "p50_ms" in errors[4]
 
 
+def test_sustained_serve_row_invariants(tmp_path):
+    """Invariant 7, sustained extension: continuous-batching rows need
+    offered_qps >= achieved_qps > 0 and non-negative queue-depth
+    percentiles — a sustained claim without queue evidence cannot grade
+    the padding-vs-latency knobs."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    base = {"kind": "serve", "app": "kmeans", "qps": 100.0,
+            "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+            "steady_compiles": 0, **stamp}
+    qd = {"qdepth_p50": 3.0, "qdepth_p95": 9.0, "qdepth_p99": 12.0}
+    rows = [
+        {**base, "mode": "sustained", "offered_qps": 200.0,
+         "achieved_qps": 100.0, **qd},                       # fine
+        {**base, "offered_qps": 90.0, "achieved_qps": 100.0,
+         **qd},                                              # ach > off
+        {**base, "mode": "sustained", "offered_qps": 200.0,
+         "achieved_qps": 0.0, **qd},                         # ach <= 0
+        {**base, "offered_qps": 200.0, "achieved_qps": 100.0,
+         "qdepth_p50": 3.0, "qdepth_p95": 9.0},              # missing p99
+        {**base, "offered_qps": 200.0, "achieved_qps": 100.0,
+         **{**qd, "qdepth_p95": -1.0}},                      # negative
+        {**base, "mode": "sustained", **qd},                 # no qps pair
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 5
+    assert ":2:" in errors[0] and "offered_qps >= achieved_qps" in errors[0]
+    assert ":3:" in errors[1] and "achieved" in errors[1]
+    assert ":4:" in errors[2] and "qdepth_p99" in errors[2]
+    assert ":5:" in errors[3] and "qdepth_p95" in errors[3]
+    assert ":6:" in errors[4] and "offered" in errors[4]
+
+
+def test_sustained_bench_row_satisfies_the_checker(tmp_path, mesh):
+    """Round-trip: benchmark_sustained through benchmark_json must pass
+    the extended invariant 7 as-is in a bench file."""
+    from harp_tpu.serve.bench import benchmark_sustained
+    from harp_tpu.utils.metrics import benchmark_json
+
+    res = benchmark_sustained(app="kmeans", n_requests=24,
+                              rows_per_request=1, burst_admit=4,
+                              ladder=(1, 8), offered_qps=2000.0,
+                              state_shape={"k": 4, "d": 8})
+    assert res["offered_qps"] >= res["achieved_qps"] > 0
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(benchmark_json("serve_kmeans_sustained", res) + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
 def test_serve_bench_row_satisfies_the_checker(tmp_path, mesh):
     """Round-trip: what serve.bench emits through benchmark_json must
     pass invariant 7 as-is — even teed into a bench file."""

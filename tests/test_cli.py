@@ -307,6 +307,7 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
     from harp_tpu.serve import bench as serve_bench
 
     stubbed(serve_bench, "benchmark")
+    stubbed(serve_bench, "benchmark_sustained")
     monkeypatch.setattr(ma, "_bench_ingest",
                         lambda smoke, quantize=None: {"stub": 1.0})
     monkeypatch.setattr(roofline, "annotate", lambda name, res: res)
