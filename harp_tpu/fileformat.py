@@ -119,10 +119,30 @@ def load_sharded_csv(pattern_or_paths, num_workers: int,
     if not paths:
         raise FileNotFoundError(f"no input files match {pattern_or_paths!r}")
     splits = multi_file_splits(paths, num_workers)
+    # per-file loads ride the shared ingest pipeline (PR 8): files are
+    # random-access units, so two reader threads parse file j+1 while
+    # file j's rows are being stacked; results come back in submission
+    # order, so the per-worker concatenation — and the output — is
+    # bit-identical to the old serial loop.  compiles=0 under the
+    # warn-mode budget: a loader that silently traces a program would
+    # be a relay trap at ingest time.
+    flat = [(w, p) for w, files in enumerate(splits) for p in files]
+    loaded: list = [None] * len(flat)
+    if flat:
+        from harp_tpu.ingest import IngestPipeline
+        from harp_tpu.utils import telemetry
+
+        with IngestPipeline(lambda j: loader(flat[j][1]), depth=4,
+                            read_threads=2,
+                            tag="fileformat.load_sharded_csv") as pipe, \
+                telemetry.budget(compiles=0, action="warn",
+                                 tag="fileformat.load_sharded_csv"):
+            for j, arr in enumerate(pipe.stream(len(flat))):
+                loaded[j] = arr
     shards: list[np.ndarray] = []
     cols = None
-    for files in splits:
-        parts = [loader(p) for p in files]
+    for w, files in enumerate(splits):
+        parts = [loaded[j] for j, (fw, _) in enumerate(flat) if fw == w]
         if parts:
             shard = np.concatenate(parts, axis=0)
             cols = shard.shape[1] if cols is None else cols

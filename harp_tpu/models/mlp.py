@@ -28,9 +28,10 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from harp_tpu.ingest import IngestPipeline
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import flightrec, prng
+from harp_tpu.utils import flightrec, prng, telemetry
 from harp_tpu.utils.timing import device_sync
 
 
@@ -352,6 +353,23 @@ def _effective_batch(batch_size: int, n: int, n_workers: int) -> int:
     return max(n_workers, (min(batch_size, n) // n_workers) * n_workers)
 
 
+def _batch_reader(x, y, batch_size, order):
+    """Stage-1 reader for the shared ingest pipeline (PR 8): contiguous
+    ZERO-COPY views of the caller's arrays.  Shuffling permutes BATCH
+    indices (``order``, re-drawn per epoch by the caller), never rows —
+    the pre-PR loop gathered ``x[perm]`` batch by batch, a full
+    fancy-index copy of the dataset every epoch; a view costs nothing
+    and the cast/H2D stages downstream touch only one batch at a time
+    (pinned by tests/test_ingest.py: the reader output shares memory
+    with the input)."""
+
+    def read(j):
+        lo = int(order[j]) * batch_size
+        return x[lo:lo + batch_size], y[lo:lo + batch_size]
+
+    return read
+
+
 class MLPTrainer:
     """Host driver (the mapCollective residue for edu.iu.daal_nn)."""
 
@@ -383,10 +401,13 @@ class MLPTrainer:
     def load_resident(self, x, y, batch_size=8192, seed=0):
         """Stage the dataset in HBM for :meth:`fit_resident`.
 
-        Rows shuffle once on host (so the batch-divisibility trim doesn't
-        bias which rows are dropped); the host→device transfer happens here,
-        once, not inside the training loop.  Returns the usable sample
-        count.
+        Rows stage in input order; when the batch-divisibility trim must
+        drop rows it drops a uniform random subset (``seed``), so the
+        trim stays unbiased without the pre-PR-8 full-row host reshuffle
+        (a whole extra dataset copy).  Batch ORDER still reshuffles on
+        device every epoch (:func:`make_epoch_fn`).  The host→device
+        transfer happens here, once, not inside the training loop.
+        Returns the usable sample count.
         """
         n = x.shape[0]
         nw = self.mesh.num_workers
@@ -394,10 +415,21 @@ class MLPTrainer:
             raise ValueError(f"need at least {nw} samples (one per worker), got {n}")
         batch_size = _effective_batch(batch_size, n, nw)
         usable = (n // batch_size) * batch_size
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n)[:usable]
-        xs = self.mesh.shard_array(np.asarray(x, np.float32)[order], 0)
-        ys = self.mesh.shard_array(np.asarray(y, np.int32)[order], 0)
+        # rows stage in INPUT order (zero extra host copies when x is
+        # already f32) — the pre-PR ``x[order]`` gather re-materialized
+        # the whole dataset just to randomize an order the on-device
+        # per-epoch batch shuffle already randomizes.  Only the
+        # divisibility trim still samples: the dropped rows are a
+        # uniform random subset (order preserved), so the trim stays
+        # unbiased without a full-row reshuffle.
+        xs_host = np.asarray(x, np.float32)
+        ys_host = np.asarray(y, np.int32)
+        if usable < n:
+            rng = np.random.default_rng(seed)
+            keep = np.sort(rng.choice(n, size=usable, replace=False))
+            xs_host, ys_host = xs_host[keep], ys_host[keep]
+        xs = self.mesh.shard_array(xs_host, 0)
+        ys = self.mesh.shard_array(ys_host, 0)
         self._resident = (xs, ys, batch_size // nw, usable // batch_size)
         return usable
 
@@ -489,20 +521,55 @@ class MLPTrainer:
         )
         return history
 
-    def fit(self, x, y, batch_size=8192, epochs=1, shuffle_seed=0):
+    def fit(self, x, y, batch_size=8192, epochs=1, shuffle_seed=0,
+            prefetch=2):
+        """Host-streamed epoch training through the shared ingest
+        pipeline (:mod:`harp_tpu.ingest`, PR 8): batches are contiguous
+        zero-copy views of ``x``/``y``, the per-epoch shuffle permutes
+        BATCH indices, and with ``prefetch >= 2`` batch j+1's f32/int32
+        cast and H2D overlap batch j's step.  The pre-PR loop gathered
+        ``x[perm]`` per batch — a full fancy-index copy of the dataset
+        every epoch.  (Batch COMPOSITION is now fixed contiguous blocks
+        in shuffled order — the same fixed-composition property the
+        resident path has after staging.)  Each epoch's loop runs under
+        a warn-mode flight budget: exactly the batch bytes on the wire,
+        zero recompiles after the first epoch."""
         n = x.shape[0]
         nw = self.mesh.num_workers
         if n < nw:
             raise ValueError(f"need at least {nw} samples (one per worker), got {n}")
         batch_size = _effective_batch(batch_size, n, nw)
+        usable = (n // batch_size) * batch_size
+        n_batches = usable // batch_size
+        x = np.asarray(x)
+        y = np.asarray(y)
         rng = np.random.default_rng(shuffle_seed)
+        order = np.arange(n_batches)  # re-permuted in place per epoch
+
+        def prep(batch):
+            xb, yb = batch
+            return np.asarray(xb, np.float32), np.asarray(yb, np.int32)
+
+        def ship(batch):
+            xb, yb = batch
+            return (self.mesh.shard_array(xb, 0),
+                    self.mesh.shard_array(yb, 0))
+
+        epoch_bytes = usable * (x.shape[1] * 4 + 4)  # f32 rows + i32 labels
         history = []
-        for _ in range(epochs):
-            order = rng.permutation(n)
-            usable = (n // batch_size) * batch_size
-            for lo in range(0, usable, batch_size):
-                idx = order[lo:lo + batch_size]
-                history.append(self.train_batch(x[idx], y[idx]))
+        with IngestPipeline(_batch_reader(x, y, batch_size, order), prep,
+                            ship, depth=max(1, prefetch),
+                            tag="mlp.fit") as pipe:
+            for e in range(epochs):
+                order[:] = rng.permutation(n_batches)
+                with telemetry.budget(h2d_bytes=epoch_bytes,
+                                      compiles=None if e == 0 else 0,
+                                      action="warn", tag="mlp.fit.ingest"):
+                    for xb, yb in pipe.stream(n_batches):
+                        self.params, self.opt_state, loss, acc = self._step(
+                            self.params, self.opt_state, xb, yb)
+                        history.append((float(device_sync(loss)),
+                                        float(device_sync(acc))))
         return history
 
     def predict(self, x):
@@ -658,6 +725,11 @@ def benchmark(n=60_000, batch=8192, steps=50, mesh=None, cfg=None, warmup=5):
         "steps_per_sec": usable * epochs / batch / dt_res,
         "loss": hist[-1][0],
         "acc": hist[-1][1],
+        # the quantized-gradient-wire flip gate's quality field (PR 8:
+        # mlp_grad_bf16/int8 candidates in measure_all + flip_decision —
+        # a degraded wire must refuse on train_acc, not win on speed)
+        "train_acc": hist[-1][1],
+        "grad_wire": cfg.grad_wire,
         "batch": batch,
         "num_workers": mesh.num_workers,
         "half_precision": cfg.half_precision,

@@ -36,9 +36,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from harp_tpu.ingest import IngestPipeline
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import flightrec
+from harp_tpu.utils import flightrec, telemetry
 
 
 @dataclasses.dataclass
@@ -68,6 +69,34 @@ def binize(x, edges):
     out = np.empty(x.shape, np.int32)
     for j in range(x.shape[1]):
         out[:, j] = np.searchsorted(edges[j], x[:, j], side="left")
+    return out
+
+
+def binize_chunked(x, edges, chunk_rows=65_536, prefetch=2):
+    """:func:`binize` through the shared ingest pipeline (PR 8):
+    bit-identical output (per-row searchsorted is row-independent) with
+    the work chunked — the read stage hands zero-copy row views and,
+    with ``prefetch >= 2``, chunk j+1 bins on a worker thread while
+    chunk j's result writes back.  Each chunk's output slice is
+    disjoint, so the side-effecting prep stage is thread-safe by
+    construction."""
+    x = np.asarray(x)
+    n = x.shape[0]
+    out = np.empty(x.shape, np.int32)
+    n_chunks = max(1, -(-n // chunk_rows))
+
+    def read(j):
+        lo = j * chunk_rows
+        return lo, x[lo:lo + chunk_rows]
+
+    def prep(t):
+        lo, blk = t
+        out[lo:lo + blk.shape[0]] = binize(blk, edges)
+
+    with IngestPipeline(read, prep, None, depth=max(1, prefetch),
+                        tag="rf.binize") as pipe:
+        for _ in pipe.stream(n_chunks):
+            pass
     return out
 
 
@@ -266,7 +295,6 @@ class RandomForest:
             skew.record_partition("rf.partition", np.full(nw, n // nw),
                                   unit="rows", padded_total=n)
         self.edges = quantile_bins(x, cfg.n_bins)
-        bins = binize(x, self.edges)
         if self._train_fn is None:
             self._train_fn = make_train_fn(self.mesh, cfg, x.shape[1])
         train = self._train_fn
@@ -276,11 +304,19 @@ class RandomForest:
             jax.random.split(jnp.asarray(prng.key_bits(cfg.seed)),
                              nw * self.trees_per_worker)
         ).reshape(nw, self.trees_per_worker, 2)
+        # binize + ship through the shared ingest pipeline (PR 8), under
+        # the standard warn-mode flight budget: exactly the bins/labels/
+        # keys bytes cross the wire and the host half compiles nothing
+        with telemetry.budget(compiles=0,
+                              h2d_bytes=(x.size * 4 + y.nbytes
+                                         + keys.nbytes),
+                              action="warn", tag="rf.ingest"):
+            bins = binize_chunked(x, self.edges)
+            bins_dev = self.mesh.shard_array(bins, 0)
+            y_dev = self.mesh.shard_array(y, 0)
+            keys_dev = self.mesh.shard_array(keys, 0)
         self.forest = jax.tree.map(np.asarray, train(
-            self.mesh.shard_array(bins, 0),
-            self.mesh.shard_array(y, 0),
-            self.mesh.shard_array(keys, 0),
-        ))
+            bins_dev, y_dev, keys_dev))
         return self
 
     def predict(self, x):

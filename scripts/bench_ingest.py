@@ -103,7 +103,7 @@ def ensure_dataset(fmt: str, rows: int, cols: int, disk_dtype: str,
 def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
         k=1000, iters=2, chunk_points=262_144, keep=False,
         compare_synthetic=False, drop_caches=False, verbose=True,
-        quantize=None) -> dict:
+        quantize=None, prefetch=2) -> dict:
     import numpy as np
 
     from harp_tpu.models.kmeans_stream import benchmark_ingest
@@ -130,7 +130,7 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
                                chunk_points=chunk_points,
                                disk_bytes=os.path.getsize(path),
                                compare_synthetic=compare_synthetic,
-                               quantize=quantize)
+                               quantize=quantize, prefetch=prefetch)
         res.update({"format": fmt, "disk_dtype":
                     (disk_dtype if fmt == "npy" else "text"),
                     "cold_cache": cold})
@@ -140,6 +140,64 @@ def run(fmt="npy", rows=100_000_000, cols=300, disk_dtype="float16",
         # kept must survive a no-keep rerun that merely reused it
         if not keep and generated and os.path.exists(path):
             os.remove(path)
+
+
+# the A/B smoke shape: big enough that the host chain, not thread/jit
+# overhead, dominates (51 MB f16 over 25 chunks × 4 epochs) yet seconds
+# on the CPU sim; the tiny run_smoke shape (2.6 MB) reads ~1.0x at any
+# truth.  f16 disk + the auto f16 wire is the north-star disk format,
+# and the shape where the staged chain's work elimination (memmap view
+# straight into device_put, masks shipped once instead of per chunk) is
+# cleanly measurable.  prefetch=1 deliberately: the staged chain is
+# bit-exact at every depth, but on a 1-core host the thread-prefetch
+# modes only add scheduler preemption noise to the measurement (depth-2
+# reruns spread 0.94-1.35x while depth-1 repeats at ~1.9x, measured
+# 2026-08-04 CPU host) — CPU-bound stages cannot overlap on one core
+# (see harp_tpu/ingest.py module doc), so the A/B grades the chain, and
+# the relay sprint's multi-core kmeans_ingest config grades the depth
+AB_SMOKE = dict(fmt="npy", rows=400_000, cols=64, disk_dtype="float16",
+                k=8, iters=4, chunk_points=16_384, prefetch=1)
+
+
+def run_ab(fmt="npy", rows=200_000, cols=64, disk_dtype="float32",
+           k=16, iters=2, chunk_points=32_768, keep=True, quantize=None,
+           prefetch=2, verbose=True) -> dict:
+    """The pipelined-vs-serial host-path A/B at ONE config (PR 8
+    acceptance row): arm A is ``prefetch=0`` — the pre-pipeline serial
+    chain kept verbatim in ``kmeans_stream._legacy_put_chunk`` — arm B
+    the prefetch pipeline.  Both arms stream the same (page-cache-warm)
+    file, so ``pipeline_speedup`` is host-chain work, not disk luck.
+    Emits ONE merged ``kind:"ingest"`` dict (checked by check_jsonl
+    invariant 8): pipelined fields canonical, serial arm suffixed."""
+    import numpy as np
+
+    path, generated = ensure_dataset(fmt, rows, cols, disk_dtype,
+                                     verbose=verbose)
+    common = dict(fmt=fmt, rows=rows, cols=cols, disk_dtype=disk_dtype,
+                  k=k, iters=iters, chunk_points=chunk_points, keep=True,
+                  quantize=quantize, verbose=verbose)
+    try:
+        if fmt == "npy":
+            # warm the page cache for BOTH arms: a freshly generated
+            # file's dirty pages flush during arm A otherwise, charging
+            # writeback to whichever arm runs first
+            float(np.asarray(np.load(path, mmap_mode="r")).max())
+        serial = run(prefetch=0, **common)
+        piped = run(prefetch=prefetch, **common)
+    finally:
+        # both arms ran keep=True so arm B reuses arm A's (warm) file;
+        # clean up here instead, only what THIS call generated
+        if not keep and generated and os.path.exists(path):
+            os.remove(path)
+    piped.update({
+        "mode": "ab",
+        "host_gb_per_sec_serial": serial["host_gb_per_sec"],
+        "host_sec_per_epoch_serial": serial["host_sec_per_epoch"],
+        "points_per_sec_serial": serial["points_per_sec"],
+        "pipeline_speedup": (piped["host_gb_per_sec"]
+                             / serial["host_gb_per_sec"]),
+    })
+    return piped
 
 
 def run_smoke(quantize=None) -> dict:
@@ -221,6 +279,9 @@ def main(argv=None):
                    help="also time the device-regenerated formulation at "
                         "the same shapes (second compile + run)")
     p.add_argument("--drop-caches", action="store_true")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="ingest pipeline work-ahead depth (0 = the "
+                        "pre-pipeline serial loop, the A/B incumbent)")
     p.add_argument("--ensure-only", action="store_true",
                    help="generate (or reuse) the dataset file and exit — "
                         "run this OUTSIDE any benchmark watchdog: on this "
@@ -237,11 +298,17 @@ def main(argv=None):
 
         jax.config.update("jax_platforms", "cpu")
     if args.smoke:
-        rows, cols, k, chunk = 20_000, 32, 16, 4096
-    else:
-        rows = args.rows or (100_000_000 if args.format == "npy"
-                             else 2_000_000)
-        cols, k, chunk = args.cols, args.k, args.chunk
+        # --smoke IS the pipelined-vs-serial A/B (PR 8 acceptance): one
+        # provenance-stamped kind:"ingest" line, ready to tee into
+        # BENCH_local.jsonl and graded by check_jsonl invariant 8
+        from harp_tpu.utils.metrics import benchmark_json
+
+        res = run_ab(keep=False, **AB_SMOKE)
+        print(benchmark_json("kmeans_ingest_ab_smoke", res))
+        return
+    rows = args.rows or (100_000_000 if args.format == "npy"
+                         else 2_000_000)
+    cols, k, chunk = args.cols, args.k, args.chunk
     if args.ensure_only:
         path, generated = ensure_dataset(args.format, rows, cols,
                                          args.disk_dtype)
@@ -250,7 +317,7 @@ def main(argv=None):
     res = run(args.format, rows, cols, args.disk_dtype, k, args.iters,
               chunk, keep=args.keep,
               compare_synthetic=args.compare_synthetic,
-              drop_caches=args.drop_caches)
+              drop_caches=args.drop_caches, prefetch=args.prefetch)
     print(json.dumps({k2: (round(v, 4) if isinstance(v, float) else v)
                       for k2, v in res.items()}))
 

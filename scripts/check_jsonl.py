@@ -72,6 +72,17 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    (``qdepth_p50``/``qdepth_p95``/``qdepth_p99``): a sustained row
    without queue evidence cannot support any claim about the
    padding-vs-latency tradeoff its knobs encode.
+
+8. **Ingest rows are coherent streaming evidence** (any file): a ``kind:
+   "ingest"`` row (``kmeans_stream.benchmark_ingest`` /
+   ``scripts/bench_ingest.py``, PR 8) must carry the provenance stamp
+   (a CPU host-chain rate must never read as relay-tunnel evidence),
+   its ``overlap_efficiency`` (the host pipeline's stage-overlap score)
+   must lie in [0, 1], and its rates must be positive:
+   ``host_gb_per_sec > 0`` and ``points_per_sec > 0`` — a zero or
+   negative rate means the instrument block never ran, and such a row
+   grading the ingest fast path would certify a measurement that did
+   not happen.
 """
 
 from __future__ import annotations
@@ -299,6 +310,34 @@ def _check_sustained_serve_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
+
+
+def _check_ingest_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 8: ingest rows must be coherent streaming evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: ingest row missing provenance field(s) "
+            f"{missing} — print it through "
+            "harp_tpu.utils.metrics.benchmark_json")
+    oe = row.get("overlap_efficiency")
+    if not _num(oe) or not 0.0 <= oe <= 1.0:
+        errs.append(
+            f"{name}:{i}: ingest row overlap_efficiency={oe!r} must lie "
+            "in [0, 1] — it is the host pipeline's stage-overlap score "
+            "(harp_tpu.ingest.IngestStats)")
+    for k in INGEST_RATE_FIELDS:
+        v = row.get(k)
+        if not _num(v) or v <= 0:
+            errs.append(
+                f"{name}:{i}: ingest row {k}={v!r} must be a positive "
+                "number — a non-positive rate means the instrumented "
+                "epoch loop never ran")
+    return errs
+
+
 def check_file(path: str, grandfathered: int = 0,
                provenance: bool = False) -> list[str]:
     """Return a list of violation messages (empty = clean)."""
@@ -328,6 +367,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_lint_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "serve":
             errors += _check_serve_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "ingest":
+            errors += _check_ingest_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -1479,3 +1479,49 @@ print(f"sustained A/B: {_ct_res['qps_ratio_vs_burst']}x vs burst at "
       f"p99 {_ct_res['p99_ms']:.1f} vs {_ct_res['burst_p99_ms']:.1f} ms, "
       "row passes extended invariant 7, forgeries loud")
 print(f"DRIVE OK round-27 ({mode})")
+
+# ---------------------------------------------------------------------------
+# Round 28 — prefetch-pipelined ingest (PR 8): the bench_ingest --smoke A/B
+# through a real subprocess (the new staged chain vs the pre-PR serial loop
+# on one page-cache-warm file), depth bit-exactness through the public
+# fit_streaming surface, and the kind:"ingest" row through invariant 8
+# both ways.
+# ---------------------------------------------------------------------------
+import subprocess as _ig_sp
+
+_ig_run = _ig_sp.run(
+    [sys.executable, "scripts/bench_ingest.py", "--smoke",
+     "--platform", "cpu"],
+    capture_output=True, text=True, timeout=600,
+    cwd=_r4os.path.dirname(_r4os.path.dirname(_r4os.path.abspath(__file__))))
+assert _ig_run.returncode == 0, _ig_run.stderr[-800:]
+_ig_row = _r5json.loads(_ig_run.stdout.strip().splitlines()[-1])
+assert _ig_row["kind"] == "ingest" and _ig_row["mode"] == "ab"
+assert _ig_row["host_gb_per_sec"] > 0 and _ig_row["points_per_sec"] > 0
+assert 0.0 <= _ig_row["overlap_efficiency"] <= 1.0
+# a loaded driver box adds scheduler noise, so this smoke pass gates the
+# A/B DIRECTION only; the graded >= 1.25x number is the committed
+# BENCH_local kmeans_ingest_ab_smoke row (2026-08-04: 1.7-1.9x)
+assert _ig_row["pipeline_speedup"] > 1.0, _ig_row["pipeline_speedup"]
+assert _ig_row["host_gb_per_sec_serial"] > 0
+assert _sv_cj._check_ingest_row("drive", 1, _ig_row) == []
+assert _sv_cj._check_ingest_row(  # forged: impossible overlap score
+    "drive", 1, {**_ig_row, "overlap_efficiency": 1.7})
+assert _sv_cj._check_ingest_row(  # forged: stamp stripped
+    "drive", 1, {k: v for k, v in _ig_row.items() if k != "backend"})
+assert _sv_cj._check_ingest_row(  # forged: the loop never ran
+    "drive", 1, {**_ig_row, "points_per_sec": 0})
+
+# depth is invisible to the math: legacy chain (0) == pipelined (2)
+_ig_pts = rng.normal(size=(2000, 12)).astype(np.float32)
+_ig_outs = [fit_streaming(_ig_pts, k=5, iters=3, chunk_points=512,
+                          mesh=mesh, seed=4, prefetch=_p)
+            for _p in (0, 2)]
+np.testing.assert_array_equal(_ig_outs[0][0], _ig_outs[1][0])
+assert _ig_outs[0][1] == _ig_outs[1][1]
+print(f"ingest A/B: pipelined {_ig_row['host_gb_per_sec']:.2f} GB/s = "
+      f"{_ig_row['pipeline_speedup']:.2f}x serial "
+      f"{_ig_row['host_gb_per_sec_serial']:.2f} GB/s, overlap "
+      f"{_ig_row['overlap_efficiency']:.2f}, depths bit-exact, "
+      "row through invariant 8 both ways")
+print(f"DRIVE OK round-28 ({mode})")

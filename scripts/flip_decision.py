@@ -114,6 +114,19 @@ CANDIDATES = {
         "incumbent": "lda_pallas_carry", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
         "flips": "LDAConfig.rotate_wire='int8'"},
+    # PR 8: the quantized gradient wire (ROADMAP decision-machinery
+    # item; EQuARX-style bf16/int8 allreduce).  train_acc gates per the
+    # module-doc tolerance (abs 0.005): a wire that degrades training
+    # must refuse no matter the byte saving.  The pair is EXCLUSIVE
+    # below — grad_wire has one default slot.
+    "mlp_grad_bf16": {
+        "incumbent": "mlp", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "MLPConfig.grad_wire='bf16'"},
+    "mlp_grad_int8": {
+        "incumbent": "mlp", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "MLPConfig.grad_wire='int8'"},
     "kmeans_int8_fused": {
         "incumbent": "kmeans_int8", "metric": "iters_per_sec",
         "quality": "inertia", "sense": "lower", "rel_tol": 0.01,
@@ -151,8 +164,11 @@ JOINT_GATES = [("lda_pallas_approx", "lda_pallas_approx_hot"),
 # alternatives for the same default slot: MFSGDConfig rejects
 # carry_w=True with algo != "dense" (mfsgd.py __post_init__), so both
 # FLIP lines applied together would crash the default config — if both
-# pass, only the faster prints a FLIP line
-EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry")]
+# pass, only the faster prints a FLIP line.  The grad-wire pair (PR 8)
+# is the same shape: MLPConfig.grad_wire is one knob, bf16 and int8
+# cannot both be its default.
+EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry"),
+                   ("mlp_grad_bf16", "mlp_grad_int8")]
 
 # stack-conditional: carry_db=True is one knob, but the evidence row
 # that authorizes it depends on which algo the verdicts make default

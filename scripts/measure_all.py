@@ -85,6 +85,12 @@ SPRINT_ORDER = [
     # verdicts; invariant 7's sustained extension refuses rows without
     # offered>=achieved and queue evidence
     "serve_kmeans_sustained", "serve_mfsgd_sustained",
+    # PR 8: quantized gradient-wire flip candidates (ROADMAP "decision
+    # machinery" item; EQuARX motivates ~2x wire savings) — the DP
+    # allreduce rides collective.allreduce_quantized; flip_decision
+    # gates on train_acc and the pair is EXCLUSIVE (one grad_wire
+    # default).  Defaults stay exact until a relay window measures them.
+    "mlp_grad_bf16", "mlp_grad_int8",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -316,6 +322,15 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
                 "n_topics": 1000, "tokens_per_doc": 100, "epochs": 1,
                 "ndk_dtype": "int16", "pack_cache": BENCH_DATA})),
         "mlp": lambda: mlp.benchmark(
+            **(SMOKE["mlp"] if smoke else {})),
+        # PR 8: the quantized-gradient-wire candidates — same shapes as
+        # the incumbent "mlp" row, only the allreduce wire differs, so
+        # the A/B isolates wire bytes vs train_acc (flip_decision gate)
+        "mlp_grad_bf16": lambda: mlp.benchmark(
+            cfg=mlp.MLPConfig(grad_wire="bf16"),
+            **(SMOKE["mlp"] if smoke else {})),
+        "mlp_grad_int8": lambda: mlp.benchmark(
+            cfg=mlp.MLPConfig(grad_wire="int8"),
             **(SMOKE["mlp"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
             **(SMOKE["subgraph"] if smoke else {})),

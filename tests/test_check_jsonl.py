@@ -381,3 +381,50 @@ def test_lint_cli_row_satisfies_the_checker(tmp_path, capsys):
     p = tmp_path / "BENCH_local.jsonl"
     p.write_text(line + "\n")
     assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_ingest_row_invariants(tmp_path):
+    """Invariant 8: ingest rows must be stamped, overlap_efficiency in
+    [0, 1], and host/point rates positive — a non-positive rate means
+    the instrumented epoch loop never ran."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    base = {"kind": "ingest", "config": "kmeans_ingest_ab_smoke",
+            "overlap_efficiency": 0.97, "host_gb_per_sec": 4.2,
+            "points_per_sec": 2.5e6}
+    rows = [
+        {**base, **stamp},                                   # fine
+        base,                                                # unstamped
+        {**base, "overlap_efficiency": 1.2, **stamp},        # oe > 1
+        {**base, "host_gb_per_sec": 0.0, **stamp},           # rate <= 0
+        {**base, "points_per_sec": -5.0, **stamp},           # negative
+        {**base, "overlap_efficiency": None, **stamp},       # missing
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 5
+    assert ":2:" in errors[0] and "provenance" in errors[0]
+    assert ":3:" in errors[1] and "overlap_efficiency" in errors[1]
+    assert ":4:" in errors[2] and "host_gb_per_sec" in errors[2]
+    assert ":5:" in errors[3] and "points_per_sec" in errors[3]
+    assert ":6:" in errors[4] and "overlap_efficiency" in errors[4]
+
+
+def test_ingest_bench_row_satisfies_the_checker(tmp_path, mesh):
+    """Round-trip: benchmark_ingest through benchmark_json must pass
+    invariant 8 as-is — even teed into a bench file."""
+    import numpy as np
+
+    from harp_tpu.models.kmeans_stream import benchmark_ingest
+    from harp_tpu.utils.metrics import benchmark_json
+
+    rng = np.random.default_rng(8)
+    pts = rng.normal(size=(2048, 8)).astype(np.float16)
+    f = tmp_path / "pts.npy"
+    np.save(f, pts)
+    res = benchmark_ingest(np.load(f, mmap_mode="r"), k=4, iters=2,
+                           chunk_points=512, mesh=mesh,
+                           disk_bytes=f.stat().st_size)
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(benchmark_json("kmeans_ingest", res) + "\n")
+    assert check_jsonl.check_file(str(p), provenance=True) == []
