@@ -2,12 +2,14 @@
 
 Reference parity (SURVEY.md §6): Harp has no static analysis; its
 communication discipline is convention only.  This package machine-checks
-the conventions (CLAUDE.md traps) in three layers — source AST lints
-(:mod:`.astlints`), jaxpr analyzers (:mod:`.jaxpr_checks`), and a
-no-hardware Mosaic kernel audit (:mod:`.mosaic_audit`) — behind one rule
-registry (:mod:`.rules`), one committed allowlist
-(``analysis/allowlist.toml``), and one CLI (``python -m harp_tpu lint``,
-:mod:`.cli`).
+the conventions (CLAUDE.md traps) in four layers — source AST lints
+(:mod:`.astlints`), jaxpr analyzers (:mod:`.jaxpr_checks`), a
+no-hardware Mosaic kernel audit (:mod:`.mosaic_audit`), and the static
+communication-graph auditor (:mod:`.commgraph`, the CommLedger
+cross-check + donation audit whose per-program byte sheets ride the
+lint JSON row) — behind one rule registry (:mod:`.rules`), one committed
+allowlist (``analysis/allowlist.toml``), and one CLI
+(``python -m harp_tpu lint``, :mod:`.cli`).
 
 The core currency is :class:`Violation`: every layer emits them, the
 allowlist suppresses reviewed exceptions, and the CLI renders the rest as

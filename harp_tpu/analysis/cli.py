@@ -1,23 +1,31 @@
 """``python -m harp_tpu lint`` — the harplint front door.
 
-Runs the three analysis layers (AST lints / jaxpr detectors / Mosaic
-kernel audit), applies the committed allowlist, prints a human report
-plus ONE provenance-stamped machine line (``kind: "lint"``, printed
-through :func:`harp_tpu.utils.metrics.benchmark_json` so it carries the
-same backend/date/commit stamp as every bench row —
-``scripts/check_jsonl.py`` invariant 6 validates the shape), and exits
-non-zero when any unallowlisted violation remains.
+Runs the four analysis layers (AST lints / jaxpr detectors / Mosaic
+kernel audit / CommGraph communication audit), applies the committed
+allowlist, prints a human report plus ONE provenance-stamped machine
+line (``kind: "lint"``, printed through
+:func:`harp_tpu.utils.metrics.benchmark_json` so it carries the same
+backend/date/commit stamp as every bench row — ``scripts/check_jsonl.py``
+invariant 6 validates the shape, including the per-program byte sheets
+the CommGraph layer ships in the row), and exits non-zero when any
+unallowlisted violation remains OR the allowlist carries a stale entry
+(an exception excusing nothing is a rotten review record — prune it).
 
 Fixture mode for tests / pre-commit checks of a single file:
 
 - positional ``paths`` restrict the AST layer to those files;
+- ``--changed`` restricts the AST layer to files changed vs git HEAD
+  (plus untracked) — the fast dev loop; the traced layers still run in
+  full, because they are program-keyed, not file-keyed;
 - ``--audit-module FILE`` imports a Python file and sweeps its
-  ``HARPLINT_DRIVERS`` (jaxpr layer) / ``HARPLINT_KERNELS`` (Mosaic
-  layer) dicts — the hook the seeded-fixture tests drive the traced
-  layers through.
+  ``HARPLINT_DRIVERS`` (jaxpr + commgraph layers) / ``HARPLINT_KERNELS``
+  (Mosaic layer) / ``HARPLINT_PROTOCOLS`` (donation audit) dicts — the
+  hook the seeded-fixture tests drive the traced layers through.
 
-Either option skips the repo-wide default sweeps, so the exit code
-reflects only the requested targets.
+``paths`` / ``--audit-module`` skip the repo-wide default sweeps, so the
+exit code reflects only the requested targets (``--changed`` does NOT:
+it is a scoped full run, and only staleness reporting is disabled since
+an unswept file cannot prove an entry stale).
 
 The jax-touching layers force the CPU backend (8 simulated workers)
 before first backend use — the axon site config pins ``JAX_PLATFORMS``
@@ -87,6 +95,53 @@ def run_jaxpr_layer(builders: dict, threshold: int) -> list[Violation]:
     return out
 
 
+def run_commgraph_layer(builders: dict) -> tuple[list[Violation], dict]:
+    """Layer 4 over driver programs: extract each CommGraph, run the
+    HL301/HL302/HL304 checks, and return the per-program byte sheets
+    (the lint row ships them — the future planner input)."""
+    from harp_tpu.analysis import commgraph
+
+    out: list[Violation] = []
+    sheets: dict[str, dict] = {}
+    for name in sorted(builders):
+        target = f"driver:{name}"
+        try:
+            fn, args = builders[name]()
+        except Exception as e:  # noqa: BLE001 - a broken builder is loud
+            out.append(Violation("HL301", target, 0,
+                                 f"driver builder failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        try:
+            violations, graph = commgraph.analyze_program(name, fn, args)
+        except Exception as e:  # noqa: BLE001
+            out.append(Violation("HL301", target, 0,
+                                 f"commgraph extraction failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(violations)
+        sheets[name] = graph.sheet()
+    return out, sheets
+
+
+def run_protocol_layer(builders: dict) -> list[Violation]:
+    """Layer 4's donation audit (HL303) over registered host protocols
+    — the serve ContinuousRunner depth-2 pipelines at lint time."""
+    from harp_tpu.analysis import commgraph
+
+    out: list[Violation] = []
+    for name in sorted(builders):
+        try:
+            drive = builders[name]()
+        except Exception as e:  # noqa: BLE001
+            out.append(Violation("HL303", f"protocol:{name}", 0,
+                                 f"protocol builder failed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(commgraph.audit_protocol(name, drive))
+    return out
+
+
 def run_mosaic_layer(builders: dict | None) -> list[Violation]:
     from harp_tpu.analysis.mosaic_audit import audit_kernel, audit_registry
 
@@ -121,15 +176,17 @@ def render(kept: list[Violation], suppressed: list[Violation],
                  f"{len(suppressed)} allowlisted")
     for e in stale:
         lines.append(f"STALE allowlist entry: {e['rule']} {e['path']} "
-                     f"({e['reason']}) matched nothing — remove it")
-    lines.append("harplint: " + ("FAILED" if kept else "clean"))
+                     f"({e['reason']}) matched nothing — remove it "
+                     "(stale entries fail the lint)")
+    lines.append("harplint: " + ("FAILED" if kept or stale else "clean"))
     return "\n".join(lines)
 
 
-def build_row(kept, suppressed, stale, scanned) -> dict:
+def build_row(kept, suppressed, stale, scanned,
+              byte_sheets: dict | None = None) -> dict:
     per_rule = Counter(v.rule for v in kept)
     per_file = Counter(v.path for v in kept)
-    return {
+    row = {
         "kind": "lint",
         "rules": rule_ids(),
         "files_scanned": scanned,
@@ -140,6 +197,35 @@ def build_row(kept, suppressed, stale, scanned) -> dict:
         "per_file": dict(sorted(per_file.items())),
         "clean": not kept,
     }
+    if byte_sheets is not None:
+        # per-program static comm sheets (full-registry runs only: the
+        # program names must come from analysis/drivers.py — check_jsonl
+        # invariant 6 pins that, so fixture rows omit the block)
+        row["byte_sheets"] = byte_sheets
+    return row
+
+
+def _changed_paths(repo: str) -> list[str]:
+    """Repo-relative .py files changed vs git HEAD, plus untracked —
+    the ``--changed`` AST scope.  Intersected with the default sweep
+    set so deleted/ignored files never error."""
+    import subprocess
+
+    changed: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=repo, capture_output=True,
+                                 text=True, timeout=30)
+        except Exception:  # pragma: no cover - no git in env
+            return []
+        if res.returncode != 0:  # pragma: no cover - not a git checkout
+            return []
+        changed.update(ln.strip() for ln in res.stdout.splitlines()
+                       if ln.strip())
+    swept = set(iter_python_files(repo))
+    return sorted(p.replace(os.sep, "/") for p in changed
+                  if p.replace(os.sep, "/") in swept)
 
 
 def main(argv=None) -> int:
@@ -151,7 +237,13 @@ def main(argv=None) -> int:
                    help="restrict the AST layer to these files "
                         "(repo-relative or absolute); skips the default "
                         "repo-wide sweeps")
-    p.add_argument("--layer", choices=("ast", "jaxpr", "mosaic", "all"),
+    p.add_argument("--changed", action="store_true",
+                   help="restrict the AST layer to files changed vs git "
+                        "HEAD (plus untracked) — the ~2 s dev loop as "
+                        "the repo grows; the traced layers still run in "
+                        "full (program-keyed, not file-keyed)")
+    p.add_argument("--layer",
+                   choices=("ast", "jaxpr", "mosaic", "commgraph", "all"),
                    default="all")
     p.add_argument("--json", action="store_true",
                    help="print only the machine-readable line")
@@ -166,6 +258,8 @@ def main(argv=None) -> int:
                    help="HL102 closed-over-constant threshold (default "
                         f"{DEFAULT_CONST_BYTES >> 20} MiB)")
     args = p.parse_args(argv)
+    if args.changed and args.paths:
+        p.error("--changed and explicit paths are mutually exclusive")
 
     repo = repo_root()
     # unconditional: even an AST-only run prints a provenance-stamped
@@ -186,16 +280,19 @@ def main(argv=None) -> int:
             violations += lint_paths(repo, rels)
             scanned += len(rels)
         elif not fixture_mode:
-            rels = list(iter_python_files(repo))
+            rels = (_changed_paths(repo) if args.changed
+                    else list(iter_python_files(repo)))
             violations += lint_paths(repo, rels)
             scanned += len(rels)
 
     fixture_drivers: dict = {}
     fixture_kernels: dict = {}
+    fixture_protocols: dict = {}
     for mod_path in args.audit_module:
         mod = _load_audit_module(mod_path)
         fixture_drivers.update(getattr(mod, "HARPLINT_DRIVERS", {}))
         fixture_kernels.update(getattr(mod, "HARPLINT_KERNELS", {}))
+        fixture_protocols.update(getattr(mod, "HARPLINT_PROTOCOLS", {}))
 
     if args.layer in ("jaxpr", "all"):
         if fixture_mode:
@@ -215,19 +312,39 @@ def main(argv=None) -> int:
             _force_cpu_backend()
             violations += run_mosaic_layer(None)
 
+    byte_sheets: dict | None = None
+    if args.layer in ("commgraph", "all"):
+        if fixture_mode:
+            if fixture_drivers:
+                vs, _ = run_commgraph_layer(fixture_drivers)
+                violations += vs
+            if fixture_protocols:
+                violations += run_protocol_layer(fixture_protocols)
+        else:
+            _force_cpu_backend()
+            from harp_tpu.analysis.drivers import DRIVERS, PROTOCOLS
+
+            vs, byte_sheets = run_commgraph_layer(DRIVERS)
+            violations += vs
+            violations += run_protocol_layer(PROTOCOLS)
+
     entries = [] if args.no_allowlist else allowlist_mod.load(args.allowlist)
     kept, suppressed, stale = allowlist_mod.apply(violations, entries)
-    # stale entries only mean something on a full repo run
-    if fixture_mode:
+    # staleness only means something when every layer swept everything:
+    # a fixture run or a --changed AST scope cannot prove an entry dead
+    if fixture_mode or args.changed:
         stale = []
 
-    row = build_row(kept, suppressed, stale, scanned)
+    row = build_row(kept, suppressed, stale, scanned, byte_sheets)
     from harp_tpu.utils.metrics import benchmark_json
 
     if not args.json:
         print(render(kept, suppressed, stale, scanned))
     print(benchmark_json("lint", row), flush=True)
-    return 1 if kept else 0
+    # stale allowlist entries are a hard failure (same exit as an
+    # unallowlisted violation): an exception excusing nothing either
+    # outlived its fix or was always wrong — both need a human
+    return 1 if kept or stale else 0
 
 
 if __name__ == "__main__":

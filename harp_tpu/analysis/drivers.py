@@ -1,23 +1,41 @@
-"""Registered driver programs for the Layer-2 jaxpr sweep.
+"""Registered driver programs for the Layer-2 jaxpr and Layer-4
+CommGraph sweeps.
 
 The copy-trap / literal detectors (:mod:`harp_tpu.analysis.jaxpr_checks`)
-need *traced programs* to walk.  This registry builds the flagship driver
+and the communication auditor (:mod:`harp_tpu.analysis.commgraph`) need
+*traced programs* to walk.  This registry builds the flagship driver
 programs at small proven shapes on the active (CPU-forced) backend —
 mirroring how the lowering tests pin them — so ``python -m harp_tpu
 lint`` sweeps real epoch programs, not just synthetic fixtures:
 
 - ``kmeans.fit`` — the full T-iteration Lloyd program (fori_loop body:
-  the dense one-hot pattern, no gathers);
+  the dense one-hot pattern, no gathers; its hand-computed allreduce
+  byte sheet is the Layer-4 HL302 cross-check fixture);
 - ``ring_attention`` — the rotate-scan K/V pipeline (a scan that carries
   and *reads* buffers every step: the structural cousin of the LDA trap
   that must stay clean);
 - ``mfsgd.epoch`` — the rotation epoch with dynamic_update_slice'd
   factor tables: the closest in-tree relative of the pre-fix LDA
-  copy-trap, pinned clean.
+  copy-trap, pinned clean;
+- ``serve.*`` — every serving engine's batched step at one ladder rung
+  (the steady-state programs the budget guard pins);
+- ``rotate.pipeline_chunked`` — PR 2's generic software double buffer
+  (n_chunks=2, the former bespoke two-halves schedule);
+- ``ingest.accum_chunk`` / ``ingest.finish_epoch`` — the program pair
+  every IngestPipeline-shipped kmeans chunk rides: per-chunk accumulate
+  (deliberately collective-free — registering it pins that emptiness in
+  the byte sheet) and the epoch-end allreduce.
 
 Builders return ``(traced_fn_or_fn, args)``; args may be concrete arrays
 or sharded ``ShapeDtypeStruct``s.  Each runs in a couple hundred ms on
 the 8-sim-worker CPU mesh.
+
+``PROTOCOLS`` registers *host-protocol* drives for the Layer-4 donation
+audit (HL303): each builder returns ``drive(audit)`` which wraps its
+donating executables via ``audit.wrap`` and runs the real pipeline — the
+serve ``ContinuousRunner`` depth-2 in-flight loop is the motivating
+case, pinned here in its correct discipline (the sabotaged twin lives in
+tests/test_lint.py).
 """
 
 from __future__ import annotations
@@ -26,10 +44,21 @@ from typing import Any, Callable
 
 DRIVERS: dict[str, Callable[[], tuple[Callable, tuple[Any, ...]]]] = {}
 
+#: host-protocol drives for the donation audit: name -> builder,
+#: builder() -> drive, drive(commgraph.DonationAudit) -> None
+PROTOCOLS: dict[str, Callable[[], Callable]] = {}
+
 
 def register_driver(name: str):
     def deco(build):
         DRIVERS[name] = build
+        return build
+    return deco
+
+
+def register_protocol(name: str):
+    def deco(build):
+        PROTOCOLS[name] = build
         return build
     return deco
 
@@ -105,6 +134,147 @@ def _serve_mfsgd_topk():
     return eng.jitted(), eng.trace_args(8)
 
 
+@register_driver("serve.lda_infer")
+def _serve_lda_infer():
+    """The LDA fold-in step (fixed-iteration EM over phi): the only
+    serve engine with a device-side loop, so its byte sheet pins that
+    fold-in stays collective-free at every trip count."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import LDAInfer
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    eng = LDAInfer(LDAInfer.synthetic_state(rng, vocab_size=64,
+                                            n_topics=8),
+                   mesh, em_iters=4)
+    return eng.jitted(), eng.trace_args(8)
+
+
+@register_driver("serve.mlp_logits")
+def _serve_mlp_logits():
+    """The MLP forward pass through models/mlp.forward — the serve
+    engine that calls back into trainer code, so the sweep sees the
+    shared forward program."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import MLPPredict
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    eng = MLPPredict(MLPPredict.synthetic_state(rng, sizes=(32, 16, 4)),
+                     mesh)
+    return eng.jitted(), eng.trace_args(8)
+
+
+@register_driver("serve.rf_vote")
+def _serve_rf_vote():
+    """Majority-vote forest routing (host binize feeds device routing)."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import RFPredict
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    eng = RFPredict(RFPredict.synthetic_state(rng, n_trees=4,
+                                              max_depth=3, n_features=8),
+                    mesh)
+    return eng.jitted(), eng.trace_args(8)
+
+
+@register_driver("serve.svm_scores")
+def _serve_svm_scores():
+    """The linear decision function — smallest serve program, pinned so
+    the sweep covers the whole engine table."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import SVMPredict
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    eng = SVMPredict(SVMPredict.synthetic_state(rng, d=32), mesh)
+    return eng.jitted(), eng.trace_args(8)
+
+
+@register_driver("rotate.pipeline_chunked")
+def _rotate_pipeline_chunked():
+    """PR 2's generic chunked rotation epoch (n_chunks=2 — the former
+    bespoke two-halves schedule) with a slice-updating step, so the
+    ppermute rides a scan whose carry the step mutates: the byte sheet
+    must show the ring traffic amplified by n_chunks * ring size and the
+    hoist detector (HL304) must stay quiet (the payload is the updated
+    carry)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.parallel.rotate import rotate_pipeline
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+
+    def epoch(acc, sl):
+        def step(c, chunk, t):
+            return c + chunk.sum(), chunk * 1.01
+
+        return rotate_pipeline(step, acc, sl, n_chunks=2)
+
+    fn = jax.jit(mesh.shard_map(
+        epoch, in_specs=(mesh.spec(0), mesh.spec(0)),
+        out_specs=(mesh.spec(0), mesh.spec(0))))
+    acc = jax.ShapeDtypeStruct((nw,), jnp.float32,
+                               sharding=mesh.sharding(mesh.spec(0)))
+    sl = jax.ShapeDtypeStruct((8 * nw, 16), jnp.float32,
+                              sharding=mesh.sharding(mesh.spec(0)))
+    return fn, (acc, sl)
+
+
+def _ingest_shapes(mesh):
+    import jax
+    import jax.numpy as jnp
+
+    nw = mesh.num_workers
+    k, d, chunk = 8, 16, 8 * nw
+    sh0 = mesh.sharding(mesh.spec(0))
+    return {
+        "pts": jax.ShapeDtypeStruct((chunk, d), jnp.float32, sharding=sh0),
+        "mask": jax.ShapeDtypeStruct((chunk,), jnp.float32, sharding=sh0),
+        "cents": jax.ShapeDtypeStruct((k, d), jnp.float32,
+                                      sharding=mesh.replicated()),
+        "sums": jax.ShapeDtypeStruct((nw, k, d), jnp.float32, sharding=sh0),
+        "counts": jax.ShapeDtypeStruct((nw, k), jnp.float32, sharding=sh0),
+        "inertia": jax.ShapeDtypeStruct((nw,), jnp.float32, sharding=sh0),
+    }
+
+
+@register_driver("ingest.accum_chunk")
+def _ingest_accum_chunk():
+    """The per-chunk accumulate every IngestPipeline-shipped kmeans chunk
+    rides (kmeans_stream._make_accum_fn) — deliberately collective-free
+    (partials land in the per-worker accumulator; the epoch-end finish
+    carries the ONE allreduce).  Registering it pins that emptiness: a
+    collective leaking into the per-chunk path would multiply by the
+    whole chunk count and show up in this byte sheet first."""
+    from harp_tpu.models.kmeans_stream import StreamConfig, _make_accum_fn
+
+    mesh = _mesh()
+    s = _ingest_shapes(mesh)
+    fn = _make_accum_fn(mesh, StreamConfig(k=8))
+    return fn, (s["pts"], s["mask"], s["cents"], s["sums"], s["counts"],
+                s["inertia"])
+
+
+@register_driver("ingest.finish_epoch")
+def _ingest_finish_epoch():
+    """The streaming epoch tail: the one allreduce the whole chunk loop
+    amortizes (kmeans_stream._make_finish_fn)."""
+    from harp_tpu.models.kmeans_stream import _make_finish_fn
+
+    mesh = _mesh()
+    s = _ingest_shapes(mesh)
+    fn = _make_finish_fn(mesh)
+    return fn, (s["sums"], s["counts"], s["inertia"], s["cents"])
+
+
 @register_driver("mfsgd.epoch")
 def _mfsgd_epoch():
     from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig, synthetic_ratings
@@ -118,3 +288,56 @@ def _mfsgd_epoch():
     model.set_ratings(users, items, vals)
     # the tracked epoch program + the device operands set_ratings staged
     return model._epoch_fn, (model.W, model.H) + model._blocks
+
+
+# ---------------------------------------------------------------------------
+# Donation-audit protocols (Layer 4, HL303)
+# ---------------------------------------------------------------------------
+
+def _serve_continuous_drive(app: str, engine_cls, state_kw: dict,
+                            req_rows: int):
+    """Build+drive the real ContinuousRunner depth-2 pipeline for one
+    app under a DonationAudit: synthetic state, two-rung ladder, six
+    requests interleaved with steps so batches genuinely overlap in
+    flight — the correct staging discipline (a FRESH buffer per batch,
+    donated exactly once, never touched after) must come out clean."""
+
+    def drive(audit):
+        import numpy as np
+
+        from harp_tpu.serve.server import Server
+
+        rng = np.random.default_rng(0)
+        srv = Server(app, state=engine_cls.synthetic_state(rng, **state_kw),
+                     mesh=_mesh(), ladder=(1, 8))
+        srv.startup()
+        n_state = len(srv.engine.state_args())
+        srv.wrap_executables(
+            lambda rung, exe: audit.wrap(exe, (n_state,),
+                                         f"serve.{app}.b{rung}"))
+        runner = srv.make_runner(depth=2)
+        for i in range(6):
+            runner.submit(i, srv.engine.synthetic_request(rng, req_rows))
+            runner.step()
+        runner.drain()
+
+    return drive
+
+
+@register_protocol("serve.kmeans_continuous")
+def _serve_kmeans_protocol():
+    from harp_tpu.serve.engines import KMeansAssign
+
+    return _serve_continuous_drive("kmeans", KMeansAssign,
+                                   {"k": 8, "d": 32}, req_rows=3)
+
+
+@register_protocol("serve.mfsgd_continuous")
+def _serve_mfsgd_protocol():
+    """The model-parallel engine (sharded H, donated user-id batch) —
+    the depth-2 pipeline the HL303 rule exists for."""
+    from harp_tpu.serve.engines import MFSGDTopK
+
+    return _serve_continuous_drive(
+        "mfsgd", MFSGDTopK,
+        {"n_users": 64, "n_items": 32, "rank": 8}, req_rows=3)

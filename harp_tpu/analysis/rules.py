@@ -8,7 +8,7 @@ trap it prevents so a violation message teaches the fix instead of just
 rejecting the diff; MIGRATING.md "Running the linter" maps ids to the
 original trap prose.
 
-Three layers (see the sibling modules):
+Four layers (see the sibling modules):
 
 - ``HL0xx`` — source AST lints (:mod:`harp_tpu.analysis.astlints`; pure
   ``ast``, no jax import, fast enough for tier-1);
@@ -16,7 +16,12 @@ Three layers (see the sibling modules):
   trace on the CPU backend, zero hardware);
 - ``HL2xx`` — Mosaic kernel audit (:mod:`harp_tpu.analysis.mosaic_audit`;
   cross-platform lowering plus jaxpr checks for the silicon limits local
-  lowering does NOT enforce).
+  lowering does NOT enforce);
+- ``HL3xx`` — CommGraph communication audit
+  (:mod:`harp_tpu.analysis.commgraph`; the static per-call-site
+  collective schedule of every registered driver program, cross-checked
+  against the CommLedger's trace-time records, plus the use-after-donate
+  protocol audit over the serve pipelines).
 """
 
 from __future__ import annotations
@@ -87,6 +92,30 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "a block shape whose second-to-last dim is neither a multiple "
          "of 8 nor the full array dim fails the real Mosaic layout rules "
          "— pad or retile (CLAUDE.md Mosaic limits)"),
+    Rule("HL301", "commgraph", "collective with no CommLedger record",
+         "a collective primitive in a driver jaxpr whose call site has "
+         "no trace-time CommLedger record is an untracked wire — every "
+         "bytes-on-wire claim the report makes silently under-counts; "
+         "route it through a harp_tpu.parallel.collective verb (the "
+         "verbs record; raw lax.p* does not)"),
+    Rule("HL302", "commgraph", "static byte sheet disagrees with ledger",
+         "the statically computed per-shard bytes of a collective site "
+         "differ from the CommLedger's trace-time payload for the same "
+         "site — one of the two sheets is lying, and the planner/report "
+         "numbers built on them are wrong (the kmeans hand-computed "
+         "sheet is the cross-check fixture)"),
+    Rule("HL303", "commgraph", "use-after-donate on a dispatched buffer",
+         "a buffer donated to a dispatch (donate_argnums) was read by "
+         "host code or re-dispatched afterwards — the CPU sim ignores "
+         "donation so tests stay green, but on TPU the buffer is gone "
+         "(the serve ContinuousRunner depth-2 in-flight pipeline is the "
+         "motivating case: stage a FRESH buffer per batch, never touch "
+         "a donated one)"),
+    Rule("HL304", "commgraph", "hoistable loop-invariant collective",
+         "a collective inside a scan/fori body whose operands do not "
+         "depend on the loop carry or scanned inputs re-ships identical "
+         "bytes every iteration — hoist it above the loop (trip count "
+         "multiplies the wire for nothing)"),
 ]}
 
 

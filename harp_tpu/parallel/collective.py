@@ -48,6 +48,36 @@ from harp_tpu.parallel.mesh import WORKER_AXIS
 from harp_tpu.utils.telemetry import record_comm
 
 
+# ---------------------------------------------------------------------------
+# The verbs' wire surface, as harplint's CommGraph layer sees it.
+#
+# Every verb lowers to one (or a few) of these jaxpr primitives; the
+# static communication auditor (harp_tpu.analysis.commgraph) keys its
+# schedule extraction on this map and matches each primitive eqn back to
+# the CommLedger record at the same call site (telemetry.site_key is the
+# shared key shape).  Keep this in sync when a verb gains a new lowering
+# — an unmapped primitive is an untracked wire (HL301).
+# ---------------------------------------------------------------------------
+
+PRIMITIVE_VERBS: dict[str, tuple[str, ...]] = {
+    "psum": ("allreduce", "allreduce_quantized", "reduce", "broadcast",
+             "barrier", "push", "push_quantized"),
+    "pmax": ("allreduce", "reduce", "push",
+             # the int8 wires' stacked per-leaf scale exchange
+             "allreduce_quantized", "push_quantized", "rotate_quantized",
+             "regroup_quantized"),
+    "pmin": ("allreduce", "reduce", "push"),
+    "ppermute": ("rotate", "rotate_quantized"),
+    "all_gather": ("allgather", "pull",
+                   "allreduce"),  # the MULTIPLY combiner's gather+prod
+    "all_to_all": ("regroup", "regroup_quantized"),
+    "reduce_scatter": ("push", "push_quantized"),  # lax.psum_scatter
+}
+
+#: the jaxpr primitives that move bytes over the worker axis
+COLLECTIVE_PRIMS = frozenset(PRIMITIVE_VERBS)
+
+
 class Combiner(enum.Enum):
     """Reduction semantics — Harp's ``PartitionCombiner`` / ``ValCombiner``.
 

@@ -135,6 +135,23 @@ class Server:
                          if base is not None else None),
         }
 
+    def wrap_executables(self, wrap_fn) -> None:
+        """Re-wrap every rung's executable: ``exe -> wrap_fn(rung, exe)``.
+
+        The hook instrumentation layers use to observe the dispatch
+        plane without touching the serving loop — harplint's CommGraph
+        donation audit (HL303: the engine donates its batch buffer, so
+        the depth-2 in-flight pipeline must stage a FRESH buffer per
+        batch and never re-read a donated one) wraps here at lint time;
+        tests wrap here to sabotage the discipline and prove the audit
+        catches it.  Wrappers must delegate attribute access like
+        ``flightrec.track``'s do.
+        """
+        if not self._exec:
+            raise RuntimeError("call startup() before wrap_executables()")
+        self._exec = {rung: wrap_fn(rung, exe)
+                      for rung, exe in self._exec.items()}
+
     @staticmethod
     def cache_less_compile(jitted, args):
         import warnings

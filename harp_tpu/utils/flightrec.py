@@ -249,12 +249,35 @@ def record_bucket(nbytes: int, site: str | None = None) -> None:
         transfers.record_bucket(nbytes, site)
 
 
+# Readback observers: the commgraph donation audit (HL303) watches the
+# counted D2H path to catch a host re-read of a donated device buffer.
+# The list is empty in every un-audited run, so the cost is one falsy
+# check per readback; observers see the ORIGINAL argument (the device
+# array), before np.asarray materializes it.
+_READBACK_OBSERVERS: list[Callable[[Any], None]] = []
+
+
+@contextlib.contextmanager
+def observe_readbacks(cb: Callable[[Any], None]):
+    """Register ``cb`` to see every :func:`readback` argument within the
+    block (the donation audit's hook; independent of the telemetry
+    enable switch — an audit must see reads even with telemetry off)."""
+    _READBACK_OBSERVERS.append(cb)
+    try:
+        yield
+    finally:
+        _READBACK_OBSERVERS.remove(cb)
+
+
 def readback(x: Any):
     """``np.asarray(x)`` that counts the D2H round trip — THE instrumented
     device→host fetch for driver code (zero-cost ``np.asarray`` when
     telemetry is off)."""
     import numpy as np
 
+    if _READBACK_OBSERVERS:
+        for cb in tuple(_READBACK_OBSERVERS):
+            cb(x)
     out = np.asarray(x)
     if telemetry.enabled():
         transfers.record_readback(out.nbytes)

@@ -143,22 +143,39 @@ def _tree_wire_bytes(tree: Any, wire_dtype: Any | None) -> tuple[int, int]:
     return total, len(leaves)
 
 
+def is_ledger_user_frame(filename: str) -> bool:
+    """Is an (absolute) source filename a *user* frame for collective
+    call-site attribution?  Shared by :func:`record_comm`'s trace-time
+    site keys and the static CommGraph matcher
+    (:mod:`harp_tpu.analysis.commgraph`), which must derive the SAME key
+    from a jaxpr eqn's traceback or the HL301/HL302 site matching would
+    compare apples to oranges.  Excluded: this module, the collective
+    verb layer, anything under the jax package, and contextlib glue."""
+    import jax
+
+    jax_dir = os.path.dirname(os.path.abspath(jax.__file__))
+    here = os.path.abspath(__file__)
+    return (filename != here
+            and not filename.endswith("parallel/collective.py")
+            and not filename.startswith(jax_dir)
+            and "contextlib" not in os.path.basename(filename))
+
+
+def site_key(filename: str, lineno: int) -> str:
+    """The ledger's call-site key shape: ``basename.py:lineno``."""
+    return f"{os.path.basename(filename)}:{lineno}"
+
+
 def _call_site() -> str:
     """Stable key for the user frame that invoked the verb: the nearest
     stack frame outside this module, the collective module, and the jax
     package (jit/shard_map tracing interposes jax frames between the
     verb and the user's code)."""
-    import jax
-
-    jax_dir = os.path.dirname(os.path.abspath(jax.__file__))
-    here = os.path.abspath(__file__)
     f = sys._getframe(1)
     while f is not None:
         fn = os.path.abspath(f.f_code.co_filename)
-        if (fn != here and not fn.endswith("parallel/collective.py")
-                and not fn.startswith(jax_dir)
-                and "contextlib" not in os.path.basename(fn)):
-            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        if is_ledger_user_frame(fn):
+            return site_key(fn, f.f_lineno)
         f = f.f_back
     return "?:0"
 

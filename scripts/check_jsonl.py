@@ -53,6 +53,14 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    (``KNOWN_LINT_RULES`` — kept in sync with
    ``harp_tpu.analysis.rules`` by tests/test_lint.py), and the
    per-file/per-rule violation counts must be non-negative integers.
+   CommGraph extension (PR 9): a lint row's per-program ``byte_sheets``
+   (the Layer-4 static collective schedule — the planner's future
+   input) must name programs from the drivers registry
+   (``KNOWN_LINT_PROGRAMS``), primitives/verbs from the frozen wire
+   vocabulary (``KNOWN_COMM_PRIMITIVES`` / ``KNOWN_COMM_VERBS``), and
+   carry non-negative byte/count fields — a sheet naming an unknown
+   program or claiming negative bytes would poison every schedule
+   decision built on it.
 
 7. **Serve rows are coherent serving evidence** (any file): a ``kind:
    "serve"`` row (``harp_tpu.serve.bench`` / ``serve <app> --bench``)
@@ -206,9 +214,29 @@ def _check_skew_row(name: str, i: int, row: dict) -> list[str]:
 # standalone (no harp_tpu import); tests/test_lint.py asserts equality
 # with harp_tpu.analysis.rules.rule_ids() so drift fails tier-1
 KNOWN_LINT_RULES = ("HL000", "HL001", "HL002", "HL003", "HL004", "HL005",
-                    "HL101", "HL102", "HL201", "HL202", "HL203", "HL204")
+                    "HL101", "HL102", "HL201", "HL202", "HL203", "HL204",
+                    "HL301", "HL302", "HL303", "HL304")
 LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
                      "stale_allowlist")
+
+# the CommGraph byte-sheet vocabulary, FROZEN like the rule ids and
+# sync-pinned by tests/test_lint.py: program names must come from the
+# drivers registry (harp_tpu.analysis.drivers.DRIVERS), primitives from
+# the verbs' wire surface (collective.PRIMITIVE_VERBS), verbs from the
+# collective verb table — a sheet naming an unknown program or verb is
+# not evidence about THIS repo's communication schedule.
+KNOWN_LINT_PROGRAMS = (
+    "ingest.accum_chunk", "ingest.finish_epoch", "kmeans.fit",
+    "mfsgd.epoch", "ring_attention", "rotate.pipeline_chunked",
+    "serve.kmeans_assign", "serve.lda_infer", "serve.mfsgd_topk",
+    "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores")
+KNOWN_COMM_PRIMITIVES = ("all_gather", "all_to_all", "pmax", "pmin",
+                         "ppermute", "psum", "reduce_scatter")
+KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_quantized",
+                    "barrier", "broadcast", "pull", "push",
+                    "push_quantized", "reduce", "regroup",
+                    "regroup_quantized", "rotate", "rotate_quantized")
+SHEET_BYTE_FIELDS = ("bytes_per_trace", "amplified_bytes")
 
 
 def _check_lint_row(name: str, i: int, row: dict) -> list[str]:
@@ -236,6 +264,53 @@ def _check_lint_row(name: str, i: int, row: dict) -> list[str]:
         if isinstance(v, bool) or not isinstance(v, int) or v < 0:
             errs.append(f"{name}:{i}: lint row count {key}={v!r} must be "
                         "a non-negative integer")
+    for prog, sheet in (row.get("byte_sheets") or {}).items():
+        errs += _check_byte_sheet(name, i, prog, sheet)
+    return errs
+
+
+def _check_byte_sheet(name: str, i: int, prog, sheet) -> list[str]:
+    """Invariant 6, CommGraph extension: a lint row's per-program byte
+    sheet (the Layer-4 static comm schedule the planner will consume)
+    must name a registered driver program, registered primitives/verbs,
+    and non-negative byte counts — a malformed sheet poisons every
+    schedule decision built on it."""
+    errs: list[str] = []
+    if prog not in KNOWN_LINT_PROGRAMS:
+        errs.append(
+            f"{name}:{i}: byte sheet for unregistered program {prog!r} "
+            "— program names must come from "
+            "harp_tpu.analysis.drivers.DRIVERS (update "
+            "KNOWN_LINT_PROGRAMS in the same commit as the registry)")
+    if not isinstance(sheet, dict):
+        return errs + [f"{name}:{i}: byte sheet for {prog!r} must be an "
+                       "object"]
+    for k in SHEET_BYTE_FIELDS:
+        v = sheet.get(k)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"{name}:{i}: byte sheet {prog!r} {k}={v!r} "
+                        "must be a non-negative integer")
+    for c in sheet.get("collectives") or []:
+        if not isinstance(c, dict):
+            errs.append(f"{name}:{i}: byte sheet {prog!r} has a "
+                        "non-object collective entry")
+            continue
+        prim = c.get("primitive")
+        if prim not in KNOWN_COMM_PRIMITIVES:
+            errs.append(
+                f"{name}:{i}: byte sheet {prog!r} names unknown "
+                f"primitive {prim!r} (known: {KNOWN_COMM_PRIMITIVES})")
+        verb = c.get("verb")
+        if verb is not None and verb not in KNOWN_COMM_VERBS:
+            errs.append(
+                f"{name}:{i}: byte sheet {prog!r} names unknown verb "
+                f"{verb!r} (known: {KNOWN_COMM_VERBS})")
+        for k in ("per_shard_bytes", "calls_per_trace", "amplification"):
+            v = c.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(
+                    f"{name}:{i}: byte sheet {prog!r} collective "
+                    f"{k}={v!r} must be a non-negative integer")
     return errs
 
 

@@ -1525,3 +1525,175 @@ print(f"ingest A/B: pipelined {_ig_row['host_gb_per_sec']:.2f} GB/s = "
       f"{_ig_row['overlap_efficiency']:.2f}, depths bit-exact, "
       "row through invariant 8 both ways")
 print(f"DRIVE OK round-28 ({mode})")
+
+# --- round 29: harplint Layer 4 — CommGraph static communication audit -----
+# The static collective schedule extractor cross-checked against the
+# CommLedger (HL301/HL302) and numpy byte math, the hoistable-collective
+# detector's per-leaf granularity (HL304), the use-after-donate audit
+# over the REAL serve ContinuousRunner depth-2 pipeline (HL303, clean)
+# and a sabotaged twin (flags), the full registry sweep, and the CLI
+# round trip: byte_sheets through check_jsonl invariant 6 both ways.
+# ---------------------------------------------------------------------------
+import contextlib as _cg_ctx
+import json as _cg_json
+import subprocess as _cg_sp
+import tempfile as _cg_tmp
+
+from jax import lax as _cg_lax
+from jax.sharding import PartitionSpec as _cg_P
+
+import harp_tpu.utils.telemetry as _cg_T
+from harp_tpu.analysis import cli as _cg_cli
+from harp_tpu.analysis import commgraph as _cg
+from harp_tpu.analysis.drivers import DRIVERS as _cg_DRIVERS
+from harp_tpu.analysis.drivers import PROTOCOLS as _cg_PROTOCOLS
+from harp_tpu.utils import flightrec as _cg_fr
+
+_cg_repo = _r4os.path.dirname(_r4os.path.dirname(_r4os.path.abspath(__file__)))
+
+# (a) hand-built iterative program: allreduce of a two-leaf tree inside
+# a 3-iter fori.  Static sheet == numpy byte math == ledger payload,
+# amplified by the trip count; both leaves depend on the carry -> clean.
+_cg_rows, _cg_d, _cg_iters = 2 * nw, 8, 3
+_cg_r = _cg_rows // nw  # per-shard rows
+_cg_x = jax.ShapeDtypeStruct((_cg_rows, _cg_d), jnp.float32,
+                             sharding=mesh.sharding(mesh.spec(0)))
+
+
+def _cg_clean_epoch(x):
+    def body(i, c):
+        s, n = C.allreduce((x * c.sum(), x[:, 0] + c[0, 0]))
+        return c + s[:1, :1] + n.sum()
+
+    return _cg_lax.fori_loop(0, _cg_iters, body,
+                             jnp.zeros((1, 1), jnp.float32))
+
+
+_cg_fn = jax.jit(mesh.shard_map(_cg_clean_epoch, in_specs=(mesh.spec(0),),
+                                out_specs=_cg_P(), check_vma=False))
+_cg_vs, _cg_g = _cg.analyze_program("drive.clean", _cg_fn, (_cg_x,))
+assert _cg_vs == [], [v.format() for v in _cg_vs]
+_cg_expect = _cg_r * _cg_d * 4 + _cg_r * 4  # leaf bytes, per shard
+assert _cg_g.bytes_per_trace() == _cg_expect, _cg_g.sheet()
+assert _cg_g.amplified_bytes() == _cg_expect * _cg_iters
+(_cg_site,) = _cg_g.sites
+assert _cg_site.verb == "allreduce" and _cg_site.amplification == _cg_iters
+_cg_ledger = sum(r["payload_bytes"] for recs in _cg_g.ledger_sites.values()
+                 for r in recs)
+assert _cg_ledger == _cg_expect  # static == ledger, to the byte
+
+# (b) per-leaf hoist granularity: make the SECOND leaf loop-invariant
+# (drops the carry term) -> exactly one HL304, naming the psum site
+def _cg_hoist_epoch(x):
+    def body(i, c):
+        s, n = C.allreduce((x * c.sum(), x[:, 0]))
+        return c + s[:1, :1] + n.sum()
+
+    return _cg_lax.fori_loop(0, _cg_iters, body,
+                             jnp.zeros((1, 1), jnp.float32))
+
+
+_cg_fn = jax.jit(mesh.shard_map(_cg_hoist_epoch, in_specs=(mesh.spec(0),),
+                                out_specs=_cg_P(), check_vma=False))
+_cg_vs, _ = _cg.analyze_program("drive.hoist", _cg_fn, (_cg_x,))
+assert [v.rule for v in _cg_vs] == ["HL304"], [v.format() for v in _cg_vs]
+assert "hoist" in _cg_vs[0].message
+
+# (c) untracked wire: the raw-lax twin leaves no ledger record -> HL301
+def _cg_raw(x):
+    return _cg_lax.psum(x, "workers")
+
+
+_cg_fn = jax.jit(mesh.shard_map(_cg_raw, in_specs=(mesh.spec(0),),
+                                out_specs=_cg_P()))
+_cg_vs, _ = _cg.analyze_program("drive.raw", _cg_fn, (_cg_x,))
+assert [v.rule for v in _cg_vs] == ["HL301"]
+
+# (d) lying byte sheet: record a scalar, psum the full array (one source
+# line, so both sides key the same call site) -> HL302
+def _cg_lying(x):
+    return _cg_T.record_comm("allreduce", x[0, 0], axis="workers") or _cg_lax.psum(x, "workers")  # noqa: E501
+
+
+_cg_fn = jax.jit(mesh.shard_map(_cg_lying, in_specs=(mesh.spec(0),),
+                                out_specs=_cg_P()))
+_cg_vs, _ = _cg.analyze_program("drive.lying", _cg_fn, (_cg_x,))
+assert [v.rule for v in _cg_vs] == ["HL302"]
+
+# (e) the full registry sweeps clean, covers >= 10 programs, and the
+# serve engines' donated batch buffer is visible in the aliasing info
+assert len(_cg_DRIVERS) >= 10
+for _cg_name, _cg_build in sorted(_cg_DRIVERS.items()):
+    _cg_f, _cg_a = _cg_build()
+    _cg_vs, _cg_g = _cg.analyze_program(_cg_name, _cg_f, _cg_a)
+    assert _cg_vs == [], (_cg_name, [v.format() for v in _cg_vs])
+    if _cg_name.startswith("serve."):
+        assert _cg_g.donated_args, _cg_name
+
+# (f) HL303: the REAL ContinuousRunner depth-2 protocol is clean; a
+# sabotaged re-read + re-dispatch of a donated buffer flags twice (the
+# audit records BEFORE jax's own deletion error, which only this CPU
+# path even raises — silicon silently reads garbage, hence the lint)
+_cg_vs = _cg.audit_protocol("serve.kmeans_continuous",
+                            _cg_PROTOCOLS["serve.kmeans_continuous"]())
+assert _cg_vs == [], [v.format() for v in _cg_vs]
+_cg_audit = _cg.DonationAudit("protocol:drive-sabotage")
+with _cg_audit:
+    _cg_exe = _cg_audit.wrap(jax.jit(lambda s, b: s + b,
+                                     donate_argnums=(1,)), (1,), "toy")
+    _cg_s = jax.device_put(np.ones((4,), np.float32))
+    _cg_b = jax.device_put(np.ones((4,), np.float32))
+    _cg_exe(_cg_s, _cg_b)
+    with _cg_ctx.suppress(RuntimeError):
+        _cg_fr.readback(_cg_b)
+    with _cg_ctx.suppress(RuntimeError, ValueError):
+        _cg_exe(_cg_s, _cg_b)
+assert [v.rule for v in _cg_audit.violations] == ["HL303", "HL303"]
+
+# (g) the CLI round trip: one full four-layer run prints a clean row
+# whose byte_sheets block carries every registered program, kmeans.fit
+# matching the hand-computed sheet exactly; the row passes invariant 6
+# and forged sheets fail it
+_cg_run = _cg_sp.run([sys.executable, "-m", "harp_tpu", "lint", "--json"],
+                     capture_output=True, text=True, timeout=900,
+                     cwd=_cg_repo)
+assert _cg_run.returncode == 0, _cg_run.stdout[-800:] + _cg_run.stderr[-800:]
+_cg_row = _cg_json.loads(_cg_run.stdout.strip().splitlines()[-1])
+assert _cg_row["clean"] is True and _cg_row["stale_allowlist"] == 0
+assert set(_cg_row["byte_sheets"]) == set(_cg_DRIVERS)
+_cg_km = _cg_row["byte_sheets"]["kmeans.fit"]
+assert _cg_km["bytes_per_trace"] == 8 * 32 * 4 + 8 * 4 + 4
+assert _cg_km["amplified_bytes"] == 2 * _cg_km["bytes_per_trace"]
+assert _sv_cj._check_lint_row("drive", 1, _cg_row) == []
+assert _sv_cj._check_lint_row(  # forged: unregistered program name
+    "drive", 1, {**_cg_row, "byte_sheets": {"madeup.prog": _cg_km}})
+assert _sv_cj._check_lint_row(  # forged: negative byte count
+    "drive", 1, {**_cg_row, "byte_sheets": {
+        "kmeans.fit": {**_cg_km, "bytes_per_trace": -1}}})
+
+# (h) stale allowlist entries hard-fail (AST layer is enough to prove
+# the exit-code contract), and --changed draws from the sweep set
+with _cg_tmp.TemporaryDirectory() as _cg_dir:
+    _cg_toml = _r4os.path.join(_cg_dir, "stale.toml")
+    with open(_r4os.path.join(_cg_repo, "harp_tpu", "analysis",
+                              "allowlist.toml")) as _cg_fh:
+        _cg_committed = _cg_fh.read()
+    with open(_cg_toml, "w") as _cg_fh:
+        _cg_fh.write(_cg_committed + '\n[[allow]]\nrule = "HL002"\n'
+                     'path = "harp_tpu/never.py"\nreason = "stale"\n')
+    _cg_run = _cg_sp.run(
+        [sys.executable, "-m", "harp_tpu", "lint", "--json",
+         "--layer", "ast", "--allowlist", _cg_toml],
+        capture_output=True, text=True, timeout=300, cwd=_cg_repo)
+    assert _cg_run.returncode == 1, _cg_run.stdout[-400:]
+    _cg_row = _cg_json.loads(_cg_run.stdout.strip().splitlines()[-1])
+    assert _cg_row["stale_allowlist"] == 1 and _cg_row["clean"] is True
+from harp_tpu.analysis.astlints import iter_python_files as _cg_iter
+
+assert set(_cg_cli._changed_paths(_cg_repo)) <= set(_cg_iter(_cg_repo))
+
+print(f"commgraph: clean epoch sheet {_cg_expect} B/shard x{_cg_iters} "
+      f"== ledger; HL301/302/303/304 all fire on their fixtures; "
+      f"{len(_cg_DRIVERS)} driver sheets clean through the CLI + "
+      "invariant 6 both ways")
+print(f"DRIVE OK round-29 ({mode})")

@@ -276,6 +276,51 @@ def test_lint_row_invariants(tmp_path):
     assert ":3:" in errors[2] and "negative" in errors[2]
 
 
+def _sheet(**over):
+    """A valid kmeans.fit byte sheet (the hand-computed Layer-4 shape),
+    with per-test forgeries spliced in."""
+    coll = {"site": "kmeans.py:324", "primitive": "psum",
+            "verb": "allreduce", "axis": "workers",
+            "wire_dtype": "float32", "per_shard_bytes": 1060,
+            "calls_per_trace": 3, "amplification": 2, "dynamic": False,
+            "path": "/shard_map/scan"}
+    coll.update({k: v for k, v in over.items() if k in coll})
+    sheet = {"collectives": [coll], "bytes_per_trace": 1060,
+             "amplified_bytes": 2120, "donated_args": [],
+             "donated_avals": []}
+    sheet.update({k: v for k, v in over.items() if k in sheet})
+    return sheet
+
+
+def test_lint_byte_sheet_invariants(tmp_path):
+    """Invariant 6, CommGraph extension: byte sheets must name
+    registered programs/primitives/verbs and non-negative bytes —
+    forged rows must each trip exactly their own violation."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    base = {"kind": "lint", "violations": 0, **stamp}
+    rows = [
+        {**base, "byte_sheets": {"kmeans.fit": _sheet()}},       # fine
+        {**base, "byte_sheets": {"notaprogram": _sheet()}},
+        {**base, "byte_sheets": {
+            "kmeans.fit": _sheet(primitive="send_recv")}},
+        {**base, "byte_sheets": {
+            "kmeans.fit": _sheet(verb="gossip")}},
+        {**base, "byte_sheets": {
+            "kmeans.fit": _sheet(bytes_per_trace=-5)}},
+        {**base, "byte_sheets": {
+            "kmeans.fit": _sheet(amplification=-1)}},
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 5, errors
+    assert ":2:" in errors[0] and "notaprogram" in errors[0]
+    assert ":3:" in errors[1] and "send_recv" in errors[1]
+    assert ":4:" in errors[2] and "gossip" in errors[2]
+    assert ":5:" in errors[3] and "bytes_per_trace" in errors[3]
+    assert ":6:" in errors[4] and "amplification" in errors[4]
+
+
 def test_serve_row_invariants(tmp_path):
     """Invariant 7: serve rows must be stamped, percentiles monotone,
     qps positive, and steady_compiles exactly 0 — a serving-throughput

@@ -3,10 +3,14 @@
 One synthetic module per Layer-1 rule that must trip it, the pre-fix LDA
 scan-carry gather+DUS pattern pinned as a Layer-2 positive (and the
 fixed tile-local form as a negative), a 3-seed-word ``prng_seed`` toy
-kernel the Mosaic audit must flag WITHOUT hardware, and the repo-wide
-tier-1 gate: zero unallowlisted violations at HEAD.
+kernel the Mosaic audit must flag WITHOUT hardware, the Layer-4
+CommGraph fixtures (kmeans' hand-computed byte sheet as the HL302
+cross-check, an unledgered psum for HL301, a sabotaged donated-buffer
+re-read for HL303, a loop-invariant allgather for HL304), and the
+repo-wide tier-1 gate: zero unallowlisted violations at HEAD.
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -21,7 +25,10 @@ sys.path.insert(0, os.path.join(ROOT, "scripts"))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
 
+import harp_tpu.utils.telemetry as T  # noqa: E402
+from harp_tpu.analysis import commgraph  # noqa: E402
 from harp_tpu.analysis import rule_ids  # noqa: E402
 from harp_tpu.analysis import allowlist as allowlist_mod  # noqa: E402
 from harp_tpu.analysis.astlints import lint_source  # noqa: E402
@@ -300,6 +307,236 @@ def test_kernel_registry_audit_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# Layer 4 — CommGraph (static communication audit)
+# ---------------------------------------------------------------------------
+
+AX = "workers"
+
+
+def _wmesh():
+    from harp_tpu.parallel.mesh import WorkerMesh
+
+    return WorkerMesh()
+
+
+def _sharded(mesh, shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype,
+                                sharding=mesh.sharding(mesh.spec(0)))
+
+
+def test_commgraph_kmeans_sheet_matches_hand_computed():
+    """THE acceptance fixture: the static byte sheet for kmeans.fit
+    equals the hand-computed (k·d·4 + k·4 + 4) per-iteration allreduce
+    sheet (sums + counts + inertia — the same sheet
+    tests/test_telemetry.py pins at runtime), amplified by the fori trip
+    count, and matches the CommLedger's trace-time bytes EXACTLY."""
+    from harp_tpu.analysis.drivers import DRIVERS
+
+    fn, args = DRIVERS["kmeans.fit"]()
+    vs, graph = commgraph.analyze_program("kmeans.fit", fn, args)
+    assert vs == [], [v.format() for v in vs]
+    (site,) = graph.sites
+    k, d, iters = 8, 32, 2  # the registry's driver shapes
+    per_iter = k * d * 4 + k * 4 + 4
+    assert site.primitive == "psum" and site.verb == "allreduce"
+    assert site.site.startswith("kmeans.py:")
+    assert site.calls_per_trace == 3          # sums, counts, inertia
+    assert site.per_shard_bytes == per_iter
+    assert site.amplification == iters and not site.dynamic
+    sheet = graph.sheet()
+    assert sheet["bytes_per_trace"] == per_iter
+    assert sheet["amplified_bytes"] == per_iter * iters
+    # static == ledger, to the byte (the HL302 contract)
+    ledger_total = sum(r["payload_bytes"]
+                       for recs in graph.ledger_sites.values()
+                       for r in recs)
+    assert ledger_total == per_iter
+
+
+def test_hl301_unledgered_collective_fires():
+    """A raw lax.psum inside shard_map leaves no CommLedger record —
+    the untracked wire HL301 exists for."""
+    mesh = _wmesh()
+
+    def raw(x):
+        return lax.psum(x, AX)
+
+    fn = jax.jit(mesh.shard_map(raw, in_specs=(mesh.spec(0),),
+                                out_specs=P()))
+    vs, graph = commgraph.analyze_program(
+        "fix301", fn, (_sharded(mesh, (8, 4)),))
+    assert _rules(vs) == ["HL301"]
+    assert "untracked wire" in vs[0].message
+    assert graph.sites and graph.sites[0].verb is None
+
+
+def test_hl302_lying_byte_sheet_fires():
+    """A verb that records a SMALLER tree than it reduces (record_comm
+    and the psum share one source line, so both sides key the same call
+    site) must trip the static-vs-ledger byte cross-check."""
+    mesh = _wmesh()
+
+    def lying(x):
+        return T.record_comm("allreduce", x[0, 0], axis=AX) or lax.psum(x, AX)  # noqa: E501
+
+    fn = jax.jit(mesh.shard_map(lying, in_specs=(mesh.spec(0),),
+                                out_specs=P()))
+    vs, _ = commgraph.analyze_program("fix302", fn,
+                                      (_sharded(mesh, (8, 4)),))
+    assert _rules(vs) == ["HL302"]
+    assert "disagrees" in vs[0].message
+
+
+def test_hl302_quantized_wire_is_exempt():
+    """The int8 wire accounts 1 B/elem logically while the lowering
+    accumulates in int32 — a documented divergence the byte cross-check
+    must NOT flag (and the extra stacked-scale pmax at the same site
+    must not read as an untracked wire either)."""
+    from harp_tpu.parallel import collective as C
+
+    mesh = _wmesh()
+
+    def q(x):
+        return C.allreduce_quantized(x, wire_dtype=jnp.int8)
+
+    fn = jax.jit(mesh.shard_map(q, in_specs=(mesh.spec(0),),
+                                out_specs=P()))
+    vs, graph = commgraph.analyze_program("fixq", fn,
+                                          (_sharded(mesh, (8, 4)),))
+    assert vs == [], [v.format() for v in vs]
+    assert any(s.ledger_wire == "int8" for s in graph.sites)
+
+
+def test_hl304_loop_invariant_collective_fires():
+    """An allgather of a scan CONST re-ships identical bytes every
+    iteration — hoistable, and the sheet must show the wasted
+    amplification."""
+    from harp_tpu.parallel import collective as C
+
+    mesh = _wmesh()
+
+    def prog(x):
+        def body(c, _):
+            return c + C.allgather(x).sum(), None
+
+        out, _ = lax.scan(body, jnp.float32(0.0), None, length=4)
+        return out
+
+    fn = jax.jit(mesh.shard_map(prog, in_specs=(mesh.spec(0),),
+                                out_specs=P()))
+    vs, graph = commgraph.analyze_program("fix304", fn,
+                                          (_sharded(mesh, (8, 4)),))
+    assert _rules(vs) == ["HL304"]
+    assert "hoist" in vs[0].message
+    (site,) = graph.sites
+    assert site.amplification == 4 and site.loop_invariant
+
+
+def test_hl304_carry_dependent_collective_is_clean():
+    """The same allgather on the CARRY is real per-iteration traffic —
+    no hoist finding (ring attention / rotate_pipeline shape)."""
+    from harp_tpu.parallel import collective as C
+
+    mesh = _wmesh()
+
+    def prog(x):
+        def body(c, _):
+            return C.allgather(c)[: c.shape[0]] * 0.5 + c, None
+
+        out, _ = lax.scan(body, x, None, length=4)
+        return out
+
+    fn = jax.jit(mesh.shard_map(prog, in_specs=(mesh.spec(0),),
+                                out_specs=mesh.spec(0)))
+    vs, _ = commgraph.analyze_program("fix304n", fn,
+                                      (_sharded(mesh, (8, 4)),))
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_hl303_sabotaged_donated_reread_and_redispatch_fire():
+    """The violation fixture: a buffer donated to a dispatch is read
+    back AND re-dispatched.  On this CPU backend the re-use may also
+    raise jax's own 'Array has been deleted' — the audit must have
+    recorded the violation BEFORE the crash (on TPU there is no crash,
+    just garbage — which is the whole point of the lint)."""
+    from harp_tpu.utils import flightrec
+
+    exe = jax.jit(lambda s, b: s + b, donate_argnums=(1,))
+    s = jax.device_put(np.ones((4,), np.float32))
+    audit = commgraph.DonationAudit("protocol:sabotage")
+    with audit:
+        w = audit.wrap(exe, (1,), "toy.step")
+        buf = jax.device_put(np.ones((4,), np.float32))
+        w(s, buf)
+        with contextlib.suppress(RuntimeError):
+            flightrec.readback(buf)        # use-after-donate: host read
+        with contextlib.suppress(RuntimeError, ValueError):
+            w(s, buf)                      # use-after-donate: re-dispatch
+        fresh = jax.device_put(np.ones((4,), np.float32))
+        w(s, fresh)                        # correct discipline: clean
+    assert [v.rule for v in audit.violations] == ["HL303", "HL303"]
+    assert "host read" in audit.violations[0].message
+    assert "re-dispatched" in audit.violations[1].message
+
+
+def test_hl303_continuous_runner_discipline_is_clean():
+    """The clean fixture: the REAL serve ContinuousRunner depth-2
+    in-flight pipeline (fresh staged buffer per batch, donated exactly
+    once) passes the donation audit — the registered lint-time
+    protocols drive exactly this."""
+    from harp_tpu.analysis.drivers import PROTOCOLS
+
+    assert set(PROTOCOLS) >= {"serve.kmeans_continuous",
+                              "serve.mfsgd_continuous"}
+    drive = PROTOCOLS["serve.kmeans_continuous"]()
+    vs = commgraph.audit_protocol("serve.kmeans_continuous", drive)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_commgraph_registry_is_clean_and_covers_the_surface():
+    """Every registered driver extracts a clean CommGraph (no untracked
+    wire, no lying sheet, no hoistable collective), the registry covers
+    >= 10 programs (all six serve engines + rotate pipeline + ingest
+    pair), and the serve engines' donated batch arg is visible in the
+    aliasing info."""
+    from harp_tpu.analysis.drivers import DRIVERS
+
+    assert len(DRIVERS) >= 10
+    assert {"serve.kmeans_assign", "serve.mfsgd_topk", "serve.lda_infer",
+            "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores",
+            "rotate.pipeline_chunked", "ingest.accum_chunk",
+            "ingest.finish_epoch"} <= set(DRIVERS)
+    for name, build in DRIVERS.items():
+        fn, args = build()
+        vs, graph = commgraph.analyze_program(name, fn, args)
+        assert vs == [], (name, [v.format() for v in vs])
+        if name.startswith("serve."):
+            assert graph.donated_args, name  # the batch buffer donates
+    # the chunked rotate pipeline's ring traffic carries the full
+    # n_chunks * ring-size amplification
+    fn, args = DRIVERS["rotate.pipeline_chunked"]()
+    _, graph = commgraph.analyze_program("rotate.pipeline_chunked", fn,
+                                         args)
+    (site,) = graph.sites
+    assert site.primitive == "ppermute" and site.amplification == 16
+
+
+def test_check_jsonl_commgraph_sets_in_sync():
+    """check_jsonl freezes the byte-sheet vocabulary (standalone
+    script); drift from the live registries fails here."""
+    import check_jsonl
+
+    from harp_tpu.analysis.drivers import DRIVERS
+    from harp_tpu.parallel.collective import PRIMITIVE_VERBS
+
+    assert tuple(sorted(DRIVERS)) == check_jsonl.KNOWN_LINT_PROGRAMS
+    assert tuple(sorted(PRIMITIVE_VERBS)) == \
+        check_jsonl.KNOWN_COMM_PRIMITIVES
+    all_verbs = set().union(*PRIMITIVE_VERBS.values())
+    assert tuple(sorted(all_verbs)) == check_jsonl.KNOWN_COMM_VERBS
+
+
+# ---------------------------------------------------------------------------
 # Allowlist + registry + CLI
 # ---------------------------------------------------------------------------
 
@@ -396,9 +633,140 @@ def test_cli_audit_module_trips_jaxpr_and_mosaic_layers(tmp_path, capsys):
     assert "HL101" in row["per_rule"] and "HL202" in row["per_rule"]
 
 
+def test_cli_audit_module_trips_commgraph_layer(tmp_path, capsys):
+    """Layer-4 exit codes through the CLI: an unledgered psum (HL301),
+    a loop-invariant allgather (HL304), and a sabotaged donation
+    protocol (HL303) in one fixture module must all land in per_rule
+    and flip the exit code."""
+    fixture = tmp_path / "fixture_cg.py"
+    fixture.write_text(textwrap.dedent("""
+        import contextlib
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from harp_tpu.parallel import collective as C
+        from harp_tpu.parallel.mesh import WorkerMesh
+        from harp_tpu.utils import flightrec
+
+
+        def _mesh():
+            return WorkerMesh()
+
+
+        def _x(mesh):
+            return jax.ShapeDtypeStruct(
+                (8, 4), jnp.float32,
+                sharding=mesh.sharding(mesh.spec(0)))
+
+
+        def _raw_psum():
+            mesh = _mesh()
+            fn = jax.jit(mesh.shard_map(
+                lambda x: lax.psum(x, "workers"),
+                in_specs=(mesh.spec(0),), out_specs=P()))
+            return fn, (_x(mesh),)
+
+
+        def _hoistable():
+            mesh = _mesh()
+
+            def prog(x):
+                def body(c, _):
+                    return c + C.allgather(x).sum(), None
+                out, _ = lax.scan(body, jnp.float32(0.0), None,
+                                  length=4)
+                return out
+
+            fn = jax.jit(mesh.shard_map(
+                prog, in_specs=(mesh.spec(0),), out_specs=P()))
+            return fn, (_x(mesh),)
+
+
+        def _sabotage():
+            def drive(audit):
+                exe = jax.jit(lambda s, b: s + b, donate_argnums=(1,))
+                w = audit.wrap(exe, (1,), "toy.step")
+                s = jax.device_put(np.ones((4,), np.float32))
+                buf = jax.device_put(np.ones((4,), np.float32))
+                w(s, buf)
+                with contextlib.suppress(RuntimeError):
+                    flightrec.readback(buf)
+            return drive
+
+
+        HARPLINT_DRIVERS = {"raw_psum": _raw_psum,
+                            "hoistable": _hoistable}
+        HARPLINT_PROTOCOLS = {"sabotage": _sabotage}
+    """))
+    rc = cli.main(["--audit-module", str(fixture), "--json"])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert {"HL301", "HL303", "HL304"} <= set(row["per_rule"])
+    # fixture rows never ship byte sheets: sheet program names are
+    # pinned to the drivers registry by check_jsonl invariant 6
+    assert "byte_sheets" not in row
+
+
+def test_cli_stale_allowlist_entry_fails(tmp_path, capsys):
+    """Satellite: a stale allowlist entry is a HARD failure, not a
+    report line — same exit as an unallowlisted violation (AST-layer
+    full-repo run; the committed entries are all AST-rule entries, so
+    the control run stays green)."""
+    committed = open(os.path.join(ROOT, "harp_tpu", "analysis",
+                                  "allowlist.toml")).read()
+    ok = tmp_path / "ok.toml"
+    ok.write_text(committed)
+    rc = cli.main(["--json", "--layer", "ast", "--allowlist", str(ok)])
+    capsys.readouterr()
+    assert rc == 0
+    stale = tmp_path / "stale.toml"
+    stale.write_text(committed + textwrap.dedent("""
+        [[allow]]
+        rule = "HL002"
+        path = "harp_tpu/models/never_existed.py"
+        reason = "synthetic stale entry for the hard-fail test"
+    """))
+    rc = cli.main(["--json", "--layer", "ast", "--allowlist",
+                   str(stale)])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert row["stale_allowlist"] == 1
+    assert row["clean"] is True  # no violations — the ENTRY is the rot
+
+
+def test_cli_changed_mode_scopes_the_ast_layer(monkeypatch, capsys):
+    """--changed lints only the git-changed files in the AST layer (the
+    ~2 s dev loop); staleness reporting is disabled because an unswept
+    file cannot prove an entry dead."""
+    monkeypatch.setattr(cli, "_changed_paths",
+                        lambda repo: ["harp_tpu/utils/timing.py"])
+    rc = cli.main(["--changed", "--json", "--layer", "ast"])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, row
+    assert row["files_scanned"] == 1
+    assert row["stale_allowlist"] == 0
+
+
+def test_changed_paths_subset_of_sweep():
+    """_changed_paths returns repo-relative paths drawn from the same
+    set the full sweep lints (deleted files never error)."""
+    from harp_tpu.analysis.astlints import iter_python_files
+
+    repo = cli.repo_root()
+    changed = cli._changed_paths(repo)
+    assert isinstance(changed, list)
+    assert set(changed) <= set(iter_python_files(repo))
+
+
 def test_cli_repo_run_is_clean(capsys):
-    """THE tier-1 gate: zero unallowlisted violations at HEAD, all three
-    layers, and the machine line passes check_jsonl invariant 6."""
+    """THE tier-1 gate: zero unallowlisted violations at HEAD, all four
+    layers, and the machine line passes check_jsonl invariant 6 — with
+    the Layer-4 byte sheets riding the row (>= 10 programs; kmeans.fit
+    matching the hand-computed sheet exactly)."""
     import check_jsonl
 
     rc = cli.main(["--json"])
@@ -408,3 +776,9 @@ def test_cli_repo_run_is_clean(capsys):
     assert row["clean"] is True and row["violations"] == 0
     assert row["stale_allowlist"] == 0
     assert check_jsonl._check_lint_row("stdout", 1, row) == []
+    sheets = row["byte_sheets"]
+    assert len(sheets) >= 10
+    km = sheets["kmeans.fit"]
+    assert km["bytes_per_trace"] == 8 * 32 * 4 + 8 * 4 + 4
+    assert km["amplified_bytes"] == 2 * km["bytes_per_trace"]
+    assert km["collectives"][0]["verb"] == "allreduce"
