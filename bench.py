@@ -18,7 +18,11 @@ one jitted program; sync is a scalar readback, because block_until_ready
 can return early on this machine's relay transport.  The watchdog
 re-arms per config; if the TPU relay hangs mid-sweep the record still
 carries every config measured before the hang, with ``error`` naming the
-hung one.
+hung one.  ``--max-seconds-per-config=SECONDS`` (PR 10) adds a bounded
+per-config timer UNDER that whole-run watchdog: the config runs on a
+worker thread, and on overrun the sweep warns, records the timeout in
+that config's submetric, abandons the thread, and keeps measuring — one
+hung relay config eats its own budget, not the measurement window.
 
 Outage behavior (VERDICT r3 item 3): a bounded subprocess probe runs
 BEFORE the first config, so a dead relay yields a ``relay_down`` record
@@ -337,10 +341,66 @@ def _configs(smoke):
             for name, key in _CONFIG_KEYS]
 
 
+def _parse_max_seconds(argv):
+    """``--max-seconds-per-config=SECONDS`` (the ``=`` form only: a bare
+    following token would be swallowed by the positional config filter).
+    None when absent; SystemExit on a malformed value."""
+    for a in argv:
+        if a.startswith("--max-seconds-per-config"):
+            if "=" not in a:
+                print("bench.py: use --max-seconds-per-config=SECONDS "
+                      "(the '=' form)", file=sys.stderr)
+                raise SystemExit(2)
+            try:
+                v = float(a.split("=", 1)[1])
+            except ValueError:
+                print(f"bench.py: bad --max-seconds-per-config value "
+                      f"{a.split('=', 1)[1]!r}", file=sys.stderr)
+                raise SystemExit(2)
+            if v <= 0:
+                print("bench.py: --max-seconds-per-config must be > 0",
+                      file=sys.stderr)
+                raise SystemExit(2)
+            return v
+    return None
+
+
+def _run_with_timeout(thunk, max_s):
+    """Per-config watchdog (subprocess-free): run ``thunk`` on a daemon
+    worker thread and wait at most ``max_s`` seconds.  On timeout the
+    thread is ABANDONED (an in-process relay hang is uninterruptible —
+    CLAUDE.md gotchas) and ``(None, error_string)`` returns so the sweep
+    moves on: one hung config costs its own budget, not the rest of the
+    measurement window.  Exceptions from the thunk re-raise in the
+    caller (the existing per-config error handling owns them)."""
+    if max_s is None:
+        return thunk(), None
+    box = {}
+
+    def run():
+        try:
+            box["res"] = thunk()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="bench-config-worker")
+    t.start()
+    t.join(max_s)
+    if t.is_alive():
+        return None, (f"timeout: config exceeded "
+                      f"--max-seconds-per-config={max_s:g}s; skipped "
+                      "(worker thread abandoned)")
+    if "exc" in box:
+        raise box["exc"]
+    return box["res"], None
+
+
 def main():
     from harp_tpu.utils.timing import HangWatchdog
 
     smoke = "--smoke" in sys.argv
+    max_seconds = _parse_max_seconds(sys.argv[1:])
     if "--cpu" in sys.argv:
         # rehearsal hook (measure_on_relay.sh --rehearse): the axon site
         # pin would otherwise send even --smoke runs to the TPU relay,
@@ -429,10 +489,17 @@ def main():
         flight_base = flightrec.snapshot() if telemetry.enabled() else None
         try:
             with telemetry.span(f"bench.{name}"):
-                res = thunk()
+                res, timeout_err = _run_with_timeout(thunk, max_seconds)
         except Exception as e:  # keep measuring the rest
             sub[name] = {"value": 0.0, "unit": unit,
                          "error": f"{type(e).__name__}: {e}"}
+            continue
+        if timeout_err is not None:
+            # warn + skip + record: a hung config must cost only itself,
+            # never the rest of the measurement window
+            print(f"bench.py WARNING: {name}: {timeout_err}",
+                  file=sys.stderr, flush=True)
+            sub[name] = {"value": 0.0, "unit": unit, "error": timeout_err}
             continue
         value = float(res[key])
         base = BASELINES[name]

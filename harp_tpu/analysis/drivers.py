@@ -341,3 +341,46 @@ def _serve_mfsgd_protocol():
     return _serve_continuous_drive(
         "mfsgd", MFSGDTopK,
         {"n_users": 64, "n_items": 32, "rank": 8}, req_rows=3)
+
+
+@register_protocol("serve.retry_restage")
+def _serve_retry_restage_protocol():
+    """The fault plane's retry path (PR 10): a seeded FaultInjector kills
+    dispatches mid-pipeline and the ContinuousRunner retries each failed
+    batch — ALWAYS through a freshly staged input buffer, because the
+    failed attempt's buffer was already donated to the dead dispatch.
+    Driving the retry loop here proves that discipline under the HL303
+    audit on every full lint run (the sabotaged twin — re-dispatching
+    the donated buffer on retry — lives in tests/test_lint.py); the
+    drive also asserts the faults actually fired, so a refactor that
+    silently unhooks the injector fails the lint instead of passing
+    vacuously."""
+
+    def drive(audit):
+        import numpy as np
+
+        from harp_tpu.serve.engines import KMeansAssign
+        from harp_tpu.serve.server import Server
+        from harp_tpu.utils.fault import FaultInjector
+
+        rng = np.random.default_rng(0)
+        srv = Server("kmeans",
+                     state=KMeansAssign.synthetic_state(rng, k=8, d=32),
+                     mesh=_mesh(), ladder=(1, 8))
+        srv.startup()
+        n_state = len(srv.engine.state_args())
+        srv.wrap_executables(
+            lambda rung, exe: audit.wrap(exe, (n_state,),
+                                         f"serve.kmeans.b{rung}"))
+        runner = srv.make_runner(depth=2, max_retries=2)
+        inj = FaultInjector(seed=0, fail={"dispatch": (2,)})
+        with inj.arm():
+            for i in range(6):
+                runner.submit(i, srv.engine.synthetic_request(rng, 3))
+                runner.step()
+            runner.drain()
+        assert inj.injected["dispatch"] == 1, "no fault fired: vacuous"
+        assert runner.fault_retries == 1, "fault fired but no retry ran"
+        assert runner.completed == 6, "retry path lost responses"
+
+    return drive

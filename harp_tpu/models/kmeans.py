@@ -407,7 +407,9 @@ def kmeanspp_init(points, k, seed=0, sample=50_000):
 
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         dtype=jnp.float32, block_points=0, use_pallas=None,
-        variant="allreduce", quantize=None, init="random"):
+        variant="allreduce", quantize=None, init="random",
+        ckpt_dir: str | None = None, ckpt_every: int = 5,
+        max_restarts: int = 3, fault=None):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
 
     ``points``: [n, d] host or device array; sharded over workers on dim 0.
@@ -416,6 +418,17 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     ``seed=None`` — deterministic, so results match a numpy Lloyd
     reference exactly (the golden tests use this mode); "kmeans++" uses
     :func:`kmeanspp_init` (beyond-reference, far less init-sensitive).
+
+    Checkpoint/resume (PR 10, the SURVEY.md §6 driver contract the other
+    graded apps already carry): with ``ckpt_dir`` set, the T iterations
+    run as ``ckpt_every``-iteration device programs with the centroids
+    checkpointed between chunks through
+    :class:`~harp_tpu.utils.checkpoint.CheckpointManager`; a crashed run
+    (or a rerun pointing at the same dir — the CLI ``--resume``) resumes
+    from the latest saved chunk instead of iteration 0.  The chunked
+    schedule replays bit-identically on resume: each chunk is the same
+    compiled program over the same operands, and restored centroids
+    round-trip host-side exactly (f32 in, f32 out).
     """
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
@@ -447,6 +460,15 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         pts = mesh.shard_array(
             np.asarray(points, dtype=np.dtype(jnp.dtype(dtype).name)), 0)
     centroids = jax.device_put(centroids, mesh.replicated())
+    if ckpt_dir is not None:
+        return _fit_ckpt(mesh, cfg, pts, centroids, iters,
+                         ckpt_dir, ckpt_every=ckpt_every,
+                         max_restarts=max_restarts, fault=fault)
+    if fault is not None:
+        raise ValueError(
+            "fault injection requires ckpt_dir (recovery restarts from "
+            "checkpoints; without one the injector would be silently "
+            "ignored)")
     fit_fn = flightrec.track(make_fit_fn(mesh, cfg), "kmeans.fit")
     # telemetry: the T iterations run inside ONE dispatch, so the traced
     # per-iteration comm sites execute cfg.iters times per invocation;
@@ -461,6 +483,53 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         skew.record_execution("kmeans.fit", st[:, 0], unit="points",
                               wall_s=time.perf_counter() - t0)
         return flightrec.readback(new_c), inertia
+
+
+def _fit_ckpt(mesh, cfg, pts, centroids, iters, ckpt_dir, *,
+              ckpt_every=5, max_restarts=3, fault=None):
+    """The recovery-looped fit: ``ckpt_every``-iteration device chunks
+    under :func:`harp_tpu.utils.fault.run_with_recovery`, centroids (+
+    the last chunk's stats, so a no-work resume still reports inertia)
+    checkpointed between chunks.  One compiled program per distinct
+    chunk length (at most two: the full chunk and a ragged tail)."""
+    from harp_tpu.utils.checkpoint import CheckpointManager
+    from harp_tpu.utils.fault import run_with_recovery
+
+    mgr = CheckpointManager(ckpt_dir)
+    lens = [min(ckpt_every, iters - s) for s in range(0, iters, ckpt_every)]
+    fns: dict[int, Any] = {}
+
+    def chunk_fn(n_it):
+        fn = fns.get(n_it)
+        if fn is None:
+            fn = fns[n_it] = flightrec.track(
+                make_fit_fn(mesh, dataclasses.replace(cfg, iters=n_it)),
+                "kmeans.fit_ckpt")
+        return fn
+
+    nw = mesh.num_workers
+
+    def place(c):
+        return jax.device_put(jnp.asarray(np.asarray(c), dtype=cfg.dtype),
+                              mesh.replicated())
+
+    def make_state():
+        return {"centroids": centroids,
+                "stats": jnp.zeros((nw, 2), jnp.float32)}
+
+    def step(ci, state):
+        c = state["centroids"]
+        if not isinstance(c, jax.Array):  # numpy from a fresh restore
+            c = place(c)
+        new_c, stats = chunk_fn(lens[ci])(pts, c)
+        return {"centroids": new_c, "stats": stats}
+
+    with telemetry.span("kmeans.fit_ckpt", iters=iters, k=cfg.k):
+        final = run_with_recovery(make_state, step, len(lens), mgr,
+                                  ckpt_every=1, max_restarts=max_restarts,
+                                  fault=fault)
+    st = np.asarray(final["stats"])
+    return np.asarray(final["centroids"]), float(st[0, 1])
 
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
@@ -577,8 +646,23 @@ def main(argv=None):
                    help="opt-in int8 point quantization (¼ the HBM traffic; "
                         "see KMeansConfig.quantize for the accuracy contract)")
     p.add_argument("--bench", action="store_true", help="synthetic benchmark mode")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="fit with checkpoint/resume: iterations run in "
+                        "--ckpt-every chunks with centroids checkpointed "
+                        "between them; rerunning with the same dir resumes "
+                        "from the latest saved chunk")
+    p.add_argument("--ckpt-every", type=int, default=5,
+                   help="iterations per checkpointed chunk")
+    p.add_argument("--resume", action="store_true",
+                   help="assert the run RESUMES: --ckpt-dir must already "
+                        "hold a checkpoint (a mistyped dir fails loudly "
+                        "instead of silently restarting from iteration 0)")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+
+    from harp_tpu.utils.fault import resolve_resume
+
+    resumed_from = resolve_resume(args.ckpt_dir, args.resume)
 
     from harp_tpu.report import maybe_emit
 
@@ -600,9 +684,11 @@ def main(argv=None):
             pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
                          variant=args.variant, quantize=args.quantize,
-                         init=args.init)
+                         init=args.init, ckpt_dir=args.ckpt_dir,
+                         ckpt_every=args.ckpt_every)
         print(benchmark_json("kmeans_cli", {"k": args.k, "iters": args.iters, "n": pts.shape[0],
-               "d": pts.shape[1], "inertia": inertia}))
+               "d": pts.shape[1], "inertia": inertia,
+               "ckpt_dir": args.ckpt_dir, "resumed_from": resumed_from}))
         maybe_emit("kmeans")
 
 

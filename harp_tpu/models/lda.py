@@ -1514,11 +1514,19 @@ def main(argv=None):
                         "benchmarking; rerunning with the same dir resumes "
                         "the chain from the latest saved epoch")
     p.add_argument("--ckpt-every", type=int, default=5)
+    p.add_argument("--resume", action="store_true",
+                   help="assert the run RESUMES from --ckpt-dir: fails "
+                        "loudly when the dir holds no checkpoint (a "
+                        "mistyped dir must not silently restart the "
+                        "chain from epoch 0)")
     p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
                    help="token files ('doc word [count]' rows) — the Harp "
                         "app's HDFS input; implies sampling mode. --docs/"
                         "--vocab are raised to max id + 1 as needed")
     args = p.parse_args(argv)
+    from harp_tpu.utils.fault import resolve_resume
+
+    resumed_from = resolve_resume(args.ckpt_dir, args.resume)
     if args.input or args.ckpt_dir:
         if args.input:
             from harp_tpu.native.datasource import load_triples_glob
@@ -1558,6 +1566,7 @@ def main(argv=None):
         model.fit(args.epochs, args.ckpt_dir, ckpt_every=args.ckpt_every)
         print(benchmark_json("lda_fit_cli", {
             "epochs": args.epochs, "ckpt_dir": args.ckpt_dir,
+            "resumed_from": resumed_from,
             "log_likelihood": round(model.log_likelihood(), 4)}))
     else:
         print(benchmark_json("lda_cli", benchmark(

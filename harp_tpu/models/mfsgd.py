@@ -935,11 +935,19 @@ def main(argv=None):
                         "rerunning with the same dir resumes from the latest "
                         "saved epoch")
     p.add_argument("--ckpt-every", type=int, default=5)
+    p.add_argument("--resume", action="store_true",
+                   help="assert the run RESUMES from --ckpt-dir: fails "
+                        "loudly when the dir holds no checkpoint (a "
+                        "mistyped dir must not silently retrain from "
+                        "epoch 0)")
     p.add_argument("--input", default=None, metavar="FILE_OR_GLOB",
                    help="rating triple files ('user item rating' rows, e.g. "
                         "MovieLens) — the Harp app's HDFS input; implies "
                         "training mode. --users/--items default to max id + 1")
     args = p.parse_args(argv)
+    from harp_tpu.utils.fault import resolve_resume
+
+    resumed_from = resolve_resume(args.ckpt_dir, args.resume)
     if args.input or args.ckpt_dir:
         if args.input:
             from harp_tpu.native.datasource import load_triples_glob
@@ -976,7 +984,7 @@ def main(argv=None):
         print(benchmark_json("mfsgd_fit_cli", {"epochs_run": len(rmses),
                "rmse_final": rmses[-1] if rmses else None,
                "nnz": len(u), "users": n_users, "items": n_items,
-               "ckpt_dir": args.ckpt_dir}))
+               "ckpt_dir": args.ckpt_dir, "resumed_from": resumed_from}))
     else:
         print(benchmark_json("mfsgd_cli", benchmark(
             args.users or 138_493, args.items or 26_744,

@@ -302,3 +302,34 @@ class ContinuousScheduler:
         """Cumulative padded / dispatched rows (0.0 before any batch)."""
         total = self.real_rows + self.padded_rows
         return self.padded_rows / total if total else 0.0
+
+    def expire(self, now: float, deadline_s: float) -> list:
+        """Remove queued requests whose deadline already passed — the
+        load-shedding half of the fault plane (PR 10).  Only requests
+        with NO rows in a dispatched batch are removable (``taken > 0``
+        means earlier segments are in flight and the reassembly contract
+        owns them — those complete late and count as deadline misses);
+        expired keys are returned so the caller answers each with a
+        structured shed error instead of unbounded latency."""
+        expired: list = []
+        keep: collections.deque[_Pending] = collections.deque()
+        for p in self._queue:
+            if p.taken == 0 and (now - p.arrival) > deadline_s:
+                expired.append(p.key)
+                self.queued_rows -= p.n_rows
+            else:
+                keep.append(p)
+        self._queue = keep
+        return expired
+
+    def discard(self, keys: set) -> None:
+        """Drop the still-queued rows of ``keys`` (a hard-failed batch's
+        requests must not leave tail segments behind to dispatch into a
+        request that was already answered with an error)."""
+        keep: collections.deque[_Pending] = collections.deque()
+        for p in self._queue:
+            if p.key in keys:
+                self.queued_rows -= p.n_rows - p.taken
+            else:
+                keep.append(p)
+        self._queue = keep

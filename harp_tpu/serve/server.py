@@ -236,14 +236,18 @@ class Server:
 
     def make_runner(self, *, max_queue_delay_s: float = 0.005,
                     rung_policy: str = "adaptive", depth: int = 2,
-                    clock: Callable[[], float] = time.perf_counter
-                    ) -> "ContinuousRunner":
+                    clock: Callable[[], float] = time.perf_counter,
+                    deadline_s: float | None = None,
+                    max_queue_rows: int | None = None,
+                    max_retries: int = 2) -> "ContinuousRunner":
         """A continuous request plane over this server's executables."""
         if not self._exec:
             raise RuntimeError("call startup() before make_runner()")
         return ContinuousRunner(self, max_queue_delay_s=max_queue_delay_s,
                                 rung_policy=rung_policy, depth=depth,
-                                clock=clock)
+                                clock=clock, deadline_s=deadline_s,
+                                max_queue_rows=max_queue_rows,
+                                max_retries=max_retries)
 
     # -- stdio loop --------------------------------------------------------
     def serve_stdio(self, stdin: IO, stdout: IO) -> int:
@@ -314,25 +318,66 @@ class ContinuousRunner:
     were exactly one dispatch + one readback per batch.  ``clock`` is
     injected so tests and the sustained-load bench drive the policy on
     a deterministic timeline.
+
+    Graceful degradation (PR 10) — under overload or faults the plane
+    degrades instead of dying, and every degradation is a counted,
+    structured response (never unbounded latency, never a dead server):
+
+    - **bounded admission** (``max_queue_rows``): a request that would
+      push the queue past the bound is SHED at submit with
+      ``{"error": ..., "shed": true, "reason": "queue_full"}``;
+    - **per-request deadlines** (``deadline_s``): a queued request whose
+      deadline passes before any of its rows dispatch is shed with
+      ``reason: "deadline"`` (dispatching it would waste a rung on an
+      answer the client already gave up on); a request that completes
+      late is still answered but counted in ``deadline_misses``;
+    - **retry-with-restage** (``max_retries``): a transient dispatch
+      failure (an :class:`~harp_tpu.utils.fault.InjectedFault`, a relay
+      hiccup) retries the batch — ALWAYS through a freshly staged input
+      buffer, because the failed attempt's buffer was already donated
+      (HL303: a donated buffer can never be re-dispatched; the
+      ``serve.retry_restage`` protocol drive in analysis/drivers.py
+      proves this discipline at lint time);
+    - **failure isolation**: when retries are exhausted the batch's
+      requests get structured error responses and the runner keeps
+      serving — one engine crash answers errors for its requests, it
+      does not kill the server (``engine_failures`` counts).
     """
+
+    #: exceptions never treated as transient: budget violations are the
+    #: guard speaking, not the device failing — retrying would bury them
+    _NON_TRANSIENT = (flightrec.BudgetExceeded,)
 
     def __init__(self, server: Server, *,
                  max_queue_delay_s: float = 0.005,
                  rung_policy: str = "adaptive", depth: int = 2,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 deadline_s: float | None = None,
+                 max_queue_rows: int | None = None,
+                 max_retries: int = 2):
         if depth < 1:
             raise ValueError(f"pipeline depth {depth} must be >= 1")
+        if max_retries < 0:
+            raise ValueError(f"max_retries {max_retries} must be >= 0")
         self.srv = server
         self.sched = ContinuousScheduler(
             server.ladder, max_queue_delay_s=max_queue_delay_s,
             rung_policy=rung_policy)
         self.depth = int(depth)
         self.clock = clock
+        self.deadline_s = deadline_s
+        self.max_queue_rows = max_queue_rows
+        self.max_retries = int(max_retries)
         self._in_flight: collections.deque = collections.deque()
         # key -> {"req", "rows", "segs"} for admitted-not-answered work
         self._asm: dict[Any, dict] = {}
         self.dispatched = 0
         self.completed = 0
+        self.shed = 0
+        self.deadline_misses = 0
+        self.fault_retries = 0
+        self.engine_failures = 0
+        self.failed = 0  # requests answered with a hard-failure error
         self.latencies_ms: collections.deque = collections.deque(
             maxlen=4096)
 
@@ -340,7 +385,8 @@ class ContinuousRunner:
     def submit(self, key: Any, req: Any,
                now: float | None = None) -> list[tuple[Any, dict]]:
         """Admit one request; returns immediately-answerable responses
-        (malformed / empty requests), else [] with the rows queued."""
+        (malformed / empty / shed requests), else [] with the rows
+        queued."""
         now = self.clock() if now is None else now
         if not isinstance(req, dict):
             return [(key, {"id": None,
@@ -353,6 +399,15 @@ class ContinuousRunner:
             return [(key, {"id": req.get("id"), "result": []})]
         if key in self._asm:
             raise ValueError(f"request key {key!r} already in flight")
+        if (self.max_queue_rows is not None
+                and self.sched.queued_rows + rows.shape[0]
+                > self.max_queue_rows):
+            self.shed += 1
+            return [(key, {
+                "id": req.get("id"), "shed": True, "reason": "queue_full",
+                "error": f"shed: admission queue full "
+                         f"({self.sched.queued_rows} rows queued, bound "
+                         f"{self.max_queue_rows})"})]
         self._asm[key] = {"req": req, "rows": rows, "segs": [],
                           "arrival": now}
         self.sched.put(key, rows.shape[0], now)
@@ -369,36 +424,91 @@ class ContinuousRunner:
     def step(self, now: float | None = None) -> list[tuple[Any, dict]]:
         """One window: dispatch if the policy fires and the pipeline has
         room, else read back the oldest in-flight batch.  Returns the
-        responses completed by this window ([] for a dispatch window or
-        an idle call)."""
+        responses completed by this window (shed/error responses for a
+        degraded window; [] for a clean dispatch window or an idle
+        call)."""
         now = self.clock() if now is None else now
+        out: list[tuple[Any, dict]] = []
+        if self.deadline_s is not None:
+            out += self._shed_expired(now)
         idle = not self._in_flight
         if (len(self._in_flight) < self.depth
                 and self.sched.ready(now, idle)):
-            with self.srv.steady.batch():
-                batch = self.sched.next_batch(now)
-                staged = self.srv._stage(
-                    batch, {key: self._asm[key]["rows"]
-                            for key, _, _ in batch.requests})
-                out_dev = self.srv._exec[batch.rung](
-                    *self.srv.engine.state_args(), staged)
-                self._in_flight.append((batch, out_dev))
+            batch = self.sched.next_batch(now)
+            if batch is None:  # everything expired out of the queue
+                return out
+            rows_by_key = {key: self._asm[key]["rows"]
+                           for key, _, _ in batch.requests}
+            attempt = 0
+            while True:
+                try:
+                    with self.srv.steady.batch():
+                        # a FRESH staged buffer per attempt: the previous
+                        # attempt's buffer was donated to the failed
+                        # dispatch and can never be re-dispatched (HL303)
+                        staged = self.srv._stage(batch, rows_by_key)
+                        out_dev = self.srv._exec[batch.rung](
+                            *self.srv.engine.state_args(), staged)
+                    break
+                except self._NON_TRANSIENT:
+                    raise
+                except Exception as e:  # noqa: BLE001 - isolate, count
+                    attempt += 1
+                    if attempt > self.max_retries:
+                        return out + self._fail_batch(batch, e)
+                    self.fault_retries += 1
+            self._in_flight.append((batch, out_dev))
             self.dispatched += 1
             self.srv.rows_served += batch.rows
-            return []
+            return out
         if self._in_flight:
             with self.srv.steady.batch():
                 batch, out_dev = self._in_flight.popleft()
-                out = flightrec.readback(out_dev)
-            return self._complete(batch, out, now)
-        return []
+                res = flightrec.readback(out_dev)
+            return out + self._complete(batch, res, now)
+        return out
+
+    def _shed_expired(self, now: float) -> list[tuple[Any, dict]]:
+        """Deadline shedding: queued requests past their deadline get a
+        structured error NOW — never a dispatch, never silent latency."""
+        out: list[tuple[Any, dict]] = []
+        for key in self.sched.expire(now, self.deadline_s):
+            a = self._asm.pop(key)
+            self.shed += 1
+            out.append((key, {
+                "id": a["req"].get("id"), "shed": True,
+                "reason": "deadline",
+                "error": f"shed: deadline ({self.deadline_s * 1e3:.1f} "
+                         f"ms) exceeded before dispatch"}))
+        return out
+
+    def _fail_batch(self, batch, exc: Exception) -> list[tuple[Any, dict]]:
+        """Retries exhausted: isolate the failure to this batch's
+        requests (structured errors) and keep the runner serving."""
+        self.engine_failures += 1
+        keys = {key for key, _, _ in batch.requests}
+        self.sched.discard(keys)  # tail segments must not dispatch later
+        out: list[tuple[Any, dict]] = []
+        for key in dict.fromkeys(k for k, _, _ in batch.requests):
+            a = self._asm.pop(key, None)
+            if a is None:
+                continue
+            self.failed += 1
+            out.append((key, {
+                "id": a["req"].get("id"),
+                "error": f"engine failure after {self.max_retries} "
+                         f"retries: {type(exc).__name__}: {exc}"}))
+        return out
 
     def _complete(self, batch, out: np.ndarray,
                   now: float) -> list[tuple[Any, dict]]:
         responses: list[tuple[Any, dict]] = []
         cursor = 0
         for key, lo, hi in batch.requests:
-            a = self._asm[key]
+            a = self._asm.get(key)
+            if a is None:  # answered with an error by a failed batch
+                cursor += hi - lo
+                continue
             a["segs"].append(out[cursor:cursor + (hi - lo)])
             cursor += hi - lo
             if hi == a["rows"].shape[0]:  # final segment (FIFO rows)
@@ -409,7 +519,10 @@ class ContinuousRunner:
                     "id": a["req"].get("id"),
                     "result": self.srv.engine.output_rows(
                         full, hi)}))
-                self.latencies_ms.append((now - a["arrival"]) * 1e3)
+                lat = now - a["arrival"]
+                self.latencies_ms.append(lat * 1e3)
+                if self.deadline_s is not None and lat > self.deadline_s:
+                    self.deadline_misses += 1  # answered, but late
                 del self._asm[key]
                 self.completed += 1
                 self.srv.requests_served += 1
@@ -442,6 +555,11 @@ class ContinuousRunner:
                 "queued_rows": len(self.sched),
                 "in_flight": len(self._in_flight),
                 "padding_frac": round(self.sched.padding_frac(), 6),
+                "shed": self.shed,
+                "deadline_misses": self.deadline_misses,
+                "fault_retries": self.fault_retries,
+                "engine_failures": self.engine_failures,
+                "failed": self.failed,
                 "p50_ms": pct(50), "p99_ms": pct(99)}
 
 
@@ -556,6 +674,24 @@ def main(argv=None) -> int:
                    help="continuous plane: adaptive holds work while "
                         "in flight to fill larger rungs; greedy "
                         "dispatches immediately at the minimal rung")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="continuous plane: per-request deadline — a "
+                        "request still queued past it is SHED with a "
+                        "structured error (never unbounded latency); a "
+                        "late completion is served but counted")
+    p.add_argument("--max-queue-rows", type=int, default=None,
+                   help="continuous plane: admission bound — a request "
+                        "that would push the queue past this many rows "
+                        "is shed at submit (reason: queue_full)")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="continuous plane: retry-with-restage attempts "
+                        "for a transient dispatch failure before the "
+                        "batch's requests get error responses")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="--sustained: seeded chaos — probability that "
+                        "any dispatch fails transiently (the injector "
+                        "rides flightrec.observe_dispatches; ~0.01 is "
+                        "the graded degraded-mode bench)")
     p.add_argument("--platform", choices=["cpu"], default=None,
                    help="force the CPU backend (the axon site pin would "
                         "otherwise route to the TPU relay — CLAUDE.md)")
@@ -578,7 +714,11 @@ def main(argv=None) -> int:
                 offered_qps=args.offered_qps,
                 burst_admit=args.burst_admit,
                 max_queue_delay_ms=args.max_queue_delay_ms,
-                rung_policy=args.rung_policy)
+                rung_policy=args.rung_policy,
+                deadline_ms=args.deadline_ms,
+                max_queue_rows=args.max_queue_rows,
+                max_retries=args.max_retries,
+                fault_rate=args.fault_rate)
             print(benchmark_json(f"serve_{args.app}_sustained", res))
         else:
             res = benchmark(app=args.app, n_requests=args.requests,
@@ -611,7 +751,11 @@ def main(argv=None) -> int:
 
         serve_forever(srv, args.host, args.tcp,
                       max_queue_delay_s=args.max_queue_delay_ms / 1e3,
-                      rung_policy=args.rung_policy)
+                      rung_policy=args.rung_policy,
+                      deadline_s=(args.deadline_ms / 1e3
+                                  if args.deadline_ms else None),
+                      max_queue_rows=args.max_queue_rows,
+                      max_retries=args.max_retries)
         return 0
     srv.serve_stdio(sys.stdin, sys.stdout)
     return 0

@@ -30,6 +30,18 @@ flush-first rule), ``{"cmd": "quit"}`` (or EOF) closes that connection
 once its outstanding responses have flushed, ``{"cmd": "shutdown"}``
 drains the pipeline and stops the whole server — scripts/drive_check.py
 uses it to exercise the transport end to end without a relay.
+
+Failure behavior (PR 10, the fault plane): a client that disconnects —
+cleanly or mid-flight with responses outstanding — costs exactly its
+own work: the dispatcher finishes any batch its rows already share
+(other requests in that batch still need the answer), the orphaned
+responses are dropped at delivery (``_Conn.closed``), and every other
+connection is untouched.  Engine failures never reach this layer as
+exceptions: the :class:`~harp_tpu.serve.server.ContinuousRunner`
+isolates them into per-request structured error responses, so the
+dispatcher thread — and with it the whole server — survives any batch
+crashing (plus shedding/deadlines via the ``deadline_s`` /
+``max_queue_rows`` knobs it forwards).
 """
 
 from __future__ import annotations
@@ -57,6 +69,7 @@ class _Conn:
         self.q: asyncio.Queue = asyncio.Queue()
         self.outstanding = 0
         self.draining = False
+        self.closed = False  # writer gone: drop orphaned responses
         self.seq = 0
 
 
@@ -67,11 +80,16 @@ class TCPFrontEnd:
 
     def __init__(self, server: Server, host: str = "127.0.0.1",
                  port: int = 0, *, max_queue_delay_s: float = 0.005,
-                 rung_policy: str = "adaptive", depth: int = 2):
+                 rung_policy: str = "adaptive", depth: int = 2,
+                 deadline_s: float | None = None,
+                 max_queue_rows: int | None = None, max_retries: int = 2):
         self.srv = server
         self.host, self.port = host, port
         self._knobs = dict(max_queue_delay_s=max_queue_delay_s,
-                           rung_policy=rung_policy, depth=depth)
+                           rung_policy=rung_policy, depth=depth,
+                           deadline_s=deadline_s,
+                           max_queue_rows=max_queue_rows,
+                           max_retries=max_retries)
         self._inq: queue.Queue = queue.Queue()
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()
@@ -114,7 +132,11 @@ class TCPFrontEnd:
         wtask = asyncio.ensure_future(self._write_loop(conn))
         try:
             while not conn.draining:
-                line = await reader.readline()
+                try:
+                    line = await reader.readline()
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        OSError):
+                    break  # peer vanished mid-flight: same as EOF
                 if not line:
                     break
                 line = line.strip()
@@ -143,7 +165,7 @@ class TCPFrontEnd:
                 self._inq.put((conn, conn.seq, req, time.perf_counter()))
         finally:
             conn.draining = True
-            if conn.outstanding == 0:
+            if conn.outstanding == 0 or conn.closed:
                 conn.q.put_nowait(_CLOSE)
             await wtask
             self._conns.discard(conn)
@@ -156,8 +178,9 @@ class TCPFrontEnd:
             conn.writer.write((json.dumps(resp) + "\n").encode())
             try:
                 await conn.writer.drain()
-            except ConnectionError:
-                break
+            except (ConnectionError, OSError):
+                break  # peer gone: remaining responses become orphans
+        conn.closed = True
         try:
             conn.writer.close()
         except Exception:  # noqa: BLE001 - already-gone peer is fine
@@ -165,8 +188,14 @@ class TCPFrontEnd:
 
     def _deliver(self, conn: _Conn, resp: dict,
                  data_response: bool = False) -> None:
-        """Runs on the event loop; per-conn order is the queue order."""
-        conn.q.put_nowait(resp)
+        """Runs on the event loop; per-conn order is the queue order.
+
+        A response for a connection whose writer already closed (client
+        disconnected mid-flight) is DROPPED — the batch that produced it
+        still served every live request in it, and the accounting below
+        still releases the reader so the connection tears down."""
+        if not conn.closed:
+            conn.q.put_nowait(resp)
         if data_response:
             conn.outstanding -= 1
             if conn.draining and conn.outstanding == 0:
@@ -229,13 +258,18 @@ class TCPFrontEnd:
 
 def serve_forever(server: Server, host: str, port: int, *,
                   max_queue_delay_s: float = 0.005,
-                  rung_policy: str = "adaptive") -> None:
+                  rung_policy: str = "adaptive",
+                  deadline_s: float | None = None,
+                  max_queue_rows: int | None = None,
+                  max_retries: int = 2) -> None:
     """CLI entry: serve until a ``{"cmd": "shutdown"}`` line arrives
     (prints one ``serve_listening`` JSON line to stderr with the bound
     port so callers of ``--tcp 0`` can find it)."""
     fe = TCPFrontEnd(server, host, port,
                      max_queue_delay_s=max_queue_delay_s,
-                     rung_policy=rung_policy)
+                     rung_policy=rung_policy, deadline_s=deadline_s,
+                     max_queue_rows=max_queue_rows,
+                     max_retries=max_retries)
 
     async def _main():
         task = asyncio.ensure_future(fe._run())

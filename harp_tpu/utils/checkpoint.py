@@ -7,15 +7,31 @@ framework utility on `orbax-checkpoint`: model pytree + iteration counter,
 atomic directories, keep-last-k, and a ``latest_step``/restore pair that a
 driver's ``--resume`` flag plugs into.  Failure model matches the
 reference (fail-fast, restart from checkpoint; no elasticity).
+
+Crash-mid-write hardening (PR 10): :meth:`CheckpointManager.save` writes
+into a ``tmp.<step>`` staging directory and atomic-renames it into
+``step_<step>`` only once the write completed — a process killed mid-save
+leaves a ``tmp.*`` dir every reader ignores, never a half-written
+``step_*``.  Against checkpoints damaged by OTHER means (a truncated
+copy, a torn filesystem), :meth:`restore_latest` / :meth:`restore` with
+``step=None`` fall back step-by-step to the newest checkpoint that
+actually restores, so one bad directory cannot strand a ``--resume``.
+The write path notifies ``flightrec.notify_ckpt_write`` first, which is
+the fault plane's ``ckpt_write`` injection site: an injected fault there
+models the crash-mid-write this layout exists for.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
+import warnings
 from typing import Any
 
 import jax
 import numpy as np
+
+from harp_tpu.utils import flightrec
 
 
 def _checkpointer():
@@ -36,6 +52,9 @@ class CheckpointManager:
     def _path(self, step: int) -> str:
         return os.path.join(self.root, f"step_{step:012d}")
 
+    def _tmp_path(self, step: int) -> str:
+        return os.path.join(self.root, f"tmp.{step:012d}")
+
     def steps(self) -> list[int]:
         out = []
         for name in os.listdir(self.root):
@@ -51,32 +70,65 @@ class CheckpointManager:
         return s[-1] if s else None
 
     def save(self, step: int, state: Any) -> str:
-        """Write state (any pytree of arrays) for ``step``; prunes old."""
-        path = self._path(step)
+        """Write state (any pytree of arrays) for ``step``; prunes old.
+
+        Crash-atomic: everything lands in ``tmp.<step>`` first and only a
+        completed write is renamed into ``step_<step>`` (one directory-
+        entry swap — atomic on POSIX), so a kill at ANY point during the
+        write leaves either the previous checkpoint set intact or the
+        previous set plus one ignorable ``tmp.*`` (swept on the next
+        save of the same step).
+        """
+        final = self._path(step)
+        tmp = self._tmp_path(step)
+        # the fault plane's ckpt_write site: BEFORE any byte lands, so an
+        # injected fault is exactly the crash-mid-write the tmp-dir
+        # layout must make unobservable
+        flightrec.notify_ckpt_write(final)
+        shutil.rmtree(tmp, ignore_errors=True)  # stale from a crashed save
         # device arrays → host before orbax (works for sharded arrays too);
         # wrap in a dict so bare-array / scalar states are valid orbax trees
         # (the dunder key cannot collide with a user pytree's own keys)
         host_state = {"__harp_state__": jax.tree.map(np.asarray, state)}
-        self._ckptr.save(path, host_state, force=True)
+        self._ckptr.save(tmp, host_state, force=True)
+        if os.path.exists(final):  # force semantics, preserved
+            shutil.rmtree(final, ignore_errors=True)
+        os.rename(tmp, final)
         for old in self.steps()[: -self.keep] if self.keep else []:
-            import shutil
-
             shutil.rmtree(self._path(old), ignore_errors=True)
-        return path
+        return final
 
     def restore_latest(self) -> tuple[int, Any]:
-        """(newest step, state) — the ``harp serve`` load path: a server
-        wants "the newest trained model under this root" without
-        enumerating steps itself.  Raises FileNotFoundError when the
-        root holds no checkpoints (same contract as :meth:`restore`)."""
-        return self.restore(None)
+        """(newest restorable step, state) — the ``harp serve`` load path
+        and every ``--resume``'s entry.  A damaged newest checkpoint
+        (truncated files, missing metadata) is skipped with a warning
+        and the previous step restores instead — one bad directory must
+        not strand a resume.  Raises FileNotFoundError when the root
+        holds no restorable checkpoint at all."""
+        steps = self.steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        last_err: Exception | None = None
+        for step in reversed(steps):
+            try:
+                return self._restore_step(step)
+            except Exception as e:  # noqa: BLE001 - fall back, loudly
+                last_err = e
+                warnings.warn(
+                    f"checkpoint {self._path(step)} failed to restore "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous step", RuntimeWarning, stacklevel=2)
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.root} "
+            f"(newest error: {last_err})")
 
     def restore(self, step: int | None = None) -> tuple[int, Any]:
-        """Restore (step, state); latest if step is None."""
+        """Restore (step, state); latest *restorable* if step is None."""
         if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.root}")
+            return self.restore_latest()
+        return self._restore_step(step)
+
+    def _restore_step(self, step: int) -> tuple[int, Any]:
         tree = self._ckptr.restore(self._path(step))
         if isinstance(tree, dict) and set(tree) == {"__harp_state__"}:
             return step, tree["__harp_state__"]

@@ -7,18 +7,34 @@ capability and improves on "from scratch": fail-fast, then restart from the
 latest orbax checkpoint (:mod:`harp_tpu.utils.checkpoint`), plus an
 explicit fault-injection hook so the recovery path is testable (Harp's
 never was).
+
+Deterministic chaos (PR 10): :class:`FaultInjector` rides the flight
+recorder's observer hooks (:func:`harp_tpu.utils.flightrec.
+observe_dispatches` / ``observe_h2d`` / ``observe_readbacks`` /
+``observe_ckpt_writes`` — the execution paths every driver already
+funnels through) to fail or delay specific sites on a seeded,
+reproducible schedule.  The injector is entirely host-side: it never
+touches a traced program (the jaxpr with an armed-but-quiet injector is
+bit-identical to the uninstrumented one — tested), and while unarmed the
+only cost anywhere is the observer lists' falsy check, so production
+paths pay nothing (the DrJAX rule from PAPERS.md: keep the hooks out of
+the traced hot path).
 """
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Collection
 
 import jax
 import numpy as np
 
 log = logging.getLogger("harp_tpu")
+
+#: the observable injection sites, in the order an epoch loop hits them
+SITES = ("dispatch", "h2d", "readback", "ckpt_write")
 
 
 def check_restored_shapes(named_pairs) -> None:
@@ -67,29 +83,168 @@ def factor_state_io(obj, fields: dict):
     return get_state, set_state
 
 
-class FaultInjector:
-    """Deterministic fault hook for tests — raise at chosen iterations.
+class WorkerFailure(RuntimeError):
+    """A worker died mid-job (Harp: container failure surfaced by YARN)."""
 
-    Install one into a training loop via :func:`run_with_recovery`'s
-    ``fault`` argument or call :meth:`check` manually inside a host loop.
-    Each scheduled iteration fires exactly once (a restarted run that
-    passes the same iteration again does not re-fail), mimicking a
-    transient container loss rather than a deterministic crash loop.
+
+class InjectedFault(WorkerFailure):
+    """A :class:`FaultInjector`-scheduled transient failure.
+
+    Carries the site and the 1-based event ordinal at which it fired, so
+    recovery code can log *which* dispatch/H2D/readback/checkpoint-write
+    died — and retry layers (``serve.ContinuousRunner``) can classify it
+    as transient.  Raised BEFORE the observed operation runs or is
+    counted (see the flightrec observer contract), so an injected fault
+    always models work that never reached the device.
     """
 
-    def __init__(self, fail_at: tuple[int, ...] = ()):
+    def __init__(self, site: str, ordinal: int):
+        super().__init__(f"injected {site} fault (event #{ordinal})")
+        self.site = site
+        self.ordinal = ordinal
+
+
+def _spec_fires(spec, ordinal: int, rng: np.random.Generator) -> bool:
+    """A site schedule is a probability (seeded Bernoulli per event) or a
+    collection of 1-based event ordinals (exact, for pinned tests)."""
+    if spec is None:
+        return False
+    if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+        return bool(rng.random() < spec)
+    return ordinal in spec
+
+
+class FaultInjector:
+    """Deterministic chaos — fail or delay chosen sites on a seeded
+    schedule.
+
+    Two independent surfaces:
+
+    - **iteration schedule** (the PR-0 contract, unchanged): ``fail_at``
+      iterations raise from :meth:`check`, which
+      :func:`run_with_recovery` calls at the top of every step.  Each
+      scheduled iteration fires exactly once (a restarted run that
+      passes the same iteration again does not re-fail), mimicking a
+      transient container loss rather than a deterministic crash loop.
+    - **site schedule** (PR 10): ``fail=`` / ``delay=`` map an
+      observable site (:data:`SITES`: ``dispatch``, ``h2d``,
+      ``readback``, ``ckpt_write``) to either a probability — a seeded
+      Bernoulli draw per event, reproducible given the same event
+      sequence — or a collection of 1-based event ordinals (exact; the
+      kill/resume pin uses ``fail={"dispatch": (4,)}``).  :meth:`arm`
+      registers the injector on the flightrec observer hooks for the
+      scheduled sites; inside the ``with`` block a due event raises
+      :class:`InjectedFault` (``fail``) or sleeps ``delay_s`` seconds
+      (``delay``) before the operation proceeds.
+
+    Determinism note: one seeded generator drives every probabilistic
+    draw in event order, so a schedule replays exactly for the same
+    event sequence — and two runs with the same seed and the same code
+    path fail at the same places.  ``max_faults`` bounds the total
+    injected failures (delays are not bounded), so a chaos bench can
+    guarantee forward progress.  The injector never touches traced
+    programs; disabled/unarmed it costs nothing (tested by jaxpr
+    equality + zero counters, the PR-3 pattern).
+    """
+
+    def __init__(self, fail_at: tuple[int, ...] = (), *, seed: int = 0,
+                 fail: dict[str, float | Collection[int]] | None = None,
+                 delay: dict[str, float | Collection[int]] | None = None,
+                 delay_s: float = 0.001, max_faults: int | None = None):
         self.pending = set(fail_at)
         self.fired: list[int] = []
+        for sched in (fail, delay):
+            for site in sched or ():
+                if site not in SITES:
+                    raise ValueError(
+                        f"unknown fault site {site!r} (sites: {SITES})")
+        self.fail = dict(fail or {})
+        self.delay = dict(delay or {})
+        self.delay_s = float(delay_s)
+        self.max_faults = max_faults
+        self._rng = np.random.default_rng(seed)
+        self.seen = {s: 0 for s in SITES}
+        self.injected = {s: 0 for s in SITES}
+        self.delayed = {s: 0 for s in SITES}
+        self.events: list[tuple[str, int]] = []  # (site, ordinal) fired
 
+    # -- iteration schedule (legacy surface) -------------------------------
     def check(self, iteration: int) -> None:
         if iteration in self.pending:
             self.pending.discard(iteration)
             self.fired.append(iteration)
             raise WorkerFailure(f"injected fault at iteration {iteration}")
 
+    # -- site schedule -----------------------------------------------------
+    def on_event(self, site: str) -> None:
+        """One observed event at ``site``; raises/sleeps when due."""
+        self.seen[site] += 1
+        n = self.seen[site]
+        if _spec_fires(self.delay.get(site), n, self._rng):
+            self.delayed[site] += 1
+            time.sleep(self.delay_s)
+        if (self.max_faults is not None
+                and sum(self.injected.values()) >= self.max_faults):
+            return
+        if _spec_fires(self.fail.get(site), n, self._rng):
+            self.injected[site] += 1
+            self.events.append((site, n))
+            raise InjectedFault(site, n)
 
-class WorkerFailure(RuntimeError):
-    """A worker died mid-job (Harp: container failure surfaced by YARN)."""
+    @contextlib.contextmanager
+    def arm(self):
+        """Attach to the flightrec observer hooks for the scheduled
+        sites (only those — an unscheduled site keeps its empty observer
+        list and stays cost-free)."""
+        from harp_tpu.utils import flightrec
+
+        hooks = {
+            "dispatch": lambda: flightrec.observe_dispatches(
+                lambda label: self.on_event("dispatch")),
+            "h2d": lambda: flightrec.observe_h2d(
+                lambda nbytes, site: self.on_event("h2d")),
+            "readback": lambda: flightrec.observe_readbacks(
+                lambda x: self.on_event("readback")),
+            "ckpt_write": lambda: flightrec.observe_ckpt_writes(
+                lambda path: self.on_event("ckpt_write")),
+        }
+        active = {s for s in SITES
+                  if s in self.fail or s in self.delay}
+        with contextlib.ExitStack() as stack:
+            for site in active:
+                stack.enter_context(hooks[site]())
+            yield self
+
+    def counters(self) -> dict:
+        """Per-site accounting for bench rows / assertions."""
+        return {"seen": dict(self.seen), "injected": dict(self.injected),
+                "delayed": dict(self.delayed)}
+
+
+def resolve_resume(ckpt_dir: str | None, resume: bool) -> int | None:
+    """The driver CLIs' ``--resume`` contract (kmeans/mfsgd/lda share it).
+
+    A rerun pointing at a populated ``--ckpt-dir`` always resumes (the
+    recovery loop restores whatever is newest); ``--resume`` makes that
+    intent CHECKED: it requires ``--ckpt-dir`` and at least one saved
+    checkpoint, so a mistyped directory fails loudly instead of silently
+    training a fresh model from epoch 0.  Returns the step that will be
+    resumed from (None without ``--resume``); raises SystemExit with an
+    actionable message otherwise.
+    """
+    if not resume:
+        return None
+    if not ckpt_dir:
+        raise SystemExit(
+            "--resume requires --ckpt-dir (it names the run to resume)")
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    latest = CheckpointManager(ckpt_dir).latest_step()
+    if latest is None:
+        raise SystemExit(
+            f"--resume: no checkpoints under {ckpt_dir} — nothing to "
+            "resume from (drop --resume to start a fresh run there)")
+    return latest
 
 
 def fit_epochs(

@@ -233,6 +233,9 @@ def reset() -> None:
 
 def record_h2d(nbytes: int, site: str | None = None) -> None:
     """Hook for host→device placement entry points (mesh.shard_array)."""
+    if _H2D_OBSERVERS:
+        for cb in tuple(_H2D_OBSERVERS):
+            cb(nbytes, site)
     if telemetry.enabled():
         transfers.record_h2d(nbytes, site)
 
@@ -249,24 +252,66 @@ def record_bucket(nbytes: int, site: str | None = None) -> None:
         transfers.record_bucket(nbytes, site)
 
 
-# Readback observers: the commgraph donation audit (HL303) watches the
-# counted D2H path to catch a host re-read of a donated device buffer.
-# The list is empty in every un-audited run, so the cost is one falsy
-# check per readback; observers see the ORIGINAL argument (the device
-# array), before np.asarray materializes it.
+# Observer hooks: audit/chaos layers watch the instrumented execution
+# paths without riding the telemetry enable switch — the commgraph
+# donation audit (HL303) watches readbacks to catch a host re-read of a
+# donated buffer, and the fault plane (utils.fault.FaultInjector, PR 10)
+# rides all four to fail/delay dispatch, H2D, readback, and
+# checkpoint-write sites on a seeded schedule.  Every list is empty in an
+# un-observed run, so the hot-path cost is one falsy check per event;
+# observers see the ORIGINAL arguments (e.g. the device array, before
+# np.asarray materializes it) and may raise — a raising observer aborts
+# the observed operation BEFORE it is counted or performed, modeling a
+# transient failure in flight.
 _READBACK_OBSERVERS: list[Callable[[Any], None]] = []
+_DISPATCH_OBSERVERS: list[Callable[[str], None]] = []
+_H2D_OBSERVERS: list[Callable[[int, Any], None]] = []
+_CKPT_WRITE_OBSERVERS: list[Callable[[str], None]] = []
 
 
 @contextlib.contextmanager
+def _observe(registry: list, cb: Callable):
+    registry.append(cb)
+    try:
+        yield
+    finally:
+        registry.remove(cb)
+
+
 def observe_readbacks(cb: Callable[[Any], None]):
     """Register ``cb`` to see every :func:`readback` argument within the
     block (the donation audit's hook; independent of the telemetry
     enable switch — an audit must see reads even with telemetry off)."""
-    _READBACK_OBSERVERS.append(cb)
-    try:
-        yield
-    finally:
-        _READBACK_OBSERVERS.remove(cb)
+    return _observe(_READBACK_OBSERVERS, cb)
+
+
+def observe_dispatches(cb: Callable[[str], None]):
+    """``cb(label)`` before every :func:`track`-wrapped dispatch — fired
+    BEFORE the dispatch is counted or launched, so a raising observer
+    models a dispatch that never reached the device (the counters stay
+    exact: only launched dispatches count)."""
+    return _observe(_DISPATCH_OBSERVERS, cb)
+
+
+def observe_h2d(cb: Callable[[int, Any], None]):
+    """``cb(nbytes, site)`` before every counted host→device placement
+    (``mesh.shard_array``/``shard_array_local``)."""
+    return _observe(_H2D_OBSERVERS, cb)
+
+
+def observe_ckpt_writes(cb: Callable[[str], None]):
+    """``cb(path)`` at the START of every ``CheckpointManager.save`` —
+    before any byte lands on disk, so a raising observer models a crash
+    mid-write (the atomic tmp-dir rename must make that unobservable to
+    readers)."""
+    return _observe(_CKPT_WRITE_OBSERVERS, cb)
+
+
+def notify_ckpt_write(path: str) -> None:
+    """Hook for checkpoint-write entry points (checkpoint.save)."""
+    if _CKPT_WRITE_OBSERVERS:
+        for cb in tuple(_CKPT_WRITE_OBSERVERS):
+            cb(path)
 
 
 def readback(x: Any):
@@ -296,6 +341,9 @@ class _Tracked:
         self._label = label
 
     def __call__(self, *args, **kw):
+        if _DISPATCH_OBSERVERS:  # BEFORE counting: a raising observer
+            for cb in tuple(_DISPATCH_OBSERVERS):  # models a dispatch
+                cb(self._label)                    # that never launched
         if telemetry.enabled():
             transfers.record_dispatch(self._label)
         return self.__wrapped__(*args, **kw)

@@ -91,6 +91,19 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    negative rate means the instrument block never ran, and such a row
    grading the ingest fast path would certify a measurement that did
    not happen.
+
+9. **Degraded-mode serve rows balance their books** (any file): a serve
+   row carrying the fault-plane fields (``serve.bench.
+   benchmark_sustained`` under shedding/deadlines/chaos, PR 10 —
+   recognizable by any of ``shed_frac`` / ``deadline_miss_frac`` /
+   ``fault_retries`` / ``shed_requests``) must carry ALL of them
+   coherently: ``shed_frac`` and ``deadline_miss_frac`` in [0, 1],
+   ``fault_retries`` a non-negative integer, and the request ledger
+   exact — ``served_requests + shed_requests + failed_requests ==
+   offered_requests`` (every offered request came back as exactly one
+   of served / structured-shed / hard-failed; a row where requests
+   vanish is not degradation evidence, it is a dead server wearing a
+   qps number).
 """
 
 from __future__ import annotations
@@ -355,6 +368,8 @@ def _check_serve_row(name: str, i: int, row: dict) -> list[str]:
     if ("offered_qps" in row or "achieved_qps" in row
             or row.get("mode") == "sustained"):
         errs += _check_sustained_serve_row(name, i, row)
+    if any(k in row for k in DEGRADED_TRIGGER_FIELDS):
+        errs += _check_degraded_serve_row(name, i, row)
     return errs
 
 
@@ -382,6 +397,47 @@ def _check_sustained_serve_row(name: str, i: int, row: dict) -> list[str]:
                 f"{name}:{i}: sustained serve row {k}={v!r} must be a "
                 "non-negative number — queue-depth evidence is what "
                 "grades the padding-vs-latency knobs")
+    return errs
+
+
+DEGRADED_TRIGGER_FIELDS = ("shed_frac", "deadline_miss_frac",
+                           "fault_retries", "shed_requests")
+DEGRADED_FRAC_FIELDS = ("shed_frac", "deadline_miss_frac")
+DEGRADED_COUNT_FIELDS = ("offered_requests", "served_requests",
+                         "shed_requests", "failed_requests",
+                         "fault_retries")
+
+
+def _check_degraded_serve_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 9: fault-plane serve rows must balance their books."""
+    errs: list[str] = []
+    for k in DEGRADED_FRAC_FIELDS:
+        v = row.get(k)
+        if not _num(v) or not 0.0 <= v <= 1.0:
+            errs.append(
+                f"{name}:{i}: degraded serve row {k}={v!r} must lie in "
+                "[0, 1] — it is a fraction of offered requests")
+    counts = {}
+    for k in DEGRADED_COUNT_FIELDS:
+        v = row.get(k)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(
+                f"{name}:{i}: degraded serve row {k}={v!r} must be a "
+                "non-negative integer")
+        else:
+            counts[k] = v
+    if all(k in counts for k in ("offered_requests", "served_requests",
+                                 "shed_requests", "failed_requests")):
+        total = (counts["served_requests"] + counts["shed_requests"]
+                 + counts["failed_requests"])
+        if total != counts["offered_requests"]:
+            errs.append(
+                f"{name}:{i}: degraded serve row served "
+                f"{counts['served_requests']} + shed "
+                f"{counts['shed_requests']} + failed "
+                f"{counts['failed_requests']} = {total} != offered "
+                f"{counts['offered_requests']} — every offered request "
+                "must come back as exactly one of the three")
     return errs
 
 

@@ -1697,3 +1697,72 @@ print(f"commgraph: clean epoch sheet {_cg_expect} B/shard x{_cg_iters} "
       f"{len(_cg_DRIVERS)} driver sheets clean through the CLI + "
       "invariant 6 both ways")
 print(f"DRIVE OK round-29 ({mode})")
+
+# 30. the fault plane (PR 10): deterministic chaos + kill/resume +
+# degraded serving, end to end over the public surface
+import tempfile as _fp_tmp
+
+from harp_tpu.models import mfsgd as _fp_MF
+from harp_tpu.serve.bench import benchmark_sustained as _fp_sustained
+from harp_tpu.utils.checkpoint import CheckpointManager as _fp_CM
+from harp_tpu.utils.fault import FaultInjector as _fp_FI
+from harp_tpu.utils.fault import InjectedFault as _fp_IF
+
+with _fp_tmp.TemporaryDirectory() as _fp_dir:
+    _fp_rng = np.random.default_rng(0)
+    _fp_u = _fp_rng.integers(0, 32, 400).astype(np.int32)
+    _fp_i = _fp_rng.integers(0, 24, 400).astype(np.int32)
+    _fp_v = _fp_rng.normal(size=400).astype(np.float32)
+
+    def _fp_model():
+        m = _fp_MF.MFSGD(32, 24, _fp_MF.MFSGDConfig(
+            rank=4, algo="dense", u_tile=8, i_tile=8, entry_cap=32),
+            mesh=mesh)
+        m.set_ratings(_fp_u, _fp_i, _fp_v)
+        return m
+
+    _fp_clean = _fp_model()
+    _fp_clean.fit(6)
+    _fp_ck = os.path.join(_fp_dir, "kill")
+    _fp_crash = _fp_model()
+    _fp_inj = _fp_FI(seed=7, fail={"dispatch": (4,)})
+    try:
+        with _fp_inj.arm():
+            _fp_crash.fit(6, _fp_ck, ckpt_every=2, max_restarts=0)
+        raise AssertionError("injector never fired")
+    except _fp_IF:
+        pass
+    assert _fp_CM(_fp_ck).latest_step() == 1
+    _fp_res = _fp_model()
+    _fp_res.fit(6, _fp_ck, ckpt_every=2)
+    np.testing.assert_array_equal(np.asarray(_fp_res.W),
+                                  np.asarray(_fp_clean.W))
+    np.testing.assert_array_equal(np.asarray(_fp_res.H),
+                                  np.asarray(_fp_clean.H))
+
+# degraded sustained serving under seeded ~1% dispatch chaos: books
+# balance, row passes invariants 7 + 9 both ways
+import check_jsonl as _fp_cj  # scripts/ already on sys.path for round 22
+
+_fp_row = _fp_sustained(
+    app="kmeans", n_requests=96, rows_per_request=1, burst_admit=8,
+    ladder=(1, 8, 32), state_shape={"k": 8, "d": 16},
+    fault_rate=0.01, fault_seed=34, deadline_ms=10_000.0,
+    max_queue_rows=4096, max_retries=3)
+assert _fp_row["faults_injected"] >= 1 and _fp_row["fault_retries"] >= 1
+assert (_fp_row["served_requests"] + _fp_row["shed_requests"]
+        + _fp_row["failed_requests"]) == _fp_row["offered_requests"] == 96
+assert _fp_row["steady_compiles"] == 0
+_fp_stamped = {**_fp_row, "backend": "cpu", "date": "2026-08-04",
+               "commit": "drive"}
+assert _fp_cj._check_serve_row("drive", 1, _fp_stamped) == []
+assert any("exactly one of the three" in e for e in _fp_cj._check_serve_row(
+    "drive", 1, {**_fp_stamped,
+                 "shed_requests": _fp_stamped["shed_requests"] + 1}))
+
+print(f"fault plane: injector-killed mfsgd resumed bit-identical from "
+      f"step 1; degraded sustained row balanced "
+      f"({_fp_row['served_requests']} served / {_fp_row['shed_requests']} "
+      f"shed / {_fp_row['failed_requests']} failed of 96, "
+      f"{_fp_row['fault_retries']} retries) through invariant 9 both ways")
+print(f"DRIVE OK round-30 ({mode})")

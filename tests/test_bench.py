@@ -235,6 +235,94 @@ def test_bench_record_carries_flip_state(mesh):
     assert 0 <= fs["flips_authorized"] <= fs["decided"]
 
 
+def test_bench_per_config_watchdog_parses_and_bounds(mesh):
+    """Satellite (PR 10): --max-seconds-per-config=S parses strictly and
+    the subprocess-free timer skips a hung thunk after ~S seconds (the
+    thread is abandoned; the sweep moves on) while fast thunks and their
+    exceptions pass through untouched."""
+    import threading
+    import time
+
+    import pytest
+
+    b = _load_bench()
+    assert b._parse_max_seconds(["--smoke"]) is None
+    assert b._parse_max_seconds(["--max-seconds-per-config=2.5"]) == 2.5
+    for bad in (["--max-seconds-per-config"],       # no '=' form
+                ["--max-seconds-per-config=nope"],  # non-numeric
+                ["--max-seconds-per-config=0"]):    # non-positive
+        with pytest.raises(SystemExit):
+            b._parse_max_seconds(bad)
+
+    # fast thunk: result passes through, no error
+    res, err = b._run_with_timeout(lambda: {"v": 7}, 30.0)
+    assert res == {"v": 7} and err is None
+    # no timer requested: straight call
+    assert b._run_with_timeout(lambda: 3, None) == (3, None)
+    # thunk exceptions re-raise for the existing per-config handling
+    with pytest.raises(ValueError, match="boom"):
+        b._run_with_timeout(lambda: (_ for _ in ()).throw(
+            ValueError("boom")), 30.0)
+
+    # hung thunk: warn-and-skip within the bound, not forever
+    release = threading.Event()
+
+    def hang():
+        release.wait(60)
+        return "too late"
+
+    t0 = time.monotonic()
+    res, err = b._run_with_timeout(hang, 0.2)
+    took = time.monotonic() - t0
+    release.set()  # let the abandoned worker die promptly
+    assert res is None
+    assert "timeout" in err and "0.2" in err
+    assert took < 5  # bounded: nowhere near the 60 s hang
+
+
+def test_bench_timed_out_config_is_recorded_and_skipped(mesh):
+    """End to end: a config that overruns --max-seconds-per-config shows
+    up in the record as an error submetric (the timeout string), and the
+    sweep still measures the configs after it."""
+    import threading
+
+    b = _load_bench()
+    release = threading.Event()
+    real = b._configs
+
+    def patched(smoke):
+        cfgs = real(smoke)
+        out = []
+        for name, unit, key, thunk in cfgs:
+            if name == "kmeans":
+                out.append((name, unit, key,
+                            lambda: release.wait(60) or {"iters_per_sec":
+                                                         1.0}))
+            elif name == "subgraph":  # fast fake: the sweep-continues pin
+                out.append((name, unit, key,
+                            lambda: {"vertices_per_sec": 123.0}))
+            else:
+                out.append((name, unit, key, thunk))
+        return out
+
+    b._configs = patched
+    old = sys.argv
+    sys.argv = ["bench.py", "--smoke", "--cpu", "kmeans", "subgraph",
+                "--max-seconds-per-config=0.5"]
+    buf = io.StringIO()
+    try:
+        with redirect_stdout(buf):
+            b.main()
+    finally:
+        sys.argv = old
+        release.set()
+    rec = json.loads(buf.getvalue())
+    assert "timeout" in rec["error"]  # surfaced on the headline (kmeans)
+    # the timed-out config reads 0.0; the config AFTER it still measured
+    assert rec["value"] == 0.0
+    assert rec["submetrics"]["subgraph"]["value"] > 0
+
+
 def test_flip_state_tolerates_truncated_tee_lines(tmp_path):
     # a sprint killed mid-write leaves a truncated last line; the summary
     # must count the valid rows, not vanish (review finding, round 5)

@@ -386,6 +386,43 @@ def test_sustained_serve_row_invariants(tmp_path):
     assert ":6:" in errors[4] and "offered" in errors[4]
 
 
+def test_degraded_serve_row_invariants(tmp_path):
+    """Invariant 9: fault-plane serve rows (PR 10) must balance their
+    books — fractions in [0, 1], fault_retries a non-negative integer,
+    and served + shed + failed == offered.  A row where requests vanish
+    is not degradation evidence."""
+    stamp = {"backend": "cpu", "date": "2026-08-04", "commit": "abc1234"}
+    base = {"kind": "serve", "app": "kmeans", "qps": 100.0,
+            "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+            "steady_compiles": 0, **stamp}
+    deg = {"offered_requests": 100, "served_requests": 95,
+           "shed_requests": 4, "failed_requests": 1,
+           "shed_frac": 0.04, "deadline_miss_frac": 0.02,
+           "fault_retries": 3}
+    rows = [
+        {**base, **deg},                                     # fine
+        {**base, **deg, "shed_frac": 1.5},                   # frac > 1
+        {**base, **deg, "deadline_miss_frac": -0.1},         # frac < 0
+        {**base, **deg, "fault_retries": -2},                # negative
+        {**base, **deg, "served_requests": 90},              # unbalanced
+        {**base, "shed_frac": 0.0},                          # partial row
+        {**base, **deg, "fault_retries": 2.5},               # non-integer
+    ]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    errors = check_jsonl.check_file(str(p))
+    assert [e.split(":")[1] for e in errors] == ["2", "3", "4", "5",
+                                                 "6", "6", "6", "6",
+                                                 "6", "6", "7"]
+    assert "shed_frac" in errors[0] and "[0, 1]" in errors[0]
+    assert "deadline_miss_frac" in errors[1]
+    assert "fault_retries" in errors[2]
+    assert "exactly one of the three" in errors[3]
+    # a partial degraded row is missing EVERY other book-keeping field
+    assert sum("must" in e for e in errors[4:10]) == 6
+    assert "fault_retries=2.5" in errors[10]
+
+
 def test_sustained_bench_row_satisfies_the_checker(tmp_path, mesh):
     """Round-trip: benchmark_sustained through benchmark_json must pass
     the extended invariant 7 as-is in a bench file."""

@@ -144,6 +144,88 @@ def test_dispatch_lda_ckpt_resume(capsys, tmp_path, monkeypatch):
     assert first == second  # and the restored chain state is identical
 
 
+def test_dispatch_kmeans_ckpt_resume_cli(capsys, tmp_path):
+    """kmeans grows the driver --ckpt-dir/--ckpt-every/--resume wiring
+    (PR 10): a run checkpoints in chunks; a rerun with --resume picks up
+    the finished run (nothing re-runs) and reports the SAME inertia —
+    and the continuation across a 'process restart' is bit-identical to
+    an uninterrupted run in a fresh dir."""
+    import json
+
+    import numpy as np
+
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    args = ["kmeans", "--n", "256", "--d", "8", "--k", "4", "--iters",
+            "6", "--ckpt-every", "2"]
+    a = str(tmp_path / "a")
+    assert cli.main(args + ["--ckpt-dir", a]) == 0
+    row1 = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert row1["resumed_from"] is None
+    mgr = CheckpointManager(a)
+    assert mgr.latest_step() == 2  # 3 chunks of 2 iterations
+
+    assert cli.main(args + ["--ckpt-dir", a, "--resume"]) == 0
+    row2 = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert row2["resumed_from"] == 2
+    assert row2["inertia"] == row1["inertia"]
+    _, s1 = mgr.restore_latest()
+    assert np.asarray(s1["centroids"]).shape == (4, 8)
+    assert np.isfinite(np.asarray(s1["centroids"])).all()
+
+
+def test_resume_flag_contract_across_drivers(tmp_path):
+    """--resume without --ckpt-dir, or against an empty dir, fails
+    loudly on every driver that grew it (a mistyped dir must not
+    silently retrain from epoch 0)."""
+    import pytest
+
+    for argv in (
+        ["kmeans", "--resume"],
+        ["mfsgd", "--resume", "--epochs", "1"],
+        ["lda", "--resume", "--epochs", "1"],
+    ):
+        with pytest.raises(SystemExit, match="requires --ckpt-dir"):
+            cli.main(argv)
+    empty = str(tmp_path / "nothing-here")
+    with pytest.raises(SystemExit, match="no checkpoints"):
+        cli.main(["mfsgd", "--resume", "--ckpt-dir", empty,
+                  "--epochs", "1"])
+
+
+def test_dispatch_mfsgd_resume_cli_bit_identical(capsys, tmp_path):
+    """mfsgd --resume end to end: train 2 of 4 epochs, then finish the
+    run under --resume from a fresh driver; the final checkpointed
+    factors are BIT-identical to one uninterrupted 4-epoch run."""
+    import json
+
+    import numpy as np
+
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    base = ["mfsgd", "--users", "32", "--items", "24", "--nnz", "300",
+            "--rank", "4", "--algo", "dense", "--u-tile", "8",
+            "--i-tile", "8", "--entry-cap", "32", "--ckpt-every", "2"]
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    assert cli.main(base + ["--epochs", "4", "--ckpt-dir", a]) == 0
+    capsys.readouterr()
+
+    assert cli.main(base + ["--epochs", "2", "--ckpt-dir", b]) == 0
+    capsys.readouterr()
+    assert cli.main(base + ["--epochs", "4", "--ckpt-dir", b,
+                            "--resume"]) == 0
+    row = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert row["resumed_from"] == 1  # epochs 0-1 were already done
+    assert row["epochs_run"] == 2    # only 2-3 ran under --resume
+
+    _, sa = CheckpointManager(a).restore_latest()
+    _, sb = CheckpointManager(b).restore_latest()
+    np.testing.assert_array_equal(np.asarray(sa["W"]),
+                                  np.asarray(sb["W"]))
+    np.testing.assert_array_equal(np.asarray(sa["H"]),
+                                  np.asarray(sb["H"]))
+
+
 def test_dispatch_file_inputs(capsys, tmp_path):
     """kmeans/mfsgd/lda consume input files like the Harp apps' HDFS paths."""
     import numpy as np

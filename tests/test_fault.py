@@ -1,11 +1,22 @@
-"""Fault-injection / recovery / sanitizer tests (SURVEY.md §6)."""
+"""Fault-injection / recovery / sanitizer tests (SURVEY.md §6).
+
+PR 10 adds the fault plane proper: the seeded site-schedule injector on
+the flightrec observer hooks (deterministic chaos), the crash-atomic
+checkpoint layout with damaged-checkpoint fallback, and the pinned
+kill/resume contract — an injector-killed epoch loop, restarted from the
+latest checkpoint, reproduces the uninterrupted run's final params
+bit-identically.
+"""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from harp_tpu.utils import flightrec, telemetry
 from harp_tpu.utils.checkpoint import CheckpointManager
-from harp_tpu.utils.fault import FaultInjector, WorkerFailure, run_with_recovery
+from harp_tpu.utils.fault import (FaultInjector, InjectedFault,
+                                  WorkerFailure, resolve_resume,
+                                  run_with_recovery)
 from harp_tpu.utils.check import assert_finite, checked_jit
 
 
@@ -137,6 +148,280 @@ def test_fault_injector_fires_once():
         fi.check(3)
     fi.check(3)  # transient: second pass over the same iteration is clean
     assert fi.fired == [3]
+
+
+# ---------------------------------------------------------------------------
+# Seeded site-schedule chaos (PR 10)
+# ---------------------------------------------------------------------------
+
+def _drive_site(inj, site, n):
+    """Feed ``n`` events into one site, collecting fired ordinals."""
+    fired = []
+    for _ in range(n):
+        try:
+            inj.on_event(site)
+        except InjectedFault as e:
+            fired.append(e.ordinal)
+    return fired
+
+
+def test_injector_seeded_schedule_is_reproducible():
+    """Same seed + same event sequence → the same faults, exactly."""
+    a = _drive_site(FaultInjector(seed=11, fail={"dispatch": 0.3}),
+                    "dispatch", 50)
+    b = _drive_site(FaultInjector(seed=11, fail={"dispatch": 0.3}),
+                    "dispatch", 50)
+    c = _drive_site(FaultInjector(seed=12, fail={"dispatch": 0.3}),
+                    "dispatch", 50)
+    assert a == b
+    assert 0 < len(a) < 50  # a rate schedule fails some, not all
+    assert a != c  # and the seed is what pins it
+
+
+def test_injector_ordinal_schedule_and_counters():
+    inj = FaultInjector(fail={"readback": (2, 4)})
+    assert _drive_site(inj, "readback", 5) == [2, 4]
+    assert inj.seen["readback"] == 5
+    assert inj.injected["readback"] == 2
+    assert inj.events == [("readback", 2), ("readback", 4)]
+    assert inj.counters()["injected"]["dispatch"] == 0
+
+
+def test_injector_max_faults_bounds_total():
+    inj = FaultInjector(fail={"dispatch": 1.0}, max_faults=3)
+    assert _drive_site(inj, "dispatch", 10) == [1, 2, 3]
+
+
+def test_injector_delay_schedule_counts():
+    inj = FaultInjector(delay={"h2d": (1,)}, delay_s=0.0)
+    inj.on_event("h2d")
+    inj.on_event("h2d")
+    assert inj.delayed["h2d"] == 1
+    assert inj.injected["h2d"] == 0  # delays never raise
+
+
+def test_injector_rejects_unknown_site():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultInjector(fail={"dispacth": 0.1})
+
+
+def test_injector_armed_kills_tracked_dispatch():
+    """Armed on the dispatch site, the injector fails the scheduled
+    tracked invocation BEFORE it launches (the wrapped fn never runs)
+    and leaves the dispatch counter exact: failed attempts don't count."""
+    calls = []
+    fn = flightrec.track(lambda x: calls.append(x) or x + 1, "t")
+    inj = FaultInjector(fail={"dispatch": (2,)})
+    with telemetry.scope(True):
+        with inj.arm():
+            assert fn(1) == 2
+            with pytest.raises(InjectedFault, match="dispatch"):
+                fn(10)
+            assert fn(2) == 3
+        assert flightrec.transfers.dispatches == 2  # the launched ones
+    assert calls == [1, 2]  # the killed attempt never reached the fn
+
+
+def test_injector_ckpt_write_site_crashes_mid_save(tmp_path):
+    """An injected ckpt_write fault models crash-mid-write: the save
+    dies BEFORE any byte lands, so the checkpoint set on disk is exactly
+    the pre-crash one (atomicity makes the crash unobservable)."""
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(0, {"x": np.arange(3.0)})
+    inj = FaultInjector(fail={"ckpt_write": (1,)})
+    with inj.arm():
+        with pytest.raises(InjectedFault, match="ckpt_write"):
+            mgr.save(1, {"x": np.arange(3.0) + 1})
+    assert mgr.steps() == [0]  # no partial step_1 appeared
+    step, state = mgr.restore_latest()
+    assert step == 0
+    np.testing.assert_array_equal(state["x"], np.arange(3.0))
+
+
+def test_injector_disabled_is_zero_cost(mesh):
+    """The PR-3 zero-cost contract, for the injector: an armed-but-empty
+    injector changes NOTHING — the traced epoch program is bit-identical
+    (jaxpr equality), the numeric result identical, no observer remains
+    registered afterwards, and an unarmed injector costs literally one
+    falsy check (the observer lists stay empty)."""
+    import jax
+
+    import harp_tpu.models.mfsgd as MF
+
+    def build_and_run():
+        cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                             entry_cap=32)
+        m = MF.MFSGD(64, 48, cfg, mesh, seed=3)
+        u, i, v = MF.synthetic_ratings(64, 48, 600, rank=4, seed=3)
+        m.set_ratings(u, i, v)
+        rmse = m.train_epoch()
+        jaxpr = str(jax.make_jaxpr(m._epoch_fn.__wrapped__)(
+            m.W, m.H, *m._blocks))
+        return rmse, jaxpr
+
+    rmse_off, jaxpr_off = build_and_run()
+    inj = FaultInjector(seed=0)  # no schedules: arm registers nothing
+    with inj.arm():
+        assert not flightrec._DISPATCH_OBSERVERS
+        assert not flightrec._H2D_OBSERVERS
+        assert not flightrec._CKPT_WRITE_OBSERVERS
+        rmse_on, jaxpr_on = build_and_run()
+    assert rmse_on == rmse_off
+    assert jaxpr_on == jaxpr_off
+    assert sum(inj.seen.values()) == 0
+    # a SCHEDULED site registers only itself, and unregisters on exit
+    with FaultInjector(fail={"dispatch": (99,)}).arm():
+        assert len(flightrec._DISPATCH_OBSERVERS) == 1
+        assert not flightrec._READBACK_OBSERVERS
+    assert not flightrec._DISPATCH_OBSERVERS
+
+
+# ---------------------------------------------------------------------------
+# The pinned kill/resume contract (PR 10 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_mfsgd_injector_kill_then_resume_is_bit_identical(mesh, tmp_path):
+    """THE acceptance pin: a seeded FaultInjector kills the mfsgd epoch
+    loop mid-run (max_restarts=0 — a process death, not an in-process
+    recovery); a FRESH driver pointing at the same checkpoint dir (the
+    CLI ``--resume`` path) completes the run, and the final factors are
+    BIT-identical to the uninterrupted run's — not rtol-close: the
+    checkpoint round trip is exact and the replayed epochs are the same
+    compiled program over the same operands."""
+    from harp_tpu.models import mfsgd as MF
+
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 32, 400).astype(np.int32)
+    i = rng.integers(0, 24, 400).astype(np.int32)
+    v = rng.normal(size=400).astype(np.float32)
+
+    def make_model():
+        m = MF.MFSGD(32, 24, MF.MFSGDConfig(rank=4, algo="dense", u_tile=8,
+                                            i_tile=8, entry_cap=32),
+                     mesh=mesh)
+        m.set_ratings(u, i, v)
+        return m
+
+    clean = make_model()
+    clean.fit(6)  # the uninterrupted reference
+
+    ckpt = str(tmp_path / "kill")
+    crashed = make_model()
+    # epoch dispatches are the only tracked dispatches inside fit();
+    # ordinal 4 = epoch index 3, after the ckpt_every=2 save at epoch 1
+    inj = FaultInjector(seed=7, fail={"dispatch": (4,)})
+    with inj.arm():
+        with pytest.raises(InjectedFault, match="dispatch"):
+            crashed.fit(6, ckpt, ckpt_every=2, max_restarts=0)
+    assert inj.injected["dispatch"] == 1
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == 1  # epochs 0-1 checkpointed, 2 ran, 3 died
+
+    resumed = make_model()  # fresh driver == restarted process
+    assert resolve_resume(ckpt, True) == 1  # the CLI --resume gate
+    resumed.fit(6, ckpt, ckpt_every=2)
+    np.testing.assert_array_equal(np.asarray(resumed.W),
+                                  np.asarray(clean.W))
+    np.testing.assert_array_equal(np.asarray(resumed.H),
+                                  np.asarray(clean.H))
+
+
+def test_kmeans_fit_ckpt_crash_resume_bit_identical(mesh, tmp_path):
+    """kmeans grows the same driver contract (PR 10): the chunked ckpt
+    path resumes a killed run bit-identically to its own uninterrupted
+    twin, and reports the final inertia even when the resume has no
+    chunks left to run."""
+    from harp_tpu.models import kmeans as KM
+
+    rng = np.random.default_rng(5)
+    pts = rng.normal(size=(128, 6)).astype(np.float32)
+
+    c_clean, in_clean = KM.fit(pts, k=4, iters=6, mesh=mesh, seed=0,
+                               ckpt_dir=str(tmp_path / "clean"),
+                               ckpt_every=2)
+    crashed_dir = str(tmp_path / "crash")
+    with pytest.raises(WorkerFailure):
+        # chunk index 2 (iterations 4-5) dies; chunks 0-1 checkpointed;
+        # max_restarts=0 = the process is gone
+        KM.fit(pts, k=4, iters=6, mesh=mesh, seed=0, ckpt_dir=crashed_dir,
+               ckpt_every=2, max_restarts=0,
+               fault=FaultInjector(fail_at=(2,)))
+    assert CheckpointManager(crashed_dir).latest_step() == 1
+
+    c_res, in_res = KM.fit(pts, k=4, iters=6, mesh=mesh, seed=0,
+                           ckpt_dir=crashed_dir, ckpt_every=2)
+    np.testing.assert_array_equal(c_res, c_clean)
+    assert in_res == in_clean
+
+    # resume with nothing left still reports the checkpointed inertia
+    c_again, in_again = KM.fit(pts, k=4, iters=6, mesh=mesh, seed=0,
+                               ckpt_dir=crashed_dir, ckpt_every=2)
+    np.testing.assert_array_equal(c_again, c_clean)
+    assert in_again == in_clean
+
+    # fault without a ckpt dir is refused on this driver too
+    with pytest.raises(ValueError, match="ckpt_dir"):
+        KM.fit(pts, k=4, iters=2, mesh=mesh,
+               fault=FaultInjector(fail_at=(1,)))
+
+
+# ---------------------------------------------------------------------------
+# Crash-atomic checkpoints + damaged-checkpoint fallback (satellite)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_save_is_atomic_no_tmp_left(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(3, {"x": np.arange(4.0)})
+    names = sorted(n for n in (tmp_path / "c").iterdir())
+    assert [n.name for n in names] == ["step_000000000003"]  # no tmp.*
+
+
+def test_checkpoint_truncated_newest_falls_back(tmp_path):
+    """Satellite pin: damage the NEWEST checkpoint (truncate its files);
+    restore_latest warns and restores the previous step instead — and
+    run_with_recovery's restore(None) path rides the same fallback."""
+    import shutil
+
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, {"x": np.arange(3.0)})
+    mgr.save(2, {"x": np.arange(3.0) + 10})
+    newest = tmp_path / "c" / "step_000000000002"
+    # truncate: gut the directory contents but leave the dir (the shape
+    # a torn copy / partial delete leaves behind)
+    for child in newest.iterdir():
+        (shutil.rmtree(child) if child.is_dir() else child.unlink())
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        step, state = mgr.restore_latest()
+    assert step == 1
+    np.testing.assert_array_equal(state["x"], np.arange(3.0))
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        step2, _ = mgr.restore(None)
+    assert step2 == 1
+
+
+def test_checkpoint_all_damaged_raises_filenotfound(tmp_path):
+    import shutil
+
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(1, {"x": np.arange(3.0)})
+    for child in (tmp_path / "c" / "step_000000000001").iterdir():
+        (shutil.rmtree(child) if child.is_dir() else child.unlink())
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(FileNotFoundError, match="no restorable"):
+            mgr.restore_latest()
+
+
+def test_resolve_resume_contract(tmp_path):
+    assert resolve_resume(None, False) is None
+    assert resolve_resume(str(tmp_path / "x"), False) is None
+    with pytest.raises(SystemExit, match="requires --ckpt-dir"):
+        resolve_resume(None, True)
+    empty = str(tmp_path / "empty")
+    with pytest.raises(SystemExit, match="no checkpoints"):
+        resolve_resume(empty, True)
+    mgr = CheckpointManager(str(tmp_path / "full"))
+    mgr.save(4, {"x": np.arange(2.0)})
+    assert resolve_resume(str(tmp_path / "full"), True) == 4
 
 
 def test_checked_jit_clean():

@@ -493,6 +493,52 @@ def test_hl303_continuous_runner_discipline_is_clean():
     assert vs == [], [v.format() for v in vs]
 
 
+def test_hl303_retry_restage_protocol_is_clean_and_non_vacuous():
+    """The PR-10 retry protocol: an injector-killed dispatch retried
+    through a FRESHLY staged buffer passes the donation audit — and the
+    drive itself asserts the fault fired, so the protocol can never go
+    vacuously green."""
+    from harp_tpu.analysis.drivers import PROTOCOLS
+
+    assert "serve.retry_restage" in PROTOCOLS
+    drive = PROTOCOLS["serve.retry_restage"]()
+    vs = commgraph.audit_protocol("serve.retry_restage", drive)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_hl303_sabotaged_retry_redispatching_donated_buffer_fires(mesh):
+    """The sabotaged twin of serve.retry_restage: a retry loop that
+    re-dispatches the SAME staged buffer after the failed attempt (the
+    'obvious' retry) is exactly the use-after-donate HL303 exists for —
+    the CPU sim would pass it silently."""
+    from harp_tpu.serve.engines import ENGINES
+    from harp_tpu.serve.server import Server
+    from harp_tpu.utils.fault import FaultInjector, InjectedFault
+
+    rng = np.random.default_rng(0)
+    srv = Server("kmeans",
+                 state=ENGINES["kmeans"].synthetic_state(rng, k=4, d=8),
+                 mesh=mesh, ladder=(1, 4))
+    srv.startup()
+    n_state = len(srv.engine.state_args())
+    audit = commgraph.DonationAudit("protocol:sabotaged_retry")
+    with audit:
+        srv.wrap_executables(
+            lambda rung, exe: audit.wrap(exe, (n_state,), f"b{rung}"))
+        staged = srv.engine.put_input(
+            srv.engine.make_input(
+                rng.normal(size=(2, 8)).astype(np.float32), 4))
+        inj = FaultInjector(fail={"dispatch": (1,)})
+        with inj.arm():
+            with contextlib.suppress(InjectedFault):
+                srv._exec[4](*srv.engine.state_args(), staged)
+            # the sabotage: retry WITHOUT restaging
+            with contextlib.suppress(RuntimeError, ValueError):
+                srv._exec[4](*srv.engine.state_args(), staged)
+    assert any(v.rule == "HL303" and "re-dispatched" in v.message
+               for v in audit.violations)
+
+
 def test_commgraph_registry_is_clean_and_covers_the_surface():
     """Every registered driver extracts a clean CommGraph (no untracked
     wire, no lying sheet, no hoistable collective), the registry covers

@@ -463,6 +463,190 @@ def test_sustained_ab_row_is_coherent(mesh):
 
 
 # ---------------------------------------------------------------------------
+# Fault plane: shedding, deadlines, retry-with-restage, isolation (PR 10)
+# ---------------------------------------------------------------------------
+
+def _kmeans_server(mesh, tmp_path, seed=40, k=4, d=8, ladder=(1, 8),
+                   budget_action="raise"):
+    rng = np.random.default_rng(seed)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=k, d=d)
+    srv = Server("kmeans", state=state, mesh=mesh, ladder=ladder,
+                 cache_dir=str(tmp_path / "aot"),
+                 budget_action=budget_action)
+    srv.startup()
+    return srv, state, rng
+
+
+def _assign_ref(state, x):
+    return np.argmin(((x[:, None, :] - state["centroids"][None]) ** 2
+                      ).sum(-1), 1).tolist()
+
+
+def test_runner_sheds_on_admission_queue_full(mesh, tmp_path):
+    """Bounded admission: a request that would overflow the queue gets a
+    STRUCTURED shed response at submit — and admission reopens once the
+    queue drains."""
+    srv, state, rng = _kmeans_server(mesh, tmp_path)
+    runner = srv.make_runner(max_queue_rows=4, rung_policy="greedy")
+    xa = rng.normal(size=(3, 8)).astype(np.float32)
+    assert runner.submit("a", {"id": "a", "x": xa.tolist()}, now=0.0) == []
+    ((key, resp),) = runner.submit(
+        "b", {"id": "b", "x": rng.normal(size=(3, 8)).tolist()}, now=0.0)
+    assert key == "b" and resp["shed"] is True
+    assert resp["reason"] == "queue_full"
+    assert "shed" in resp["error"] and resp["id"] == "b"
+    assert runner.shed == 1
+    got = dict(runner.drain(now=0.0))
+    assert got["a"]["result"] == _assign_ref(state, xa)
+    # queue drained: the next request is admitted, not shed
+    assert runner.submit(
+        "c", {"id": "c", "x": xa.tolist()}, now=1.0) == []
+    assert dict(runner.drain(now=1.0))["c"]["result"] == \
+        _assign_ref(state, xa)
+
+
+def test_runner_deadline_sheds_queued_and_counts_late(mesh, tmp_path):
+    """Per-request deadlines: a request still queued past its deadline
+    is shed with a structured error (never dispatched, never unbounded
+    latency); one that completes late is served but counted."""
+    srv, state, rng = _kmeans_server(mesh, tmp_path)
+    runner = srv.make_runner(deadline_s=0.05, rung_policy="greedy")
+    xa = rng.normal(size=(2, 8)).astype(np.float32)
+    runner.submit("a", {"id": "a", "x": xa.tolist()}, now=0.0)
+    ((key, resp),) = runner.step(now=0.2)  # expired before any dispatch
+    assert key == "a" and resp["shed"] is True
+    assert resp["reason"] == "deadline"
+    assert runner.shed == 1 and runner.pending() == 0
+
+    # late COMPLETION: dispatched in time, read back after the deadline
+    runner.submit("b", {"id": "b", "x": xa.tolist()}, now=1.0)
+    assert runner.step(now=1.0) == []  # dispatch window
+    got = dict(runner.step(now=2.0))   # readback, 1 s late
+    assert got["b"]["result"] == _assign_ref(state, xa)
+    assert runner.deadline_misses == 1
+    assert runner.shed == 1  # the late serve was NOT shed
+
+
+def test_runner_retries_transient_fault_with_fresh_stage(mesh, tmp_path):
+    """Retry-with-restage: an injected transient dispatch fault retries
+    the batch through a FRESHLY staged buffer (the donated one is never
+    re-dispatched — the serve.retry_restage protocol drive proves that
+    under the HL303 audit at lint time); every response still comes back
+    correct and the steady-state totals stay EXACT (failed attempts are
+    never counted as dispatches)."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    with telemetry.scope(True):
+        srv, state, rng = _kmeans_server(mesh, tmp_path)
+        runner = srv.make_runner(max_retries=2, rung_policy="greedy")
+        inj = FaultInjector(seed=0, fail={"dispatch": (2,)})
+        xs = {f"r{i}": rng.normal(size=(1, 8)).astype(np.float32)
+              for i in range(4)}
+        got = {}
+        with inj.arm():
+            for key, x in xs.items():
+                runner.submit(key, {"id": key, "x": x.tolist()})
+                got.update(runner.step())
+            got.update(runner.drain())
+        assert inj.injected["dispatch"] == 1
+        assert runner.fault_retries == 1
+        assert runner.engine_failures == 0
+        for key, x in xs.items():
+            assert got[key]["result"] == _assign_ref(state, x)
+        spent = runner.verify_exact()  # exact despite the fault
+        assert spent["dispatches"] == runner.dispatched
+        assert srv.steady.violations == 0
+
+
+def test_runner_hard_failure_isolates_batch(mesh, tmp_path):
+    """Retries exhausted: the batch's requests get structured errors and
+    the runner KEEPS SERVING — one crashing batch is not a dead server."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    srv, state, rng = _kmeans_server(mesh, tmp_path)
+    runner = srv.make_runner(max_retries=1, rung_policy="greedy")
+    inj = FaultInjector(fail={"dispatch": (2, 3)})  # batch 2, both tries
+    xa = rng.normal(size=(1, 8)).astype(np.float32)
+    xc = rng.normal(size=(1, 8)).astype(np.float32)
+    got = {}
+    with inj.arm():
+        runner.submit("a", {"id": "a", "x": xa.tolist()})
+        got.update(runner.step())
+        runner.submit("b", {"id": "b", "x": xa.tolist()})
+        got.update(runner.step())  # fails, retries, hard-fails
+        runner.submit("c", {"id": "c", "x": xc.tolist()})
+        got.update(runner.step())
+        got.update(runner.drain())
+    assert "engine failure after 1 retries" in got["b"]["error"]
+    assert "shed" not in got["b"]  # a hard failure is not a shed
+    assert runner.engine_failures == 1 and runner.failed == 1
+    assert runner.fault_retries == 1
+    assert got["a"]["result"] == _assign_ref(state, xa)
+    assert got["c"]["result"] == _assign_ref(state, xc)
+    assert runner.pending() == 0  # nothing leaked
+
+
+def test_runner_hard_failure_discards_spanning_tail(mesh, tmp_path):
+    """An oversized request whose middle batch hard-fails must not leave
+    tail segments queued (they would dispatch into an already-errored
+    request); later requests still serve."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    srv, state, rng = _kmeans_server(mesh, tmp_path, ladder=(1, 4))
+    runner = srv.make_runner(max_retries=0, rung_policy="greedy")
+    big = rng.normal(size=(10, 8)).astype(np.float32)  # spans 3 batches
+    xc = rng.normal(size=(1, 8)).astype(np.float32)
+    got = {}
+    with FaultInjector(fail={"dispatch": (2,)}).arm():
+        runner.submit("big", {"id": "big", "x": big.tolist()})
+        got.update(runner.step())  # batch 1 of the span: ok
+        got.update(runner.step())  # batch 2: hard fail (max_retries=0)
+        runner.submit("c", {"id": "c", "x": xc.tolist()})
+        got.update(runner.drain())
+    assert "engine failure" in got["big"]["error"]
+    assert got["c"]["result"] == _assign_ref(state, xc)
+    assert runner.pending() == 0 and len(runner.sched) == 0
+
+
+def test_sustained_degraded_row_under_faults(mesh):
+    """The acceptance bench: sustained CPU-sim load with seeded ~1%
+    transient dispatch faults + a deadline + a bounded queue.  The
+    server stays up, every request comes back as served / structured
+    shed / hard-fail (the invariant-9 ledger), clean batches still
+    compile nothing, and the row passes the extended checker."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import check_jsonl
+
+    from harp_tpu.serve.bench import benchmark_sustained
+
+    res = benchmark_sustained(
+        app="kmeans", n_requests=96, rows_per_request=1, burst_admit=8,
+        ladder=(1, 8, 32), state_shape={"k": 8, "d": 16},
+        fault_rate=0.01, fault_seed=34,  # seed 34: first draw (0.004)
+        deadline_ms=10_000.0, max_queue_rows=4096, max_retries=3)  # fires
+    assert res["offered_requests"] == 96
+    assert (res["served_requests"] + res["shed_requests"]
+            + res["failed_requests"]) == 96
+    assert res["faults_injected"] >= 1  # chaos actually ran
+    assert res["fault_retries"] >= 1    # and the retry path absorbed it
+    assert 0.0 <= res["shed_frac"] <= 1.0
+    assert 0.0 <= res["deadline_miss_frac"] <= 1.0
+    assert res["steady_compiles"] == 0  # clean batches never recompile
+    assert res["budget_violations"] == 0
+    # the committed-row contract: a stamped copy passes invariants 7 + 9
+    row = {**res, "backend": "cpu", "date": "2026-08-04", "commit": "x"}
+    assert check_jsonl._check_serve_row("t", 1, row) == []
+    # and a forged unbalanced ledger fails invariant 9
+    bad = dict(row, served_requests=row["served_requests"] - 1)
+    assert any("must come back as exactly one" in e
+               for e in check_jsonl._check_serve_row("t", 1, bad))
+
+
+# ---------------------------------------------------------------------------
 # TCP transport: real socket, concurrent connections, ordered responses
 # ---------------------------------------------------------------------------
 
@@ -557,6 +741,75 @@ def test_tcp_front_end_stats_errors_and_shutdown(mesh, tmp_path):
     assert resp["id"] == "last" and resp["result"] == ref.tolist()
     fe.join(60)
     s.close()
+
+
+def test_tcp_client_disconnect_mid_flight_cleanup(mesh, tmp_path):
+    """A client that slams its socket shut with responses outstanding
+    costs exactly its own work: the dispatcher finishes the in-flight
+    batches, the orphaned responses are dropped, the admitted work
+    drains fully (nothing leaks in the assembler), and a concurrent
+    connection is untouched."""
+    import socket
+    import threading
+    import time as _time
+
+    from harp_tpu.serve.transport import TCPFrontEnd
+
+    rng = np.random.default_rng(36)
+    state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+    srv = Server("kmeans", state=state, mesh=mesh, ladder=(1, 8),
+                 cache_dir=str(tmp_path / "aot"), budget_action="warn")
+    srv.startup()
+    fe = TCPFrontEnd(srv, port=0,
+                     max_queue_delay_s=0.002).start_in_thread()
+    try:
+        # rude client: 6 requests, then the socket slams shut unread
+        rude = socket.create_connection(("127.0.0.1", fe.port),
+                                        timeout=60)
+        payload = b"".join(
+            json.dumps({"id": f"rude-{i}",
+                        "x": rng.normal(size=(2, 8)).tolist()}
+                       ).encode() + b"\n" for i in range(6))
+        rude.sendall(payload)
+        rude.close()  # mid-flight: nothing was read back
+
+        # polite client on its own connection: full round trip
+        xs = [rng.normal(size=(1 + i % 3, 8)).astype(np.float32)
+              for i in range(8)]
+        lines = [json.dumps({"id": f"ok-{i}", "x": x.tolist()})
+                 for i, x in enumerate(xs)]
+        got = _tcp_client(fe.port, lines, len(lines))
+        assert [r["id"] for r in got] == [f"ok-{i}" for i in range(8)]
+        cent = state["centroids"]
+        for r, x in zip(got, xs):
+            ref = np.argmin(((x[:, None, :] - cent[None]) ** 2).sum(-1),
+                            1)
+            assert r["result"] == ref.tolist()
+
+        # every admitted request (rude ones included) fully drained —
+        # the orphans were SERVED then dropped at delivery, not leaked
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline and (
+                fe.runner.completed < 14 or fe.runner.pending()):
+            _time.sleep(0.01)
+        assert fe.runner.completed == 14
+        assert fe.runner.pending() == 0
+
+        # and the server still answers its control plane
+        s = socket.create_connection(("127.0.0.1", fe.port), timeout=60)
+        f = s.makefile("rw")
+        f.write(json.dumps({"cmd": "stats"}) + "\n")
+        f.flush()
+        stats = json.loads(f.readline())
+        assert stats["kind"] == "serve_stats"
+        assert stats["continuous"]["completed"] == 14
+        f.write(json.dumps({"cmd": "quit"}) + "\n")
+        f.flush()
+        s.close()
+    finally:
+        fe.shutdown()
+        fe.join(60)
+    assert threading.active_count() < 50  # no runaway leaked threads
 
 
 # ---------------------------------------------------------------------------
