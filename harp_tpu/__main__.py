@@ -37,6 +37,8 @@ APPS = {
                "merged run report: comm ledger + spans + metrics + top ops"),
     "lint": ("harp_tpu.analysis.cli",
              "harplint: static relay-burner analysis (AST + jaxpr + Mosaic)"),
+    "plan": ("harp_tpu.plan.cli",
+             "topology-aware collective planner over the lint byte sheets"),
 }
 
 

@@ -290,6 +290,116 @@ def _mfsgd_epoch():
     return model._epoch_fn, (model.W, model.H) + model._blocks
 
 
+@register_driver("lda.epoch")
+def _lda_epoch():
+    """The third flagship rotation epoch (PR 11): Gibbs sweep on the
+    dense tiled algo, word-topic slices riding the reshard-shimmed ring
+    — registering it closes the flagship set (kmeans/mfsgd/lda all
+    byte-sheeted) and gives the planner its lda_planner_wire /
+    lda_rotate_int8 candidate site."""
+    from harp_tpu.models.lda import LDA, LDAConfig, synthetic_corpus
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    d_ids, w_ids = synthetic_corpus(n_docs=6 * nw, vocab_size=8 * nw,
+                                    n_topics_true=3, tokens_per_doc=16,
+                                    seed=0)
+    model = LDA(6 * nw, 8 * nw,
+                LDAConfig(n_topics=4, algo="dense", d_tile=8, w_tile=8,
+                          entry_cap=32), mesh, seed=0)
+    model.set_tokens(d_ids, w_ids)
+    keys = mesh.shard_array(model._keys, 0)
+    return model._epoch_fn, (model.Ndk, model.Nwk, model.Nk,
+                             model.z_grid) + model._tokens + (keys,)
+
+
+@register_driver("kmeans.fit_hier")
+def _kmeans_fit_hier():
+    """The planner's hierarchical two-stage psum schedule on the kmeans
+    fit program (flip candidate kmeans_hier_psum) — registered so
+    HL301/HL302 byte-exact cross-checking covers the alternative
+    schedule the planner can emit, not just the incumbent: the
+    allreduce_hier site's sheet must show BOTH psum stages and agree
+    with the ledger to the byte."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.kmeans import KMeansConfig, make_fit_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_fit_fn(mesh, KMeansConfig(k=8, iters=2,
+                                        psum_schedule="hier"))
+    pts = jax.ShapeDtypeStruct((16 * nw, 32), jnp.float32,
+                               sharding=mesh.sharding(mesh.spec(0)))
+    cents = jax.ShapeDtypeStruct((8, 32), jnp.float32,
+                                 sharding=mesh.replicated())
+    return fn, (pts, cents)
+
+
+@register_driver("collective.reshard")
+def _collective_reshard():
+    """The reshard verb's exact lowerings in one traced program (PR 11):
+    ring rotation (ppermute), dim change (all_to_all), replication
+    (all_gather), and the local slice (deliberately wire-free — its
+    absence from the sheet pins that a replicated→blocked move costs
+    nothing).  One program, four sites, each HL301/HL302-checked."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.parallel.collective import ShardSpec, reshard
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+
+    def prog(x):
+        rot = reshard(x, ShardSpec.blocked(0), ShardSpec.blocked(0, 1))
+        swap = reshard(x, ShardSpec.blocked(0), ShardSpec.blocked(1))
+        full = reshard(x, ShardSpec.blocked(0), ShardSpec.replicated())
+        back = reshard(full, ShardSpec.replicated(), ShardSpec.blocked(0))
+        return rot, swap, full.sum(), back
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0, ndim=2),),
+        out_specs=(mesh.spec(0, ndim=2), mesh.spec(1, ndim=2), P(),
+                   mesh.spec(0, ndim=2))))
+    x = jax.ShapeDtypeStruct((8 * nw, nw), jnp.float32,
+                             sharding=mesh.sharding(mesh.spec(0, ndim=2)))
+    return fn, (x,)
+
+
+@register_driver("collective.reshard_wire")
+def _collective_reshard_wire():
+    """The planner's non-default reshard schedules (PR 11): the chunked
+    ppermute pipeline (n_chunks=2 — the sheet must show the hop at
+    chunk size with 2x amplification) and the int8 quantized wire (the
+    stacked-pmax scale exchange plus the narrow hop; ledger wire_dtype
+    exempts it from the exact-byte cross-check, exactly like the
+    *_quantized verbs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.parallel.collective import ShardSpec, reshard
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+
+    def prog(x):
+        chunked = reshard(x, ShardSpec.blocked(0), ShardSpec.blocked(0, 1),
+                          n_chunks=2)
+        narrow = reshard(x, ShardSpec.blocked(0), ShardSpec.blocked(0, 2),
+                         wire="int8")
+        return chunked, narrow
+
+    fn = jax.jit(mesh.shard_map(
+        prog, in_specs=(mesh.spec(0, ndim=2),),
+        out_specs=(mesh.spec(0, ndim=2),) * 2))
+    x = jax.ShapeDtypeStruct((8 * nw, 16), jnp.float32,
+                             sharding=mesh.sharding(mesh.spec(0, ndim=2)))
+    return fn, (x,)
+
+
 # ---------------------------------------------------------------------------
 # Donation-audit protocols (Layer 4, HL303)
 # ---------------------------------------------------------------------------

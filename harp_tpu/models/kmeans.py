@@ -72,6 +72,18 @@ class KMeansConfig:
     # select a different Lloyd basin — the same sensitivity any metric
     # perturbation has.  TPU wall-clock pending (relay outage, BASELINE.md).
     quantize: str | None = None
+    # PR 11 (collective planner): the per-iteration partials allreduce's
+    # schedule.  "one_shot" (default — today's single fused psum, bit-
+    # identical to every committed row) or "hier" (the planner's
+    # hierarchical two-stage psum, collective.allreduce_hier: the
+    # payload crosses the inter-host link class once per host group
+    # instead of once per worker — a win only on multi-host meshes, and
+    # ~2x the bytes on a flat ring, which is why it FAILS CLOSED as flip
+    # candidate `kmeans_hier_psum` until relay-measured; float partials
+    # reassociate across the two stages, gated on inertia like the int8
+    # candidates).  Ignored by variant="regroupallgather" (that schedule
+    # already two-phases through push+pull).
+    psum_schedule: str = "one_shot"
 
     def __post_init__(self):
         if self.k < 1:
@@ -86,6 +98,10 @@ class KMeansConfig:
             raise ValueError(
                 f"variant must be 'allreduce' or 'regroupallgather', "
                 f"got {self.variant!r}")
+        if self.psum_schedule not in ("one_shot", "hier"):
+            raise ValueError(
+                f"psum_schedule must be 'one_shot' or 'hier', "
+                f"got {self.psum_schedule!r}")
 
 
 def _partials_block(points, centroids, c2, mask=None):
@@ -321,7 +337,13 @@ def _combine_partials(sums, counts, partial_inertia, centroids, cfg, nw):
         inertia = C.allreduce(partial_inertia)
         return new_centroids, inertia
 
-    sums, counts, inertia = C.allreduce((sums, counts, partial_inertia))
+    if cfg.psum_schedule == "hier":
+        # the planner's hierarchical two-stage psum (fail-closed flip
+        # candidate kmeans_hier_psum; see KMeansConfig.psum_schedule)
+        sums, counts, inertia = C.allreduce_hier(
+            (sums, counts, partial_inertia))
+    else:
+        sums, counts, inertia = C.allreduce((sums, counts, partial_inertia))
     return normalize(sums, counts, centroids), inertia
 
 
@@ -408,6 +430,7 @@ def kmeanspp_init(points, k, seed=0, sample=50_000):
 def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
         dtype=jnp.float32, block_points=0, use_pallas=None,
         variant="allreduce", quantize=None, init="random",
+        psum_schedule="one_shot",
         ckpt_dir: str | None = None, ckpt_every: int = 5,
         max_restarts: int = 3, fault=None):
     """Host driver — the ``mapCollective`` residue (SURVEY.md §4.2).
@@ -433,7 +456,8 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
     cfg = KMeansConfig(k=k, iters=iters, dtype=dtype, block_points=block_points,
-                       use_pallas=use_pallas, variant=variant, quantize=quantize)
+                       use_pallas=use_pallas, variant=variant, quantize=quantize,
+                       psum_schedule=psum_schedule)
     n = points.shape[0]
     if init == "kmeans++":
         init_c = kmeanspp_init(points, k, seed=0 if seed is None else seed)
@@ -534,12 +558,13 @@ def _fit_ckpt(mesh, cfg, pts, centroids, iters, ckpt_dir, *,
 
 def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
               warmup=2, seed=0, use_pallas=None, variant="allreduce",
-              quantize=None):
+              quantize=None, psum_schedule="one_shot"):
     """Measure iter/sec on the graded 1M×300 k=100 config (north-star metric)."""
     mesh = mesh or current_mesh()
     variant = _effective_variant(variant, k, mesh.num_workers)
     cfg = KMeansConfig(k=k, iters=1, dtype=dtype, use_pallas=use_pallas,
-                       variant=variant, quantize=quantize)
+                       variant=variant, quantize=quantize,
+                       psum_schedule=psum_schedule)
     nw = mesh.num_workers
     n = (n // nw) * nw  # actual points generated/processed (and reported)
 
@@ -618,6 +643,7 @@ def benchmark(n=1_000_000, d=300, k=100, iters=10, mesh=None, dtype=jnp.float32,
         "dtype": str(jnp.dtype(dtype).name),
         "variant": variant,  # the variant that actually ran (post-fallback)
         "quantize": quantize,
+        "psum_schedule": psum_schedule,
     }
 
 
@@ -645,6 +671,12 @@ def main(argv=None):
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="opt-in int8 point quantization (¼ the HBM traffic; "
                         "see KMeansConfig.quantize for the accuracy contract)")
+    p.add_argument("--psum-schedule", choices=["one_shot", "hier"],
+                   default="one_shot",
+                   help="partials-allreduce schedule: one fused psum "
+                        "(default) or the planner's hierarchical two-stage "
+                        "psum (flip candidate kmeans_hier_psum — see "
+                        "KMeansConfig.psum_schedule)")
     p.add_argument("--bench", action="store_true", help="synthetic benchmark mode")
     p.add_argument("--ckpt-dir", default=None,
                    help="fit with checkpoint/resume: iterations run in "
@@ -668,7 +700,8 @@ def main(argv=None):
 
     if args.bench:
         out = benchmark(args.n, args.d, args.k, args.iters, dtype=dtype,
-                        variant=args.variant, quantize=args.quantize)
+                        variant=args.variant, quantize=args.quantize,
+                        psum_schedule=args.psum_schedule)
         print(out)
         maybe_emit("kmeans_bench")
     else:
@@ -684,7 +717,8 @@ def main(argv=None):
             pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
         c, inertia = fit(pts, args.k, args.iters, dtype=dtype,
                          variant=args.variant, quantize=args.quantize,
-                         init=args.init, ckpt_dir=args.ckpt_dir,
+                         init=args.init, psum_schedule=args.psum_schedule,
+                         ckpt_dir=args.ckpt_dir,
                          ckpt_every=args.ckpt_every)
         print(benchmark_json("kmeans_cli", {"k": args.k, "iters": args.iters, "n": pts.shape[0],
                "d": pts.shape[1], "inertia": inertia,

@@ -18,12 +18,17 @@ from harp_tpu.parallel.mesh import (
 )
 from harp_tpu.parallel.collective import (
     Combiner,
+    ShardSpec,
     allreduce,
+    allreduce_hier,
     allgather,
     broadcast,
+    match_reshard_rules,
     reduce,
     regroup,
     regroup_quantized,
+    reshard,
+    reshard_reference,
     rotate,
     rotate_quantized,
     push,
@@ -42,8 +47,13 @@ __all__ = [
     "pipeline_forward",
     "pipeline_loss_and_grads",
     "Combiner",
+    "ShardSpec",
     "allreduce",
+    "allreduce_hier",
     "allgather",
+    "match_reshard_rules",
+    "reshard",
+    "reshard_reference",
     "broadcast",
     "reduce",
     "regroup",

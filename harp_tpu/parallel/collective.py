@@ -36,7 +36,9 @@ in user space.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
+import re
 from functools import partial
 from typing import Any
 
@@ -61,16 +63,19 @@ from harp_tpu.utils.telemetry import record_comm
 
 PRIMITIVE_VERBS: dict[str, tuple[str, ...]] = {
     "psum": ("allreduce", "allreduce_quantized", "reduce", "broadcast",
-             "barrier", "push", "push_quantized"),
+             "barrier", "push", "push_quantized",
+             # the planner's hierarchical two-stage schedule (PR 11):
+             # two grouped psums at one call site
+             "allreduce_hier"),
     "pmax": ("allreduce", "reduce", "push",
              # the int8 wires' stacked per-leaf scale exchange
              "allreduce_quantized", "push_quantized", "rotate_quantized",
-             "regroup_quantized"),
+             "regroup_quantized", "reshard"),
     "pmin": ("allreduce", "reduce", "push"),
-    "ppermute": ("rotate", "rotate_quantized"),
-    "all_gather": ("allgather", "pull",
+    "ppermute": ("rotate", "rotate_quantized", "reshard"),
+    "all_gather": ("allgather", "pull", "reshard",
                    "allreduce"),  # the MULTIPLY combiner's gather+prod
-    "all_to_all": ("regroup", "regroup_quantized"),
+    "all_to_all": ("regroup", "regroup_quantized", "reshard"),
     "reduce_scatter": ("push", "push_quantized"),  # lax.psum_scatter
 }
 
@@ -489,6 +494,397 @@ def barrier(*, axis: str = WORKER_AXIS):
     z = jnp.zeros((), jnp.int32)
     record_comm("barrier", z, axis=axis)
     return lax.psum(z, axis)
+
+
+# ---------------------------------------------------------------------------
+# reshard — the general redistribution verb (PR 11).
+#
+# Harp repartitions by hand-rolled plumbing per app (mfsgd/lda rotate
+# their model slices, the KV tables regroup, pull replicates); the
+# portable-redistribution paper (PAPERS.md arXiv:2112.01075) shows the
+# whole family is ONE operation between two sharding layouts.  A
+# :class:`ShardSpec` names a layout of a logical global array over the
+# 1-D worker ring; ``reshard(x, src, dst)`` lowers to the cheapest legal
+# move between the two — the decision table the collective planner
+# (:mod:`harp_tpu.plan`) prices per site.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """One leaf's layout over the worker ring (device view).
+
+    ``dim=None``: replicated — every worker holds the full array.
+    ``dim=d``: block-partitioned along ``d`` into ``num_workers`` equal
+    blocks; ``shift=s`` is the ring offset — worker ``w`` holds global
+    block ``(w - s) % num_workers`` (``s=0`` is the home layout; the
+    layout after ``rotate(shift=s)`` is exactly ``shift=s``).
+    """
+
+    dim: int | None = 0
+    shift: int = 0
+
+    def __post_init__(self):
+        if self.dim is None and self.shift:
+            raise ValueError("a replicated ShardSpec has no ring shift")
+
+    @classmethod
+    def replicated(cls) -> "ShardSpec":
+        return cls(dim=None)
+
+    @classmethod
+    def blocked(cls, dim: int = 0, shift: int = 0) -> "ShardSpec":
+        return cls(dim=dim, shift=shift)
+
+
+def _leaf_path_name(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts)
+
+
+def match_reshard_rules(rules, tree):
+    """Regex partition-rule matching over a pytree — the SNIPPETS.md [1]
+    ``match_partition_rules`` pattern (fmengine-style; pattern only, no
+    code taken) applied to :class:`ShardSpec`.
+
+    ``rules``: ordered ``[(regex, ShardSpec), ...]``; each leaf's
+    '/'-joined key path is matched with ``re.search``, first hit wins.
+    Scalar leaves (rank 0 or one element) are never partitioned — they
+    resolve to the replicated spec, as the reference helper does.
+    Raises on an unmatched non-scalar leaf: a silently-unsharded table
+    is exactly the bug rule matching exists to prevent.
+    """
+    import numpy as np
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+
+    def spec_for(path, leaf) -> ShardSpec:
+        shape = getattr(leaf, "shape", np.shape(leaf))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return ShardSpec.replicated()
+        name = _leaf_path_name(path)
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no reshard rule matches leaf {name!r}")
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(p, x) for p, x in flat])
+
+
+#: reshard wire formats (shared vocabulary with the rotate pipeline)
+RESHARD_WIRES = ("exact", "bf16", "int8")
+
+_WIRE_DTYPES = {"bf16": jnp.bfloat16, "int8": jnp.int8}
+
+
+def _spec_trees(tree, spec):
+    """Broadcast a single ShardSpec over ``tree``, or pass a matching
+    pytree of specs through (the ``match_reshard_rules`` output)."""
+    if isinstance(spec, ShardSpec):
+        return jax.tree.map(lambda _: spec, tree)
+    return spec
+
+
+def _reshard_plan(src: ShardSpec, dst: ShardSpec, n: int) -> tuple:
+    """(kind, *params) for one leaf.  Kinds: "identity", "slice" (local
+    dynamic_slice, no wire), "rotate" (ppermute ring shift), "a2a" (one
+    all_to_all), "gather" (all_gather + static roll), "gather_slice"
+    (the always-legal fallback: replicate, then slice locally)."""
+    s_src = 0 if src.dim is None else src.shift % n
+    s_dst = 0 if dst.dim is None else dst.shift % n
+    if src.dim is None and dst.dim is None:
+        return ("identity",)
+    if src.dim == dst.dim and s_src == s_dst:
+        return ("identity",)
+    if src.dim is None:
+        return ("slice", dst.dim, s_dst)
+    if dst.dim is None:
+        return ("gather", src.dim, s_src)
+    if src.dim == dst.dim:
+        return ("rotate", (s_dst - s_src) % n)
+    if s_src == 0 and s_dst == 0:
+        return ("a2a", src.dim, dst.dim)
+    return ("gather_slice", src.dim, s_src, dst.dim, s_dst)
+
+
+def _block_size(x, dim: int, n: int, what: str) -> int:
+    if dim >= x.ndim:
+        raise ValueError(
+            f"reshard: {what} dim {dim} out of range for rank-{x.ndim} leaf")
+    if x.shape[dim] % n:
+        raise ValueError(
+            f"reshard: leaf dim {dim} of size {x.shape[dim]} does not "
+            f"split into {n} worker blocks")
+    return x.shape[dim] // n
+
+
+def _chunked_ring_move(x, dim: int, n_chunks: int, move):
+    """The chunked ppermute pipeline lowering: split the leaf along its
+    sharded dim into ``n_chunks`` sub-chunks and ship them through a
+    scan — TACCL's chunked-pipelining observation (PAPERS.md
+    arXiv:2111.04867) applied to a bare redistribution, so a planner
+    schedule can overlap the hops of one large move."""
+    if x.shape[dim] % n_chunks:
+        raise ValueError(
+            f"reshard: n_chunks={n_chunks} does not divide leaf dim "
+            f"{dim} of size {x.shape[dim]}")
+    m = x.shape[dim] // n_chunks
+    shape = x.shape[:dim] + (n_chunks, m) + x.shape[dim + 1:]
+    chunks = jnp.moveaxis(x.reshape(shape), dim, 0)
+
+    def body(_, c):
+        return None, move(c)
+
+    _, out = lax.scan(body, None, chunks)
+    out = jnp.moveaxis(out, 0, dim)
+    return out.reshape(x.shape)
+
+
+def _wire_move(x, wire: str, move, amax=None):
+    """Apply ``move`` on the selected wire format — the one-rounding
+    :func:`_quantized_move` trade, inlined so reshard emits exactly one
+    collective per leaf (plus the shared scale pmax for int8)."""
+    if wire == "exact" or not jnp.issubdtype(x.dtype, jnp.floating):
+        return move(x)
+    if wire == "bf16":
+        return move(x.astype(jnp.bfloat16)).astype(x.dtype)
+    q, scale = quantize_to_int8(x, amax)
+    return (move(q).astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def reshard(tree: Any, src_spec, dst_spec, *, axis: str = WORKER_AXIS,
+            wire: str = "exact", n_chunks: int = 1):
+    """Move a pytree from one :class:`ShardSpec` layout to another —
+    the general repartition verb behind the collective planner.
+
+    ``src_spec`` / ``dst_spec``: one spec applied to every leaf, or a
+    matching pytree of specs (see :func:`match_reshard_rules`).  Lowers
+    per leaf to the cheapest legal move:
+
+    ==============================  ====================================
+    (src, dst)                      lowering
+    ==============================  ====================================
+    equal layouts                   identity (no wire)
+    replicated → blocked            local ``dynamic_slice`` (no wire)
+    same dim, shifts differ         ``ppermute`` ring rotation
+    blocked dim a → blocked dim b   one ``all_to_all``  (shifts 0)
+    blocked → replicated            ``all_gather`` + static roll
+    anything else                   all_gather + local slice (fallback)
+    ==============================  ====================================
+
+    ``wire`` ("exact" | "bf16" | "int8") narrows the moving payload the
+    :func:`rotate_quantized` way — pure data movement, one rounding per
+    call, int8 scales ride ONE stacked pmax shared by all float leaves.
+    ``n_chunks > 1`` lowers ring rotations as a chunked ppermute
+    pipeline (a scan of sub-chunk hops — the planner's
+    ``chunked_pipeline`` schedule); it is rotation-only and requires
+    the sharded dim to split evenly.
+
+    Every lowering is bit-identical to the naive
+    :func:`reshard_reference` (all_gather + slice) on the exact wire —
+    pinned pairwise by tests/test_reshard.py.  Must be called inside
+    ``shard_map`` (device view).
+    """
+    if wire not in RESHARD_WIRES:
+        raise ValueError(f"wire must be one of {RESHARD_WIRES}, "
+                         f"got {wire!r}")
+    if n_chunks < 1:
+        raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
+    n = lax.axis_size(axis)
+    leaves, treedef = jax.tree.flatten(tree)
+    src_l = jax.tree.leaves(_spec_trees(tree, src_spec),
+                            is_leaf=lambda s: isinstance(s, ShardSpec))
+    dst_l = jax.tree.leaves(_spec_trees(tree, dst_spec),
+                            is_leaf=lambda s: isinstance(s, ShardSpec))
+    if not (len(leaves) == len(src_l) == len(dst_l)):
+        raise ValueError("reshard: spec trees do not match the data tree")
+    plans = [_reshard_plan(s, d, n) for s, d in zip(src_l, dst_l)]
+
+    # one ledger record for the wire the move actually rides: chunked
+    # rotations record the chunk-sized payload (what the traced ppermute
+    # eqn carries per scan step — the HL302 cross-check is byte-exact),
+    # local moves (identity/slice) record nothing.
+    moving = []
+    for x, src, plan in zip(leaves, src_l, plans):
+        kind = plan[0]
+        if kind in ("identity", "slice"):
+            continue
+        shape = x.shape
+        if kind == "rotate" and n_chunks > 1:
+            dim = _record_rotate_dim(x, src)
+            shape = shape[:dim] + (shape[dim] // n_chunks,) + shape[dim + 1:]
+        moving.append(jax.ShapeDtypeStruct(shape, x.dtype))
+    if moving:
+        record_comm("reshard", tuple(moving), axis=axis,
+                    wire_dtype=None if wire == "exact"
+                    else _WIRE_DTYPES[wire])
+
+    # shared int8 scales: every moving float leaf's |max| rides ONE
+    # stacked pmax (the _quantized_move idiom)
+    amaxes = None
+    if wire == "int8":
+        flt = [x for x, p in zip(leaves, plans)
+               if p[0] not in ("identity", "slice")
+               and jnp.issubdtype(x.dtype, jnp.floating)]
+        if flt:
+            amax = jnp.stack([jnp.max(jnp.abs(x)).astype(jnp.float32)
+                              for x in flt])
+            amaxes = iter(lax.pmax(amax, axis))
+
+    me = lax.axis_index(axis)
+    out = []
+    for x, src, dst, plan in zip(leaves, src_l, dst_l, plans):
+        kind = plan[0]
+        if kind == "identity":
+            out.append(x)
+            continue
+        if kind == "slice":
+            _, dim, s = plan
+            bs = _block_size(x, dim, n, "dst")
+            idx = ((me - s) % n) * bs
+            out.append(lax.dynamic_slice_in_dim(x, idx, bs, axis=dim))
+            continue
+        amax = (next(amaxes) if amaxes is not None
+                and jnp.issubdtype(x.dtype, jnp.floating) else None)
+        if kind == "rotate":
+            delta = plan[1]  # never 0: equal layouts plan as "identity"
+            perm = [(i, (i + delta) % n) for i in range(n)]
+
+            def hop(c, perm=perm):
+                return lax.ppermute(c, axis, perm)
+
+            def move(y, hop=hop):
+                if n_chunks > 1:
+                    dim = _record_rotate_dim(y, src)
+                    return _chunked_ring_move(y, dim, n_chunks, hop)
+                return hop(y)
+
+            out.append(_wire_move(x, wire, move, amax))
+            continue
+        if n_chunks > 1:
+            raise ValueError(
+                "reshard: n_chunks applies to ring rotations only "
+                f"(this leaf lowers to {kind!r})")
+        if kind == "a2a":
+            _, sd, dd = plan
+            _block_size(x, dd, n, "dst")
+
+            def move(y, sd=sd, dd=dd):
+                return lax.all_to_all(y, axis, split_axis=dd,
+                                      concat_axis=sd, tiled=True)
+
+            out.append(_wire_move(x, wire, move, amax))
+            continue
+        # gather / gather_slice: replicate (all_gather + static roll for
+        # a shifted source), then slice the destination block locally
+        dim, s = plan[1], plan[2]
+
+        def move(y, dim=dim):
+            return lax.all_gather(y, axis, axis=dim, tiled=True)
+
+        full = _wire_move(x, wire, move, amax)
+        if s:
+            full = jnp.roll(full, -s * x.shape[dim], axis=dim)
+        if kind == "gather_slice":
+            _, _, _, ddim, ds = plan
+            bs = _block_size(full, ddim, n, "dst")
+            idx = ((me - ds) % n) * bs
+            full = lax.dynamic_slice_in_dim(full, idx, bs, axis=ddim)
+        out.append(full)
+    return jax.tree.unflatten(treedef, out)
+
+
+def _record_rotate_dim(x, src: ShardSpec) -> int:
+    """The dim a chunked rotation splits: the spec's sharded dim,
+    clamped into range for low-rank leaves (a scalar ring hop cannot
+    chunk — it degenerates to dim 0 and the divisibility check fires)."""
+    dim = 0 if src.dim is None else src.dim
+    if dim >= max(x.ndim, 1):
+        raise ValueError(
+            f"reshard: cannot chunk a rank-{x.ndim} leaf along dim {dim}")
+    return dim
+
+
+def reshard_reference(tree: Any, src_spec, dst_spec, *,
+                      axis: str = WORKER_AXIS):
+    """The naive lowering every :func:`reshard` path must reproduce
+    bit-for-bit on the exact wire: replicate (all_gather + roll), then
+    slice the destination block.  Test oracle only — it is deliberately
+    unrecorded (no CommLedger entry) and always moves O(global) bytes.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    src_l = _spec_trees(tree, src_spec)
+    dst_l = _spec_trees(tree, dst_spec)
+
+    def one(x, src: ShardSpec, dst: ShardSpec):
+        full = x
+        if src.dim is not None:
+            full = lax.all_gather(x, axis, axis=src.dim, tiled=True)
+            if src.shift % n:
+                full = jnp.roll(full, -(src.shift % n) * x.shape[src.dim],
+                                axis=src.dim)
+        if dst.dim is None:
+            return full
+        bs = _block_size(full, dst.dim, n, "dst")
+        idx = ((me - dst.shift) % n) * bs
+        return lax.dynamic_slice_in_dim(full, idx, bs, axis=dst.dim)
+
+    return jax.tree.map(one, tree, src_l, dst_l)
+
+
+def allreduce_hier(tree: Any, *, group_size: int | None = None,
+                   axis: str = WORKER_AXIS):
+    """ADD-allreduce as a hierarchical two-stage psum — the planner's
+    ``hier_psum`` schedule (TACCL-style sketch, PAPERS.md
+    arXiv:2111.04867): stage 1 reduces within contiguous groups of
+    ``group_size`` workers (the intra-host link class), stage 2 reduces
+    the group sums across groups (the inter-host class), so the payload
+    crosses the slow link class once per group instead of once per
+    worker.  On a FLAT ring this moves ~2× the one-shot psum's bytes
+    (analytic ring algebra, 2026-08-04 — no silicon number yet) — it
+    wins only when inter-host links are slower, which is exactly why
+    it is a fail-closed flip candidate (``kmeans_hier_psum``), never a
+    default.  ADD only; float sums reassociate across the two stages
+    (ints are exact), the same tolerance class as any ring-order change.
+    ``group_size`` must divide the axis size; ``None`` picks the largest
+    divisor ≤ √n (the balanced two-stage split).
+    """
+    n = lax.axis_size(axis)
+    if group_size is None:
+        group_size = next(g for g in range(int(n ** 0.5), 0, -1)
+                          if n % g == 0)
+    if group_size < 1 or n % group_size:
+        raise ValueError(
+            f"group_size={group_size} must divide the axis size {n}")
+    # both stages' payload rides the wire: account both (the CommGraph
+    # byte sheet sees two psum eqns at this site and HL302 checks the
+    # ledger to the byte)
+    record_comm("allreduce_hier", (tree, tree), axis=axis, combiner="add")
+    if group_size in (1, n):
+        # degenerate split: one of the stages is a no-op group-of-one —
+        # still TWO psums so the byte sheet matches the recorded wire
+        intra = [[i] for i in range(n)] if group_size == 1 else [list(range(n))]
+        inter = [list(range(n))] if group_size == 1 else [[i] for i in range(n)]
+    else:
+        intra = [list(range(g * group_size, (g + 1) * group_size))
+                 for g in range(n // group_size)]
+        inter = [list(range(i, n, group_size)) for i in range(group_size)]
+
+    def two_stage(x):
+        y = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+        y = lax.psum(y, axis, axis_index_groups=intra)
+        y = lax.psum(y, axis, axis_index_groups=inter)
+        return y.astype(x.dtype) if x.dtype == jnp.bool_ else y
+
+    return jax.tree.map(two_stage, tree)
 
 
 # ---------------------------------------------------------------------------

@@ -40,24 +40,32 @@ import jax.numpy as jnp
 from jax import lax
 
 from harp_tpu.parallel.mesh import WORKER_AXIS
-from harp_tpu.parallel.collective import rotate, rotate_quantized
+from harp_tpu.parallel.collective import ShardSpec, reshard
 
 #: ring payload formats for the pipelined rotation (see rotate_pipeline)
 ROTATE_WIRES = ("exact", "bf16", "int8")
 
 
 def _wire_rotate(wire: str | None, shift: int, axis: str):
-    """Resolve a ``wire`` name to the rotation verb moving in-flight chunks."""
-    if wire in (None, "exact"):
-        return lambda tree: rotate(tree, shift=shift, axis=axis)
-    if wire == "bf16":
-        return lambda tree: rotate_quantized(
-            tree, shift=shift, wire_dtype=jnp.bfloat16, axis=axis)
-    if wire == "int8":
-        return lambda tree: rotate_quantized(
-            tree, shift=shift, wire_dtype=jnp.int8, axis=axis)
-    raise ValueError(
-        f"wire must be one of {ROTATE_WIRES}, got {wire!r}")
+    """Resolve a ``wire`` name to the ring-hop move for in-flight chunks.
+
+    PR 11: a ring hop IS a reshard between ring-shifted layouts, so the
+    former bespoke rotate/rotate_quantized dispatch collapses into ONE
+    ``reshard(blocked(0), blocked(0, shift), wire=...)`` call — the
+    equivalence-pinned shim behind every rotation app (mfsgd, lda, ccd,
+    ring attention ride this pipeline).  The lowering emits the exact
+    same ``ppermute`` (same perm, same payload; quantized wires keep
+    the one-rounding stacked-pmax contract), pinned bit-for-bit against
+    the direct verb by tests/test_reshard.py and the apps' numpy
+    goldens; the CommLedger verb at these sites is now ``reshard``.
+    """
+    if wire is None:
+        wire = "exact"
+    if wire not in ROTATE_WIRES:
+        raise ValueError(
+            f"wire must be one of {ROTATE_WIRES}, got {wire!r}")
+    src, dst = ShardSpec.blocked(0), ShardSpec.blocked(0, shift=shift)
+    return lambda tree: reshard(tree, src, dst, axis=axis, wire=wire)
 
 
 def _split_chunks(tree: Any, n_chunks: int, axis: int):

@@ -488,14 +488,18 @@ def regroup_by_key(keys, values, *, capacity: int, axis: str = WORKER_AXIS):
 def pull_rows(global_shard, row_ids, *, axis: str = WORKER_AXIS):
     """Fetch specific rows of a row-sharded global table into local storage.
 
-    O(table) wire: pulls the WHOLE table then takes rows — simple and
-    fast when the table fits HBM anyway.  For model tables larger
+    O(table) wire: replicates the WHOLE table then takes rows — simple
+    and fast when the table fits HBM anyway.  For model tables larger
     than one chip's HBM (or when touched rows ≪ table), use
-    :func:`pull_rows_sparse`.
+    :func:`pull_rows_sparse`.  PR 11: the replication is a
+    ``reshard(blocked(0) → replicated)`` — the same all_gather lowering
+    the ``pull`` verb emitted, now priced by the collective planner like
+    every other redistribution (bit-identical; tests/test_reshard.py).
     """
-    from harp_tpu.parallel.collective import pull as _pull
+    from harp_tpu.parallel.collective import ShardSpec, reshard
 
-    full = _pull(global_shard, axis=axis)
+    full = reshard(global_shard, ShardSpec.blocked(0),
+                   ShardSpec.replicated(), axis=axis)
     return jnp.take(full, row_ids, axis=0)
 
 

@@ -97,6 +97,9 @@ WORK_MODELS = {
     "kmeans": _kmeans_work,
     "kmeans_int8": _kmeans_work,
     "kmeans_int8_fused": _kmeans_work,
+    # PR 11: the planner's hier-psum candidate only reschedules the
+    # collective — compute and HBM floors are the family's
+    "kmeans_hier_psum": _kmeans_work,
     "kmeans_stream": _kmeans_work,
     "kmeans_stream_int8": _kmeans_work,
     "mfsgd": _mfsgd_work,
@@ -122,6 +125,8 @@ WORK_MODELS = {
     "lda_pallas_hot": _lda_work,
     "lda_pallas_approx_hot": _lda_work,
     "lda_rotate_int8": _lda_work,
+    # PR 11: the planner's bf16 wire — same compute, narrower ring only
+    "lda_planner_wire": _lda_work,
     "lda_scale": _lda_work,
     "lda_scale_1m": _lda_work,
     "lda_scale_1m_pallas": _lda_work,

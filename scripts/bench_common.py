@@ -55,3 +55,10 @@ SMOKE = {
     "subgraph": {"n_vertices": 2000, "avg_degree": 4},
     "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2},
 }
+
+# PR 11 planner candidates measure the SAME shapes as their incumbents
+# (only the collective schedule differs — an A/B over different shapes
+# would attribute shape noise to the schedule): aliases, not copies, so
+# an incumbent smoke-shape change can never drift the pair apart.
+SMOKE["kmeans_hier_psum"] = SMOKE["kmeans"]
+SMOKE["lda_planner_wire"] = SMOKE["lda_pallas"]

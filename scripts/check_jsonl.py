@@ -104,6 +104,20 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
    of served / structured-shed / hard-failed; a row where requests
    vanish is not degradation evidence, it is a dead server wearing a
    qps number).
+
+10. **Plan rows are coherent schedule evidence** (any file): a ``kind:
+    "plan"`` row (``python -m harp_tpu plan``, PR 11) must carry the
+    provenance stamp (a schedule decision is about a specific commit's
+    byte sheets), name a registered driver program
+    (``KNOWN_LINT_PROGRAMS``) and a frozen topology tag
+    (``KNOWN_PLAN_TOPOLOGIES``), choose every site's schedule from the
+    frozen vocabulary (``KNOWN_PLAN_SCHEDULES``) — and today that
+    chosen schedule must be ``"keep"``: the planner FAILS CLOSED, so a
+    committed row claiming any other choice is evidence of a bypassed
+    flip gate — with per-site ``predicted_bytes`` equal to the frozen
+    schedule scaling of the site's ``sheet_bytes`` (for ``keep``,
+    exactly the program's byte sheet: a plan whose predictions drift
+    from the sheet is pricing a program this repo does not run).
 """
 
 from __future__ import annotations
@@ -239,16 +253,20 @@ LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
 # collective verb table — a sheet naming an unknown program or verb is
 # not evidence about THIS repo's communication schedule.
 KNOWN_LINT_PROGRAMS = (
+    "collective.reshard", "collective.reshard_wire",
     "ingest.accum_chunk", "ingest.finish_epoch", "kmeans.fit",
+    "kmeans.fit_hier", "lda.epoch",
     "mfsgd.epoch", "ring_attention", "rotate.pipeline_chunked",
     "serve.kmeans_assign", "serve.lda_infer", "serve.mfsgd_topk",
     "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores")
 KNOWN_COMM_PRIMITIVES = ("all_gather", "all_to_all", "pmax", "pmin",
                          "ppermute", "psum", "reduce_scatter")
-KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_quantized",
+KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_hier",
+                    "allreduce_quantized",
                     "barrier", "broadcast", "pull", "push",
                     "push_quantized", "reduce", "regroup",
-                    "regroup_quantized", "rotate", "rotate_quantized")
+                    "regroup_quantized", "reshard", "rotate",
+                    "rotate_quantized")
 SHEET_BYTE_FIELDS = ("bytes_per_trace", "amplified_bytes")
 
 
@@ -441,6 +459,82 @@ def _check_degraded_serve_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the plan-row vocabularies (invariant 10), FROZEN standalone like the
+# lint rule ids and sync-pinned by tests/test_plan.py against
+# harp_tpu.plan (topology.TOPOLOGY_NAMES / planner.SCHEDULES /
+# planner.predicted_bytes)
+KNOWN_PLAN_TOPOLOGIES = ("single_chip", "sim_ring_8", "v4_32")
+KNOWN_PLAN_SCHEDULES = ("keep", "hier_psum", "chunked_pipeline",
+                        "wire_bf16", "wire_int8")
+
+
+def _plan_predicted_bytes(schedule: str, sheet_bytes: int) -> int:
+    """The frozen schedule→bytes scaling (mirror of
+    harp_tpu.plan.planner.predicted_bytes; drift fails tests)."""
+    if schedule in ("keep", "chunked_pipeline"):
+        return int(sheet_bytes)
+    if schedule == "hier_psum":
+        return 2 * int(sheet_bytes)
+    if schedule == "wire_bf16":
+        return (int(sheet_bytes) + 1) // 2
+    return (int(sheet_bytes) + 3) // 4  # wire_int8
+
+
+def _check_plan_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 10: plan rows must be coherent schedule evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: plan row missing provenance field(s) {missing} "
+            "— print it through harp_tpu.plan.cli (benchmark_json stamps "
+            "them)")
+    prog = row.get("program")
+    if prog not in KNOWN_LINT_PROGRAMS:
+        errs.append(
+            f"{name}:{i}: plan row for unregistered program {prog!r} — "
+            "programs must come from harp_tpu.analysis.drivers.DRIVERS "
+            "(update KNOWN_LINT_PROGRAMS in the same commit as the "
+            "registry)")
+    topo = row.get("topology")
+    if topo not in KNOWN_PLAN_TOPOLOGIES:
+        errs.append(
+            f"{name}:{i}: plan row names unknown topology {topo!r} "
+            f"(known: {KNOWN_PLAN_TOPOLOGIES})")
+    for s in row.get("sites") or []:
+        if not isinstance(s, dict):
+            errs.append(f"{name}:{i}: plan row has a non-object site "
+                        "entry")
+            continue
+        sched = s.get("schedule")
+        if sched not in KNOWN_PLAN_SCHEDULES:
+            errs.append(
+                f"{name}:{i}: plan site {s.get('site')!r} chose unknown "
+                f"schedule {sched!r} (known: {KNOWN_PLAN_SCHEDULES})")
+            continue
+        if sched != "keep":
+            errs.append(
+                f"{name}:{i}: plan site {s.get('site')!r} chose "
+                f"{sched!r} — the planner fails closed (schedule is "
+                "always 'keep'; alternatives ride flip candidates, "
+                "never the chosen slot)")
+        sb, pb = s.get("sheet_bytes"), s.get("predicted_bytes")
+        for k, v in (("sheet_bytes", sb), ("predicted_bytes", pb)):
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: plan site {k}={v!r} must be a "
+                            "non-negative integer")
+        if (isinstance(sb, int) and isinstance(pb, int)
+                and not isinstance(sb, bool) and not isinstance(pb, bool)
+                and pb != _plan_predicted_bytes(sched, sb)):
+            errs.append(
+                f"{name}:{i}: plan site {s.get('site')!r} predicts "
+                f"{pb} B under {sched!r} but the sheet says {sb} B — "
+                f"expected {_plan_predicted_bytes(sched, sb)}; the "
+                "prediction must equal the frozen scaling of the "
+                "program's byte sheet")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -500,6 +594,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_serve_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "ingest":
             errors += _check_ingest_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "plan":
+            errors += _check_plan_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -837,7 +837,9 @@ def _p2rot_bytes(wire):
             _m._epoch_fn.lower(_m.W, _m.H, *_m._blocks)
         return sum(s["payload_bytes"]
                    for s in _P2T.ledger.summary()["probe"]["sites"]
-                   if s["verb"].startswith("rotate"))
+                   # PR 11: the ring hop is the reshard shim now
+                   if s["verb"] in ("rotate", "rotate_quantized",
+                                    "reshard"))
 
 
 assert _p2rot_bytes("exact") == 4 * _p2rot_bytes("int8") > 0
@@ -1173,7 +1175,9 @@ with _HLT.scope(True):
     np.testing.assert_allclose(_hl_after, _hl_gold, rtol=1e-5)
     _hl_verbs = {s["verb"] for t in _HLT.ledger.summary().values()
                  for s in t["sites"]}
-    assert {"pull", "push"} <= _hl_verbs, _hl_verbs   # HL001's whole point
+    # HL001's whole point: the row exchange is on the ledger (PR 11:
+    # pull_rows's replication rides the reshard shim)
+    assert {"reshard", "push"} <= _hl_verbs, _hl_verbs
 
 # (e) the lint CLI at HEAD: exit 0, clean, stamped line that satisfies
 # check_jsonl invariant 6; a seeded file exits 1
@@ -1766,3 +1770,81 @@ print(f"fault plane: injector-killed mfsgd resumed bit-identical from "
       f"shed / {_fp_row['failed_requests']} failed of 96, "
       f"{_fp_row['fault_retries']} retries) through invariant 9 both ways")
 print(f"DRIVE OK round-30 ({mode})")
+
+# --- round 31: the collective planner end-to-end (PR 11) -------------------
+# One registered program, subprocess-free: CommGraph byte sheet -> Plan ->
+# the executed schedule -> ledger agreement BOTH ways (every planned site
+# has a trace-time record; every recorded wire is a planned site), plus
+# the reshard verb executing the planner's alternative schedules
+# bit-identically to "keep".
+from harp_tpu.analysis import commgraph as _plC
+from harp_tpu.analysis.drivers import DRIVERS as _plD
+from harp_tpu.parallel.collective import ShardSpec as _plS
+from harp_tpu.plan import planner as _plP
+from harp_tpu.plan import topology as _plT
+from harp_tpu.utils import telemetry as _plTel
+
+_pl_topo = _plT.detect(mesh)
+assert _pl_topo.name == ("sim_ring_8" if mode == "cpu8" else _pl_topo.name)
+
+# byte sheet -> Plan (fail closed, predictions == sheet, exactly)
+_pl_fn, _pl_args = _plD["mfsgd.epoch"]()
+_pl_graph = _plC.extract("mfsgd.epoch", _pl_fn, _pl_args)
+_pl_plan = _plP.plan_sheet(
+    "mfsgd.epoch", {"collectives": [s.row() for s in _pl_graph.sites]},
+    _pl_topo)
+assert all(d.schedule == "keep" for d in _pl_plan.sites)
+assert _pl_plan.predicted_bytes_total() == _pl_graph.amplified_bytes() > 0
+
+# ledger agreement both ways: the extraction traced under the ledger, so
+# every static site must have a record (HL301's direction) AND every
+# recorded comm site must be a planned site (the planner misses nothing)
+_pl_static_sites = {d.site for d in _pl_plan.sites}
+_pl_ledger_sites = set(_pl_graph.ledger_sites)
+assert _pl_static_sites <= _pl_ledger_sites, (
+    _pl_static_sites - _pl_ledger_sites)
+assert _pl_ledger_sites <= _pl_static_sites, (
+    _pl_ledger_sites - _pl_static_sites)
+# and byte-exactness site by site: sheet bytes == ledger payload *
+# amplification for every exact-wire site (HL302's direction, from the
+# planner's own rows)
+_pl_amp = {d.site: d for d in _pl_plan.sites}
+for _pl_site, _pl_recs in _pl_graph.ledger_sites.items():
+    if all(r["wire_dtype"] is None for r in _pl_recs):
+        _pl_led = sum(r["payload_bytes"] for r in _pl_recs)
+        _pl_sheet = sum(s.per_shard_bytes for s in _pl_graph.sites
+                        if s.site == _pl_site)
+        assert _pl_led == _pl_sheet, (_pl_site, _pl_led, _pl_sheet)
+
+# the planner's alternative schedules EXECUTE and agree with "keep":
+# chunked pipeline bit-identical, int8 wire within its rounding bound
+_pl_x = np.arange(nw * 8 * 4, dtype=np.float32).reshape(nw * 8, 4)
+
+
+def _pl_prog(a):
+    keep = C.reshard(a, _plS.blocked(0), _plS.blocked(0, 1))
+    chunked = C.reshard(a, _plS.blocked(0), _plS.blocked(0, 1), n_chunks=4)
+    narrow = C.reshard(a, _plS.blocked(0), _plS.blocked(0, 1), wire="int8")
+    return keep, chunked, narrow
+
+
+_pl_keep, _pl_chunk, _pl_n8 = jax.jit(mesh.shard_map(
+    _pl_prog, in_specs=(mesh.spec(0),),
+    out_specs=(mesh.spec(0),) * 3))(mesh.shard_array(_pl_x, 0))
+np.testing.assert_array_equal(np.asarray(_pl_keep), np.asarray(_pl_chunk))
+assert np.abs(np.asarray(_pl_n8) - np.asarray(_pl_keep)).max() <= \
+    np.abs(_pl_x).max() / 254 + 1e-6
+
+# a topology where the alternatives win names ONLY measurable flip
+# candidates and still chooses "keep" everywhere (fail closed under
+# temptation); kmeans's hier candidate appears exactly on the
+# multi-host price list
+_pl_flat = _plP.plan_program("kmeans.fit", _plT.sim_ring(8))
+_pl_multi = _plP.plan_program("kmeans.fit", _plT.v4_32())
+assert _pl_flat.flip_candidates() == []
+assert _pl_multi.flip_candidates() == ["kmeans_hier_psum"]
+assert all(d.schedule == "keep" for d in _pl_multi.sites)
+print(f"planner: mfsgd.epoch sheet {_pl_plan.predicted_bytes_total()} B "
+      "== ledger both ways; alt schedules execute bit-identical; "
+      "hier candidate only on v4_32")
+print(f"DRIVE OK round-31 ({mode})")

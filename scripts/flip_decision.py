@@ -114,6 +114,25 @@ CANDIDATES = {
         "incumbent": "lda_pallas_carry", "metric": "tokens_per_sec_per_chip",
         "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
         "flips": "LDAConfig.rotate_wire='int8'"},
+    # PR 11: the planner-named bf16 reshard wire — same incumbent and
+    # gate as the int8 twin (rotate_wire is ONE knob: the pair is
+    # EXCLUSIVE below), half the ring bytes at one bf16 rounding per
+    # hop.  The Plan row (python -m harp_tpu plan) prices this site;
+    # only this gate can flip it.
+    "lda_planner_wire": {
+        "incumbent": "lda_pallas_carry", "metric": "tokens_per_sec_per_chip",
+        "quality": "log_likelihood", "sense": "higher", "abs_tol": 0.05,
+        "flips": "LDAConfig.rotate_wire='bf16'"},
+    # PR 11: the planner's hierarchical two-stage psum on the graded
+    # kmeans shape.  Quality gates on inertia at the int8 candidates'
+    # tolerance: the two-stage reduce only reassociates float sums —
+    # orders of magnitude below 1% — so a miss here means a broken
+    # schedule, not noise.  A flat-ring measurement SHOULD read ~1.0x
+    # and refuse; the flip is expected only from a multi-host window.
+    "kmeans_hier_psum": {
+        "incumbent": "kmeans", "metric": "iters_per_sec",
+        "quality": "inertia", "sense": "lower", "rel_tol": 0.01,
+        "flips": "KMeansConfig.psum_schedule='hier'"},
     # PR 8: the quantized gradient wire (ROADMAP decision-machinery
     # item; EQuARX-style bf16/int8 allreduce).  train_acc gates per the
     # module-doc tolerance (abs 0.005): a wire that degrades training
@@ -168,7 +187,10 @@ JOINT_GATES = [("lda_pallas_approx", "lda_pallas_approx_hot"),
 # is the same shape: MLPConfig.grad_wire is one knob, bf16 and int8
 # cannot both be its default.
 EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry"),
-                   ("mlp_grad_bf16", "mlp_grad_int8")]
+                   ("mlp_grad_bf16", "mlp_grad_int8"),
+                   # PR 11: LDAConfig.rotate_wire is one default slot —
+                   # the int8 and planner-bf16 wires cannot both hold it
+                   ("lda_rotate_int8", "lda_planner_wire")]
 
 # stack-conditional: carry_db=True is one knob, but the evidence row
 # that authorizes it depends on which algo the verdicts make default

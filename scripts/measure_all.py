@@ -73,6 +73,11 @@ SPRINT_ORDER = [
     "lda_pallas_hot", "lda_pallas_approx_hot",
     "lda_pallas_carry", "lda_carry", "lda_exprace", "lda_fast",
     "lda_rotate_int8",
+    # PR 11: planner-named flip candidates (harp_tpu/plan emits these as
+    # fail-closed Plan rows; the schedules exist in code TODAY —
+    # collective.allreduce_hier and the bf16 reshard wire — and flip
+    # only through flip_decision's gates like every other candidate)
+    "kmeans_hier_psum", "lda_planner_wire",
     # PR 6: serving latency/throughput (harp_tpu/serve) — no committed
     # TPU row yet, so they ride the candidates block: the next armed
     # relay window yields the first serve verdicts (p50/p95/p99 + qps
@@ -132,6 +137,15 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "kmeans_int8_fused": lambda: kmeans.benchmark(
             quantize="int8", use_pallas=True,
             **(SMOKE["kmeans"] if smoke else
+               {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
+        # PR 11: the planner's hierarchical two-stage psum on the graded
+        # kmeans shape (collective.allreduce_hier; Plan rows name this
+        # config).  On one chip/host it should read ~1.0x — the win
+        # condition is a multi-host mesh — so the verdict doubles as the
+        # cost model's honesty check: flip only where topology says to.
+        "kmeans_hier_psum": lambda: kmeans.benchmark(
+            psum_schedule="hier",
+            **(SMOKE["kmeans_hier_psum"] if smoke else
                {"n": 1_000_000, "d": 300, "k": 100, "iters": 100})),
         # north-star shape (SURVEY.md §1): blocked-epoch streaming at
         # 100M×300 k=1000 (full 1B runs via --n on the app CLI)
@@ -245,6 +259,15 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "lda_rotate_int8": lambda: lda.benchmark(
             algo="pallas", carry_db=True, rotate_wire="int8",
             **(SMOKE["lda_pallas"] if smoke else
+               {"pack_cache": BENCH_DATA})),
+        # PR 11: the planner's bf16 reshard wire on the flipped default
+        # stack — half the ring bytes at ONE rounding per hop (better
+        # conditioned than int8's lossy count dequant), the middle rung
+        # the Plan row prices between exact and int8.  EXCLUSIVE with
+        # lda_rotate_int8 in flip_decision: rotate_wire is one knob.
+        "lda_planner_wire": lambda: lda.benchmark(
+            algo="pallas", carry_db=True, rotate_wire="bf16",
+            **(SMOKE["lda_planner_wire"] if smoke else
                {"pack_cache": BENCH_DATA})),
         "lda_scatter": lambda: lda.benchmark(
             algo="scatter",

@@ -510,3 +510,81 @@ def test_ingest_bench_row_satisfies_the_checker(tmp_path, mesh):
     p = tmp_path / "BENCH_local.jsonl"
     p.write_text(benchmark_json("kmeans_ingest", res) + "\n")
     assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+# -- invariant 10: plan rows (PR 11) ----------------------------------------
+
+def _plan_row(**over):
+    """A minimal valid plan row; forge one field per test below."""
+    site = {"site": "kmeans.py:346", "primitive": "psum",
+            "verb": "allreduce", "schedule": "keep",
+            "sheet_bytes": 2120, "predicted_bytes": 2120,
+            "cost_s": 1e-7, "alternatives": {}, "candidates": {},
+            "flip_candidate": None}
+    row = {"kind": "plan", "config": "plan", "program": "kmeans.fit",
+           "topology": "sim_ring_8", "rates_source": "declared",
+           "sites": [site], "predicted_bytes_total": 2120,
+           "flip_candidates": [], "backend": "cpu",
+           "date": "2026-08-04", "commit": "abc1234"}
+    row.update(over)
+    return row
+
+
+def _plan_errs(row):
+    return check_jsonl._check_plan_row("t", 1, row)
+
+
+def test_plan_row_valid_round_trip(tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text(json.dumps(_plan_row()) + "\n")
+    assert check_jsonl.check_file(str(p)) == []
+
+
+def test_plan_row_requires_provenance():
+    row = _plan_row()
+    del row["backend"]
+    assert any("provenance" in e for e in _plan_errs(row))
+
+
+def test_plan_row_rejects_unknown_program_and_topology():
+    assert any("unregistered program" in e
+               for e in _plan_errs(_plan_row(program="made.up")))
+    assert any("unknown topology" in e
+               for e in _plan_errs(_plan_row(topology="v9000")))
+
+
+def test_plan_row_rejects_unknown_and_non_keep_schedules():
+    row = _plan_row()
+    row["sites"][0]["schedule"] = "teleport"
+    assert any("unknown schedule" in e for e in _plan_errs(row))
+    # a non-"keep" CHOICE is a bypassed flip gate, even with coherent
+    # bytes — the planner fails closed by contract
+    row = _plan_row()
+    row["sites"][0]["schedule"] = "wire_int8"
+    row["sites"][0]["predicted_bytes"] = 530
+    assert any("fails closed" in e for e in _plan_errs(row))
+
+
+def test_plan_row_predicted_bytes_must_equal_sheet_scaling():
+    # drifted keep prediction: the plan prices a program we do not run
+    row = _plan_row()
+    row["sites"][0]["predicted_bytes"] = 2121
+    errs = _plan_errs(row)
+    assert any("must equal the frozen scaling" in e for e in errs)
+    # negative / non-int bytes are refused before the equality check
+    row = _plan_row()
+    row["sites"][0]["sheet_bytes"] = -5
+    assert any("non-negative integer" in e for e in _plan_errs(row))
+
+
+def test_plan_cli_rows_satisfy_the_checker(tmp_path, capsys, mesh):
+    """Round-trip: python -m harp_tpu plan --json rows pass invariant
+    10 as-is — even teed into a committed file."""
+    from harp_tpu.plan import cli
+
+    rc = cli.main(["--program", "mfsgd.epoch", "--json"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    p = tmp_path / "rows.jsonl"
+    p.write_text(line + "\n")
+    assert check_jsonl.check_file(str(p)) == []

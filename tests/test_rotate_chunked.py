@@ -439,7 +439,11 @@ def test_lda_rotate_wire_int8_chain_stays_sane(mesh):
 # -- telemetry: the wire-byte claims ----------------------------------------
 
 def _mfsgd_rotate_site_bytes(mesh, **cfg_kwargs):
-    """Per-trace rotate-verb payload bytes of one MF-SGD epoch program."""
+    """Per-trace ring-hop payload bytes of one MF-SGD epoch program.
+
+    PR 11: the pipeline's ring hop is the ``reshard`` shim (same
+    ppermute, same bytes — the verb name on the ledger changed, the
+    wire accounting did not)."""
     u, i, v = MF.synthetic_ratings(64, 64, 500, seed=0)
     cfg = MF.MFSGDConfig(rank=8, algo="scatter", chunk=64, **cfg_kwargs)
     with telemetry.scope(True):
@@ -449,7 +453,7 @@ def _mfsgd_rotate_site_bytes(mesh, **cfg_kwargs):
             model._epoch_fn.lower(model.W, model.H, *model._blocks)
         probe = telemetry.ledger.summary()["probe"]
         return sum(s["payload_bytes"] for s in probe["sites"]
-                   if s["verb"].startswith("rotate"))
+                   if s["verb"] == "reshard")
 
 
 def test_ledger_int8_rotate_bytes_quarter_of_f32(mesh):

@@ -190,7 +190,9 @@ def test_model_epoch_loops_feed_ledger(mesh):
         assert T.ledger.executions("mfsgd.epochs") == 3
         tag = T.ledger.summary()["mfsgd.epochs"]
         verbs = {s["verb"] for s in tag["sites"]}
-        assert "rotate" in verbs  # the rotation ring is on the ledger
+        # the rotation ring is on the ledger — since PR 11 through the
+        # reshard shim (same ppermute, same bytes, new verb name)
+        assert "reshard" in verbs
         assert tag["bytes_per_execution"] > 0
         assert tag["total_bytes"] == 3 * tag["bytes_per_execution"]
         spans = T.tracer.summary()
@@ -269,6 +271,6 @@ def test_full_lda_run_ledger_and_report(mesh, capsys):
     tag = rec["comm_tags"]["lda.epochs"]
     # benchmark(): 1 warmup sample_epoch + sample_epochs(2)
     assert tag["executions"] == 3
-    assert {"rotate"} <= set(rec["comm_verbs"])
+    assert {"reshard"} <= set(rec["comm_verbs"])  # the PR-11 ring-hop shim
     assert tag["total_bytes"] == 3 * tag["bytes_per_execution"] > 0
     assert rec["spans"]["lda.epochs"]["n"] == 1
