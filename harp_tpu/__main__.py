@@ -35,6 +35,9 @@ APPS = {
     "bench": ("harp_tpu.benchmark", "collective micro-benchmarks (edu.iu.benchmark)"),
     "report": ("harp_tpu.report",
                "merged run report: comm ledger + spans + metrics + top ops"),
+    "trace": ("harp_tpu.utils.reqtrace",
+              "request-level timeline: validate/summarize a trace JSONL, "
+              "export Chrome/Perfetto trace.json"),
     "lint": ("harp_tpu.analysis.cli",
              "harplint: static relay-burner analysis (AST + jaxpr + Mosaic)"),
     "plan": ("harp_tpu.plan.cli",

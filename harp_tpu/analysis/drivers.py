@@ -400,6 +400,59 @@ def _collective_reshard_wire():
     return fn, (x,)
 
 
+@register_driver("svm.train")
+def _svm_train():
+    """The SVM outer loop (PR 12): per-round SV exchange riding
+    ``reshard`` blocked→replicated (SVMConfig.sv_wire's site — the
+    planner's svm_sv_bf16/_int8 candidates price it) amplified by
+    ``outer_rounds``, plus the final model-average allreduce pair.  One
+    of the two per-app wires that had no byte sheet (ROADMAP planner
+    item, with wdamds.smacof)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.svm import SVMConfig, make_train_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    n_loc = 8
+    fn = make_train_fn(mesh, SVMConfig(inner_steps=4, outer_rounds=2,
+                                       sv_per_worker=4),
+                       d=16, n_loc=n_loc)
+    sh0 = mesh.sharding(mesh.spec(0))
+    x = jax.ShapeDtypeStruct((n_loc * nw, 16), jnp.float32, sharding=sh0)
+    y = jax.ShapeDtypeStruct((n_loc * nw,), jnp.float32, sharding=sh0)
+    sw = jax.ShapeDtypeStruct((n_loc * nw,), jnp.float32, sharding=sh0)
+    return fn, (x, y, sw)
+
+
+@register_driver("wdamds.smacof")
+def _wdamds_smacof():
+    """The unweighted SMACOF run (PR 12): the per-iteration coordinate
+    exchange riding ``reshard`` blocked→replicated
+    (MDSConfig.coord_wire's site — wdamds_coord_bf16/_int8 candidates)
+    amplified by ``iters``, plus the final stress allreduce.  Closes
+    the per-app wire coverage (ROADMAP planner item, with svm.train)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.wdamds import MDSConfig, make_smacof_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    n_pad = 4 * nw
+    fn = make_smacof_fn(mesh, MDSConfig(dim=2, iters=2), n_pad)
+    sh0 = mesh.sharding(mesh.spec(0))
+    delta = jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32,
+                                 sharding=sh0)
+    mask = jax.ShapeDtypeStruct((n_pad,), jnp.float32, sharding=sh0)
+    x0 = jax.ShapeDtypeStruct((n_pad, 2), jnp.float32,
+                              sharding=mesh.replicated())
+    n_real = jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=mesh.replicated())
+    return fn, (delta, mask, x0, n_real)
+
+
 # ---------------------------------------------------------------------------
 # Donation-audit protocols (Layer 4, HL303)
 # ---------------------------------------------------------------------------

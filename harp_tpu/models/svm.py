@@ -35,6 +35,20 @@ class SVMConfig:
     inner_steps: int = 200    # pegasos steps per outer round
     outer_rounds: int = 5     # allgather-SV rounds
     sv_per_worker: int = 256  # top-k margin violators exchanged
+    # the per-round SV exchange's wire (PR 12: the last per-app wire
+    # with no planner byte sheet, with wdamds — ROADMAP item).  The
+    # exchange rides collective.reshard blocked(0)→replicated, so
+    # "bf16"/"int8" halve/quarter the [nw*k, d] SV rows per round at
+    # ONE rounding per exchange (labels/masks ride exact — reshard
+    # narrows float leaves only).  Flip candidates svm_sv_bf16/_int8
+    # gate on train_acc (flip_decision.py); default stays exact until
+    # a relay window measures them.
+    sv_wire: str = "exact"
+
+    def __post_init__(self):
+        if self.sv_wire not in ("exact", "bf16", "int8"):
+            raise ValueError(
+                f"sv_wire must be exact|bf16|int8, got {self.sv_wire!r}")
 
 
 def _pegasos(w, b, x, y, sample_w, cfg: SVMConfig):
@@ -118,9 +132,16 @@ def _make_train_prog(cfg: SVMConfig, d: int, k: int, sparse: bool):
             score = jnp.where(sample_w > 0, y * fwd(rows, w, b), jnp.inf)
             _, idx = jax.lax.top_k(-score, k)       # most-violating k
             cand_m = (score[idx] < 1.0).astype(jnp.float32)
-            # Harp step: allgather the SV lists
-            sv_rows, sv_y, sv_m = C.allgather(
-                (take_rows(rows, idx), y[idx], cand_m))
+            # Harp step: exchange the SV lists — the general reshard
+            # verb (blocked→replicated lowers to the same tiled
+            # all_gather the old C.allgather call emitted, bit-exact on
+            # the exact wire), so cfg.sv_wire can narrow the rows and
+            # the planner prices this site off its byte sheet
+            # (analysis/drivers.py "svm.train")
+            sv_rows, sv_y, sv_m = C.reshard(
+                (take_rows(rows, idx), y[idx], cand_m),
+                C.ShardSpec.blocked(0), C.ShardSpec.replicated(),
+                wire=cfg.sv_wire)
             return (w, b, sv_rows, sv_y, sv_m), None
 
         (w, b, *_), _ = jax.lax.scan(
@@ -202,19 +223,19 @@ class SVM:
         return float((self.predict(x) == np.asarray(y)).mean())
 
 
-def benchmark(n=500_000, d=128, mesh=None, seed=0):
+def benchmark(n=500_000, d=128, mesh=None, seed=0, sv_wire="exact"):
     rng = np.random.default_rng(seed)
     true_w = rng.normal(size=d).astype(np.float32)
     x = rng.normal(size=(n, d)).astype(np.float32)
     y = np.sign(x @ true_w + 0.1 * rng.normal(size=n)).astype(np.float32)
-    model = SVM(mesh=mesh)
+    model = SVM(SVMConfig(sv_wire=sv_wire), mesh=mesh)
     model.fit(x, y)  # warmup: compile at full shape
     t0 = time.perf_counter()
     model.fit(x, y)
     dt = time.perf_counter() - t0
     return {"fit_sec": dt, "samples_per_sec": n / dt,
             "train_acc": model.accuracy(x[:50_000], y[:50_000]),
-            "n": n, "d": d}
+            "n": n, "d": d, "sv_wire": sv_wire}
 
 
 def main(argv=None):

@@ -39,6 +39,22 @@ class MDSConfig:
     # Laplacian, singular along translations — centering handles the null
     # space).  10 matched full solves to ~1e-5 relative on test problems.
     cg_iters: int = 10
+    # the per-iteration coordinate exchange's wire (PR 12: last per-app
+    # wire with no planner byte sheet, with svm — ROADMAP item).  The
+    # unweighted Guttman update's X block exchange rides
+    # collective.reshard blocked(0)→replicated; "bf16"/"int8" narrow
+    # the [N, dim] payload per iteration at one rounding per hop.
+    # UNWEIGHTED path only: the weighted CG solve applies V through its
+    # exchanges, and a quantized operator inside CG breaks the residual
+    # recurrence — that path stays exact by design.  Flip candidates
+    # wdamds_coord_bf16/_int8 gate on final_stress (flip_decision.py);
+    # default stays exact until a relay window measures them.
+    coord_wire: str = "exact"
+
+    def __post_init__(self):
+        if self.coord_wire not in ("exact", "bf16", "int8"):
+            raise ValueError(f"coord_wire must be exact|bf16|int8, got "
+                             f"{self.coord_wire!r}")
 
 
 def make_smacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
@@ -69,7 +85,14 @@ def make_smacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
             BX_rows = off @ X + diag_fix[:, None] * Xl  # [n_loc, d]
             # Guttman transform (unweighted): X ← B(X) X / n_real
             Xl_new = BX_rows / jnp.maximum(n_real, 1.0)
-            X_new = C.allgather(Xl_new)                 # [N, d] everywhere
+            # coordinate exchange via the general reshard verb
+            # (blocked→replicated = the same tiled all_gather the old
+            # C.allgather emitted, bit-exact on the exact wire) so
+            # cfg.coord_wire can narrow it and the planner prices the
+            # site (analysis/drivers.py "wdamds.smacof")
+            X_new = C.reshard(Xl_new, C.ShardSpec.blocked(0),
+                              C.ShardSpec.replicated(),
+                              wire=cfg.coord_wire)     # [N, d] everywhere
             return X_new, None
 
         X, _ = jax.lax.scan(body, X0, None, length=cfg.iters)
@@ -228,17 +251,22 @@ def mds(delta, cfg: MDSConfig | None = None, mesh: WorkerMesh | None = None,
     return np.asarray(X)[:n], float(np.asarray(stress))
 
 
-def benchmark(n=4096, mesh=None, seed=0):
+def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact"):
     rng = np.random.default_rng(seed)
-    pts = rng.normal(size=(n, 3)).astype(np.float32)
+    # 4-D points embedded into dim=3: genuinely LOSSY, so final_stress
+    # is bounded away from 0 and the coord_wire flip gate's 2% relative
+    # tolerance grades a real number — a perfectly-embeddable benchmark
+    # (3-D into 3-D) converges to stress ~0 and a relative quality gate
+    # against ~0 refuses every wire unconditionally (vacuous gate)
+    pts = rng.normal(size=(n, 4)).astype(np.float32)
     delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
-    cfg = MDSConfig(dim=3, iters=30)
+    cfg = MDSConfig(dim=3, iters=30, coord_wire=coord_wire)
     mds(delta, cfg, mesh, seed)  # warmup/compile
     t0 = time.perf_counter()
     X, stress = mds(delta, cfg, mesh, seed)
     dt = time.perf_counter() - t0
     return {"sec_total": dt, "iters_per_sec": cfg.iters / dt,
-            "final_stress": stress, "n": n}
+            "final_stress": stress, "n": n, "coord_wire": coord_wire}
 
 
 def main(argv=None):

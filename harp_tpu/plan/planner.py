@@ -85,6 +85,14 @@ FLIP_CANDIDATE_CONFIGS = {
     ("mfsgd.epoch", "reshard", "chunked_pipeline"): "mfsgd_chunked_rotate",
     ("lda.epoch", "reshard", "wire_bf16"): "lda_planner_wire",
     ("lda.epoch", "reshard", "wire_int8"): "lda_rotate_int8",
+    # PR 12: the last two per-app wires gain byte sheets + measurement
+    # paths (ROADMAP planner item) — svm's per-round SV exchange and
+    # wdamds's per-iteration coordinate exchange, both reshard
+    # blocked→replicated sites gated on train_acc / final_stress
+    ("svm.train", "reshard", "wire_bf16"): "svm_sv_bf16",
+    ("svm.train", "reshard", "wire_int8"): "svm_sv_int8",
+    ("wdamds.smacof", "reshard", "wire_bf16"): "wdamds_coord_bf16",
+    ("wdamds.smacof", "reshard", "wire_int8"): "wdamds_coord_int8",
 }
 
 

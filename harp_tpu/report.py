@@ -134,7 +134,8 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               top_ops: list | None = None,
               compile_info: dict | None = None,
               transfer_info: dict | None = None,
-              skew_info: dict | None = None) -> dict:
+              skew_info: dict | None = None,
+              trace_info: dict | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -153,6 +154,9 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
     # skew section (PR 4) only when the run recorded per-worker loads
     if skew_info:
         row["skew"] = skew_info
+    # request-trace section (PR 12) only when the run served requests
+    if trace_info and trace_info.get("requests"):
+        row["requests"] = trace_info
     for t in comm.values():
         execs = max(1, t["executions"])
         for s in t["sites"]:
@@ -259,6 +263,20 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
                 lines.append(
                     f"    min {arr[0]:g}  median {arr[len(arr) // 2]:g}  "
                     f"max {arr[-1]:g}")
+    rq = row.get("requests")
+    if rq:
+        lines.append(
+            f"requests (trace): {rq.get('requests', 0)} — "
+            f"{rq.get('served', 0)} served / {rq.get('shed', 0)} shed / "
+            f"{rq.get('failed', 0)} failed over "
+            f"{rq.get('batches', 0)} batch(es)")
+        if rq.get("served_p50_ms") is not None:
+            lines.append(f"  served latency p50 {rq['served_p50_ms']} ms"
+                         f"  p99 {rq['served_p99_ms']} ms")
+        if rq.get("unterminated"):
+            lines.append(f"  UNTERMINATED spans: {rq['unterminated']} "
+                         "(every offered request must end served/shed/"
+                         "failed — see python -m harp_tpu trace)")
     if "metrics_rows" in row:
         lines.append(f"metrics: {row['metrics_rows']} row(s)")
         if row.get("metrics_last"):
@@ -272,14 +290,16 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
-    from harp_tpu.utils import flightrec, skew
+    from harp_tpu.utils import flightrec, reqtrace, skew
 
     comm = telemetry.ledger.summary()
     spans = telemetry.tracer.summary()
     return (build_row(comm, spans, telemetry.tracer.records,
                       compile_info=flightrec.compile_watch.summary(),
                       transfer_info=flightrec.transfers.summary(),
-                      skew_info=skew.ledger.summary()),
+                      skew_info=skew.ledger.summary(),
+                      trace_info=reqtrace.summarize_rows(
+                          reqtrace.tracer.rows())),
             telemetry.tracer.records)
 
 
@@ -332,11 +352,13 @@ def main(argv=None) -> int:
     compile_rows: list[dict] = []
     transfer_rows: list[dict] = []
     skew_rows: list[dict] = []
+    trace_rows: list[dict] = []
     if args.telemetry:
         kinds = telemetry.load_rows(args.telemetry)
         span_rows, comm_rows = kinds["span"], kinds["comm"]
         compile_rows, transfer_rows = kinds["compile"], kinds["transfer"]
         skew_rows = kinds["skew"]
+        trace_rows = kinds["trace"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -351,12 +373,16 @@ def main(argv=None) -> int:
 
         top_ops = op_breakdown(args.trace_logdir, top=args.top)
 
+    from harp_tpu.utils.reqtrace import summarize_rows as trace_summary
+
     row = build_row(comm_summary_from_rows(comm_rows),
                     span_summary_from_rows(span_rows),
                     span_rows, metrics_rows, top_ops,
                     compile_info=compile_summary_from_rows(compile_rows),
                     transfer_info=transfer_summary_from_rows(transfer_rows),
-                    skew_info=skew_summary_from_rows(skew_rows))
+                    skew_info=skew_summary_from_rows(skew_rows),
+                    trace_info=(trace_summary(trace_rows)
+                                if trace_rows else None))
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

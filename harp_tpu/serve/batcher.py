@@ -76,6 +76,10 @@ class Batch:
     rung: int                    # padded row count (the compiled shape)
     rows: int                    # real rows (<= rung)
     requests: list[tuple[Any, int, int]]
+    #: scheduler-assigned formation ordinal (continuous plane only):
+    #: the request→batch join key the request tracer records, so a
+    #: trace can say WHICH batch carried which row slice (PR 12)
+    seq: int = -1
 
     @property
     def padding_frac(self) -> float:
@@ -214,6 +218,7 @@ class ContinuousScheduler:
         self.queued_rows = 0
         self.padded_rows = 0
         self.real_rows = 0
+        self.batches_formed = 0  # monotone Batch.seq source
 
     def put(self, key: Any, n_rows: int, now: float) -> None:
         """Admit a request (legal mid-flight — that is the point)."""
@@ -296,7 +301,9 @@ class ContinuousScheduler:
         self.queued_rows -= rows
         self.real_rows += rows
         self.padded_rows += rung - rows
-        return Batch(rung=rung, rows=rows, requests=requests)
+        seq = self.batches_formed
+        self.batches_formed += 1
+        return Batch(rung=rung, rows=rows, requests=requests, seq=seq)
 
     def padding_frac(self) -> float:
         """Cumulative padded / dispatched rows (0.0 before any batch)."""

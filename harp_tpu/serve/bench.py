@@ -145,6 +145,20 @@ def _pctls(xs, ps=(50, 95, 99)) -> tuple[float, ...]:
     return tuple(round(float(v), 4) for v in np.percentile(list(xs), ps))
 
 
+def _rank_pctls(xs, ps=(50, 95, 99)) -> tuple[float, ...]:
+    """Ceil-rank (inverse-CDF) percentiles — the SAME rank convention
+    :class:`~harp_tpu.utils.reqtrace.LogHist` uses, so the win_* vs
+    exact comparison is bucketization error alone, no interpolation
+    slack."""
+    import math
+
+    if not len(xs):
+        return tuple(0.0 for _ in ps)
+    arr = sorted(float(v) for v in xs)
+    return tuple(round(arr[max(1, math.ceil(p / 100 * len(arr))) - 1], 4)
+                 for p in ps)
+
+
 def _burst_replay(srv: Server, reqs: list[dict], arrivals: np.ndarray,
                   burst_admit: int) -> dict:
     """The PR-6 plane on the trace: admit up to ``burst_admit`` arrived
@@ -237,6 +251,16 @@ def _continuous_replay(srv: Server, runner, reqs: list[dict],
             "qdepth_p50": q50, "qdepth_p95": q95, "qdepth_p99": q99,
             "padding_frac": round(runner.sched.padding_frac(), 6),
             "served": served, "shed": shed, "failed": failed,
+            # the STREAMING percentiles at end-of-replay (PR 12):
+            # bounded-memory log-bucket histograms fed at the runner's
+            # completion clock.  Their exact-sample accuracy reference
+            # is runner.latencies_ms (the SAME events, same clock) —
+            # p50/p95/p99 above additionally include the completing
+            # window's host wall (the client-observed basis), so only
+            # the runner-basis pair is a pure bucket-error comparison
+            # (win_rel_err is that documented bound).
+            "window": runner.win.snapshot(now),
+            "runner_pctls_ms": _rank_pctls(runner.latencies_ms),
             "span_s": now}
 
 
@@ -326,7 +350,12 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
                 max_queue_delay_s=max_queue_delay_ms / 1e3,
                 rung_policy=rung_policy,
                 deadline_s=(deadline_ms / 1e3 if deadline_ms else None),
-                max_queue_rows=max_queue_rows, max_retries=max_retries)
+                max_queue_rows=max_queue_rows, max_retries=max_retries,
+                # window sized past any replay so the win_* fields and
+                # the exact percentiles describe the SAME sample set —
+                # the bucket-error comparison is apples-to-apples (live
+                # servers keep the 60 s rolling default)
+                stats_window_s=3600.0)
             injector = FaultInjector(
                 seed=fault_seed,
                 fail={"dispatch": fault_rate} if fault_rate else None)
@@ -354,6 +383,23 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
             "qdepth_p50": cont["qdepth_p50"],
             "qdepth_p95": cont["qdepth_p95"],
             "qdepth_p99": cont["qdepth_p99"],
+            # rolling-window (streaming-histogram) twins of the exact
+            # percentiles above — what a LIVE server reports through the
+            # TCP stats line; agreement is bounded by win_rel_err
+            # (reqtrace.QUANTILE_REL_ERR, the log-bucket width)
+            "win_p50_ms": cont["window"]["p50_ms"],
+            "win_p95_ms": cont["window"]["p95_ms"],
+            "win_p99_ms": cont["window"]["p99_ms"],
+            "win_qdepth_p99": cont["window"]["qdepth_p99"],
+            "win_samples": cont["window"]["samples"],
+            "win_rel_err": cont["window"]["rel_err"],
+            # exact ceil-rank percentiles over the SAME samples/clock
+            # the streaming histogram ingested — |win_pXX - runner_pXX|
+            # <= win_rel_err * runner_pXX is the machine-checked
+            # agreement contract (invariant 11 / tests)
+            "runner_p50_ms": cont["runner_pctls_ms"][0],
+            "runner_p95_ms": cont["runner_pctls_ms"][1],
+            "runner_p99_ms": cont["runner_pctls_ms"][2],
             "padding_frac": cont["padding_frac"],
             "burst_qps": round(burst["qps"], 4),
             "burst_p50_ms": burst["p50_ms"],

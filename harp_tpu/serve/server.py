@@ -58,7 +58,7 @@ from harp_tpu.serve.batcher import (DEFAULT_LADDER, ContinuousScheduler,
                                     MicroBatcher, ShapeLadder)
 from harp_tpu.serve.cache import ExecutableCache, code_fingerprint
 from harp_tpu.serve.engines import make_engine
-from harp_tpu.utils import flightrec, telemetry
+from harp_tpu.utils import flightrec, reqtrace, telemetry
 
 
 class Server:
@@ -239,7 +239,8 @@ class Server:
                     clock: Callable[[], float] = time.perf_counter,
                     deadline_s: float | None = None,
                     max_queue_rows: int | None = None,
-                    max_retries: int = 2) -> "ContinuousRunner":
+                    max_retries: int = 2,
+                    stats_window_s: float = 60.0) -> "ContinuousRunner":
         """A continuous request plane over this server's executables."""
         if not self._exec:
             raise RuntimeError("call startup() before make_runner()")
@@ -247,7 +248,8 @@ class Server:
                                 rung_policy=rung_policy, depth=depth,
                                 clock=clock, deadline_s=deadline_s,
                                 max_queue_rows=max_queue_rows,
-                                max_retries=max_retries)
+                                max_retries=max_retries,
+                                stats_window_s=stats_window_s)
 
     # -- stdio loop --------------------------------------------------------
     def serve_stdio(self, stdin: IO, stdout: IO) -> int:
@@ -354,7 +356,8 @@ class ContinuousRunner:
                  clock: Callable[[], float] = time.perf_counter,
                  deadline_s: float | None = None,
                  max_queue_rows: int | None = None,
-                 max_retries: int = 2):
+                 max_retries: int = 2,
+                 stats_window_s: float = 60.0):
         if depth < 1:
             raise ValueError(f"pipeline depth {depth} must be >= 1")
         if max_retries < 0:
@@ -369,7 +372,7 @@ class ContinuousRunner:
         self.max_queue_rows = max_queue_rows
         self.max_retries = int(max_retries)
         self._in_flight: collections.deque = collections.deque()
-        # key -> {"req", "rows", "segs"} for admitted-not-answered work
+        # key -> {"req", "rows", "segs", "rid"} admitted-not-answered
         self._asm: dict[Any, dict] = {}
         self.dispatched = 0
         self.completed = 0
@@ -380,22 +383,35 @@ class ContinuousRunner:
         self.failed = 0  # requests answered with a hard-failure error
         self.latencies_ms: collections.deque = collections.deque(
             maxlen=4096)
+        # streaming windowed percentiles (PR 12): bounded-memory rolling
+        # latency/queue-depth histograms on the runner's own clock —
+        # live p50/p95/p99 for the TCP stats line and the sustained
+        # bench row without retaining samples
+        self.win = reqtrace.RollingWindow(window_s=stats_window_s)
 
     # -- admission ---------------------------------------------------------
-    def submit(self, key: Any, req: Any,
-               now: float | None = None) -> list[tuple[Any, dict]]:
+    def submit(self, key: Any, req: Any, now: float | None = None,
+               trace_id: int | None = None) -> list[tuple[Any, dict]]:
         """Admit one request; returns immediately-answerable responses
         (malformed / empty / shed requests), else [] with the rows
-        queued."""
+        queued.  ``trace_id`` carries a request-tracer span minted at
+        transport arrival (PR 12); without one, a span is minted here
+        at admission time — either way every offered request ends in a
+        terminated span with outcome served/shed/failed."""
         now = self.clock() if now is None else now
+        rid = (trace_id if trace_id is not None
+               else reqtrace.tracer.begin(now))
         if not isinstance(req, dict):
+            reqtrace.tracer.end(rid, "failed", now, reason="bad_request")
             return [(key, {"id": None,
                            "error": "request must be a JSON object"})]
         try:
             rows = self.srv.engine.rows_from_request(req)
         except (ValueError, KeyError, TypeError) as e:
+            reqtrace.tracer.end(rid, "failed", now, reason="bad_request")
             return [(key, {"id": req.get("id"), "error": str(e)})]
         if rows.shape[0] == 0:
+            reqtrace.tracer.end(rid, "served", now, rows=0)
             return [(key, {"id": req.get("id"), "result": []})]
         if key in self._asm:
             raise ValueError(f"request key {key!r} already in flight")
@@ -403,13 +419,17 @@ class ContinuousRunner:
                 and self.sched.queued_rows + rows.shape[0]
                 > self.max_queue_rows):
             self.shed += 1
+            reqtrace.tracer.end(rid, "shed", now, reason="queue_full",
+                                queued_rows=self.sched.queued_rows)
             return [(key, {
                 "id": req.get("id"), "shed": True, "reason": "queue_full",
                 "error": f"shed: admission queue full "
                          f"({self.sched.queued_rows} rows queued, bound "
                          f"{self.max_queue_rows})"})]
+        reqtrace.tracer.event(rid, "admit", now, rows=int(rows.shape[0]),
+                              queued_rows=self.sched.queued_rows)
         self._asm[key] = {"req": req, "rows": rows, "segs": [],
-                          "arrival": now}
+                          "arrival": now, "rid": rid}
         self.sched.put(key, rows.shape[0], now)
         return []
 
@@ -428,6 +448,7 @@ class ContinuousRunner:
         degraded window; [] for a clean dispatch window or an idle
         call)."""
         now = self.clock() if now is None else now
+        self.win.add_qdepth(now, self.sched.queued_rows)
         out: list[tuple[Any, dict]] = []
         if self.deadline_s is not None:
             out += self._shed_expired(now)
@@ -439,6 +460,13 @@ class ContinuousRunner:
                 return out
             rows_by_key = {key: self._asm[key]["rows"]
                            for key, _, _ in batch.requests}
+            tr = reqtrace.tracer
+            tr.batch(batch.seq, now, rung=batch.rung, rows=batch.rows,
+                     members=[(self._asm[key]["rid"], lo, hi)
+                              for key, lo, hi in batch.requests])
+            for key, lo, hi in batch.requests:
+                tr.event(self._asm[key]["rid"], "batch", now,
+                         seq=batch.seq, lo=lo, hi=hi, rung=batch.rung)
             attempt = 0
             while True:
                 try:
@@ -455,16 +483,25 @@ class ContinuousRunner:
                 except Exception as e:  # noqa: BLE001 - isolate, count
                     attempt += 1
                     if attempt > self.max_retries:
-                        return out + self._fail_batch(batch, e)
+                        return out + self._fail_batch(batch, e, now)
                     self.fault_retries += 1
+                    # timestamps stay on the CALLER's clock (`now`): the
+                    # sustained replay drives a virtual timeline, and a
+                    # wall-clock stamp here would break the trace's
+                    # monotone-ts contract (invariant 11)
+                    tr.batch_event(batch.seq, "retry", now,
+                                   attempt=attempt,
+                                   error=f"{type(e).__name__}: {e}")
             self._in_flight.append((batch, out_dev))
             self.dispatched += 1
             self.srv.rows_served += batch.rows
+            tr.batch_event(batch.seq, "dispatch", now)
             return out
         if self._in_flight:
             with self.srv.steady.batch():
                 batch, out_dev = self._in_flight.popleft()
                 res = flightrec.readback(out_dev)
+            reqtrace.tracer.batch_event(batch.seq, "readback", now)
             return out + self._complete(batch, res, now)
         return out
 
@@ -475,6 +512,7 @@ class ContinuousRunner:
         for key in self.sched.expire(now, self.deadline_s):
             a = self._asm.pop(key)
             self.shed += 1
+            reqtrace.tracer.end(a["rid"], "shed", now, reason="deadline")
             out.append((key, {
                 "id": a["req"].get("id"), "shed": True,
                 "reason": "deadline",
@@ -482,10 +520,13 @@ class ContinuousRunner:
                          f"ms) exceeded before dispatch"}))
         return out
 
-    def _fail_batch(self, batch, exc: Exception) -> list[tuple[Any, dict]]:
+    def _fail_batch(self, batch, exc: Exception,
+                    now: float) -> list[tuple[Any, dict]]:
         """Retries exhausted: isolate the failure to this batch's
         requests (structured errors) and keep the runner serving."""
         self.engine_failures += 1
+        reqtrace.tracer.batch_event(batch.seq, "engine_failure", now,
+                                    error=f"{type(exc).__name__}: {exc}")
         keys = {key for key, _, _ in batch.requests}
         self.sched.discard(keys)  # tail segments must not dispatch later
         out: list[tuple[Any, dict]] = []
@@ -494,6 +535,8 @@ class ContinuousRunner:
             if a is None:
                 continue
             self.failed += 1
+            reqtrace.tracer.end(a["rid"], "failed", now,
+                                reason="engine_failure", seq=batch.seq)
             out.append((key, {
                 "id": a["req"].get("id"),
                 "error": f"engine failure after {self.max_retries} "
@@ -521,8 +564,11 @@ class ContinuousRunner:
                         full, hi)}))
                 lat = now - a["arrival"]
                 self.latencies_ms.append(lat * 1e3)
+                self.win.add_latency(now, lat * 1e3)
                 if self.deadline_s is not None and lat > self.deadline_s:
                     self.deadline_misses += 1  # answered, but late
+                reqtrace.tracer.end(a["rid"], "served", now,
+                                    latency_ms=round(lat * 1e3, 4))
                 del self._asm[key]
                 self.completed += 1
                 self.srv.requests_served += 1
@@ -560,7 +606,10 @@ class ContinuousRunner:
                 "fault_retries": self.fault_retries,
                 "engine_failures": self.engine_failures,
                 "failed": self.failed,
-                "p50_ms": pct(50), "p99_ms": pct(99)}
+                "p50_ms": pct(50), "p99_ms": pct(99),
+                # live rolling-window percentiles (PR 12): bounded-memory
+                # log-bucket histograms, error documented in the field
+                "window": self.win.snapshot(self.clock())}
 
 
 class _BurstReader:
@@ -719,12 +768,20 @@ def main(argv=None) -> int:
                 max_queue_rows=args.max_queue_rows,
                 max_retries=args.max_retries,
                 fault_rate=args.fault_rate)
-            print(benchmark_json(f"serve_{args.app}_sustained", res))
+            config = f"serve_{args.app}_sustained"
+            print(benchmark_json(config, res))
         else:
             res = benchmark(app=args.app, n_requests=args.requests,
                             rows_per_request=args.rows_per_request,
                             ladder=ladder)
-            print(benchmark_json(f"serve_{args.app}", res))
+            config = f"serve_{args.app}"
+            print(benchmark_json(config, res))
+        # under HARP_TELEMETRY=1 the request trace rides the standard
+        # exit report (HARP_TELEMETRY_OUT exports kind:"trace" rows for
+        # python -m harp_tpu trace), like every instrumented app CLI
+        from harp_tpu import report
+
+        report.maybe_emit(config)
         return 0
 
     if args.ckpt is None:

@@ -55,6 +55,7 @@ import time
 from typing import Any
 
 from harp_tpu.serve.server import Server
+from harp_tpu.utils import reqtrace
 
 _STOP = object()   # dispatcher-queue sentinel
 _CLOSE = object()  # per-connection writer sentinel
@@ -95,6 +96,7 @@ class TCPFrontEnd:
         self._started = threading.Event()
         self._thread: threading.Thread | None = None
         self._conns: set[_Conn] = set()
+        self._rids: dict[Any, int] = {}  # (conn, seq) -> trace id
         self.runner = None
 
     # -- event-loop side ---------------------------------------------------
@@ -162,7 +164,12 @@ class TCPFrontEnd:
                     break
                 conn.outstanding += 1
                 conn.seq += 1
-                self._inq.put((conn, conn.seq, req, time.perf_counter()))
+                # trace id minted AT the socket (PR 12): the honest span
+                # origin is transport arrival, not dispatcher admission
+                t = time.perf_counter()
+                rid = reqtrace.arrive(t, transport="tcp",
+                                      conn=id(conn), seq=conn.seq)
+                self._inq.put((conn, conn.seq, req, t, rid))
         finally:
             conn.draining = True
             if conn.outstanding == 0 or conn.closed:
@@ -204,7 +211,20 @@ class TCPFrontEnd:
     # -- dispatcher side ---------------------------------------------------
     def _post(self, key: Any, resp: dict) -> None:
         conn, _seq = key
+        # delivery closes the causal chain: the span already terminated
+        # (served/shed/failed) when the runner answered; this stamps the
+        # moment the response left the dispatcher for the owning socket
+        reqtrace.tracer.event(self._rids.pop(key, None), "deliver",
+                              time.perf_counter())
         self._loop.call_soon_threadsafe(self._deliver, conn, resp, True)
+
+    def _submit(self, item) -> None:
+        conn, seq, req, t, rid = item
+        key = (conn, seq)
+        if rid is not None:
+            self._rids[key] = rid
+        for k, resp in self.runner.submit(key, req, now=t, trace_id=rid):
+            self._post(k, resp)
 
     def _dispatch_loop(self) -> None:
         r = self.runner
@@ -218,9 +238,7 @@ class TCPFrontEnd:
                 if item is _STOP:
                     stop = True
                     break
-                conn, seq, req, t = item
-                for key, resp in r.submit((conn, seq), req, now=t):
-                    self._post(key, resp)
+                self._submit(item)
             if stop:
                 for key, resp in r.drain():
                     self._post(key, resp)
@@ -233,9 +251,7 @@ class TCPFrontEnd:
                     for key, resp in r.drain():
                         self._post(key, resp)
                     return
-                conn, seq, req, t = item
-                for key, resp in r.submit((conn, seq), req, now=t):
-                    self._post(key, resp)
+                self._submit(item)
 
     # -- lifecycle ---------------------------------------------------------
     def start_in_thread(self) -> "TCPFrontEnd":

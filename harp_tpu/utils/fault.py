@@ -182,6 +182,7 @@ class FaultInjector:
         n = self.seen[site]
         if _spec_fires(self.delay.get(site), n, self._rng):
             self.delayed[site] += 1
+            self._mark(site, n, "delay")
             time.sleep(self.delay_s)
         if (self.max_faults is not None
                 and sum(self.injected.values()) >= self.max_faults):
@@ -189,7 +190,23 @@ class FaultInjector:
         if _spec_fires(self.fail.get(site), n, self._rng):
             self.injected[site] += 1
             self.events.append((site, n))
+            self._mark(site, n, "fail")
             raise InjectedFault(site, n)
+
+    @staticmethod
+    def _mark(site: str, ordinal: int, action: str) -> None:
+        """Fault-plane events ride the unified timeline (PR 12): a
+        ``source:"fault"`` mark on the SpanTracer clock, so an injected
+        failure shows up next to the batch that absorbed it in
+        ``telemetry.export_timeline`` / the Perfetto view.  No-op while
+        telemetry is off, like every other spine hook."""
+        from harp_tpu.utils import reqtrace, telemetry
+
+        if telemetry.enabled():
+            reqtrace.tracer.mark(
+                "fault", f"injected_{action}",
+                time.perf_counter() - telemetry.tracer._t0,
+                site=site, ordinal=ordinal)
 
     @contextlib.contextmanager
     def arm(self):

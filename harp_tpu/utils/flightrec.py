@@ -93,13 +93,20 @@ class CompileWatch:
     def reset(self) -> None:
         self.count = 0
         self.total_s = 0.0
-        self.records: list[dict] = []  # {"dur", "span"} per compile
+        self.records: list[dict] = []  # {"dur", "span", "t"} per compile
 
     def on_compile(self, duration: float) -> None:
+        import time
+
         self.count += 1
         self.total_s += float(duration)
+        # "t": completion offset on the SpanTracer's clock, so the
+        # compile lands on telemetry.export_timeline next to the host
+        # span it fired under (PR 12)
         self.records.append({"dur": round(float(duration), 6),
-                             "span": telemetry.tracer.current_path()})
+                             "span": telemetry.tracer.current_path(),
+                             "t": round(time.perf_counter()
+                                        - telemetry.tracer._t0, 6)})
 
     def summary(self) -> dict:
         """{"count", "total_s", "by_span": {span_path: {count, total_s}}}."""
@@ -120,7 +127,7 @@ class CompileWatch:
             cum = round(cum + r["dur"], 6)
             row = {"kind": "compile", "event": "backend_compile",
                    "count": i + 1, "dur": r["dur"], "total_s": cum,
-                   "span": r["span"], **(stamp or {})}
+                   "span": r["span"], "t": r.get("t"), **(stamp or {})}
             fh.write(json.dumps(row) + "\n")
 
 

@@ -82,10 +82,11 @@ def scope(on: bool = True, *, reset: bool = True):
     if reset:
         ledger.reset()
         tracer.reset()
-        from harp_tpu.utils import flightrec, skew
+        from harp_tpu.utils import flightrec, reqtrace, skew
 
         flightrec.reset()
         skew.reset()
+        reqtrace.reset()
     try:
         yield
     finally:
@@ -396,25 +397,88 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 def export(path: str) -> None:
     """Write every collected record (spans + ledger + flight recorder +
-    skew ledger) as one JSONL file — the input format of ``python -m
-    harp_tpu report``."""
-    from harp_tpu.utils import flightrec, skew
+    skew ledger + request traces) as one JSONL file — the input format
+    of ``python -m harp_tpu report`` and ``python -m harp_tpu trace``."""
+    from harp_tpu.utils import flightrec, reqtrace, skew
 
     with open(path, "w") as fh:
         tracer.export_jsonl(fh)
         ledger.export_jsonl(fh)
         flightrec.export_jsonl(fh)
         skew.export_jsonl(fh)
+        reqtrace.tracer.export_jsonl(fh)
+
+
+def export_timeline(path: str) -> None:
+    """Merge EVERY spine into one causally-ordered ``kind:"trace"``
+    JSONL (PR 12) — request spans + batch records + fault-plane marks
+    (already timestamped trace rows), host spans (ts = span t0) and XLA
+    compiles (ts = the compile's wall offset on the span clock) folded
+    in as marks, and the timestamp-less aggregate spines (comm ledger,
+    transfer sites, skew phases) appended at the end as ``summary``
+    rows riding the final timestamp — they describe the whole run, so
+    the causal slot they occupy is "after everything".
+
+    Clock domains are normalized per source to its own origin (the
+    serve replay drives a virtual clock; spans/compiles ride the
+    SpanTracer's wall offset), so ordering is exact within a source and
+    aligned-at-zero across sources.  The output passes
+    scripts/check_jsonl.py invariant 11 and loads in
+    ``python -m harp_tpu trace`` / Perfetto via :func:`harp_tpu.utils.
+    reqtrace.perfetto`.
+    """
+    from harp_tpu.utils import flightrec, reqtrace, skew
+
+    def _normalized(rows: list[dict]) -> list[dict]:
+        if not rows:
+            return []
+        t0 = min(float(r["ts"]) for r in rows)
+        return [{**r, "ts": round(float(r["ts"]) - t0, 6)} for r in rows]
+
+    rows = _normalized(reqtrace.tracer.rows())
+    host: list[dict] = [
+        {"kind": "trace", "ev": "mark", "source": "span", "ts": r["t0"],
+         "name": r["span"], "path": r["path"], "dur": r["dur"],
+         "depth": r["depth"]}
+        for r in tracer.records]
+    host += [
+        {"kind": "trace", "ev": "mark", "source": "compile",
+         "ts": r.get("t", 0.0), "name": "backend_compile",
+         "dur": r["dur"], "span": r["span"]}
+        for r in flightrec.compile_watch.records]
+    rows += _normalized(host)
+    rows.sort(key=lambda r: r["ts"])
+    t_end = rows[-1]["ts"] if rows else 0.0
+    for tag, t in sorted(ledger.summary().items()):
+        rows.append({"kind": "trace", "ev": "summary", "source": "comm",
+                     "ts": t_end, "name": tag,
+                     "executions": t["executions"],
+                     "total_bytes": t["total_bytes"]})
+    tr = flightrec.transfers.summary()
+    if tr["sites"]:
+        rows.append({"kind": "trace", "ev": "summary",
+                     "source": "transfer", "ts": t_end, "name": "totals",
+                     "h2d_bytes": tr["h2d_bytes"],
+                     "dispatches": tr["dispatches"],
+                     "readbacks": tr["readbacks"]})
+    for phase, s in skew.ledger.summary().items():
+        rows.append({"kind": "trace", "ev": "summary", "source": "skew",
+                     "ts": t_end, "name": phase,
+                     "max_mean_ratio": s.get("max_mean_ratio")})
+    stamp = flightrec.provenance_stamp()
+    with open(path, "w") as fh:
+        for row in rows:
+            fh.write(json.dumps({**row, **stamp}) + "\n")
 
 
 def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
-    [...], "skew": [...]}`` (unknown kinds land under ``"comm"`` for
-    backward compatibility with pre-flight-recorder exports, whose only
-    unmarked rows were the ledger's)."""
+    [...], "skew": [...], "trace": [...]}`` (unknown kinds land under
+    ``"comm"`` for backward compatibility with pre-flight-recorder
+    exports, whose only unmarked rows were the ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
-                                  "transfer": [], "skew": []}
+                                  "transfer": [], "skew": [], "trace": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

@@ -54,6 +54,10 @@ SMOKE = {
                                               "rank": 8}},
     "subgraph": {"n_vertices": 2000, "avg_degree": 4},
     "rf": {"n": 4096, "f": 16, "max_depth": 3, "n_trees": 2},
+    # PR 12: first svm/wdamds sweep rows (incumbents of the new wire
+    # candidates) — small enough for seconds on the CPU sim
+    "svm": {"n": 4096, "d": 32},
+    "wdamds": {"n": 256},
 }
 
 # PR 11 planner candidates measure the SAME shapes as their incumbents
@@ -62,3 +66,7 @@ SMOKE = {
 # an incumbent smoke-shape change can never drift the pair apart.
 SMOKE["kmeans_hier_psum"] = SMOKE["kmeans"]
 SMOKE["lda_planner_wire"] = SMOKE["lda_pallas"]
+# PR 12 wire candidates measure their incumbents' shapes (only the
+# exchange wire differs) — aliases so the pairs can never drift apart
+SMOKE["svm_sv_bf16"] = SMOKE["svm_sv_int8"] = SMOKE["svm"]
+SMOKE["wdamds_coord_bf16"] = SMOKE["wdamds_coord_int8"] = SMOKE["wdamds"]

@@ -118,6 +118,26 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     schedule scaling of the site's ``sheet_bytes`` (for ``keep``,
     exactly the program's byte sheet: a plan whose predictions drift
     from the sheet is pricing a program this repo does not run).
+
+11. **Trace rows are a complete causal timeline** (any file): a ``kind:
+    "trace"`` row (``harp_tpu.utils.reqtrace`` — ``telemetry.export`` /
+    ``export_timeline``, PR 12) must carry the provenance stamp (a
+    CPU-sim request timeline must never read as relay latency
+    evidence), declare a known row shape (``ev`` ∈
+    ``KNOWN_TRACE_EVS``), and carry a numeric non-negative ``ts`` that
+    is MONOTONE non-decreasing down the file (the exporters sort — a
+    decrease means two runs' timelines were interleaved, and a
+    "causally ordered" file that is not ordered is not a timeline).
+    Every request id seen in an ``ev:"event"`` row must have a
+    TERMINATED ``ev:"request"`` row whose ``outcome`` ∈
+    ``KNOWN_TRACE_OUTCOMES`` (served / shed / failed — an offered
+    request that simply vanishes from its own trace is the exact
+    failure mode request tracing exists to make impossible), and when
+    the same file carries exactly one invariant-9 degraded-mode serve
+    row, the per-outcome request counts must reconcile with that
+    ledger EXACTLY (served == served_requests, etc.): a trace and a
+    bench row telling different stories about the same run means one
+    of them is lying.
 """
 
 from __future__ import annotations
@@ -258,7 +278,8 @@ KNOWN_LINT_PROGRAMS = (
     "kmeans.fit_hier", "lda.epoch",
     "mfsgd.epoch", "ring_attention", "rotate.pipeline_chunked",
     "serve.kmeans_assign", "serve.lda_infer", "serve.mfsgd_topk",
-    "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores")
+    "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores",
+    "svm.train", "wdamds.smacof")
 KNOWN_COMM_PRIMITIVES = ("all_gather", "all_to_all", "pmax", "pmin",
                          "ppermute", "psum", "reduce_scatter")
 KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_hier",
@@ -535,6 +556,90 @@ def _check_plan_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the trace-row vocabularies (invariant 11), FROZEN standalone like the
+# lint rule ids and sync-pinned by tests/test_reqtrace.py against
+# harp_tpu.utils.reqtrace.OUTCOMES
+KNOWN_TRACE_OUTCOMES = ("served", "shed", "failed")
+KNOWN_TRACE_EVS = ("event", "request", "batch", "mark", "summary")
+
+
+def _check_trace_row(name: str, i: int, row: dict,
+                     state: dict) -> list[str]:
+    """Invariant 11, per-row half: stamp, row shape, monotone ts.
+
+    ``state`` accumulates the file-level evidence the end-of-file half
+    (:func:`_finish_trace_checks`) reconciles: request ids seen in
+    event rows, terminated request rows with their outcomes, and the
+    previous row's timestamp for monotonicity.
+    """
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: trace row missing provenance field(s) "
+            f"{missing} — export through telemetry.export / "
+            "telemetry.export_timeline, which stamp them")
+    ev = row.get("ev")
+    if ev not in KNOWN_TRACE_EVS:
+        errs.append(f"{name}:{i}: trace row ev={ev!r} not in "
+                    f"{KNOWN_TRACE_EVS}")
+    ts = row.get("ts")
+    if not _num(ts) or ts < 0:
+        errs.append(f"{name}:{i}: trace row ts={ts!r} must be a "
+                    "non-negative number — a timeline row without a "
+                    "timestamp cannot be causally ordered")
+    else:
+        last = state.get("last_ts")
+        if last is not None and ts < last:
+            errs.append(
+                f"{name}:{i}: trace row ts={ts} decreased from {last} — "
+                "timeline rows must be monotone (interleaved exports?)")
+        state["last_ts"] = ts
+    if ev == "event" and "req" in row:
+        state.setdefault("seen", set()).add(row["req"])
+    if ev == "request":
+        outcome = row.get("outcome")
+        if outcome not in KNOWN_TRACE_OUTCOMES:
+            errs.append(
+                f"{name}:{i}: trace request row req={row.get('req')!r} "
+                f"has outcome={outcome!r} — every request span must "
+                f"terminate with one of {KNOWN_TRACE_OUTCOMES}")
+        else:
+            counts = state.setdefault(
+                "outcomes", {o: 0 for o in KNOWN_TRACE_OUTCOMES})
+            counts[outcome] += 1
+        state.setdefault("terminated", set()).add(row.get("req"))
+    return errs
+
+
+def _finish_trace_checks(name: str, state: dict,
+                         degraded: list[tuple[int, dict]]) -> list[str]:
+    """Invariant 11, file-level half: span completeness + ledger
+    reconciliation (runs after the whole file was scanned)."""
+    errs: list[str] = []
+    unterminated = sorted(state.get("seen", set())
+                          - state.get("terminated", set()))
+    if unterminated:
+        errs.append(
+            f"{name}: trace has {len(unterminated)} request span(s) with "
+            f"events but no terminated outcome row: {unterminated[:8]} — "
+            "every offered request must end served/shed/failed")
+    counts = state.get("outcomes")
+    if counts is not None and len(degraded) == 1:
+        _, row = degraded[0]
+        ledger = {"served": row.get("served_requests"),
+                  "shed": row.get("shed_requests"),
+                  "failed": row.get("failed_requests")}
+        if all(isinstance(v, int) and not isinstance(v, bool)
+               for v in ledger.values()) and counts != ledger:
+            errs.append(
+                f"{name}: trace outcome counts {counts} do not "
+                f"reconcile with the file's invariant-9 serve ledger "
+                f"{ledger} — the timeline and the bench row describe "
+                "different runs")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -573,6 +678,8 @@ def check_file(path: str, grandfathered: int = 0,
     except OSError as e:
         return [f"{name}: unreadable: {e}"]
     flight_state: dict = {}
+    trace_state: dict = {}
+    degraded_rows: list[tuple[int, dict]] = []
     for i, line in enumerate(lines, 1):
         if not line.strip():
             continue
@@ -592,10 +699,14 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_lint_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "serve":
             errors += _check_serve_row(name, i, row)
+            if any(k in row for k in DEGRADED_TRIGGER_FIELDS):
+                degraded_rows.append((i, row))
         if isinstance(row, dict) and row.get("kind") == "ingest":
             errors += _check_ingest_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "plan":
             errors += _check_plan_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "trace":
+            errors += _check_trace_row(name, i, row, trace_state)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:
@@ -606,6 +717,7 @@ def check_file(path: str, grandfathered: int = 0,
                 f"{name}:{i}: bench row config={row.get('config')!r} "
                 f"missing provenance field(s) {missing} — print it "
                 "through harp_tpu.utils.metrics.benchmark_json")
+    errors += _finish_trace_checks(name, trace_state, degraded_rows)
     return errors
 
 

@@ -1848,3 +1848,112 @@ print(f"planner: mfsgd.epoch sheet {_pl_plan.predicted_bytes_total()} B "
       "== ledger both ways; alt schedules execute bit-identical; "
       "hier candidate only on v4_32")
 print(f"DRIVE OK round-31 ({mode})")
+
+# --- round 32: request-level tracing (PR 12) -------------------------------
+# One causal timeline across the serve plane: a continuous run under
+# seeded chaos yields complete span trees that reconcile EXACTLY with
+# the runner's own counters, the merged timeline passes check_jsonl
+# invariant 11 next to its ledger row, the trace CLI and the Perfetto
+# exporter both load it, and the new svm/wdamds wire knobs execute
+# with their exact arm unchanged.
+from harp_tpu.serve.engines import ENGINES as _rtE
+from harp_tpu.serve.server import Server as _rtServer
+from harp_tpu.utils import reqtrace as _rt
+from harp_tpu.utils import telemetry as _rtT
+from harp_tpu.utils.fault import FaultInjector as _rtFI
+
+import json as _rt_json
+import subprocess as _rt_sp
+import tempfile as _rt_tmp
+
+with _rtT.scope(True):
+    _rt_rng = np.random.default_rng(32)
+    _rt_srv = _rtServer(
+        "kmeans", state=_rtE["kmeans"].synthetic_state(_rt_rng, k=4, d=8),
+        mesh=mesh, ladder=(1, 8))
+    _rt_srv.startup()
+    _rt_srv.steady.reset()
+    _rt_r = _rt_srv.make_runner(depth=2, max_queue_rows=8, max_retries=1)
+    _rt_inj = _rtFI(seed=0, fail={"dispatch": (2,)})
+    _rt_t = 0.0
+    with _rt_inj.arm():
+        for _rt_i in range(8):
+            _rt_r.submit(_rt_i, {"id": _rt_i, "x": _rt_rng.normal(
+                size=(2, 8)).tolist()}, now=_rt_t)
+            _rt_t += 0.001
+            _rt_r.step(_rt_t)
+        _rt_r.drain(_rt_t + 0.1)
+    # chaos fired, the retry absorbed it, and EVERY offered request has
+    # exactly one terminated span whose counts match the runner's books
+    assert _rt_inj.injected["dispatch"] == 1
+    assert _rt_r.fault_retries == 1
+    _rt_tr = _rt.tracer
+    assert _rt_tr.counts["served"] == _rt_r.completed
+    assert _rt_tr.counts["shed"] == _rt_r.shed
+    assert _rt_tr.counts["failed"] == _rt_r.failed
+    assert sum(_rt_tr.counts.values()) == 8
+    assert _rt_tr.summary()["open"] == 0
+    assert _rt_tr.batch_event_count("retry") == 1
+    assert any(m["source"] == "fault" for m in _rt_tr.marks)
+    _rt_r.verify_exact()  # flagship budgets hold with tracing armed
+    # streaming window percentiles agree with the exact samples they saw
+    _rt_win = _rt_r.win.snapshot(_rt_t + 0.1)
+    _rt_lat = sorted(_rt_r.latencies_ms)
+    import math as _rt_math
+    _rt_exact99 = _rt_lat[max(1, _rt_math.ceil(0.99 * len(_rt_lat))) - 1]
+    assert abs(_rt_win["p99_ms"] - _rt_exact99) <= \
+        _rt.QUANTILE_REL_ERR * _rt_exact99 + 1e-9
+    with _rt_tmp.TemporaryDirectory() as _rt_d:
+        _rt_p = os.path.join(_rt_d, "timeline.jsonl")
+        _rtT.export_timeline(_rt_p)
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__))))
+        import check_jsonl as _rt_cj
+        assert _rt_cj.check_file(_rt_p) == []
+        _rt_rows = _rtT.load_rows(_rt_p)["trace"]
+        _rt_perf = _rt.perfetto(_rt_rows)
+        _rt_json.dumps(_rt_perf)
+        assert any(e.get("ph") == "X" for e in _rt_perf["traceEvents"])
+        # the CLI validates the same file (exit 0, machine row)
+        _rt_out = _rt_sp.run(
+            [sys.executable, "-m", "harp_tpu", "trace", _rt_p, "--json"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert _rt_out.returncode == 0, _rt_out.stderr[-500:]
+        _rt_row = _rt_json.loads(_rt_out.stdout.strip().splitlines()[-1])
+        assert _rt_row["unterminated"] == []
+        assert _rt_row["served"] == _rt_tr.counts["served"]
+print(f"reqtrace: 8 requests -> {_rt_tr.counts} reconciled, 1 injected "
+      "fault absorbed, timeline invariant-11 clean, CLI + Perfetto load")
+
+# svm/wdamds wires: the exact arm still trains/embeds (the reshard shim
+# is bit-identical to the old allgather), bf16 stays close, and the
+# planner names exactly the new measurable candidates
+from harp_tpu.models.svm import SVM as _rtSVM, SVMConfig as _rtSVMC
+_rt_x = _rt_rng.normal(size=(128, 8)).astype(np.float32)
+_rt_y = np.sign(_rt_x @ _rt_rng.normal(size=8) + 1e-3).astype(np.float32)
+_rt_cfg = dict(inner_steps=40, outer_rounds=2, sv_per_worker=8)
+_rt_exact = _rtSVM(_rtSVMC(**_rt_cfg), mesh).fit(_rt_x, _rt_y)
+_rt_bf16 = _rtSVM(_rtSVMC(sv_wire="bf16", **_rt_cfg), mesh).fit(_rt_x, _rt_y)
+assert _rt_exact.accuracy(_rt_x, _rt_y) > 0.9
+assert abs(_rt_bf16.accuracy(_rt_x, _rt_y)
+           - _rt_exact.accuracy(_rt_x, _rt_y)) < 0.05
+from harp_tpu.models.wdamds import MDSConfig as _rtMDSC, mds as _rt_mds
+_rt_pts = _rt_rng.normal(size=(64, 4)).astype(np.float32)
+_rt_delta = np.sqrt(((_rt_pts[:, None] - _rt_pts[None]) ** 2).sum(-1))
+_rt_X, _rt_s = _rt_mds(_rt_delta, _rtMDSC(dim=3, iters=10), mesh, seed=0)
+_rt_Xb, _rt_sb = _rt_mds(_rt_delta, _rtMDSC(dim=3, iters=10,
+                                            coord_wire="bf16"), mesh,
+                         seed=0)
+assert np.isfinite(_rt_s) and _rt_s > 0
+assert abs(_rt_sb - _rt_s) / _rt_s < 0.05
+from harp_tpu.plan import planner as _rt_plan, topology as _rt_topo
+assert set(_rt_plan.plan_program(
+    "svm.train", _rt_topo.sim_ring(8)).flip_candidates()) == \
+    {"svm_sv_bf16", "svm_sv_int8"}
+assert set(_rt_plan.plan_program(
+    "wdamds.smacof", _rt_topo.sim_ring(8)).flip_candidates()) == \
+    {"wdamds_coord_bf16", "wdamds_coord_int8"}
+print("svm/wdamds wires: exact arm trains/embeds, bf16 within bounds, "
+      "planner names the four new candidates")
+print(f"DRIVE OK round-32 ({mode})")

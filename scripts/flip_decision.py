@@ -146,6 +146,30 @@ CANDIDATES = {
         "incumbent": "mlp", "metric": "samples_per_sec",
         "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
         "flips": "MLPConfig.grad_wire='int8'"},
+    # PR 12: the last per-app wires (planner-named; see
+    # plan.planner.FLIP_CANDIDATE_CONFIGS).  svm gates on train_acc at
+    # the mlp grad-wire tolerance — a quantized SV exchange that
+    # degrades the ensemble must refuse.  wdamds gates on final_stress
+    # (lower better) at the kernels' 2% band: SMACOF is a contraction,
+    # so surviving wire noise shows as a small stress offset while a
+    # broken exchange moves it by large factors.  Both pairs EXCLUSIVE
+    # below (one wire slot per knob).
+    "svm_sv_bf16": {
+        "incumbent": "svm", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "SVMConfig.sv_wire='bf16'"},
+    "svm_sv_int8": {
+        "incumbent": "svm", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "SVMConfig.sv_wire='int8'"},
+    "wdamds_coord_bf16": {
+        "incumbent": "wdamds", "metric": "iters_per_sec",
+        "quality": "final_stress", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MDSConfig.coord_wire='bf16'"},
+    "wdamds_coord_int8": {
+        "incumbent": "wdamds", "metric": "iters_per_sec",
+        "quality": "final_stress", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MDSConfig.coord_wire='int8'"},
     "kmeans_int8_fused": {
         "incumbent": "kmeans_int8", "metric": "iters_per_sec",
         "quality": "inertia", "sense": "lower", "rel_tol": 0.01,
@@ -190,7 +214,10 @@ EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry"),
                    ("mlp_grad_bf16", "mlp_grad_int8"),
                    # PR 11: LDAConfig.rotate_wire is one default slot —
                    # the int8 and planner-bf16 wires cannot both hold it
-                   ("lda_rotate_int8", "lda_planner_wire")]
+                   ("lda_rotate_int8", "lda_planner_wire"),
+                   # PR 12: one wire slot per exchange knob
+                   ("svm_sv_bf16", "svm_sv_int8"),
+                   ("wdamds_coord_bf16", "wdamds_coord_int8")]
 
 # stack-conditional: carry_db=True is one knob, but the evidence row
 # that authorizes it depends on which algo the verdicts make default

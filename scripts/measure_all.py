@@ -96,6 +96,15 @@ SPRINT_ORDER = [
     # gates on train_acc and the pair is EXCLUSIVE (one grad_wire
     # default).  Defaults stay exact until a relay window measures them.
     "mlp_grad_bf16", "mlp_grad_int8",
+    # PR 12: the LAST two per-app wires get measurement paths (ROADMAP
+    # planner item) — svm's per-round SV exchange and wdamds's
+    # per-iteration coordinate exchange now ride reshard with a wire
+    # knob, their drivers are byte-sheeted, and the planner names these
+    # configs.  Each pair is EXCLUSIVE (one wire slot per knob); gates:
+    # train_acc (svm) / final_stress (wdamds).  Incumbent svm/wdamds
+    # rows ride the remaining-apps block below.
+    "svm_sv_bf16", "svm_sv_int8",
+    "wdamds_coord_bf16", "wdamds_coord_int8",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -106,6 +115,9 @@ SPRINT_ORDER = [
     # ladder / graded-scale / remaining apps
     "lda_scale", "lda_scale_1m", "lda_scale_1m_pallas",
     "mlp", "subgraph", "rf",
+    # PR 12: first-ever svm/wdamds rows — the incumbents the new wire
+    # candidates' verdicts compare against
+    "svm", "wdamds",
     # host-bound ingest: last, outside everyone else's window
     "kmeans_ingest", "kmeans_ingest_int8",
 ]
@@ -116,7 +128,7 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
 
     from bench_common import SMOKE
     from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp, rf,
-                                 subgraph)
+                                 subgraph, svm, wdamds)
     from harp_tpu.serve import bench as serve_bench
 
     # (name, callable) — each returns the model module's benchmark dict
@@ -355,6 +367,23 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "mlp_grad_int8": lambda: mlp.benchmark(
             cfg=mlp.MLPConfig(grad_wire="int8"),
             **(SMOKE["mlp"] if smoke else {})),
+        # PR 12: svm/wdamds incumbents + wire candidates (same shapes as
+        # their incumbent so the A/B isolates wire bytes vs quality —
+        # train_acc for svm, final_stress for wdamds; EXCLUSIVE pairs
+        # in flip_decision, one wire slot per knob).  Full shapes are
+        # the apps' graded defaults (svm 500k×128, wdamds n=4096).
+        "svm": lambda: svm.benchmark(
+            **(SMOKE["svm"] if smoke else {})),
+        "svm_sv_bf16": lambda: svm.benchmark(
+            sv_wire="bf16", **(SMOKE["svm"] if smoke else {})),
+        "svm_sv_int8": lambda: svm.benchmark(
+            sv_wire="int8", **(SMOKE["svm"] if smoke else {})),
+        "wdamds": lambda: wdamds.benchmark(
+            **(SMOKE["wdamds"] if smoke else {})),
+        "wdamds_coord_bf16": lambda: wdamds.benchmark(
+            coord_wire="bf16", **(SMOKE["wdamds"] if smoke else {})),
+        "wdamds_coord_int8": lambda: wdamds.benchmark(
+            coord_wire="int8", **(SMOKE["wdamds"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
             **(SMOKE["subgraph"] if smoke else {})),
         # overflow-tail A/B pair (r2 verdict item 7): POWERLAW graph so

@@ -588,3 +588,143 @@ def test_plan_cli_rows_satisfy_the_checker(tmp_path, capsys, mesh):
     p = tmp_path / "rows.jsonl"
     p.write_text(line + "\n")
     assert check_jsonl.check_file(str(p)) == []
+
+
+# ---------------------------------------------------------------------------
+# Invariant 11: trace rows (PR 12)
+# ---------------------------------------------------------------------------
+
+_TSTAMP = {"backend": "cpu", "date": "2026-08-05", "commit": "abc1234"}
+
+
+def _trace_rows():
+    """A minimal complete 2-request timeline (1 served, 1 shed)."""
+    return [
+        {"kind": "trace", "ev": "event", "req": 1, "name": "arrival",
+         "ts": 0.001, **_TSTAMP},
+        {"kind": "trace", "ev": "event", "req": 2, "name": "arrival",
+         "ts": 0.002, **_TSTAMP},
+        {"kind": "trace", "ev": "event", "req": 2, "name": "shed",
+         "ts": 0.002, "reason": "queue_full", **_TSTAMP},
+        {"kind": "trace", "ev": "request", "req": 2, "ts": 0.002,
+         "t0": 0.002, "outcome": "shed", "n_events": 2, **_TSTAMP},
+        {"kind": "trace", "ev": "batch", "ts": 0.004, "seq": 0,
+         "t0": 0.003, "rung": 8, "rows": 3, "padding_frac": 0.625,
+         "members": [[1, 0, 3]], "events": [{"name": "form", "ts": 0.003}],
+         **_TSTAMP},
+        {"kind": "trace", "ev": "request", "req": 1, "ts": 0.004,
+         "t0": 0.001, "outcome": "served", "n_events": 3, **_TSTAMP},
+    ]
+
+
+def _trace_errs(tmp_path, rows):
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return check_jsonl.check_file(str(p))
+
+
+def test_trace_rows_valid_round_trip(tmp_path):
+    assert _trace_errs(tmp_path, _trace_rows()) == []
+
+
+def test_trace_row_requires_provenance_and_known_shape(tmp_path):
+    rows = _trace_rows()
+    rows[0] = {k: v for k, v in rows[0].items() if k != "backend"}
+    errs = _trace_errs(tmp_path, rows)
+    assert any("missing provenance" in e and ":1:" in e for e in errs)
+    rows = _trace_rows()
+    rows[0]["ev"] = "wormhole"
+    assert any("ev='wormhole'" in e for e in _trace_errs(tmp_path, rows))
+
+
+def test_trace_rows_must_be_monotone(tmp_path):
+    rows = _trace_rows()
+    rows[2]["ts"] = 0.0005  # earlier than row 1's 0.001
+    errs = _trace_errs(tmp_path, rows)
+    assert any("decreased" in e and "monotone" in e for e in errs)
+    rows = _trace_rows()
+    rows[1]["ts"] = "later"
+    assert any("non-negative number" in e
+               for e in _trace_errs(tmp_path, rows))
+
+
+def test_trace_request_spans_must_terminate(tmp_path):
+    # drop request 1's terminal row: its events now dangle
+    rows = [r for r in _trace_rows()
+            if not (r["ev"] == "request" and r["req"] == 1)]
+    errs = _trace_errs(tmp_path, rows)
+    assert any("no terminated outcome row" in e and "[1]" in e
+               for e in errs)
+    # an unknown outcome is refused at the row
+    rows = _trace_rows()
+    rows[-1]["outcome"] = "vanished"
+    assert any("outcome='vanished'" in e
+               for e in _trace_errs(tmp_path, rows))
+
+
+def test_trace_counts_reconcile_with_degraded_ledger(tmp_path):
+    serve = {"kind": "serve", "app": "kmeans", "qps": 100.0,
+             "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+             "steady_compiles": 0, "offered_requests": 2,
+             "served_requests": 1, "shed_requests": 1,
+             "failed_requests": 0, "shed_frac": 0.5,
+             "deadline_miss_frac": 0.0, "fault_retries": 0, **_TSTAMP}
+    assert _trace_errs(tmp_path, [serve] + _trace_rows()) == []
+    # a ledger claiming different outcome totals must fail the file
+    bad = dict(serve, served_requests=2, shed_requests=0)
+    errs = _trace_errs(tmp_path, [bad] + _trace_rows())
+    assert any("do not reconcile" in e for e in errs)
+
+
+def test_trace_outcome_vocabulary_in_sync():
+    """check_jsonl freezes the trace vocabularies standalone; drift
+    from the live reqtrace module fails here."""
+    from harp_tpu.utils import reqtrace
+
+    assert tuple(reqtrace.OUTCOMES) == check_jsonl.KNOWN_TRACE_OUTCOMES
+
+
+def test_exported_trace_rows_satisfy_the_checker(tmp_path, mesh):
+    """Round-trip: a real continuous-plane run through
+    telemetry.export passes invariant 11 as-is."""
+    import numpy as np
+
+    from harp_tpu.serve.engines import ENGINES
+    from harp_tpu.serve.server import Server
+    from harp_tpu.utils import telemetry
+
+    with telemetry.scope(True):
+        rng = np.random.default_rng(3)
+        srv = Server("kmeans",
+                     state=ENGINES["kmeans"].synthetic_state(rng, k=4, d=8),
+                     mesh=mesh, ladder=(1, 8),
+                     cache_dir=str(tmp_path / "aot"))
+        srv.startup()
+        r = srv.make_runner(max_queue_rows=4)
+        r.submit("A", {"id": "A", "x": rng.normal(size=(3, 8)).tolist()},
+                 now=0.001)
+        r.submit("B", {"id": "B", "x": rng.normal(size=(3, 8)).tolist()},
+                 now=0.002)
+        r.step(0.003)
+        r.step(0.004)
+        p = tmp_path / "run.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p)) == []
+    trace = [json.loads(ln) for ln in p.read_text().splitlines()
+             if json.loads(ln).get("kind") == "trace"]
+    assert sum(r.get("ev") == "request" for r in trace) == 2
+
+
+def test_golden_trace_fixture_is_clean_and_loads():
+    """The committed 2-request golden trace (tests/data) passes the
+    checker — the fixture the trace CLI smoke drives."""
+    p = os.path.join(os.path.dirname(__file__), "data",
+                     "golden_trace.jsonl")
+    assert check_jsonl.check_file(p) == []
+    from harp_tpu.utils import reqtrace, telemetry
+
+    rows = telemetry.load_rows(p)["trace"]
+    s = reqtrace.summarize_rows(rows)
+    assert (s["requests"], s["served"], s["shed"], s["failed"]) == \
+        (2, 1, 1, 0)
+    assert s["unterminated"] == []

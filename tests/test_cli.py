@@ -371,7 +371,7 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
     spec.loader.exec_module(ma)
 
     from harp_tpu.models import (kmeans, kmeans_stream, lda, mfsgd, mlp,
-                                 rf, subgraph)
+                                 rf, subgraph, svm, wdamds)
     from harp_tpu.utils import roofline
 
     def stubbed(mod, attr):
@@ -383,7 +383,7 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
 
         monkeypatch.setattr(mod, attr, stub)
 
-    for mod in (kmeans, lda, mfsgd, mlp, rf, subgraph):
+    for mod in (kmeans, lda, mfsgd, mlp, rf, subgraph, svm, wdamds):
         stubbed(mod, "benchmark")
     stubbed(kmeans_stream, "benchmark_streaming")
     from harp_tpu.serve import bench as serve_bench
@@ -474,3 +474,42 @@ def test_stats_file_inputs_validation(tmp_path):
     np.savetxt(tmp_path / "big.csv", big, delimiter=",")
     with pytest.raises(SystemExit, match="regression target"):
         stats.main(["naive", "--input", str(tmp_path / "big.csv")])
+
+
+def test_dispatch_trace_cli_smoke(capsys, tmp_path):
+    """python -m harp_tpu trace (PR 12): the committed golden 2-request
+    fixture summarizes clean (exit 0) in human and JSON modes, exports
+    a loadable Perfetto trace.json, and the failure exits are honest —
+    1 for an incomplete trace, 2 for an unreadable file."""
+    import json
+    import os
+
+    golden = os.path.join(os.path.dirname(__file__), "data",
+                          "golden_trace.jsonl")
+    assert cli.main(["trace", golden]) == 0
+    out = capsys.readouterr().out
+    assert "1 served / 1 shed / 0 failed" in out
+    assert "[shed]" in out and "queue_full" in out  # the shed walkthrough
+
+    pf = tmp_path / "trace.json"
+    assert cli.main(["trace", golden, "--json",
+                     "--perfetto", str(pf)]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert (row["requests"], row["served"], row["shed"]) == (2, 1, 1)
+    assert row["unterminated"] == []
+    assert all(k in row for k in ("backend", "date", "commit"))
+    perf = json.loads(pf.read_text())
+    assert perf["traceEvents"] and all(
+        "ph" in e and "name" in e for e in perf["traceEvents"])
+    assert any(e["ph"] == "X" for e in perf["traceEvents"])
+
+    # incomplete trace (events with no terminal row) exits 1
+    lines = [ln for ln in open(golden)
+             if '"ev": "request"' not in ln or '"req": 1' not in ln]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("".join(lines))
+    assert cli.main(["trace", str(bad)]) == 1
+    assert "unterminated" in capsys.readouterr().err
+
+    # unreadable input exits 2
+    assert cli.main(["trace", str(tmp_path / "nope.jsonl")]) == 2

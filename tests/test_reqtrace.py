@@ -1,0 +1,391 @@
+"""Request-level tracing (PR 12, harp_tpu/utils/reqtrace.py).
+
+Pins, in order: the streaming log-bucket histograms' documented quantile
+error and rolling expiry; zero-cost-when-disabled (the PR-3 contract);
+complete span trees through the continuous serve plane (admission →
+batch membership → dispatch → readback → outcome) with the flagship
+per-batch budgets UNCHANGED while tracing is armed; the acceptance
+criterion — a CPU-sim ``benchmark_sustained`` run under telemetry with
+injected faults yields a Perfetto-loadable timeline whose request-span
+outcomes reconcile EXACTLY with the invariant-9 row and whose
+rolling-window p99 agrees with the exact percentile within the
+documented bucket error; and the TCP plane's arrival-minted ids.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+import check_jsonl  # noqa: E402
+from harp_tpu.serve.engines import ENGINES  # noqa: E402
+from harp_tpu.serve.server import Server  # noqa: E402
+from harp_tpu.utils import reqtrace, telemetry  # noqa: E402
+from harp_tpu.utils.reqtrace import (LogHist, QUANTILE_REL_ERR,  # noqa: E402
+                                     RollingWindow)
+
+
+# ---------------------------------------------------------------------------
+# Streaming histograms
+# ---------------------------------------------------------------------------
+
+def _rank_pct(xs, p):
+    arr = sorted(xs)
+    return arr[max(1, math.ceil(p / 100 * len(arr))) - 1]
+
+
+def test_loghist_quantiles_within_documented_bucket_error():
+    """The bound callers rely on: every quantile read is within
+    QUANTILE_REL_ERR of the exact ceil-rank sample percentile, across
+    three orders of magnitude of lognormal latencies."""
+    rng = np.random.default_rng(0)
+    xs = np.exp(rng.normal(1.0, 1.2, size=5000))  # ~0.1 .. ~100 ms
+    h = LogHist()
+    for v in xs:
+        h.add(float(v))
+    assert h.total == 5000
+    for p in (10, 50, 90, 95, 99, 99.9):
+        exact = _rank_pct(xs, p)
+        got = h.quantile(p)
+        assert abs(got - exact) <= QUANTILE_REL_ERR * exact, (p, got,
+                                                             exact)
+
+
+def test_loghist_zeros_and_empty():
+    h = LogHist(lo=0.5)  # queue-depth shape: 0 is a real sample
+    assert h.quantile(50) is None
+    for v in (0, 0, 0, 4):
+        h.add(v)
+    assert h.quantile(50) == 0.0
+    assert h.quantile(99) == pytest.approx(4.0, rel=QUANTILE_REL_ERR)
+
+
+def test_loghist_memory_is_fixed():
+    h = LogHist()
+    for v in np.random.default_rng(1).exponential(5.0, size=20000):
+        h.add(float(v))
+    assert len(h.counts) == h.n + 1  # no retained samples, ever
+
+
+def test_rolling_window_expires_old_samples():
+    w = RollingWindow(window_s=6.0, subwindows=3)
+    for t in (0.1, 0.2, 0.3):
+        w.add_latency(t, 1000.0)  # old: 1 s latencies
+    w.add_latency(10.0, 1.0)      # recent: 1 ms
+    snap = w.snapshot(10.0)
+    assert snap["samples"] == 1   # the 1 s samples expired with their
+    assert snap["p99_ms"] == pytest.approx(1.0, rel=QUANTILE_REL_ERR)
+    # sub-windows; only the live one remains
+    assert snap["rel_err"] == round(QUANTILE_REL_ERR, 4)
+
+
+# ---------------------------------------------------------------------------
+# Zero-cost when disabled (PR-3 contract)
+# ---------------------------------------------------------------------------
+
+def test_tracer_is_zero_cost_when_disabled():
+    with telemetry.scope(False):
+        assert reqtrace.tracer.begin(0.0) is None
+        reqtrace.tracer.event(1, "x", 0.0)
+        reqtrace.tracer.end(1, "served", 0.0)
+        reqtrace.tracer.batch(0, 0.0, rung=8, rows=3, members=[])
+        reqtrace.tracer.mark("fault", "x", 0.0)
+        assert reqtrace.tracer.summary() == {
+            "requests": 0, "open": 0, "batches": 0,
+            "served": 0, "shed": 0, "failed": 0}
+        assert reqtrace.tracer.rows() == []
+
+
+def test_untraced_continuous_run_records_nothing(mesh, tmp_path):
+    """With telemetry off the serve plane runs exactly as before —
+    no spans, no ids, no marks (begin returns None end to end)."""
+    rng = np.random.default_rng(5)
+    srv = Server("kmeans",
+                 state=ENGINES["kmeans"].synthetic_state(rng, k=4, d=8),
+                 mesh=mesh, ladder=(1, 8),
+                 cache_dir=str(tmp_path / "aot"))
+    srv.startup()
+    r = srv.make_runner()
+    r.submit(0, {"id": 0, "x": rng.normal(size=(3, 8)).tolist()},
+             now=0.0)
+    out = r.drain(0.01)
+    assert len(out) == 1 and "result" in out[0][1]
+    assert reqtrace.tracer.summary()["requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Complete span trees + budgets unchanged with tracing armed
+# ---------------------------------------------------------------------------
+
+def test_continuous_plane_traces_complete_span_trees(mesh, tmp_path):
+    """Every admitted request's span walks arrival → admit → batch →
+    served with its batch membership recorded, batches carry dispatch
+    <= readback, and the flagship per-batch budgets hold EXACTLY (one
+    dispatch + one readback per batch, zero compiles) with tracing
+    armed — tracing is host-side bookkeeping, never device work."""
+    with telemetry.scope(True):
+        rng = np.random.default_rng(6)
+        srv = Server("kmeans",
+                     state=ENGINES["kmeans"].synthetic_state(rng, k=4,
+                                                             d=8),
+                     mesh=mesh, ladder=(1, 8),
+                     cache_dir=str(tmp_path / "aot"))
+        srv.startup()
+        srv.steady.reset()
+        r = srv.make_runner(depth=2)
+        t = 0.0
+        for i in range(6):
+            r.submit(i, {"id": i,
+                         "x": rng.normal(size=(2, 8)).tolist()}, now=t)
+            t += 0.001
+            r.step(t)
+        out = r.drain(t + 0.01)
+        assert r.completed == 6
+        r.verify_exact()  # budgets pinned with tracing ARMED
+
+        tr = reqtrace.tracer
+        assert tr.counts == {"served": 6, "shed": 0, "failed": 0}
+        assert tr.summary()["open"] == 0  # every span terminated
+        rows = tr.rows()
+        ts = [row["ts"] for row in rows]
+        assert ts == sorted(ts)  # causally ordered by construction
+        # the request→batch join: every request's batch event names a
+        # batch whose member list names it back
+        batches = {row["seq"]: row for row in rows
+                   if row["ev"] == "batch"}
+        assert batches and len(batches) == r.dispatched
+        for row in rows:
+            if row["ev"] == "event" and row["name"] == "batch":
+                b = batches[row["seq"]]
+                assert any(m[0] == row["req"] for m in b["members"])
+        for b in batches.values():
+            evs = {e["name"]: e["ts"] for e in b["events"]}
+            assert evs["form"] <= evs["dispatch"] <= evs["readback"]
+            assert 0.0 <= b["padding_frac"] < 1.0
+
+
+def test_deadline_shed_and_failure_spans_terminate(mesh, tmp_path):
+    """The degraded paths terminate spans too: queue_full and deadline
+    sheds end 'shed', exhausted retries end 'failed' with the batch's
+    engine_failure event alongside."""
+    from harp_tpu.utils.fault import FaultInjector
+
+    with telemetry.scope(True):
+        rng = np.random.default_rng(7)
+        srv = Server("kmeans",
+                     state=ENGINES["kmeans"].synthetic_state(rng, k=4,
+                                                             d=8),
+                     mesh=mesh, ladder=(1, 8),
+                     cache_dir=str(tmp_path / "aot"))
+        srv.startup()
+        r = srv.make_runner(max_queue_rows=4, deadline_s=0.01,
+                            max_retries=0)
+        x = rng.normal(size=(3, 8)).tolist()
+        r.submit("ok", {"id": "ok", "x": x}, now=0.0)
+        out = r.submit("full", {"id": "full", "x": x}, now=0.001)
+        assert out and out[0][1]["reason"] == "queue_full"
+        # kill the only dispatch: retries exhausted -> engine failure
+        inj = FaultInjector(seed=0, fail={"dispatch": (1,)})
+        with inj.arm():
+            r.step(0.002)
+        assert r.engine_failures == 1
+        tr = reqtrace.tracer
+        assert tr.counts == {"served": 0, "shed": 1, "failed": 1}
+        assert tr.batch_event_count("engine_failure") == 1
+        # the injector's mark rode the unified timeline
+        assert any(m["source"] == "fault" and m["site"] == "dispatch"
+                   for m in tr.marks)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bench: chaos trace completeness + streaming percentiles
+# ---------------------------------------------------------------------------
+
+def _sustained_with_faults():
+    from harp_tpu.serve.bench import benchmark_sustained
+
+    return benchmark_sustained(
+        app="kmeans", n_requests=96, rows_per_request=1, burst_admit=8,
+        ladder=(1, 8, 32), state_shape={"k": 8, "d": 16},
+        fault_rate=0.01, fault_seed=34,  # seed 34: a fault fires early
+        deadline_ms=10_000.0, max_queue_rows=4096, max_retries=3)
+
+
+def test_sustained_trace_reconciles_with_invariant9_ledger(mesh,
+                                                           tmp_path):
+    """THE acceptance pin: a sustained CPU-sim run under telemetry with
+    injected faults yields (a) a trace whose shed/retry/failure events
+    sum to the row's shed_frac / fault_retries / engine_failures
+    EXACTLY, (b) rolling-window percentiles within the documented
+    bucket error of the exact same-sample percentiles, (c) the flagship
+    budgets pinned unchanged, and (d) a Perfetto-loadable, invariant-11
+    clean timeline file."""
+    with telemetry.scope(True):
+        res = _sustained_with_faults()
+        tr = reqtrace.tracer
+
+        # (a) exact reconciliation — every offered request has exactly
+        # one terminated span, and the degraded counters match the
+        # trace's own event counts
+        assert res["faults_injected"] >= 1  # chaos actually ran
+        assert tr.counts["served"] == res["served_requests"]
+        assert tr.counts["shed"] == res["shed_requests"]
+        assert tr.counts["failed"] == res["failed_requests"]
+        assert (tr.counts["served"] + tr.counts["shed"]
+                + tr.counts["failed"]) == res["offered_requests"]
+        assert tr.summary()["open"] == 0
+        assert tr.batch_event_count("retry") == res["fault_retries"]
+        assert tr.batch_event_count("engine_failure") == \
+            res["engine_failures"]
+        assert round(tr.counts["shed"] / res["offered_requests"], 6) == \
+            res["shed_frac"]
+        assert sum(1 for m in tr.marks if m["source"] == "fault") == \
+            res["faults_injected"]
+
+        # (b) streaming vs exact percentiles: same samples, same clock,
+        # agreement bounded by the documented bucket error
+        assert res["win_samples"] == res["served_requests"]
+        assert res["win_rel_err"] == round(QUANTILE_REL_ERR, 4)
+        for p in (50, 95, 99):
+            win, exact = res[f"win_p{p}_ms"], res[f"runner_p{p}_ms"]
+            assert abs(win - exact) <= QUANTILE_REL_ERR * exact + 1e-9, p
+
+        # (c) flagship budgets pinned with tracing armed
+        assert res["steady_compiles"] == 0
+        assert res["budget_violations"] == 0
+        assert res["steady_dispatches"] == res["batches"]
+        assert res["steady_readbacks"] == res["batches"]
+
+        # (d) the exported timeline is invariant-11 clean and loads as
+        # a Perfetto trace next to its invariant-9 row
+        p = tmp_path / "timeline.jsonl"
+        telemetry.export_timeline(str(p))
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert rows and all(r["kind"] == "trace" for r in rows)
+    with open(p, "a") as fh:  # the run's own bench row joins the file
+        fh.write(json.dumps({**res, "kind": "serve", "app": "kmeans",
+                             "backend": "cpu", "date": "2026-08-05",
+                             "commit": "test"}) + "\n")
+    assert check_jsonl.check_file(str(p)) == []
+    perf = reqtrace.perfetto(rows)
+    json.dumps(perf)  # loadable = serializable + well-formed events
+    assert any(e.get("ph") == "X" for e in perf["traceEvents"])
+    assert any(e.get("ph") == "i" and "fault" in e["name"]
+               for e in perf["traceEvents"])
+
+
+def test_sustained_row_unchanged_with_tracing_disabled(mesh):
+    """Tracing off (telemetry disabled): the sustained bench still
+    balances its books and records no spans — the serve plane's
+    behavior does not depend on the tracer's presence.  (The bench
+    enables telemetry internally for its CompileWatch evidence, so this
+    drives the runner directly.)"""
+    with telemetry.scope(False):  # reset collectors, telemetry OFF
+        rng = np.random.default_rng(8)
+        srv = Server("kmeans",
+                     state=ENGINES["kmeans"].synthetic_state(rng, k=4,
+                                                             d=8),
+                     mesh=mesh, ladder=(1, 8))
+        srv.startup()
+        r = srv.make_runner()
+        for i in range(4):
+            r.submit(i, {"id": i,
+                         "x": rng.normal(size=(2, 8)).tolist()},
+                     now=0.001 * i)
+            r.step(0.001 * i + 0.0005)
+        r.drain(1.0)
+        assert r.completed == 4
+        assert reqtrace.tracer.summary()["requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Transport: ids minted at socket arrival, delivery closes the chain
+# ---------------------------------------------------------------------------
+
+def test_tcp_plane_mints_ids_at_arrival_and_stamps_delivery(mesh,
+                                                            tmp_path):
+    import socket
+
+    from harp_tpu.serve.transport import TCPFrontEnd
+
+    with telemetry.scope(True):
+        rng = np.random.default_rng(9)
+        state = ENGINES["kmeans"].synthetic_state(rng, k=4, d=8)
+        srv = Server("kmeans", state=state, mesh=mesh, ladder=(1, 8),
+                     cache_dir=str(tmp_path / "aot"),
+                     budget_action="warn")
+        srv.startup()
+        fe = TCPFrontEnd(srv, port=0).start_in_thread()
+        s = socket.create_connection(("127.0.0.1", fe.port), timeout=60)
+        f = s.makefile("rw")
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        for i in range(2):
+            f.write(json.dumps({"id": i, "x": x.tolist()}) + "\n")
+        f.flush()
+        got = [json.loads(f.readline()) for _ in range(2)]
+        assert all("result" in g for g in got)
+        fe.shutdown()
+        fe.join(60)
+        s.close()
+
+        tr = reqtrace.tracer
+        assert tr.counts["served"] == 2 and tr.summary()["open"] == 0
+        rows = tr.rows()
+        arrivals = [r for r in rows if r["ev"] == "event"
+                    and r["name"] == "arrival"]
+        assert len(arrivals) == 2
+        assert all(r.get("transport") == "tcp" for r in arrivals)
+        # delivery events landed after the spans served
+        delivers = [r for r in rows if r["ev"] == "event"
+                    and r["name"] == "deliver"]
+        assert len(delivers) == 2
+        # the runner's live stats carry the rolling window
+        win = fe.runner.stats()["window"]
+        assert win["rel_err"] == round(QUANTILE_REL_ERR, 4)
+        assert win["samples"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# The timeline merge + report section
+# ---------------------------------------------------------------------------
+
+def test_export_timeline_merges_spines_in_order(mesh, tmp_path):
+    """Spans and fault marks fold into the trace timeline; aggregate
+    spines (comm/transfer) ride summary rows at the tail; the whole
+    file is monotone and invariant-11 clean."""
+    with telemetry.scope(True):
+        with telemetry.span("phase_a"):
+            pass
+        rid = reqtrace.tracer.begin(0.001)
+        reqtrace.tracer.end(rid, "served", 0.002)
+        p = tmp_path / "t.jsonl"
+        telemetry.export_timeline(str(p))
+    rows = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert check_jsonl.check_file(str(p)) == []
+    kinds = {(r["ev"], r.get("source")) for r in rows}
+    assert ("mark", "span") in kinds
+    assert ("request", None) in kinds
+    ts = [r["ts"] for r in rows]
+    assert ts == sorted(ts)
+
+
+def test_report_carries_request_section(mesh):
+    from harp_tpu import report
+
+    with telemetry.scope(True):
+        rid = reqtrace.tracer.begin(0.0)
+        reqtrace.tracer.end(rid, "served", 0.003)
+        rid2 = reqtrace.tracer.begin(0.001)
+        reqtrace.tracer.end(rid2, "shed", 0.002)
+        row, _ = report.live_report()
+        text = report.render(row)
+    assert row["requests"]["served"] == 1
+    assert row["requests"]["shed"] == 1
+    assert "requests (trace): 2 — 1 served / 1 shed / 0 failed" in text
