@@ -42,6 +42,9 @@ APPS = {
              "harplint: static relay-burner analysis (AST + jaxpr + Mosaic)"),
     "plan": ("harp_tpu.plan.cli",
              "topology-aware collective planner over the lint byte sheets"),
+    "predict": ("harp_tpu.perfmodel.cli",
+                "offline predictive cost model: price configs/programs, "
+                "rank flip candidates, self-grade vs committed evidence"),
 }
 
 

@@ -1,4 +1,5 @@
-"""Registry of every Pallas kernel in ``ops/`` — the Mosaic audit's input.
+"""Registry of every Pallas kernel in ``ops/`` — the Mosaic audit's input
+AND the cost model's kernel work sheet.
 
 Reference parity note (SURVEY.md §3.2): Harp's native compute kernels
 lived behind DAAL's JNI boundary with no enumeration — auditing them
@@ -12,6 +13,18 @@ dims, no uint32→f32 cast).  Shapes mirror the smallest cases already
 pinned by the kernel test files, so an audit failure means the kernel
 changed, not the harness.
 
+PR 13 (perfmodel): registration now REQUIRES a declared work model —
+``flops`` (arithmetic at the registered shape), ``min_hbm_bytes`` (the
+roofline-style lower-bound HBM traffic: inputs read once, outputs
+written once), and ``vmem_bytes`` (the kernel's own scoped-VMEM budget
+estimate at the registered shape, the same byte algebra its dispatch
+gate enforces — e.g. ``kmeans_kernel._tile_rows_int8``'s OOM-calibrated
+model).  The Mosaic audit and :mod:`harp_tpu.perfmodel` read ONE source
+of truth: a new kernel registered without its work model raises HERE,
+at import/lint time, not twenty minutes into a predict run
+(tests/test_perfmodel.py pins that every entry prices without a
+fallback and fits the 16 MiB VMEM ceiling).
+
 Builders are lazy (imports inside) — registering costs nothing until an
 audit actually runs, and the registry module itself imports without jax.
 """
@@ -23,15 +36,50 @@ from typing import Any, Callable
 # name -> zero-arg builder returning (fn, args_tuple)
 KERNELS: dict[str, Callable[[], tuple[Callable, tuple[Any, ...]]]] = {}
 
+#: name -> {"flops", "min_hbm_bytes", "vmem_bytes"} at the builder's
+#: registered shape (ints; every field required and positive)
+KERNEL_WORK: dict[str, dict] = {}
 
-def register_kernel(name: str):
+_WORK_FIELDS = ("flops", "min_hbm_bytes", "vmem_bytes")
+
+
+def register_kernel(name: str, *, flops: int, min_hbm_bytes: int,
+                    vmem_bytes: int):
+    """Register a kernel builder WITH its declared work model.
+
+    The keyword fields are mandatory by signature: a kernel that cannot
+    state its FLOPs, HBM floor, and VMEM footprint at its own registered
+    shape is not auditable or priceable, and the failure happens at
+    import time (``python -m harp_tpu lint`` imports this module) —
+    loudly, before any relay window is spent discovering it.
+    """
+    work = {"flops": flops, "min_hbm_bytes": min_hbm_bytes,
+            "vmem_bytes": vmem_bytes}
+    for k in _WORK_FIELDS:
+        v = work[k]
+        if isinstance(v, bool) or not isinstance(v, int) or v <= 0:
+            raise ValueError(
+                f"kernel {name!r}: work field {k}={v!r} must be a "
+                "positive int — declare the kernel's work model at its "
+                "registered shape (see module docstring)")
+
     def deco(build):
         KERNELS[name] = build
+        KERNEL_WORK[name] = work
         return build
     return deco
 
 
-@register_kernel("kmeans.partials")
+# kmeans.partials at (n=128, d=256, k=8, kp=128): one Lloyd partial pass.
+# flops = 4ndk (distance matmul 2ndk + one-hot sums matmul 2ndk);
+# min bytes = points once (f32) + centroid operand + sums/counts out;
+# vmem = point tile (tn=128, double-buffered) + padded centroid operand
+# + [kp, d] sums + [tn, kp] score/one-hot temporaries, all f32.
+@register_kernel("kmeans.partials",
+                 flops=4 * 128 * 256 * 8,
+                 min_hbm_bytes=4 * (128 * 256 + 128 * 256 + 8 * 256 + 8 + 1),
+                 vmem_bytes=4 * (2 * 128 * 256 + 2 * 128 * 256
+                                 + 2 * 128 * 128))
 def _kmeans_f32():
     import functools
 
@@ -44,7 +92,16 @@ def _kmeans_f32():
                 jnp.zeros((8, 256), jnp.float32))
 
 
-@register_kernel("kmeans.partials_int8")
+# kmeans.partials_int8 at (n=128, d=256, k=8, kp=128): int8 OPs on the
+# MXU (same 4ndk count), int8 points read once; vmem = the kernel's own
+# OOM-calibrated byte model (kmeans_kernel._tile_rows_int8, measured
+# 2026-08-01): tn·(2d + 8kp) + 5·kp·d + 64 KiB at tn=128.
+@register_kernel("kmeans.partials_int8",
+                 flops=4 * 128 * 256 * 8,
+                 min_hbm_bytes=(128 * 256 + 128 * 256
+                                + 4 * (8 * 256 + 8 + 1)),
+                 vmem_bytes=128 * (2 * 256 + 8 * 128) + 5 * 128 * 256
+                 + (64 << 10))
 def _kmeans_int8():
     import functools
 
@@ -60,7 +117,16 @@ def _kmeans_int8():
                 jnp.ones(256, jnp.float32))
 
 
-@register_kernel("lda.cgs_entry_update")
+# lda.cgs_entry_update at (K=64, DR=WR=128, C=256): per token ~14K flops
+# (posterior + draw + delta matmuls) over C tokens; min bytes = both
+# table tiles in/out + token streams; vmem = the kernel's own est(cc)
+# budget model (lda_kernel.py) at cc=C=256 with exact-gather planes.
+@register_kernel("lda.cgs_entry_update",
+                 flops=14 * 64 * 256,
+                 min_hbm_bytes=(2 * 4 * (64 * 128 + 64 * 128) + 4 * 64
+                                + 3 * 4 * 256),
+                 vmem_bytes=(4 + 4) * 64 * 128 + 8 * 64 * 128
+                 + 6 * 4 * 64 * 256 + 6 * 64 * 128)
 def _lda_cgs():
     import functools
 
@@ -82,7 +148,17 @@ def _lda_cgs():
                 jnp.zeros(2, jnp.int32))
 
 
-@register_kernel("mfsgd.sgd_tile_update")
+# mfsgd.sgd_tile_update at the 8-worker-sim smoke tiling (R=64,
+# UB=2048, IB=13440, NE=8, C=2048, tile=256): 6·R flops per rating over
+# NE·C rating slots; min bytes = W/H blocks in+out (f32) + entry
+# streams; vmem = the kernel's own budget algebra: TWO resident H
+# copies (h_in + h_out) + four [R, tile] scratch tiles + chunk streams.
+@register_kernel("mfsgd.sgd_tile_update",
+                 flops=6 * 64 * 8 * 2048,
+                 min_hbm_bytes=(2 * 4 * (64 * 2048 + 64 * 13440)
+                                + 3 * 4 * 8 * 2048),
+                 vmem_bytes=2 * 13440 * 64 * 4 + 4 * 64 * 256 * 4
+                 + 3 * 4 * 512)
 def _mfsgd_tile():
     import functools
 
@@ -103,7 +179,14 @@ def _mfsgd_tile():
                 jnp.zeros(NE, jnp.int32))
 
 
-@register_kernel("flash_attention")
+# flash_attention at (batch=2, T=256, d=128), causal: 4·T²·d flops per
+# batch row (QK^T + PV, halved by causality, ×2 ops per MAC cancels);
+# min bytes = Q/K/V read + O written (f32); vmem = Q block + K/V blocks
+# + online-softmax scratch (m, l, acc) at the kernel's default blocks.
+@register_kernel("flash_attention",
+                 flops=2 * 4 * 256 * 256 * 128 // 2,
+                 min_hbm_bytes=4 * 4 * 2 * 256 * 128,
+                 vmem_bytes=4 * (3 * 256 * 128 + 256 * 128 + 2 * 256))
 def _flash():
     import functools
 

@@ -168,11 +168,15 @@ class Plan:
 
 def _site_cost(topo: Topology, primitive: str, schedule: str,
                sheet_bytes: int) -> float:
-    """Price one (site, schedule) pair.  The sheet's bytes are already
-    amplification-folded, so the topology sees amplification=1 here."""
-    if schedule == "hier_psum":
-        return topo.hier_stage_cost_s(sheet_bytes)
-    return topo.cost_s(primitive, predicted_bytes(schedule, sheet_bytes))
+    """Price one (site, schedule) pair — delegates to the SHARED wire
+    oracle (PR 13): the Plan rows' cost column and the perfmodel's wire
+    term are one function (``perfmodel.model.wire_cost_s``), so the
+    planner and the predictor can never price the same site
+    differently.  The sheet's bytes are already amplification-folded,
+    so the topology sees amplification=1 here."""
+    from harp_tpu.perfmodel.model import wire_cost_s
+
+    return wire_cost_s(topo, primitive, schedule, sheet_bytes)
 
 
 def decide_site(program: str, entry: dict, topo: Topology) -> SiteDecision:

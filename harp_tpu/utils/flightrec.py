@@ -563,6 +563,42 @@ class SteadyState:
 
 
 # ---------------------------------------------------------------------------
+# Calibrated overheads (the perfmodel readout)
+# ---------------------------------------------------------------------------
+
+#: Fixed per-operation costs of the execution plane, calibrated from the
+#: measured flight-recorder deltas (CLAUDE.md "Relay performance traps",
+#: all measured 2026-07-30 on the relay-attached v5e) — the offline cost
+#: model (:mod:`harp_tpu.perfmodel`) reads THESE numbers for its
+#: ``overhead`` term, so the trap list and the model can never disagree
+#: about what a dispatch costs.  Values are the measured FLOORS (the
+#: round-trip band was 20–150 ms; a ranking model must not flatter the
+#: incumbent by charging the ceiling to every candidate equally):
+#:
+#: - ``dispatch_s`` / ``readback_s``: one driver→device round trip /
+#:   one blocking D2H fetch (the budget(dispatches=1, readbacks=1)
+#:   discipline makes a run pay each exactly once);
+#: - ``compile_s``: one fresh XLA backend compile shipped over the relay
+#:   (the ~140 ms PRNGKey-specialization recompile, HL002);
+#: - ``h2d_gbs``: the relay ingest tunnel rate (30–40 MB/s measured;
+#:   the floor keeps H2D-bound predictions honest — the tunnel, not
+#:   PCIe, is the wall).
+CALIBRATED_OVERHEADS = {
+    "dispatch_s": 0.020,
+    "readback_s": 0.020,
+    "compile_s": 0.140,
+    "h2d_gbs": 0.030e9,
+}
+
+
+def calibrated_overheads() -> dict:
+    """A copy of :data:`CALIBRATED_OVERHEADS` (the perfmodel entry
+    point; a copy so a consumer mutating its dict cannot silently
+    recalibrate everyone else's)."""
+    return dict(CALIBRATED_OVERHEADS)
+
+
+# ---------------------------------------------------------------------------
 # Export
 # ---------------------------------------------------------------------------
 

@@ -12,6 +12,12 @@ outputs written once — XLA fusion can't do better) and exact FLOP
 counts for the dominant kernels; percentages can therefore slightly
 UNDERSTATE achieved bandwidth but never flatter it.  Peaks are the
 public TPU v5e datasheet figures.
+
+PR 13: these work models are also the FLOOR layer of the predictive
+cost model (:mod:`harp_tpu.perfmodel.model`), which adds per-variant
+mechanism terms on top and self-grades the combined ranking against
+the committed bench rows — change a formula here and the perfmodel
+grading (tier-1) re-checks every committed ranking it feeds.
 """
 
 from __future__ import annotations

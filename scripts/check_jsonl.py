@@ -138,6 +138,24 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     ledger EXACTLY (served == served_requests, etc.): a trace and a
     bench row telling different stories about the same run means one
     of them is lying.
+
+12. **Model rows are coherent prediction evidence** (any file): a
+    ``kind: "model"`` row (``python -m harp_tpu predict``, PR 13 —
+    :mod:`harp_tpu.perfmodel`) must carry the provenance stamp (a
+    prediction is about a specific commit's byte sheets and work
+    models), name a registered program (``KNOWN_LINT_PROGRAMS``)
+    and/or a config from the sprint surface (``KNOWN_MODEL_CONFIGS``
+    — frozen against ``measure_all.SPRINT_ORDER``: a model row
+    referencing a config the sprint cannot run prunes nothing), stamp
+    ``rates_source`` and ``bound`` from the frozen vocabularies
+    (``KNOWN_MODEL_RATES_SOURCES`` / ``KNOWN_MODEL_BOUNDS`` —
+    sync-pinned against ``harp_tpu.perfmodel`` by
+    tests/test_perfmodel.py), predict POSITIVE seconds, carry all four
+    per-term entries summing to ``predicted_s`` within float
+    tolerance, and name as ``bound`` the largest term — a breakdown
+    that does not reconcile with its own total is a wrong prediction
+    that cannot even be diagnosed, which is the one thing a model row
+    exists to prevent.
 """
 
 from __future__ import annotations
@@ -640,6 +658,93 @@ def _finish_trace_checks(name: str, state: dict,
     return errs
 
 
+# the model-row vocabularies (invariant 12), FROZEN standalone like the
+# plan vocabularies and sync-pinned by tests/test_perfmodel.py against
+# harp_tpu.perfmodel (BOUNDS / RATES_SOURCES) and scripts/measure_all.py
+# (SPRINT_ORDER)
+KNOWN_MODEL_BOUNDS = ("compute", "memory", "wire", "overhead")
+KNOWN_MODEL_RATES_SOURCES = ("declared", "probed")
+KNOWN_MODEL_CONFIGS = (
+    "kmeans", "kmeans_hier_psum", "kmeans_ingest", "kmeans_ingest_int8",
+    "kmeans_int8", "kmeans_int8_fused", "kmeans_stream",
+    "kmeans_stream_int8", "lda", "lda_carry", "lda_exprace", "lda_fast",
+    "lda_pallas", "lda_pallas_approx", "lda_pallas_approx_hot",
+    "lda_pallas_carry", "lda_pallas_hot", "lda_planner_wire",
+    "lda_rotate_int8", "lda_scale", "lda_scale_1m", "lda_scale_1m_pallas",
+    "lda_scatter", "mfsgd", "mfsgd_carry", "mfsgd_chunked_rotate",
+    "mfsgd_pallas", "mfsgd_scatter", "mlp", "mlp_grad_bf16",
+    "mlp_grad_int8", "rf", "serve_kmeans", "serve_kmeans_sustained",
+    "serve_mfsgd_sustained", "serve_mfsgd_topk", "subgraph",
+    "subgraph_1m", "subgraph_1m_onehot", "subgraph_onehot", "subgraph_pl",
+    "svm", "svm_sv_bf16", "svm_sv_int8", "wdamds", "wdamds_coord_bf16",
+    "wdamds_coord_int8")
+MODEL_TERM_FIELDS = ("compute_s", "memory_s", "wire_s", "overhead_s")
+
+
+def _check_model_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 12: model rows must be coherent prediction evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: model row missing provenance field(s) "
+            f"{missing} — print it through harp_tpu.perfmodel.cli, "
+            "which stamps them")
+    prog, cfg = row.get("program"), row.get("config")
+    if prog is None and cfg is None:
+        errs.append(f"{name}:{i}: model row names neither a program nor "
+                    "a config — a prediction about nothing prices "
+                    "nothing")
+    if prog is not None and prog not in KNOWN_LINT_PROGRAMS:
+        errs.append(
+            f"{name}:{i}: model row for unregistered program {prog!r} — "
+            "programs must come from harp_tpu.analysis.drivers.DRIVERS")
+    for c in ([cfg] if cfg is not None else []) + list(
+            row.get("configs") or []):
+        if c not in KNOWN_MODEL_CONFIGS:
+            errs.append(
+                f"{name}:{i}: model row references config {c!r} not in "
+                "the sprint surface (KNOWN_MODEL_CONFIGS — update in "
+                "the same commit as measure_all.SPRINT_ORDER)")
+    rs = row.get("rates_source")
+    if rs not in KNOWN_MODEL_RATES_SOURCES:
+        errs.append(f"{name}:{i}: model row rates_source={rs!r} not in "
+                    f"{KNOWN_MODEL_RATES_SOURCES} — a declared ranking "
+                    "must never masquerade as a measured one")
+    bound = row.get("bound")
+    if bound not in KNOWN_MODEL_BOUNDS:
+        errs.append(f"{name}:{i}: model row bound={bound!r} not in "
+                    f"{KNOWN_MODEL_BOUNDS}")
+    ps = row.get("predicted_s")
+    if not _num(ps) or ps <= 0:
+        errs.append(f"{name}:{i}: model row predicted_s={ps!r} must be "
+                    "a positive number — zero predicted seconds is not "
+                    "a prediction")
+    terms = row.get("terms")
+    if (not isinstance(terms, dict)
+            or sorted(terms) != sorted(MODEL_TERM_FIELDS)
+            or not all(_num(terms[k]) and terms[k] >= 0
+                       for k in MODEL_TERM_FIELDS)):
+        errs.append(
+            f"{name}:{i}: model row terms={terms!r} must carry exactly "
+            f"{MODEL_TERM_FIELDS} as non-negative numbers — the "
+            "breakdown is what makes a wrong prediction diagnosable")
+    elif _num(ps) and ps > 0:
+        total = sum(terms.values())
+        if abs(total - ps) > 1e-6 * max(abs(ps), 1e-12):
+            errs.append(
+                f"{name}:{i}: model row terms sum to {total} but "
+                f"predicted_s claims {ps} — the per-term breakdown "
+                "must sum to the total")
+        if bound in KNOWN_MODEL_BOUNDS and \
+                terms[f"{bound}_s"] < max(terms.values()) - 1e-12:
+            errs.append(
+                f"{name}:{i}: model row bound={bound!r} is not the "
+                "largest term — the bound names the wall the "
+                "prediction is against")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -707,6 +812,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_plan_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "trace":
             errors += _check_trace_row(name, i, row, trace_state)
+        if isinstance(row, dict) and row.get("kind") == "model":
+            errors += _check_model_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

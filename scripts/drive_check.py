@@ -1957,3 +1957,124 @@ assert set(_rt_plan.plan_program(
 print("svm/wdamds wires: exact arm trains/embeds, bf16 within bounds, "
       "planner names the four new candidates")
 print(f"DRIVE OK round-32 ({mode})")
+
+# --- round 33: the predictive performance observatory (PR 13) --------------
+# Byte sheets -> model rows -> --predicted-top --only list ->
+# flip_decision gates respected, end-to-end through the CLI subprocess,
+# CPU-only: (a) the predict CLI prices every byte-sheeted program AND
+# every modeled config as invariant-12-clean rows; (b) self-grading
+# against the committed evidence exits 0; (c) measure_all's pruned
+# selection is gate-closed and flip_decision accepts it without a
+# bypassed gate; (d) the shared wire oracle prices the planner's sites
+# identically; (e) the pre-sizer reproduces the OOM-calibrated tiles.
+import json as _pm_json
+import subprocess as _pm_sp
+import tempfile as _pm_tmp
+
+from harp_tpu import perfmodel as _pm
+from harp_tpu.perfmodel import grade as _pm_g
+
+_pm_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_pm_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+# (a) predict CLI: one row per program with a byte sheet (18+) + one per
+# modeled config, every row invariant-12-clean
+_pm_out = _pm_sp.run(
+    [sys.executable, "-m", "harp_tpu", "predict", "--json",
+     "--topology", "v4_32"],
+    capture_output=True, text=True, timeout=600, env=_pm_env,
+    cwd=_pm_root)
+assert _pm_out.returncode == 0, _pm_out.stderr[-800:]
+_pm_rows = [_pm_json.loads(ln)
+            for ln in _pm_out.stdout.strip().splitlines()]
+assert sum(1 for r in _pm_rows if r.get("program")) >= 18
+assert sum(1 for r in _pm_rows if r.get("config")) >= 25
+import check_jsonl as _pm_cj
+with _pm_tmp.TemporaryDirectory() as _pm_d:
+    _pm_p = os.path.join(_pm_d, "model.jsonl")
+    with open(_pm_p, "w") as _pm_f:
+        _pm_f.write(_pm_out.stdout)
+    assert _pm_cj.check_file(_pm_p) == []
+for _pm_r in _pm_rows:
+    assert _pm_r["rates_source"] in ("declared", "probed")
+    assert abs(sum(_pm_r["terms"].values()) - _pm_r["predicted_s"]) \
+        <= 1e-6 * _pm_r["predicted_s"]
+
+# (b) the honesty gate: the model agrees with every committed verdict
+# it can price (exit 1 + term breakdowns on any drift)
+_pm_gr = _pm_sp.run(
+    [sys.executable, "-m", "harp_tpu", "predict", "--grade",
+     "--repo", _pm_root],
+    capture_output=True, text=True, timeout=300, env=_pm_env,
+    cwd=_pm_root)
+assert _pm_gr.returncode == 0, _pm_gr.stderr[-800:]
+_pm_grow = _pm_json.loads(_pm_gr.stdout.strip().splitlines()[-1])
+assert _pm_grow["ok"] is True
+assert sum(1 for e in _pm_grow["pairs"]
+           if e["status"] == "agrees") >= 5
+
+# (c) pruning through the CLI subprocess: the --predicted-top list is
+# gate-closed, and flip_decision evaluates it without a bypassed gate
+# (exit 0/1 only — 2 would be an argparse rejection of the list)
+_pm_ma = _pm_sp.run(
+    [sys.executable, os.path.join(_pm_root, "scripts", "measure_all.py"),
+     "--predicted-top", "3", "--dry-run", "--topology", "v4_32"],
+    capture_output=True, text=True, timeout=300, env=_pm_env,
+    cwd=_pm_root)
+assert _pm_ma.returncode == 0, _pm_ma.stderr[-800:]
+_pm_sel = _pm_json.loads(_pm_ma.stdout.strip().splitlines()[-1])
+_pm_meta = _pm_json.loads(_pm_ma.stderr.strip().splitlines()[-1])
+assert _pm_sel["would_run"] == _pm_meta["only"]
+import flip_decision as _pm_fd
+for _pm_group in _pm_fd.JOINT_GATES + _pm_fd.EXCLUSIVE_GATES:
+    if set(_pm_sel["would_run"]) & set(_pm_group):
+        assert set(_pm_group) <= set(_pm_sel["would_run"]), _pm_group
+_pm_fd_rc = _pm_sp.run(
+    [sys.executable, os.path.join(_pm_root, "scripts",
+                                  "flip_decision.py"),
+     "--only"] + [c for c in _pm_sel["would_run"]
+                  if c in _pm_fd.CANDIDATES],
+    capture_output=True, text=True, timeout=300, env=_pm_env,
+    cwd=_pm_root)
+assert _pm_fd_rc.returncode in (0, 1), _pm_fd_rc.stderr[-500:]
+for _pm_ln in _pm_fd_rc.stdout.strip().splitlines():
+    _pm_v = _pm_json.loads(_pm_ln)
+    assert "flip" in _pm_v  # every selected candidate got a verdict row
+
+# (d) one wire oracle: planner site costs == model wire term, and the
+# Plan rows still fail closed after the re-point
+from harp_tpu.plan import planner as _pm_plan
+_pm_plan_row = _pm_plan.plan_program(
+    "kmeans.fit", _rt_topo.v4_32()).row()
+assert all(s["schedule"] == "keep" for s in _pm_plan_row["sites"])
+for _pm_sched in _pm_plan.SCHEDULES:
+    assert _pm_plan._site_cost(_rt_topo.v4_32(), "psum", _pm_sched,
+                               4096) == \
+        _pm.wire_cost_s(_rt_topo.v4_32(), "psum", _pm_sched, 4096)
+
+# (e) the pre-sizer reproduces the hand-calibrated tiles offline
+assert _pm.presize("kmeans.partials_int8",
+                   n=1_000_000, d=300, k=100)["tile"] == 8000
+assert _pm.presize("mfsgd.sgd_tile_update",
+                   rank=64, n_items=26_744)["tile"] == 256
+
+# and the grading harness itself fails closed under sabotage: a model
+# whose dense arm prices like the kernel must flip ok to False
+_pm_real_price = _pm_g.price
+def _pm_sab(config, row=None, topo=None):
+    p = _pm_real_price(config, row, topo)
+    if config == "mfsgd":
+        return _pm.Price(p.config, p.metric, p.compute_s, 1e-12,
+                         p.wire_s, p.overhead_s)
+    return p
+_pm_g.price = _pm_sab
+try:
+    assert _pm_g.grade(_pm_root)["ok"] is False
+finally:
+    _pm_g.price = _pm_real_price
+
+print(f"perfmodel: {len(_pm_rows)} model rows invariant-12-clean, "
+      f"grade OK ({sum(1 for e in _pm_grow['pairs'] if e['status'] == 'agrees')}"
+      f" agreements), predicted-top {_pm_sel['would_run']} gate-closed, "
+      "wire oracle shared, pre-sizer == hand-calibrated tiles")
+print(f"DRIVE OK round-33 ({mode})")

@@ -123,6 +123,51 @@ SPRINT_ORDER = [
 ]
 
 
+def gate_closure(selected) -> set:
+    """Expand a candidate selection with every gate partner/anchor the
+    verdict machinery needs (PR 13, reusing flip_decision's OWN gate
+    tables): a JOINT partner (the knob flips only if every gate flips),
+    an EXCLUSIVE partner (the verdict picks the faster — absent rows
+    cannot be compared), and a CONDITIONAL anchor (an unmeasured anchor
+    vetoes with exit 1).  Pruning that dropped any of these would turn
+    a short window into re-run homework; tests pin that it never can.
+    """
+    import flip_decision
+
+    out = set(selected)
+    changed = True
+    while changed:
+        changed = False
+        for group in flip_decision.JOINT_GATES + flip_decision.EXCLUSIVE_GATES:
+            if out & set(group) and not set(group) <= out:
+                out |= set(group)
+                changed = True
+        for name, (_, anchor) in flip_decision.CONDITIONAL_GATES.items():
+            if name in out and anchor not in out:
+                out.add(anchor)
+                changed = True
+    return out
+
+
+def predicted_only(top_n: int, topology: str) -> tuple:
+    """The perfmodel-pruned ``--only`` list: rank every priceable flip
+    candidate by predicted speedup on the chosen topology, keep the top
+    N, close over the flip gates, and order by SPRINT_ORDER (the
+    unmeasured-candidates-first priority stays exactly as committed —
+    the model proposes, the gates and the sprint order dispose).
+    Returns (ordered config list, ranked [(cand, speedup)], unpriced).
+    """
+    from harp_tpu.perfmodel.cli import _topology, candidate_ranking
+    from harp_tpu.perfmodel.grade import latest_tpu_rows
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = latest_tpu_rows(os.path.join(repo, "BENCH_local.jsonl"))
+    ranked, unpriced = candidate_ranking(_topology(topology), bench)
+    selected = gate_closure(c for c, _ in ranked[:top_n])
+    only = [c for c in SPRINT_ORDER if c in selected]
+    return only, ranked, unpriced
+
+
 def run_all(smoke: bool, only, watchdog=None, skip=None):
     import jax
 
@@ -491,7 +536,43 @@ def main(argv=None):
                    help="force the CPU backend (the axon site pin would "
                         "otherwise send even --smoke runs to the TPU "
                         "relay, which can hang — CLAUDE.md)")
+    # PR 13: perfmodel sprint pruning — the model's candidate ranking
+    # mapped onto the --only machinery; gate partners are ALWAYS pulled
+    # in (gate_closure), so a pruned sprint can still produce verdicts
+    p.add_argument("--predicted-top", type=int, default=None, metavar="N",
+                   help="run only the perfmodel's top-N predicted flip "
+                        "candidates (plus their JOINT/EXCLUSIVE "
+                        "partners and CONDITIONAL anchors — "
+                        "flip_decision's gates stay authoritative); "
+                        "mutually exclusive with --only")
+    p.add_argument("--topology",
+                   choices=("auto", "single_chip", "sim_ring_8", "v4_32"),
+                   default="v4_32",
+                   help="topology the --predicted-top ranking prices "
+                        "wire terms against (default: the north-star "
+                        "v4_32 slice)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the selected config list and exit "
+                        "without benchmarking anything (CPU-only; the "
+                        "drive_check/CI hook for --predicted-top)")
     args = p.parse_args(argv)
+    if args.predicted_top is not None:
+        if args.only:
+            p.error("--predicted-top computes its own --only list; "
+                    "pass one or the other")
+        only, ranked, unpriced = predicted_only(args.predicted_top,
+                                                args.topology)
+        print(json.dumps({"predicted_top": args.predicted_top,
+                          "topology": args.topology,
+                          "ranking": ranked, "unpriced": unpriced,
+                          "only": only}), file=sys.stderr, flush=True)
+        args.only = only
+    if args.dry_run:
+        sel = [c for c in SPRINT_ORDER
+               if (not args.only or c in args.only)
+               and not (args.skip and c in args.skip)]
+        print(json.dumps({"dry_run": True, "would_run": sel}))
+        return
     if args.platform == "cpu":
         import jax
 

@@ -728,3 +728,93 @@ def test_golden_trace_fixture_is_clean_and_loads():
     assert (s["requests"], s["served"], s["shed"], s["failed"]) == \
         (2, 1, 1, 0)
     assert s["unterminated"] == []
+
+
+# -- invariant 12: model rows (PR 13) ---------------------------------------
+
+def _model_row(**over):
+    """A minimal valid model row; forge one field per test below."""
+    row = {"kind": "model", "program": "kmeans.fit", "config": None,
+           "configs": ["kmeans", "kmeans_int8"],
+           "topology": "v4_32", "rates_source": "declared",
+           "metric": "program_runs_per_sec",
+           "predicted_s": 0.0400001,
+           "predicted_rate": 25.0,
+           "bound": "overhead",
+           "terms": {"compute_s": 0.0, "memory_s": 0.0,
+                     "wire_s": 1e-7, "overhead_s": 0.04},
+           "backend": "cpu", "date": "2026-08-05", "commit": "abc1234"}
+    row.update(over)
+    return row
+
+
+def _model_errs(row):
+    return check_jsonl._check_model_row("t", 1, row)
+
+
+def test_model_row_valid_round_trip(tmp_path):
+    p = tmp_path / "rows.jsonl"
+    p.write_text(json.dumps(_model_row()) + "\n")
+    assert check_jsonl.check_file(str(p)) == []
+
+
+def test_model_row_requires_provenance():
+    row = _model_row()
+    del row["commit"]
+    assert any("provenance" in e for e in _model_errs(row))
+
+
+def test_model_row_needs_a_subject():
+    # a prediction about nothing prices nothing
+    row = _model_row(program=None, config=None, configs=[])
+    assert any("neither a program nor a config" in e
+               for e in _model_errs(row))
+
+
+def test_model_row_rejects_unknown_program_and_config():
+    assert any("unregistered program" in e
+               for e in _model_errs(_model_row(program="made.up")))
+    assert any("not in the sprint surface" in e
+               for e in _model_errs(_model_row(config="warp_drive")))
+    assert any("not in the sprint surface" in e
+               for e in _model_errs(_model_row(configs=["kmeans", "nope"])))
+
+
+def test_model_row_rejects_bad_vocabularies():
+    assert any("rates_source" in e
+               for e in _model_errs(_model_row(rates_source="vibes")))
+    assert any("bound" in e
+               for e in _model_errs(_model_row(bound="luck")))
+
+
+def test_model_row_predicted_seconds_must_be_positive():
+    for bad in (0, -1.0, None, "fast"):
+        assert any("predicted_s" in e
+                   for e in _model_errs(_model_row(predicted_s=bad))), bad
+
+
+def test_model_row_terms_must_sum_to_total():
+    row = _model_row(predicted_s=0.9)  # terms sum to 0.0400001
+    assert any("must sum to the total" in e for e in _model_errs(row))
+    # a missing or negative term is equally loud
+    row = _model_row()
+    del row["terms"]["wire_s"]
+    assert any("terms" in e for e in _model_errs(row))
+    row = _model_row()
+    row["terms"]["wire_s"] = -1e-9
+    assert any("terms" in e for e in _model_errs(row))
+
+
+def test_model_row_bound_must_name_the_largest_term():
+    row = _model_row(bound="compute")  # overhead dominates
+    assert any("largest term" in e for e in _model_errs(row))
+
+
+def test_model_vocabularies_in_sync_with_perfmodel():
+    """The frozen invariant-12 vocabularies mirror harp_tpu.perfmodel
+    (this file stays standalone; drift fails here, tier-1)."""
+    from harp_tpu import perfmodel
+
+    assert tuple(perfmodel.BOUNDS) == check_jsonl.KNOWN_MODEL_BOUNDS
+    assert tuple(perfmodel.RATES_SOURCES) == \
+        check_jsonl.KNOWN_MODEL_RATES_SOURCES

@@ -399,6 +399,17 @@ def test_measure_all_full_mode_kwargs_bind(monkeypatch):
     assert not bad, bad  # a binding failure shows up as the error row
     assert [r["config"] for r in rows] == ma.SPRINT_ORDER
 
+    # PR 13: the perfmodel-pruned selection binds through the same
+    # machinery — the --predicted-top list is a valid --only list whose
+    # full-shape lambdas construct (and stays gate-closed, so a pruned
+    # sprint can still print verdicts)
+    only, ranked, _ = ma.predicted_only(4, "v4_32")
+    assert only and set(only) == ma.gate_closure(
+        c for c, _ in ranked[:4])
+    pruned = list(ma.run_all(smoke=False, only=only))
+    assert [r["config"] for r in pruned] == only
+    assert not [r for r in pruned if "error" in r]
+
 
 def test_dispatch_bench_smoke(capsys):
     rc = cli.main(["bench", "--verbs", "allreduce", "rotate",
