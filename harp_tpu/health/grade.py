@@ -1,0 +1,161 @@
+"""Evidence regression — grade fresh measurements, gate the pruning.
+
+The fourth detector family (see :mod:`harp_tpu.health.sentinel`): a
+freshly measured bench row is judged against two baselines —
+
+1. the **committed incumbent** (the latest full-shape TPU row for the
+   same config in BENCH_local.jsonl, the same filter as
+   ``flip_decision.latest_rows``): relative-tolerance verdict per
+   metric family — ``regressed`` / ``improved`` outside the ±10% dead
+   band (:data:`REL_TOL`, the flip rule's own margin), ``confirmed``
+   inside it;
+2. the **perfmodel's prediction** (:mod:`harp_tpu.perfmodel`): the
+   magnitude band (``grade.MAGNITUDE_TOL``) and — for flip candidates
+   with a measured incumbent — the ranking direction.  Either failing
+   yields ``model_invalidated``: the model mis-priced real silicon.
+
+``model_invalidated`` is the verdict ROADMAP autotuning item (3) wants
+blocking the next sprint pruning: :func:`model_gate` re-runs the
+perfmodel's full self-grade against ALL committed evidence and
+``measure_all.py --predicted-top`` REFUSES (fail closed) when it fails
+— a model invalidated by fresh silicon evidence cannot prune the sprint
+that would re-measure it.  ``measure_on_relay.sh`` runs
+``python -m harp_tpu health --grade-model`` right after a sprint lands
+new rows, so the verdict is committed evidence, not a scrolled warning.
+"""
+
+from __future__ import annotations
+
+import os
+
+from harp_tpu.health import sentinel
+
+#: |ratio - 1| at or below this is "confirmed" — the same 10% margin the
+#: flip rule and the perfmodel's ranking dead band use.
+REL_TOL = 0.10
+
+#: headline metric resolution order (bench.py UNITS keys + serve qps) —
+#: the first key present in a row is its metric family.
+METRIC_KEYS = ("iters_per_sec", "updates_per_sec_per_chip",
+               "tokens_per_sec_per_chip", "samples_per_sec",
+               "vertices_per_sec", "trees_per_sec", "points_per_sec",
+               "iters_per_sec_ex_gen", "qps")
+
+
+def headline_metric(row: dict) -> tuple[str | None, float | None]:
+    for k in METRIC_KEYS:
+        v = row.get(k)
+        if v is not None:
+            try:
+                return k, float(v)
+            except (TypeError, ValueError):
+                return None, None
+    return None, None
+
+
+def grade_bench_row(row: dict, repo: str, *, bench: dict | None = None,
+                    topo=None) -> dict | None:
+    """Judge one freshly measured bench row; register and return the
+    ``evidence_regression`` finding, or None when there is nothing to
+    grade against (no incumbent AND no model — fail-closed rows are the
+    flip gate's job, not the grader's).
+
+    Smoke / error / CPU-sim rows are never graded (the same
+    CPU-inversion filter as ``flip_decision.latest_rows``).
+    """
+    from harp_tpu.perfmodel import grade as G
+    from harp_tpu.perfmodel import model as M
+
+    cfg = row.get("config")
+    if (not cfg or row.get("smoke") or "error" in row
+            or row.get("backend") == "cpu"):
+        return None
+    metric, value = headline_metric(row)
+    if metric is None or not value or value <= 0:
+        return None
+    if bench is None:
+        bench = G.latest_tpu_rows(os.path.join(repo, "BENCH_local.jsonl"))
+
+    finding: dict = {"config": cfg, "metric": metric,
+                     "measured": round(value, 4)}
+    verdict = None
+
+    # 1. vs the committed incumbent (same config, same metric family)
+    inc = bench.get(cfg)
+    iv = inc.get(metric) if inc is not None else None
+    if iv:
+        ratio = value / float(iv)
+        finding["incumbent"] = round(float(iv), 4)
+        finding["ratio_vs_incumbent"] = round(ratio, 4)
+        verdict = ("regressed" if ratio < 1.0 - REL_TOL
+                   else "improved" if ratio > 1.0 + REL_TOL
+                   else "confirmed")
+
+    # 2. vs the model: magnitude band + ranking direction
+    if cfg in M.CONFIG_MODELS:
+        if topo is None:
+            from harp_tpu.plan.topology import single_chip
+
+            topo = single_chip()  # graded evidence is 1x v5e
+        p = M.price(cfg, row, topo)
+        factor = max(p.predicted_rate / value, value / p.predicted_rate)
+        finding["predicted"] = round(p.predicted_rate, 4)
+        finding["model_factor"] = round(factor, 2)
+        if factor > G.MAGNITUDE_TOL:
+            verdict = "model_invalidated"
+        pair = G.FAMILY_PAIRS.get(cfg)
+        if pair is not None and verdict != "model_invalidated":
+            inc_name, pmetric, fb = pair
+            irow = bench.get(inc_name)
+            miv = G._metric_value(irow, pmetric, fb) if irow else None
+            mcv = G._metric_value(row, pmetric, fb)
+            if miv and mcv and inc_name in M.CONFIG_MODELS:
+                pi = M.price(inc_name, irow, topo)
+                measured = mcv / miv
+                predicted = pi.predicted_s / p.predicted_s
+                finding["measured_speedup"] = round(measured, 4)
+                finding["predicted_speedup"] = round(predicted, 4)
+                if (abs(measured - 1.0) > G.DEAD_BAND
+                        and (measured > 1.0) != (predicted > 1.0)):
+                    verdict = "model_invalidated"
+
+    if verdict is None:
+        return None
+    sev = ("warn" if verdict in ("regressed", "model_invalidated")
+           else "info")
+    out = sentinel.monitor.upsert("evidence_regression", cfg,
+                                  severity=sev)
+    out.update(finding)
+    out["verdict"] = verdict
+    return sentinel._public(out)
+
+
+def model_gate(repo: str) -> tuple[bool, dict]:
+    """ROADMAP autotuning item (3), closed: re-run the perfmodel's full
+    self-grade (``perfmodel.grade.grade`` — flip-pair directions, sweep
+    rank correlation, magnitude band, all against the COMMITTED
+    evidence files, which include any rows a sprint just landed) and
+    turn the outcome into an ``evidence_regression`` health finding.
+
+    Returns ``(ok, finding)``.  ``measure_all.py --predicted-top``
+    calls this as its preflight and REFUSES to prune when ``ok`` is
+    False — the gate re-runs the grade every time, so the refusal lifts
+    exactly when the model has been re-calibrated against the evidence
+    that invalidated it (no manual ack file to go stale).
+    """
+    from harp_tpu.perfmodel import grade as G
+
+    report = G.grade(repo)
+    ok = bool(report["ok"])
+    verdict = "confirmed" if ok else "model_invalidated"
+    row = sentinel.monitor.upsert("evidence_regression",
+                                  "perfmodel.grade",
+                                  severity="info" if ok else "page")
+    row.update({
+        "tag": "perfmodel.grade", "verdict": verdict,
+        "failures": len(report["failures"]),
+        # enough detail to act on without re-running (--grade has the
+        # full term breakdowns); bounded so the row stays one line
+        "detail": [f["what"] for f in report["failures"]][:4],
+    })
+    return ok, sentinel._public(row)

@@ -135,7 +135,8 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               compile_info: dict | None = None,
               transfer_info: dict | None = None,
               skew_info: dict | None = None,
-              trace_info: dict | None = None) -> dict:
+              trace_info: dict | None = None,
+              health_info: dict | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -157,6 +158,9 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
     # request-trace section (PR 12) only when the run served requests
     if trace_info and trace_info.get("requests"):
         row["requests"] = trace_info
+    # health section (PR 14) only when the sentinel recorded findings
+    if health_info and health_info.get("findings"):
+        row["health"] = health_info
     for t in comm.values():
         execs = max(1, t["executions"])
         for s in t["sites"]:
@@ -277,6 +281,31 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
             lines.append(f"  UNTERMINATED spans: {rq['unterminated']} "
                          "(every offered request must end served/shed/"
                          "failed — see python -m harp_tpu trace)")
+    hl = row.get("health")
+    if hl:
+        lines.append(
+            f"health (sentinel findings): {hl.get('findings', 0)} — "
+            f"{hl.get('actionable', 0)} actionable, worst severity "
+            f"{hl.get('worst_severity')}")
+        for r in hl.get("rows", []):
+            who = r.get("tag") or r.get("phase") or r.get("config") or "?"
+            extra = ""
+            if r.get("detector") == "slo_burn":
+                extra = (f"  burn {r.get('fast_burn')}/"
+                         f"{r.get('slow_burn')}, "
+                         f"{r.get('shed', 0)} shed / "
+                         f"{r.get('failed', 0)} failed")
+            elif r.get("detector") == "skew_trigger":
+                extra = (f"  wasted {r.get('wasted_frac')}, plan: "
+                         f"{len((r.get('plan') or {}).get('moves') or [])}"
+                         " move(s)")
+            elif r.get("detector") == "budget_drift":
+                extra = (f"  {r.get('violations')}x, worst "
+                         f"{r.get('worst')}")
+            elif r.get("detector") == "evidence_regression":
+                extra = f"  verdict {r.get('verdict')}"
+            lines.append(f"  [{r.get('severity')}] "
+                         f"{r.get('detector')} {who}{extra}")
     if "metrics_rows" in row:
         lines.append(f"metrics: {row['metrics_rows']} row(s)")
         if row.get("metrics_last"):
@@ -290,6 +319,7 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
+    from harp_tpu import health
     from harp_tpu.utils import flightrec, reqtrace, skew
 
     comm = telemetry.ledger.summary()
@@ -299,7 +329,8 @@ def live_report() -> tuple[dict, list[dict]]:
                       transfer_info=flightrec.transfers.summary(),
                       skew_info=skew.ledger.summary(),
                       trace_info=reqtrace.summarize_rows(
-                          reqtrace.tracer.rows())),
+                          reqtrace.tracer.rows()),
+                      health_info=health.monitor.summary()),
             telemetry.tracer.records)
 
 
@@ -353,12 +384,14 @@ def main(argv=None) -> int:
     transfer_rows: list[dict] = []
     skew_rows: list[dict] = []
     trace_rows: list[dict] = []
+    health_rows: list[dict] = []
     if args.telemetry:
         kinds = telemetry.load_rows(args.telemetry)
         span_rows, comm_rows = kinds["span"], kinds["comm"]
         compile_rows, transfer_rows = kinds["compile"], kinds["transfer"]
         skew_rows = kinds["skew"]
         trace_rows = kinds["trace"]
+        health_rows = kinds["health"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -373,6 +406,7 @@ def main(argv=None) -> int:
 
         top_ops = op_breakdown(args.trace_logdir, top=args.top)
 
+    from harp_tpu import health as health_mod
     from harp_tpu.utils.reqtrace import summarize_rows as trace_summary
 
     row = build_row(comm_summary_from_rows(comm_rows),
@@ -382,7 +416,10 @@ def main(argv=None) -> int:
                     transfer_info=transfer_summary_from_rows(transfer_rows),
                     skew_info=skew_summary_from_rows(skew_rows),
                     trace_info=(trace_summary(trace_rows)
-                                if trace_rows else None))
+                                if trace_rows else None),
+                    health_info=(health_mod.summarize_rows(health_rows)
+                                 | {"rows": health_rows}
+                                 if health_rows else None))
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

@@ -43,6 +43,7 @@ import time
 
 import numpy as np
 
+from harp_tpu import health as health_mod
 from harp_tpu.serve.engines import ENGINES
 from harp_tpu.serve.server import Server
 from harp_tpu.utils import flightrec, telemetry
@@ -278,6 +279,7 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
                         max_queue_rows: int | None = None,
                         max_retries: int = 3,
                         fault_rate: float = 0.0,
+                        fault_ordinals: tuple[int, ...] | None = None,
                         fault_seed: int = 0) -> dict:
     """Sustained-load burst-vs-continuous A/B on one seeded trace.
 
@@ -299,7 +301,17 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
     ``served + shed + failed == offered`` identity and the usual
     ``steady_compiles == 0`` both machine-checked by check_jsonl
     (invariants 9 and 7).  Faults are injected on the CONTINUOUS plane
-    only (the burst arm stays the clean incumbent).
+    only (the burst arm stays the clean incumbent); ``fault_ordinals``
+    pins EXACT 1-based dispatch events instead of a probability (the
+    deterministic chaos the health acceptance test drives).
+
+    Health sentinel (PR 14): the continuous replay runs with the SLO
+    burn detector live on the runner AND a warn-mode "one staging per
+    batch window" budget (``steady.h2d_calls=1`` — a retry-with-restage
+    legitimately stages twice, and that drift lands in a budget_drift
+    health row instead of a scrolled RuntimeWarning).  The row's
+    ``health_*`` fields summarize the run's findings; a fault-free,
+    unshed run reports zero.
     """
     from harp_tpu.parallel.mesh import current_mesh
 
@@ -356,10 +368,20 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
                 # the bucket-error comparison is apples-to-apples (live
                 # servers keep the 60 s rolling default)
                 stats_window_s=3600.0)
+            fault_spec = (fault_ordinals if fault_ordinals
+                          else fault_rate if fault_rate else None)
             injector = FaultInjector(
                 seed=fault_seed,
-                fail={"dispatch": fault_rate} if fault_rate else None)
+                fail={"dispatch": fault_spec}
+                if fault_spec is not None else None)
             srv.steady.reset()
+            # the staging discipline as a warn-mode budget: one counted
+            # put_input per batch window.  A retry-with-restage breaks
+            # it BY DESIGN (HL303 demands the fresh buffer) — the point
+            # is that the drift becomes a budget_drift health row, i.e.
+            # committed evidence that this run restaged under faults.
+            srv.steady.limits["h2d_calls"] = 1
+            hmark = health_mod.monitor.mark()
             base = flightrec.snapshot()
             with injector.arm():
                 cont = _continuous_replay(srv, runner, reqs, arrivals)
@@ -420,6 +442,17 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
             "fault_retries": runner.fault_retries,
             "engine_failures": runner.engine_failures,
             "faults_injected": injector.injected["dispatch"],
+            # health sentinel evidence (PR 14): findings NEW to this
+            # replay (the monitor is monotone like the flight counters),
+            # the SLO burn peaks, and the staging-discipline violations
+            # — all zero on a clean run (the acceptance pin)
+            "health_findings": len(health_mod.monitor.since(hmark)),
+            "health_worst_severity": health_mod.summarize_rows(
+                health_mod.monitor.since(hmark))["worst_severity"],
+            "health_fast_burn": round(runner.health.peak_fast, 3),
+            "health_slow_burn": round(runner.health.peak_slow, 3),
+            "health_breaches": runner.health.breaches,
+            "health_budget_drift": srv.steady.violations,
             "deadline_ms": deadline_ms,
             "max_queue_rows": max_queue_rows,
             "fault_rate": fault_rate,

@@ -162,6 +162,13 @@ class Engine:
     def put_input(self, arr: np.ndarray):
         import jax
 
+        from harp_tpu.utils import flightrec
+
+        # flight recorder: staging IS the serve plane's bulk H2D — one
+        # counted placement per batch window, so the "one staging per
+        # batch" discipline (h2d_calls=1) is budget-enforceable and a
+        # retry-with-restage shows up in the budget-drift health row
+        flightrec.record_h2d(arr.nbytes)
         return jax.device_put(arr, self.mesh.replicated())
 
     # -- bench/test helpers ------------------------------------------------

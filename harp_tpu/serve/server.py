@@ -54,6 +54,7 @@ from typing import IO, Any, Callable, Sequence
 
 import numpy as np
 
+from harp_tpu import health as health_mod
 from harp_tpu.serve.batcher import (DEFAULT_LADDER, ContinuousScheduler,
                                     MicroBatcher, ShapeLadder)
 from harp_tpu.serve.cache import ExecutableCache, code_fingerprint
@@ -388,6 +389,13 @@ class ContinuousRunner:
         # live p50/p95/p99 for the TCP stats line and the sustained
         # bench row without retaining samples
         self.win = reqtrace.RollingWindow(window_s=stats_window_s)
+        # health sentinel (PR 14): multi-window SLO burn over this
+        # plane's terminal outcomes, on the same clock/window geometry
+        # as the rolling percentiles.  No-op while telemetry is off; the
+        # flagship budgets are pinned UNCHANGED with it armed.
+        self.health = health_mod.SLOBurn(
+            tag=f"serve.{server.app}", window_s=stats_window_s,
+            latency_slo_ms=(deadline_s * 1e3 if deadline_s else None))
 
     # -- admission ---------------------------------------------------------
     def submit(self, key: Any, req: Any, now: float | None = None,
@@ -403,15 +411,18 @@ class ContinuousRunner:
                else reqtrace.tracer.begin(now))
         if not isinstance(req, dict):
             reqtrace.tracer.end(rid, "failed", now, reason="bad_request")
+            self.health.observe(now, "failed", rid=rid)
             return [(key, {"id": None,
                            "error": "request must be a JSON object"})]
         try:
             rows = self.srv.engine.rows_from_request(req)
         except (ValueError, KeyError, TypeError) as e:
             reqtrace.tracer.end(rid, "failed", now, reason="bad_request")
+            self.health.observe(now, "failed", rid=rid)
             return [(key, {"id": req.get("id"), "error": str(e)})]
         if rows.shape[0] == 0:
             reqtrace.tracer.end(rid, "served", now, rows=0)
+            self.health.observe(now, "served", latency_ms=0.0)
             return [(key, {"id": req.get("id"), "result": []})]
         if key in self._asm:
             raise ValueError(f"request key {key!r} already in flight")
@@ -421,6 +432,7 @@ class ContinuousRunner:
             self.shed += 1
             reqtrace.tracer.end(rid, "shed", now, reason="queue_full",
                                 queued_rows=self.sched.queued_rows)
+            self.health.observe(now, "shed", rid=rid)
             return [(key, {
                 "id": req.get("id"), "shed": True, "reason": "queue_full",
                 "error": f"shed: admission queue full "
@@ -468,30 +480,40 @@ class ContinuousRunner:
                 tr.event(self._asm[key]["rid"], "batch", now,
                          seq=batch.seq, lo=lo, hi=hi, rung=batch.rung)
             attempt = 0
-            while True:
-                try:
-                    with self.srv.steady.batch():
+            fatal: Exception | None = None
+            # ONE steady window for the whole dispatch-with-retries
+            # phase ("produce one dispatched batch"), so a retry's
+            # second staging is VISIBLE to the per-window budget — in
+            # warn mode it lands in the budget-drift health row (PR 14)
+            # as committed restage evidence instead of vanishing with
+            # the aborted window
+            with self.srv.steady.batch():
+                while True:
+                    try:
                         # a FRESH staged buffer per attempt: the previous
                         # attempt's buffer was donated to the failed
                         # dispatch and can never be re-dispatched (HL303)
                         staged = self.srv._stage(batch, rows_by_key)
                         out_dev = self.srv._exec[batch.rung](
                             *self.srv.engine.state_args(), staged)
-                    break
-                except self._NON_TRANSIENT:
-                    raise
-                except Exception as e:  # noqa: BLE001 - isolate, count
-                    attempt += 1
-                    if attempt > self.max_retries:
-                        return out + self._fail_batch(batch, e, now)
-                    self.fault_retries += 1
-                    # timestamps stay on the CALLER's clock (`now`): the
-                    # sustained replay drives a virtual timeline, and a
-                    # wall-clock stamp here would break the trace's
-                    # monotone-ts contract (invariant 11)
-                    tr.batch_event(batch.seq, "retry", now,
-                                   attempt=attempt,
-                                   error=f"{type(e).__name__}: {e}")
+                        break
+                    except self._NON_TRANSIENT:
+                        raise
+                    except Exception as e:  # noqa: BLE001 - isolate
+                        attempt += 1
+                        if attempt > self.max_retries:
+                            fatal = e
+                            break
+                        self.fault_retries += 1
+                        # timestamps stay on the CALLER's clock (`now`):
+                        # the sustained replay drives a virtual timeline,
+                        # and a wall-clock stamp here would break the
+                        # trace's monotone-ts contract (invariant 11)
+                        tr.batch_event(batch.seq, "retry", now,
+                                       attempt=attempt,
+                                       error=f"{type(e).__name__}: {e}")
+            if fatal is not None:
+                return out + self._fail_batch(batch, fatal, now)
             self._in_flight.append((batch, out_dev))
             self.dispatched += 1
             self.srv.rows_served += batch.rows
@@ -513,6 +535,7 @@ class ContinuousRunner:
             a = self._asm.pop(key)
             self.shed += 1
             reqtrace.tracer.end(a["rid"], "shed", now, reason="deadline")
+            self.health.observe(now, "shed", rid=a["rid"])
             out.append((key, {
                 "id": a["req"].get("id"), "shed": True,
                 "reason": "deadline",
@@ -537,6 +560,7 @@ class ContinuousRunner:
             self.failed += 1
             reqtrace.tracer.end(a["rid"], "failed", now,
                                 reason="engine_failure", seq=batch.seq)
+            self.health.observe(now, "failed", rid=a["rid"])
             out.append((key, {
                 "id": a["req"].get("id"),
                 "error": f"engine failure after {self.max_retries} "
@@ -565,10 +589,16 @@ class ContinuousRunner:
                 lat = now - a["arrival"]
                 self.latencies_ms.append(lat * 1e3)
                 self.win.add_latency(now, lat * 1e3)
-                if self.deadline_s is not None and lat > self.deadline_s:
+                missed = (self.deadline_s is not None
+                          and lat > self.deadline_s)
+                if missed:
                     self.deadline_misses += 1  # answered, but late
                 reqtrace.tracer.end(a["rid"], "served", now,
                                     latency_ms=round(lat * 1e3, 4))
+                self.health.observe(now, "served",
+                                    latency_ms=lat * 1e3,
+                                    deadline_missed=missed,
+                                    rid=a["rid"])
                 del self._asm[key]
                 self.completed += 1
                 self.srv.requests_served += 1
@@ -609,7 +639,11 @@ class ContinuousRunner:
                 "p50_ms": pct(50), "p99_ms": pct(99),
                 # live rolling-window percentiles (PR 12): bounded-memory
                 # log-bucket histograms, error documented in the field
-                "window": self.win.snapshot(self.clock())}
+                "window": self.win.snapshot(self.clock()),
+                # live SLO burn (PR 14): multi-window error-budget burn
+                # over this plane's outcomes — the stats-line surface of
+                # the health sentinel (zeros while telemetry is off)
+                "health": self.health.snapshot(self.clock())}
 
 
 class _BurstReader:

@@ -155,6 +155,11 @@ class TCPFrontEnd:
                     stats = self.srv.stats()
                     if self.runner is not None:
                         stats["continuous"] = self.runner.stats()
+                        # health sentinel (PR 14) at top level too: the
+                        # SLO-burn snapshot an operator polls for —
+                        # also nested under continuous.health
+                        stats["health"] = self.runner.health.snapshot(
+                            time.perf_counter())
                     conn.q.put_nowait(stats)
                     continue
                 if cmd == "quit":

@@ -375,8 +375,8 @@ class BudgetExceeded(RuntimeError):
     """A flight-recorder budget was violated (see :func:`budget`)."""
 
 
-_BUDGET_KEYS = ("compiles", "compile_s", "h2d_bytes", "dispatches",
-                "readbacks", "d2h_bytes")
+_BUDGET_KEYS = ("compiles", "compile_s", "h2d_bytes", "h2d_calls",
+                "dispatches", "readbacks", "d2h_bytes")
 
 
 def snapshot() -> dict:
@@ -385,6 +385,7 @@ def snapshot() -> dict:
     return {"compiles": compile_watch.count,
             "compile_s": round(compile_watch.total_s, 6),
             "h2d_bytes": transfers.h2d_bytes,
+            "h2d_calls": transfers.h2d_calls,
             "dispatches": transfers.dispatches,
             "readbacks": transfers.readbacks,
             "d2h_bytes": transfers.d2h_bytes}
@@ -406,11 +407,22 @@ class _BudgetScope:
         return delta_since(self._base)
 
 
+def _notify_health(tag: str, over: list[tuple[str, Any, Any]]) -> None:
+    """WARN-mode violations also land on the health monitor's
+    budget-drift detector (PR 14) — a trap that fires mid-bench leaves
+    committed evidence instead of a scrolled RuntimeWarning.  Raise-mode
+    violations are already loud (they kill the test); only warn mode
+    needs the paper trail."""
+    from harp_tpu import health
+
+    health.monitor.observe_budget(tag, over)
+
+
 @contextlib.contextmanager
 def budget(compiles: int | None = None, h2d_bytes: int | None = None,
            dispatches: int | None = None, readbacks: int | None = None,
-           d2h_bytes: int | None = None, *, action: str = "raise",
-           tag: str = ""):
+           d2h_bytes: int | None = None, h2d_calls: int | None = None,
+           *, action: str = "raise", tag: str = ""):
     """Enforce execution-discipline bounds over a block.
 
     Each keyword is an inclusive upper bound on that counter's *delta*
@@ -438,20 +450,22 @@ def budget(compiles: int | None = None, h2d_bytes: int | None = None,
         yield None
         return
     limits = {"compiles": compiles, "h2d_bytes": h2d_bytes,
-              "dispatches": dispatches, "readbacks": readbacks,
-              "d2h_bytes": d2h_bytes}
+              "h2d_calls": h2d_calls, "dispatches": dispatches,
+              "readbacks": readbacks, "d2h_bytes": d2h_bytes}
     scope_ = _BudgetScope(snapshot())
     yield scope_
     spent = scope_.spent()
-    violations = [
-        f"{name} used {spent[name]} > budget {limit}"
-        for name, limit in limits.items()
-        if limit is not None and spent[name] > limit]
-    if violations:
+    over = [(name, spent[name], limit)
+            for name, limit in limits.items()
+            if limit is not None and spent[name] > limit]
+    if over:
         msg = (f"flight-recorder budget exceeded"
-               f"{f' [{tag}]' if tag else ''}: " + "; ".join(violations))
+               f"{f' [{tag}]' if tag else ''}: "
+               + "; ".join(f"{n} used {s} > budget {l}"
+                           for n, s, l in over))
         if action == "warn":
             warnings.warn(msg, RuntimeWarning, stacklevel=3)
+            _notify_health(tag or _call_site(), over)
         else:
             raise BudgetExceeded(msg)
 
@@ -482,11 +496,12 @@ class SteadyState:
     def __init__(self, compiles: int | None = 0,
                  dispatches: int | None = 1, readbacks: int | None = 1,
                  h2d_bytes: int | None = None,
-                 d2h_bytes: int | None = None, *,
+                 d2h_bytes: int | None = None,
+                 h2d_calls: int | None = None, *,
                  action: str = "raise", tag: str = "steady"):
         self.limits = {"compiles": compiles, "dispatches": dispatches,
                        "readbacks": readbacks, "h2d_bytes": h2d_bytes,
-                       "d2h_bytes": d2h_bytes}
+                       "d2h_bytes": d2h_bytes, "h2d_calls": h2d_calls}
         self.action = action
         self.tag = tag
         self.reset()
@@ -509,15 +524,17 @@ class SteadyState:
         yield None
         spent = delta_since(base)
         self.batches += 1
-        over = [f"{k} used {spent[k]} > budget {v}"
-                for k, v in self.limits.items()
+        over = [(k, spent[k], v) for k, v in self.limits.items()
                 if v is not None and spent[k] > v]
         if over:
             self.violations += 1
             msg = (f"steady-state budget exceeded [{self.tag}] batch "
-                   f"{self.batches}: " + "; ".join(over))
+                   f"{self.batches}: "
+                   + "; ".join(f"{k} used {s} > budget {v}"
+                               for k, s, v in over))
             if self.action == "warn":
                 warnings.warn(msg, RuntimeWarning, stacklevel=3)
+                _notify_health(self.tag, over)
             else:
                 raise BudgetExceeded(msg)
 
@@ -546,7 +563,7 @@ class SteadyState:
         if self._base is None:
             return {}
         spent = delta_since(self._base)
-        wrong = [f"{k} spent {spent[k]} != exactly {want}"
+        wrong = [(k, spent[k], want)
                  for k, want in (("compiles", compiles),
                                  ("dispatches", batches),
                                  ("readbacks", batches))
@@ -554,9 +571,12 @@ class SteadyState:
         if wrong:
             self.violations += 1
             msg = (f"steady-state exact accounting failed [{self.tag}] "
-                   f"over {batches} batches: " + "; ".join(wrong))
+                   f"over {batches} batches: "
+                   + "; ".join(f"{k} spent {s} != exactly {w}"
+                               for k, s, w in wrong))
             if self.action == "warn":
                 warnings.warn(msg, RuntimeWarning, stacklevel=2)
+                _notify_health(self.tag, wrong)
             else:
                 raise BudgetExceeded(msg)
         return spent

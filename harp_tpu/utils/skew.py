@@ -267,17 +267,30 @@ def reset() -> None:
 def record_partition(phase: str, work, *, unit: str = "rows",
                      padded_total: int | None = None,
                      units=None) -> None:
-    """Ingest hook for readers/partitioners (no-op when telemetry off)."""
+    """Ingest hook for readers/partitioners (no-op when telemetry off).
+
+    Also feeds the health sentinel's skew trigger (PR 14): K consecutive
+    records with ``wasted_frac`` over the threshold emit a
+    ``kind:"health"`` finding carrying the ``suggest_rebalance`` plan
+    inline — the elastic-execution hook, advisory in this PR."""
     if telemetry.enabled():
         ledger.record_partition(phase, work, unit=unit,
                                 padded_total=padded_total, units=units)
+        from harp_tpu import health
+
+        health.monitor.observe_skew(phase, ledger)
 
 
 def record_execution(phase: str, work, *, unit: str,
                      wall_s: float | None = None) -> None:
-    """Execution hook for the epoch drivers (no-op when telemetry off)."""
+    """Execution hook for the epoch drivers (no-op when telemetry off).
+    Feeds the health sentinel's skew trigger like
+    :func:`record_partition` — each call is one superstep's record."""
     if telemetry.enabled():
         ledger.record_execution(phase, work, unit=unit, wall_s=wall_s)
+        from harp_tpu import health
+
+        health.monitor.observe_skew(phase, ledger)
 
 
 def record_host(phase: str, worker: int, wall_s: float,

@@ -82,11 +82,13 @@ def scope(on: bool = True, *, reset: bool = True):
     if reset:
         ledger.reset()
         tracer.reset()
+        from harp_tpu import health
         from harp_tpu.utils import flightrec, reqtrace, skew
 
         flightrec.reset()
         skew.reset()
         reqtrace.reset()
+        health.reset()
     try:
         yield
     finally:
@@ -397,8 +399,10 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 def export(path: str) -> None:
     """Write every collected record (spans + ledger + flight recorder +
-    skew ledger + request traces) as one JSONL file — the input format
-    of ``python -m harp_tpu report`` and ``python -m harp_tpu trace``."""
+    skew ledger + request traces + health findings) as one JSONL file —
+    the input format of ``python -m harp_tpu report``, ``python -m
+    harp_tpu trace``, and ``python -m harp_tpu health``."""
+    from harp_tpu import health
     from harp_tpu.utils import flightrec, reqtrace, skew
 
     with open(path, "w") as fh:
@@ -407,6 +411,7 @@ def export(path: str) -> None:
         flightrec.export_jsonl(fh)
         skew.export_jsonl(fh)
         reqtrace.tracer.export_jsonl(fh)
+        health.export_jsonl(fh)
 
 
 def export_timeline(path: str) -> None:
@@ -474,11 +479,13 @@ def export_timeline(path: str) -> None:
 def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
-    [...], "skew": [...], "trace": [...]}`` (unknown kinds land under
-    ``"comm"`` for backward compatibility with pre-flight-recorder
-    exports, whose only unmarked rows were the ledger's)."""
+    [...], "skew": [...], "trace": [...], "health": [...]}`` (unknown
+    kinds land under ``"comm"`` for backward compatibility with
+    pre-flight-recorder exports, whose only unmarked rows were the
+    ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
-                                  "transfer": [], "skew": [], "trace": []}
+                                  "transfer": [], "skew": [],
+                                  "trace": [], "health": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

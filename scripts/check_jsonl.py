@@ -156,6 +156,24 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     that does not reconcile with its own total is a wrong prediction
     that cannot even be diagnosed, which is the one thing a model row
     exists to prevent.
+
+13. **Health rows are coherent monitoring evidence** (any file): a
+    ``kind: "health"`` row (the PR-14 sentinel — ``harp_tpu.health``,
+    exported by ``telemetry.export`` / emitted by ``python -m harp_tpu
+    health --grade-model``) must carry the provenance stamp (a CPU-sim
+    finding must never read as relay degradation evidence), name a
+    registered detector and severity (``KNOWN_HEALTH_DETECTORS`` /
+    ``KNOWN_HEALTH_SEVERITIES`` — frozen standalone and sync-pinned
+    against ``harp_tpu.health`` by tests), carry non-negative integer
+    counts and non-negative burn/ratio numbers, and — per detector —
+    an ``evidence_regression`` row MUST carry a ``verdict`` from
+    ``KNOWN_HEALTH_VERDICTS`` (``model_invalidated`` is the one that
+    fails ``measure_all --predicted-top`` closed), while a
+    ``skew_trigger`` row MUST carry a structurally valid inline
+    rebalance plan (``schedule.apply_rebalance``'s input shape:
+    ``phase``, ``moves`` with non-negative worker ids and work, numeric
+    before/after ratios) — the elastic-execution hook is only a hook if
+    its payload is replayable.
 """
 
 from __future__ import annotations
@@ -745,6 +763,108 @@ def _check_model_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the health-row vocabularies (invariant 13), FROZEN standalone like the
+# plan/model vocabularies and sync-pinned by tests/test_check_jsonl.py
+# against harp_tpu.health (DETECTORS / SEVERITIES / VERDICTS)
+KNOWN_HEALTH_DETECTORS = ("slo_burn", "skew_trigger", "budget_drift",
+                          "evidence_regression")
+KNOWN_HEALTH_SEVERITIES = ("info", "warn", "page")
+KNOWN_HEALTH_VERDICTS = ("confirmed", "improved", "regressed",
+                         "model_invalidated")
+HEALTH_COUNT_FIELDS = ("offered", "served", "shed", "failed",
+                       "deadline_missed", "breaches", "violations",
+                       "supersteps", "consecutive", "failures")
+HEALTH_RATIO_FIELDS = ("fast_burn", "slow_burn", "wasted_frac",
+                       "max_mean_ratio", "ratio_vs_incumbent",
+                       "model_factor", "error_budget")
+
+
+def _check_health_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 13: health rows must be coherent monitoring evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: health row missing provenance field(s) "
+            f"{missing} — export through telemetry.export / the health "
+            "CLI, which stamp them")
+    det = row.get("detector")
+    if det not in KNOWN_HEALTH_DETECTORS:
+        errs.append(f"{name}:{i}: health row detector={det!r} not in "
+                    f"{KNOWN_HEALTH_DETECTORS}")
+    sev = row.get("severity")
+    if sev not in KNOWN_HEALTH_SEVERITIES:
+        errs.append(f"{name}:{i}: health row severity={sev!r} not in "
+                    f"{KNOWN_HEALTH_SEVERITIES}")
+    for k in HEALTH_COUNT_FIELDS:
+        v = row.get(k)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"{name}:{i}: health row count {k}={v!r} must "
+                        "be a non-negative integer")
+    for k in HEALTH_RATIO_FIELDS:
+        v = row.get(k)
+        if v is None:
+            continue
+        if not _num(v) or v < 0:
+            errs.append(f"{name}:{i}: health row {k}={v!r} must be a "
+                        "non-negative number")
+    verdict = row.get("verdict")
+    if det == "evidence_regression":
+        if verdict not in KNOWN_HEALTH_VERDICTS:
+            errs.append(
+                f"{name}:{i}: evidence_regression health row has "
+                f"verdict={verdict!r} — every graded row must carry "
+                f"one of {KNOWN_HEALTH_VERDICTS}")
+    elif verdict is not None and verdict not in KNOWN_HEALTH_VERDICTS:
+        errs.append(f"{name}:{i}: health row verdict={verdict!r} not "
+                    f"in {KNOWN_HEALTH_VERDICTS}")
+    if det == "skew_trigger":
+        errs += _check_rebalance_plan(name, i, row.get("plan"))
+    elif row.get("plan") is not None:
+        errs += _check_rebalance_plan(name, i, row.get("plan"))
+    return errs
+
+
+def _check_rebalance_plan(name: str, i: int, plan) -> list[str]:
+    """Invariant 13, skew-trigger extension: the inline plan must be
+    apply_rebalance-shaped — the elastic-execution PR will replay it."""
+    if not isinstance(plan, dict):
+        return [f"{name}:{i}: skew_trigger health row plan={plan!r} "
+                "must be a suggest_rebalance object (the inline "
+                "elastic-execution payload)"]
+    errs: list[str] = []
+    if not isinstance(plan.get("phase"), str):
+        errs.append(f"{name}:{i}: rebalance plan phase="
+                    f"{plan.get('phase')!r} must be a string")
+    moves = plan.get("moves")
+    if not isinstance(moves, list):
+        errs.append(f"{name}:{i}: rebalance plan moves={moves!r} must "
+                    "be a list")
+        moves = []
+    for m in moves:
+        if not isinstance(m, dict):
+            errs.append(f"{name}:{i}: rebalance plan has a non-object "
+                        "move entry")
+            continue
+        for k in ("from", "to"):
+            v = m.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: rebalance move {k}={v!r} "
+                            "must be a non-negative worker index")
+        w = m.get("work")
+        if not _num(w) or w < 0:
+            errs.append(f"{name}:{i}: rebalance move work={w!r} must "
+                        "be a non-negative number")
+    for k in ("ratio_before", "ratio_after"):
+        v = plan.get(k)
+        if v is not None and (not _num(v) or v < 0):
+            errs.append(f"{name}:{i}: rebalance plan {k}={v!r} must be "
+                        "a non-negative number")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -814,6 +934,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_trace_row(name, i, row, trace_state)
         if isinstance(row, dict) and row.get("kind") == "model":
             errors += _check_model_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "health":
+            errors += _check_health_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

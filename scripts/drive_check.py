@@ -2078,3 +2078,150 @@ print(f"perfmodel: {len(_pm_rows)} model rows invariant-12-clean, "
       f" agreements), predicted-top {_pm_sel['would_run']} gate-closed, "
       "wire oracle shared, pre-sizer == hand-calibrated tiles")
 print(f"DRIVE OK round-33 ({mode})")
+
+# --- round 34: the health sentinel (PR 14) ---------------------------------
+# The sixth (derived) spine end-to-end, CPU-only: (a) a seeded-ordinal
+# chaos sustained serve run fires slo_burn + budget_drift findings whose
+# counts reconcile EXACTLY with the row's invariant-9 ledger and the
+# ReqTracer outcome counts, and the one exported file (trace + health +
+# the stamped bench row) passes check_jsonl invariants 9/11/13 together,
+# while the identical healthy control emits zero findings; (b) the skew
+# trigger fires only after K consecutive over-threshold supersteps and
+# its INLINE plan replays through schedule.apply_rebalance (numpy-checked
+# resulting loads); (c) the health CLI summarizes/exits honestly and
+# --grade-model emits the invariant-13-clean verdict row the sprint
+# script tees; (d) the fail-closed --predicted-top gate is OPEN at HEAD
+# (the committed evidence grades confirmed); (e) the driver record is
+# bounded under the tail capture in the worst outage case.
+import json as _hl_json
+import subprocess as _hl_sp
+import tempfile as _hl_tmp
+import warnings as _hl_w
+
+from harp_tpu import health as _hl
+from harp_tpu import schedule as _hl_sched
+from harp_tpu.serve.bench import benchmark_sustained as _hl_bs
+from harp_tpu.utils import reqtrace as _hl_rt
+from harp_tpu.utils import skew as _hl_skew
+from harp_tpu.utils import telemetry as _hl_tm
+from harp_tpu.utils.metrics import benchmark_json as _hl_bj
+
+_hl_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_hl_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+import check_jsonl as _hl_cj
+
+# (a) chaos run: dispatch event #2 fails (exact ordinal), queue bounded
+# at 16 rows under ~flood load -> shedding + one retry-with-restage
+with _hl_tm.scope(True):
+    with _hl_w.catch_warnings():
+        _hl_w.simplefilter("ignore", RuntimeWarning)
+        _hl_res = _hl_bs(app="kmeans", n_requests=48, rows_per_request=1,
+                         burst_admit=8, ladder=(8,), offered_qps=1e5,
+                         state_shape={"k": 4, "d": 8}, max_queue_rows=16,
+                         max_retries=2, fault_ordinals=(2,), mesh=mesh)
+    assert _hl_res["faults_injected"] == 1
+    assert _hl_res["fault_retries"] == 1 and _hl_res["shed_requests"] > 0
+    _hl_rows = {r["detector"]: r for r in _hl.monitor.findings()}
+    _hl_slo, _hl_bd = _hl_rows["slo_burn"], _hl_rows["budget_drift"]
+    for _hl_k, _hl_f in (("offered", "offered_requests"),
+                         ("served", "served_requests"),
+                         ("shed", "shed_requests"),
+                         ("failed", "failed_requests")):
+        assert _hl_slo[_hl_k] == _hl_res[_hl_f], (_hl_k, _hl_slo, _hl_res)
+    assert _hl_rt.tracer.counts == {"served": _hl_slo["served"],
+                                    "shed": _hl_slo["shed"],
+                                    "failed": _hl_slo["failed"]}
+    assert _hl_bd["violations"] == 1
+    assert "h2d_calls used 2 > budget 1" in _hl_bd["worst"]
+    assert _hl_res["health_findings"] == 2
+    assert _hl_res["health_budget_drift"] == 1
+    with _hl_tmp.TemporaryDirectory() as _hl_d:
+        _hl_p = os.path.join(_hl_d, "chaos.jsonl")
+        _hl_tm.export(_hl_p)
+        with open(_hl_p, "a") as _hl_f:
+            _hl_f.write(_hl_bj("serve_kmeans_sustained", _hl_res) + "\n")
+        assert _hl_cj.check_file(_hl_p, provenance=True) == []
+        # (c) the CLI on the same file: actionable findings -> exit 1
+        _hl_cli = _hl_sp.run(
+            [sys.executable, "-m", "harp_tpu", "health", _hl_p, "--json",
+             "--repo", _hl_root],
+            capture_output=True, text=True, timeout=300, env=_hl_env,
+            cwd=_hl_root)
+        assert _hl_cli.returncode == 1, _hl_cli.stderr[-500:]
+        _hl_sum = _hl_json.loads(
+            _hl_cli.stdout.strip().splitlines()[-1])
+        assert _hl_sum["findings"] == 2 and _hl_sum["actionable"] == 2
+        assert _hl_sum["worst_severity"] == "page"
+# healthy control: same trace shape, degradation knobs off -> clean
+with _hl_tm.scope(True):
+    _hl_ok = _hl_bs(app="kmeans", n_requests=48, rows_per_request=1,
+                    burst_admit=8, ladder=(8,), offered_qps=500.0,
+                    state_shape={"k": 4, "d": 8}, mesh=mesh)
+    assert _hl_ok["health_findings"] == 0
+    assert _hl_ok["health_breaches"] == 0
+    assert _hl_ok["health_budget_drift"] == 0
+    assert _hl.monitor.findings() == []
+
+# (b) skew trigger -> apply_rebalance, loads numpy-checked
+with _hl_tm.scope(True):
+    for _hl_i in range(_hl.TRIGGER_SUPERSTEPS):
+        _hl_skew.record_partition(
+            "files", [10, 1, 0, 1], unit="bytes",
+            units=[[("a", 6), ("b", 4)], [("c", 1)], [], [("d", 1)]])
+        if _hl_i < _hl.TRIGGER_SUPERSTEPS - 1:
+            assert _hl.monitor.findings() == []  # K-1 never fires
+    _hl_r = _hl.monitor.findings()[0]
+    assert _hl_r["detector"] == "skew_trigger"
+    _hl_plan = _hl_r["plan"]
+    _hl_new = _hl_sched.apply_rebalance([["a", "b"], ["c"], [], ["d"]],
+                                        _hl_plan)
+    _hl_sizes = {"a": 6, "b": 4, "c": 1, "d": 1}
+    _hl_loads = sorted(sum(_hl_sizes[u] for u in w) for w in _hl_new)
+    assert _hl_loads == [1, 1, 4, 6]  # greedy LPT on measured loads
+    assert _hl_plan["ratio_after"] < _hl_plan["ratio_before"]
+
+# (c) --grade-model: the one verdict row the sprint tees, checker-clean
+_hl_gm = _hl_sp.run(
+    [sys.executable, "-m", "harp_tpu", "health", "--grade-model",
+     "--repo", _hl_root],
+    capture_output=True, text=True, timeout=600, env=_hl_env,
+    cwd=_hl_root)
+assert _hl_gm.returncode == 0, _hl_gm.stderr[-800:]
+_hl_row = _hl_json.loads(_hl_gm.stdout.strip().splitlines()[-1])
+assert _hl_row["verdict"] == "confirmed"
+assert _hl_cj._check_health_row("t", 1, _hl_row) == []
+
+# (d) the gate is OPEN at HEAD: pruning still selects (round 33 already
+# proved the selection machinery; this proves PR 14 did not close it)
+_hl_ma = _hl_sp.run(
+    [sys.executable, os.path.join(_hl_root, "scripts", "measure_all.py"),
+     "--predicted-top", "2", "--dry-run"],
+    capture_output=True, text=True, timeout=600, env=_hl_env,
+    cwd=_hl_root)
+assert _hl_ma.returncode == 0, _hl_ma.stderr[-800:]
+assert _hl_json.loads(_hl_ma.stdout.strip().splitlines()[-1])["would_run"]
+
+# (e) the driver record stays under the tail capture in the worst case
+import importlib.util as _hl_il
+_hl_spec = _hl_il.spec_from_file_location(
+    "bench_r34", os.path.join(_hl_root, "bench.py"))
+_hl_b = _hl_il.module_from_spec(_hl_spec)
+_hl_spec.loader.exec_module(_hl_b)
+_hl_rec = {"metric": "kmeans_iters_per_sec_1Mx300_k100", "value": 0.0,
+           "unit": "iter/s", "vs_baseline": None,
+           "submetrics": {n: {"value": 0.0, "unit": "u",
+                              "error": "timeout: config exceeded budget"}
+                          for n, _ in _hl_b._CONFIG_KEYS},
+           "error": "relay_down: probe timed out",
+           "last_measured": _hl_b._last_measured()}
+_hl_line = _hl_json.dumps(_hl_b._fit_record(_hl_rec))
+assert len(_hl_line) <= _hl_b.RECORD_CAP_BYTES < 2000
+assert "kmeans" in _hl_json.loads(_hl_line)["last_measured"]
+
+print(f"health: chaos run {_hl_res['served_requests']}/"
+      f"{_hl_res['shed_requests']}/{_hl_res['failed_requests']} "
+      "reconciled across ledger+trace+sentinel, control clean, "
+      f"skew plan applied (loads {_hl_loads}), grade-model confirmed, "
+      f"pruning gate open, driver record {len(_hl_line)} B <= "
+      f"{_hl_b.RECORD_CAP_BYTES}")
+print(f"DRIVE OK round-34 ({mode})")

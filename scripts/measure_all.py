@@ -156,11 +156,32 @@ def predicted_only(top_n: int, topology: str) -> tuple:
     unmeasured-candidates-first priority stays exactly as committed —
     the model proposes, the gates and the sprint order dispose).
     Returns (ordered config list, ranked [(cand, speedup)], unpriced).
+
+    FAIL-CLOSED preflight (PR 14, ROADMAP autotuning item 3): before
+    the model may prune anything, :func:`harp_tpu.health.grade.
+    model_gate` re-runs the perfmodel's self-grade against ALL
+    committed evidence — including any rows the last sprint just
+    landed.  A ``model_invalidated`` verdict REFUSES the pruning
+    (SystemExit 1): a model that fresh silicon evidence contradicts
+    must not choose which configs get the next scarce relay window.
+    The refusal lifts the moment the model is re-calibrated (the gate
+    re-grades live each time; no stale ack file).
     """
     from harp_tpu.perfmodel.cli import _topology, candidate_ranking
     from harp_tpu.perfmodel.grade import latest_tpu_rows
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from harp_tpu.health import grade as health_grade
+
+    ok, finding = health_grade.model_gate(repo)
+    if not ok:
+        raise SystemExit(
+            "measure_all: --predicted-top REFUSED (fail closed): the "
+            "perfmodel is INVALIDATED by committed evidence "
+            f"({finding.get('failures')} grade failure(s): "
+            f"{finding.get('detail')}). Re-calibrate the model and "
+            "re-check with `python -m harp_tpu predict --grade` before "
+            "pruning a sprint with it.")
     bench = latest_tpu_rows(os.path.join(repo, "BENCH_local.jsonl"))
     ranked, unpriced = candidate_ranking(_topology(topology), bench)
     selected = gate_closure(c for c, _ in ranked[:top_n])

@@ -194,5 +194,20 @@ echo "== default-flip decisions, final (>=10% at equal quality, in code) =="
 # is informational here — the sprint itself still succeeded
 python scripts/flip_decision.py | tee FLIP_DECISIONS.jsonl || true
 
+echo "== perfmodel self-grade vs the fresh rows (fail-closed pruning gate) =="
+# ROADMAP autotuning item (3), closed by PR 14: a sprint that just landed
+# new silicon rows re-checks the cost model IN the sprint.  The one
+# kind:"health" row (verdict confirmed / model_invalidated, invariant 13)
+# is committed evidence in ${OUT}; on model_invalidated the next
+# `measure_all.py --predicted-top` REFUSES to prune (the gate re-runs
+# this same grade live) until the model is re-calibrated.  CPU-only —
+# never touches the relay — and never fails the sprint itself.
+python -m harp_tpu health --grade-model | tee -a "$OUT" || {
+  echo "WARNING: perfmodel INVALIDATED by fresh evidence — the next" >&2
+  echo "--predicted-top pruning will refuse until the model is" >&2
+  echo "re-calibrated (python -m harp_tpu predict --grade for the" >&2
+  echo "term breakdowns)" >&2
+}
+
 echo "done — apply the FLIP lines above (one-line config flips +"
 echo "BASELINE.md + bench.py BASELINES in the same commit), then COMMIT NOW"

@@ -160,10 +160,14 @@ def test_bench_headline_failure_surfaces_error(mesh, monkeypatch):
     lm = rec["last_measured"]
     assert lm["kmeans"]["value"] > 0
     assert lm["kmeans"]["date"]
-    assert lm["kmeans"]["source"] == "BENCH_local.jsonl"
+    # compact entries (VERDICT r5 weak #1): a BENCH_local-sourced entry
+    # carries no baseline flag; per-entry source strings are gone
+    assert "baseline" not in lm["kmeans"] and "source" not in lm["kmeans"]
     assert lm["mfsgd"]["unit"] == "updates/s/chip"
     # configs with no committed row fall back to the BASELINES constants
     assert all(v["value"] > 0 for v in lm.values())
+    # and the one line is bounded under the driver's tail capture
+    assert len(lines[0]) < 2000
 
 
 def test_bench_dead_relay_reports_relay_down_in_seconds(mesh, monkeypatch):
@@ -361,3 +365,71 @@ def test_ingest_smoke_preset_runs_int8_wire(tmp_path, monkeypatch, mesh):
     # same data, same seed: int8 quantization moves inertia by well
     # under the contract's 1% (measured 1.6e-4 rel on the 12 GB run)
     assert abs(res["inertia"] - res_f["inertia"]) / res_f["inertia"] < 0.01
+
+
+def test_error_record_bounded_under_driver_tail_capture(tmp_path):
+    """VERDICT r5 weak #1 (BENCH_r05 parsed:null): the one emitted JSON
+    line must stay under the driver's ~2000-char tail capture in the
+    WORST case — error path, a last_measured entry for every BASELINES
+    config PLUS a pile of unknown configs from committed rows, and a
+    long error string.  _fit_record trims lowest-priority-first and the
+    graded headline configs survive."""
+    b = _load_bench(tmp_path)
+    # worst-case committed file: every graded config + 15 unknowns
+    rows = [{"config": name, key: 123.456, "date": "2026-08-01"}
+            for name, key in b._CONFIG_KEYS]
+    rows += [{"config": f"mystery_config_number_{i:02d}",
+              "trees_per_sec": 1.0 + i, "date": "2026-08-01"}
+             for i in range(15)]
+    (tmp_path / "BENCH_local.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+    lm = b._last_measured()
+    assert len(lm) >= len(b._CONFIG_KEYS) + 15
+
+    rec = {"metric": "kmeans_iters_per_sec_1Mx300_k100", "value": 0.0,
+           "unit": "iter/s", "vs_baseline": None,
+           "submetrics": {name: {"value": 0.0, "unit": "u",
+                                 "error": "timeout: config exceeded "
+                                          "--max-seconds-per-config"}
+                          for name, _ in b._CONFIG_KEYS},
+           "error": "relay_down: jax.devices() probe timed out after "
+                    "90s - TPU relay hung before any config ran",
+           "last_measured": lm}
+    out = b._fit_record(rec)
+    line = json.dumps(out)
+    assert "\n" not in line
+    assert len(line) <= b.RECORD_CAP_BYTES < 2000
+    assert json.loads(line)["error"].startswith("relay_down")
+    # trimming dropped the unknowns first; the graded headline configs
+    # (the _CONFIG_KEYS front) survive
+    kept = out["last_measured"]
+    assert out["last_measured_dropped"] >= 1
+    assert kept  # something survives, and headline-first:
+    prio = [name for name, _ in b._CONFIG_KEYS]
+    assert list(kept) == prio[:len(kept)]  # a PREFIX of priority order
+    assert "kmeans" in kept  # the headline survives longest
+    assert not any(c.startswith("mystery") for c in kept)
+
+    # a record already under the cap is untouched (no spurious field)
+    small = {"metric": "m", "value": 1.0,
+             "last_measured": {"kmeans": {"value": 1.0, "unit": "iter/s",
+                                          "date": "2026-08-01"}}}
+    assert "last_measured_dropped" not in b._fit_record(dict(small))
+
+
+def test_live_error_record_measures_under_cap(mesh, monkeypatch):
+    """Integration: a real bench.py error record (the headline-failure
+    path against the REAL committed BENCH_local) emits one line under
+    the cap — the exact scenario that produced BENCH_r05."""
+    from harp_tpu.models import kmeans
+
+    def boom(**kw):
+        raise RuntimeError("synthetic kmeans failure " + "x" * 120)
+
+    monkeypatch.setattr(kmeans, "benchmark", boom)
+    out = _run_bench(["kmeans"])
+    lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert "error" in rec and rec["last_measured"]
+    assert len(lines[0]) <= 1800

@@ -818,3 +818,138 @@ def test_model_vocabularies_in_sync_with_perfmodel():
     assert tuple(perfmodel.BOUNDS) == check_jsonl.KNOWN_MODEL_BOUNDS
     assert tuple(perfmodel.RATES_SOURCES) == \
         check_jsonl.KNOWN_MODEL_RATES_SOURCES
+
+
+# -- invariant 13: health rows (PR 14) --------------------------------------
+
+_HSTAMP = {"backend": "cpu", "date": "2026-08-05", "commit": "abc1234"}
+
+
+def _health_row(**over):
+    """A minimal valid slo_burn health row; forge one field per test."""
+    row = {"kind": "health", "detector": "slo_burn", "severity": "warn",
+           "tag": "serve.kmeans", "offered": 10, "served": 8, "shed": 2,
+           "failed": 0, "fast_burn": 4.0, "slow_burn": 2.0,
+           "breaches": 1, **_HSTAMP}
+    row.update(over)
+    return row
+
+
+def _health_errs(row):
+    return check_jsonl._check_health_row("t", 1, row)
+
+
+def _skew_trigger_row(**plan_over):
+    plan = {"phase": "p", "unit": "tokens",
+            "moves": [{"id": "f1", "from": 0, "to": 2, "work": 12.0}],
+            "ratio_before": 1.8, "ratio_after": 1.05,
+            "work_after": [10.0, 10.0, 11.0, 9.0]}
+    plan.update(plan_over)
+    return _health_row(detector="skew_trigger", phase="p",
+                       wasted_frac=0.42, supersteps=3, consecutive=3,
+                       plan=plan)
+
+
+def test_health_row_valid_round_trip(tmp_path):
+    rows = [_health_row(), _skew_trigger_row(),
+            _health_row(detector="budget_drift", violations=2,
+                        worst="h2d_calls used 2 > budget 1"),
+            _health_row(detector="evidence_regression", severity="info",
+                        config="kmeans", verdict="confirmed",
+                        measured=380.9, incumbent=381.2)]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_health_row_requires_provenance_and_vocabularies():
+    row = _health_row()
+    del row["backend"]
+    assert any("provenance" in e for e in _health_errs(row))
+    assert any("detector='gut_feeling'" in e
+               for e in _health_errs(_health_row(detector="gut_feeling")))
+    assert any("severity='mild'" in e
+               for e in _health_errs(_health_row(severity="mild")))
+    assert any("verdict='vibes'" in e
+               for e in _health_errs(_health_row(verdict="vibes")))
+
+
+def test_health_row_counts_and_ratios_nonnegative():
+    assert any("shed=-1" in e
+               for e in _health_errs(_health_row(shed=-1)))
+    assert any("breaches=1.5" in e
+               for e in _health_errs(_health_row(breaches=1.5)))
+    assert any("fast_burn" in e
+               for e in _health_errs(_health_row(fast_burn=-0.1)))
+    assert any("wasted_frac" in e
+               for e in _health_errs(_health_row(wasted_frac="lots")))
+
+
+def test_evidence_regression_row_requires_verdict():
+    row = _health_row(detector="evidence_regression", config="kmeans")
+    assert any("verdict=None" in e for e in _health_errs(row))
+    row["verdict"] = "model_invalidated"
+    assert _health_errs(row) == []
+
+
+def test_skew_trigger_row_requires_replayable_plan():
+    assert _health_errs(_skew_trigger_row()) == []
+    # no plan at all: the elastic hook has no payload
+    row = _skew_trigger_row()
+    del row["plan"]
+    assert any("suggest_rebalance object" in e for e in _health_errs(row))
+    # forged plan internals each trip their own violation
+    assert any("worker index" in e for e in _health_errs(
+        _skew_trigger_row(moves=[{"id": "f1", "from": -1, "to": 2,
+                                  "work": 1.0}])))
+    assert any("work=None" in e for e in _health_errs(
+        _skew_trigger_row(moves=[{"id": "f1", "from": 0, "to": 2,
+                                  "work": None}])))
+    assert any("moves='nope'" in e
+               for e in _health_errs(_skew_trigger_row(moves="nope")))
+    assert any("ratio_after" in e for e in _health_errs(
+        _skew_trigger_row(ratio_after=-2.0)))
+
+
+def test_health_vocabularies_in_sync_with_health_module():
+    """check_jsonl freezes the health vocabularies standalone; drift
+    from the live harp_tpu.health module fails here (tier-1)."""
+    from harp_tpu import health
+
+    assert tuple(health.DETECTORS) == check_jsonl.KNOWN_HEALTH_DETECTORS
+    assert tuple(health.SEVERITIES) == check_jsonl.KNOWN_HEALTH_SEVERITIES
+    assert tuple(health.VERDICTS) == check_jsonl.KNOWN_HEALTH_VERDICTS
+
+
+def test_exported_health_rows_satisfy_the_checker(tmp_path):
+    """Round-trip: what the monitor exports (via telemetry.export) must
+    pass invariant 13 as-is — even teed into a bench file."""
+    from harp_tpu import health
+    from harp_tpu.utils import skew, telemetry
+
+    with telemetry.scope(True):
+        for _ in range(health.TRIGGER_SUPERSTEPS):
+            skew.record_partition(
+                "files", [10, 1, 0, 1], unit="bytes",
+                units=[[("a", 6), ("b", 4)], [("c", 1)], [], [("d", 1)]])
+        health.monitor.observe_budget("serve.kmeans",
+                                      [("h2d_calls", 2, 1)])
+        p = tmp_path / "BENCH_local.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_golden_health_fixture_is_clean_and_summarizes():
+    """The committed golden health fixture (tests/data) passes the
+    checker — the fixture the health CLI smoke drives."""
+    p = os.path.join(os.path.dirname(__file__), "data",
+                     "golden_health.jsonl")
+    assert check_jsonl.check_file(p) == []
+    from harp_tpu import health
+    from harp_tpu.utils import telemetry
+
+    rows = telemetry.load_rows(p)["health"]
+    s = health.summarize_rows(rows)
+    assert s["findings"] == 4
+    assert s["worst_severity"] == "page"
+    assert s["actionable"] == 3  # page + warn + warn; confirmed is info

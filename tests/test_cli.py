@@ -524,3 +524,96 @@ def test_dispatch_trace_cli_smoke(capsys, tmp_path):
 
     # unreadable input exits 2
     assert cli.main(["trace", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_dispatch_health_cli_smoke(capsys, tmp_path):
+    """python -m harp_tpu health (PR 14): the committed golden fixture
+    summarizes with exit 1 (actionable findings), a healthy file exits
+    0, an unreadable one exits 2, and --json emits one stamped line."""
+    import json
+    import os
+
+    golden = os.path.join(os.path.dirname(__file__), "data",
+                          "golden_health.jsonl")
+    assert cli.main(["health", golden]) == 1  # page + warns: actionable
+    out = capsys.readouterr().out
+    assert "4 finding(s), 3 actionable" in out
+    assert "slo_burn" in out and "skew_trigger" in out
+    assert "budget_drift" in out and "evidence_regression" in out
+    assert "ratio 1.72 -> 1.05" in out  # the inline rebalance plan
+
+    assert cli.main(["health", golden, "--json"]) == 1
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["findings"] == 4 and row["worst_severity"] == "page"
+    assert all(k in row for k in ("backend", "date", "commit"))
+
+    # a healthy file (info-only findings, no config rows) exits 0
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps(
+        {"kind": "health", "detector": "evidence_regression",
+         "severity": "info", "config": "kmeans", "verdict": "confirmed",
+         "backend": "cpu", "date": "2026-08-05",
+         "commit": "x"}) + "\n")
+    assert cli.main(["health", str(ok)]) == 0
+    assert "no findings" not in capsys.readouterr().out  # 1 info row
+
+    # unreadable input exits 2
+    assert cli.main(["health", str(tmp_path / "nope.jsonl")]) == 2
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_health_cli_grades_fresh_bench_rows(capsys, tmp_path,
+                                            monkeypatch):
+    """The grader half: a sprint output file with a regressed fresh row
+    (vs a committed incumbent in --repo) exits 1 and names the verdict;
+    --no-grade-bench turns the same file healthy."""
+    import json
+
+    from harp_tpu import health
+
+    health.monitor.reset()
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "BENCH_local.jsonl").write_text(json.dumps(
+        {"config": "rf", "trees_per_sec": 10.0, "backend": "tpu",
+         "date": "2026-08-01", "commit": "abc1234"}) + "\n")
+    fresh = tmp_path / "sprint.jsonl"
+    fresh.write_text(json.dumps(
+        {"config": "rf", "trees_per_sec": 5.0, "backend": "tpu",
+         "date": "2026-08-05", "commit": "def5678"}) + "\n")
+    assert cli.main(["health", str(fresh), "--repo", str(repo),
+                     "--json"]) == 1
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["graded_configs"] == 1 and row["actionable"] == 1
+    health.monitor.reset()
+    assert cli.main(["health", str(fresh), "--repo", str(repo),
+                     "--no-grade-bench"]) == 0
+    capsys.readouterr()
+    health.monitor.reset()
+
+
+def test_health_cli_grade_model_emits_checker_clean_row(capsys):
+    """--grade-model on the real repo: the committed evidence grades
+    clean (tier-1 pins perfmodel.grade ok), the CLI exits 0, and the
+    one emitted kind:'health' row passes invariant 13 — the line
+    measure_on_relay.sh tees into the evidence file."""
+    import json
+    import os
+    import sys
+
+    from harp_tpu import health
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import check_jsonl
+
+    health.monitor.reset()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert cli.main(["health", "--grade-model", "--repo", root]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    row = json.loads(line)
+    assert row["kind"] == "health"
+    assert row["detector"] == "evidence_regression"
+    assert row["verdict"] == "confirmed"
+    assert check_jsonl._check_health_row("t", 1, row) == []
+    health.monitor.reset()

@@ -636,7 +636,14 @@ def test_sustained_degraded_row_under_faults(mesh):
     assert 0.0 <= res["shed_frac"] <= 1.0
     assert 0.0 <= res["deadline_miss_frac"] <= 1.0
     assert res["steady_compiles"] == 0  # clean batches never recompile
-    assert res["budget_violations"] == 0
+    # PR 14: a retry-with-restage stages twice in its batch window, and
+    # the sustained bench's "one staging per window" warn budget counts
+    # exactly those windows — the drift IS the committed restage
+    # evidence (it also lands in the budget-drift health row), so under
+    # injected faults violations > 0 is the CORRECT reading
+    assert 1 <= res["budget_violations"] <= res["fault_retries"]
+    assert res["health_budget_drift"] == res["budget_violations"]
+    assert res["health_findings"] >= 1
     # the committed-row contract: a stamped copy passes invariants 7 + 9
     row = {**res, "backend": "cpu", "date": "2026-08-04", "commit": "x"}
     assert check_jsonl._check_serve_row("t", 1, row) == []
