@@ -24,7 +24,10 @@ lint`` sweeps real epoch programs, not just synthetic fixtures:
 - ``ingest.accum_chunk`` / ``ingest.finish_epoch`` — the program pair
   every IngestPipeline-shipped kmeans chunk rides: per-chunk accumulate
   (deliberately collective-free — registering it pins that emptiness in
-  the byte sheet) and the epoch-end allreduce.
+  the byte sheet) and the epoch-end allreduce;
+- ``elastic.regather`` — PR 15's mid-run state move (one all_gather
+  over the reshard verb + a wire-free local gather), so an elastic
+  rebalance's cost stays on the byte sheet.
 
 Builders return ``(traced_fn_or_fn, args)``; args may be concrete arrays
 or sharded ``ShapeDtypeStruct``s.  Each runs in a couple hundred ms on
@@ -400,6 +403,30 @@ def _collective_reshard_wire():
     return fn, (x,)
 
 
+@register_driver("elastic.regather")
+def _elastic_regather():
+    """The PR-15 elastic row move: rebalanced model-state rows ride the
+    reshard verb's always-legal split — ONE all_gather (blocked →
+    replicated) then a purely local gather of each worker's new rows.
+    Registering it keeps the mid-run move on the CommGraph byte sheet:
+    the sheet must show exactly the replication hop (no second
+    collective — the local gather is wire-free), HL301/HL302-checked on
+    every full lint like the other reshard programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.elastic.move import make_regather_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_regather_fn(mesh, ndim=2)
+    x = jax.ShapeDtypeStruct((8 * nw, 16), jnp.float32,
+                             sharding=mesh.sharding(mesh.spec(0, ndim=2)))
+    rows = jax.ShapeDtypeStruct((8 * nw,), jnp.int32,
+                                sharding=mesh.sharding(mesh.spec(0)))
+    return fn, (x, rows)
+
+
 @register_driver("svm.train")
 def _svm_train():
     """The SVM outer loop (PR 12): per-round SV exchange riding
@@ -545,5 +572,67 @@ def _serve_retry_restage_protocol():
         assert inj.injected["dispatch"] == 1, "no fault fired: vacuous"
         assert runner.fault_retries == 1, "fault fired but no retry ran"
         assert runner.completed == 6, "retry path lost responses"
+
+    return drive
+
+
+@register_protocol("elastic.rebalance_restage")
+def _elastic_rebalance_restage_protocol():
+    """The PR-15 restage-after-shrink path (HL303): a host loop donates
+    a freshly staged batch per dispatch; an injected PERMANENT worker
+    loss kills a dispatch mid-run, the loop shrinks to the survivor
+    mesh, rebuilds its executable there, and must RESTAGE every
+    post-shrink input from host data — the pre-shrink buffer was
+    already donated to the dead dispatch (and lives on a mesh that no
+    longer exists).  Driving it here proves the discipline under the
+    donation audit on every full lint; the sabotaged twin
+    (re-dispatching the pre-shrink donated buffer on the survivors)
+    lives in tests/test_lint.py.  The drive asserts the loss actually
+    fired, so a refactor that unhooks the injector fails the lint
+    instead of passing vacuously."""
+
+    def drive(audit):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from harp_tpu.parallel.mesh import WorkerMesh
+        from harp_tpu.utils import flightrec
+        from harp_tpu.utils.fault import (FaultInjector,
+                                          PermanentWorkerLoss)
+
+        def build(mesh, tag):
+            fn = jax.jit(lambda c, x: (c + x.sum(), x * 2.0),
+                         donate_argnums=(1,))
+            return audit.wrap(flightrec.track(fn, tag), (1,), tag)
+
+        mesh = WorkerMesh()
+        exe = build(mesh, "elastic.step_full")
+        carry = jax.device_put(jnp.float32(0.0), mesh.replicated())
+        rng = np.random.default_rng(0)
+        # 56 rows: divisible by the 8-worker mesh AND any 7-survivor one
+        batches = [rng.normal(size=(56, 4)).astype(np.float32)
+                   for _ in range(4)]
+        inj = FaultInjector(seed=0, permanent={"dispatch": (2,)},
+                            lost_worker=mesh.num_workers - 1)
+        survived = False
+        with inj.arm():
+            try:
+                for b in batches[:2]:
+                    staged = mesh.shard_array(b, 0)  # fresh per dispatch
+                    carry, _ = exe(carry, staged)
+            except PermanentWorkerLoss as e:
+                surv = WorkerMesh([d for i, d in enumerate(mesh.devices)
+                                   if i != e.worker])
+                exe2 = build(surv, "elastic.step_surv")
+                carry = jax.device_put(
+                    jnp.float32(float(np.asarray(carry))),
+                    surv.replicated())
+                for b in batches[2:]:
+                    staged = surv.shard_array(b, 0)  # RESTAGE on survivors
+                    carry, _ = exe2(carry, staged)
+                survived = True
+        assert inj.permanent_fired, "no permanent loss fired: vacuous"
+        assert survived, "loss fired but the survivor loop never ran"
 
     return drive

@@ -1,0 +1,38 @@
+"""Elastic execution — act on the skew trigger mid-run, survive
+permanent worker loss without a restart (PR 15).
+
+Reference parity (SURVEY.md §3.1, §6): Harp's schdynamic/dymoro
+schedulers rebalanced load exactly *between* supersteps, but only inside
+one worker's thread pool; across workers Harp had static partitions and
+YARN's kill-the-job failure model.  HARP (arXiv:2509.24859, PAPERS.md)
+is the modern statement that orchestration — rebalance, shrink, resume —
+should be driven by continuously monitored runtime signals.  This
+package is the ACTING half of that loop (PR 14's health sentinel is the
+observing half):
+
+- **Layer 1 — mid-run rebalance** (:mod:`harp_tpu.elastic.rebalance`):
+  between supersteps a driver consumes a latched ``skew_trigger``
+  health finding exactly once (the sentinel↔driver handshake,
+  ``health.monitor.consume_skew_trigger``), replays its inline plan
+  through ``schedule.apply_rebalance`` over the corpus's movable packs,
+  and repartitions — factor-table rows ride the existing ``reshard``
+  wire (:mod:`harp_tpu.elastic.move`, the registered
+  ``elastic.regather`` program, so the CommGraph byte sheet accounts
+  the move), token/rating layouts repack on host.  SkewLedger
+  before/after evidence lands as ``kind:"elastic"`` rebalance rows.
+- **Layer 2 — worker-loss survival** (:mod:`harp_tpu.elastic.apps`):
+  an injected :class:`~harp_tpu.utils.fault.PermanentWorkerLoss`
+  shrinks the mesh to the survivors, derives a repartition plan over
+  them (same plan shape, forced whole-unit), replays it from the last
+  crash-atomic checkpoint, and keeps training — degraded-throughput
+  ``kind:"elastic"`` shrink/resume rows instead of downtime.
+
+Evidence: :mod:`harp_tpu.elastic.ledger` (``kind:"elastic"`` rows,
+scripts/check_jsonl.py invariant 14; frozen event vocabulary
+rebalance/shrink/resume).  This ``__init__`` stays light (the ledger
+only — no jax): ``telemetry.export``/``scope`` import it on every run.
+"""
+
+from harp_tpu.elastic import ledger  # noqa: F401  (the module)
+from harp_tpu.elastic.ledger import (  # noqa: F401
+    EVENTS, ElasticLedger, export_jsonl, record, reset)

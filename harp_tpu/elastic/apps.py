@@ -1,0 +1,551 @@
+"""Elastic driver adapters — lda/mfsgd/kmeans-stream on the elastic
+loop (PR 15).
+
+Each adapter owns the ORIGINAL corpus, the pack structure
+(:class:`~harp_tpu.elastic.rebalance.Packs` over the app's partition
+key: users / docs / point rows), the current pack→worker assignment,
+and the live model; it knows how to
+
+- run one superstep (``train_one`` — the model's own epoch driver,
+  unchanged, with the pack grains attached to the skew execution record
+  so the sentinel's trigger plan is whole-unit);
+- apply a new assignment mid-run (``apply_assignment`` — Layer 1: the
+  MF-SGD factor rows ride the ``reshard`` wire via
+  :func:`harp_tpu.elastic.move.regather_rows`; LDA's count tables are
+  reconstructed EXACTLY from the preserved per-token chain state, so no
+  approximation enters the move);
+- round-trip a CANONICAL, mesh-independent checkpoint state
+  (``canonical_state`` / ``install`` — Layer 2: external-id numpy
+  arrays plus the pack assignment, so the same checkpoint restores onto
+  any survivor mesh; ``install`` is a deterministic function of
+  ``(state, mesh)``, which is what makes the elastic resume BIT-identical
+  to an uninterrupted survivors-only run from the same checkpoint).
+
+:func:`elastic_fit` is the shared superstep loop: train → consume a
+latched ``skew_trigger`` (``maybe_rebalance``) → checkpoint canonical
+state; worker loss rides ``run_with_recovery``'s ``on_permanent`` hook
+(:meth:`ElasticAdapter.shrink`), which excises the lost device, and the
+next restore replays the repartition plan over the survivors.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import numpy as np
+
+from harp_tpu.elastic import ledger as eledger
+from harp_tpu.elastic import move
+from harp_tpu.elastic.rebalance import (IdRemap, Packs, maybe_rebalance,
+                                        pack_units, replay_repartition,
+                                        wasted_frac, worker_loads)
+from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
+from harp_tpu.utils import prng
+from harp_tpu.utils.fault import PermanentWorkerLoss, run_with_recovery
+
+
+class ElasticAdapter:
+    """Shared pack/assignment/mesh state machine (see module doc)."""
+
+    phase = "elastic"
+
+    def __init__(self, mesh: WorkerMesh, packs: Packs, loads,
+                 max_worker_loss: int = 1):
+        self.mesh = mesh
+        self.packs = packs
+        self.loads = np.asarray(loads, np.float64)
+        self.assignment = packs.home_assignment()
+        self.max_worker_loss = int(max_worker_loss)
+        self.losses = 0
+        self._live: Any = None
+        self._stale = False
+
+    # -- layer 1: the trigger's view ---------------------------------------
+    def worker_loads(self) -> np.ndarray:
+        return worker_loads(self.assignment, self.loads,
+                            self.mesh.num_workers)
+
+    def pack_units(self) -> list[list[tuple]]:
+        return pack_units(self.assignment, self.loads,
+                          self.mesh.num_workers)
+
+    def apply_assignment(self, assignment) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    # -- layer 2: loss + resume --------------------------------------------
+    def shrink(self, e: PermanentWorkerLoss) -> None:
+        """``run_with_recovery``'s ``on_permanent`` hook: excise the
+        lost device, within the loss budget; the NEXT ``install`` (the
+        restore at the top of the recovery loop) replays the
+        repartition plan over the survivors."""
+        self.losses += 1
+        if self.losses > self.max_worker_loss:
+            raise e  # loss budget exhausted: fail loudly, not elastically
+        nb = self.mesh.num_workers
+        self.mesh = self.mesh.survivors(e.worker)
+        self._stale = True
+        eledger.record(
+            "shrink", self.phase, lost_worker=int(e.worker),
+            site=e.site, ordinal=int(e.ordinal),
+            n_workers_before=nb, n_workers_after=nb - 1,
+            capacity_frac=round((nb - 1) / nb, 6))
+
+    def install(self, state) -> None:
+        """Restore a canonical checkpoint state onto the CURRENT mesh.
+
+        No-op when ``state`` is this adapter's own live state and no
+        shrink intervened (the steady-state path pays nothing).  A
+        checkpoint written on a different mesh size replays the
+        whole-unit repartition plan over the survivors
+        (:func:`replay_repartition` — deterministic, the bit-identity
+        pin); a same-size restore reuses the stored assignment, so a
+        transient restart reproduces the pre-crash layout exactly.
+        """
+        if state is self._live and not self._stale:
+            return
+        n = self.mesh.num_workers
+        # the pack GRID is canonical state too: a comparison/restore
+        # adapter constructed on a survivor mesh would otherwise derive
+        # a different grain (n_home = survivors) and a different layout
+        grid = tuple(int(x) for x in np.asarray(state["pack_grid"]))
+        if grid != (self.packs.n_ids, self.packs.n_home,
+                    self.packs.per_worker):
+            self.packs = Packs(*grid)
+            self.loads = self._pack_loads()
+        asg = np.asarray(state["assignment"], np.int64)
+        shrunk = int(state["n_workers"]) != n
+        if shrunk:
+            asg, _ = replay_repartition(self.packs, self.loads, asg, n,
+                                        self.phase)
+        self.assignment = asg
+        self._rebuild(state)
+        lw = self.worker_loads()
+        step = state.get("step")
+        eledger.record(
+            "resume", self.phase, n_workers=n,
+            from_step=None if step is None else int(step),
+            loads=[round(float(x), 4) for x in lw],
+            total=round(float(lw.sum()), 4),
+            wasted_frac=round(wasted_frac(lw), 4),
+            replayed_plan=bool(shrunk))
+        self._stale = False
+        self._live = state
+
+    def canonical_state(self) -> dict:
+        st = self._extract()
+        st["assignment"] = np.asarray(self.assignment, np.int64)
+        st["n_workers"] = self.mesh.num_workers
+        st["pack_grid"] = np.asarray(
+            [self.packs.n_ids, self.packs.n_home, self.packs.per_worker],
+            np.int64)
+        self._live = st
+        return st
+
+    def _pack_loads(self) -> np.ndarray:  # pragma: no cover - hook
+        raise NotImplementedError
+
+    def _extract(self) -> dict:  # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    def _rebuild(self, state) -> None:  # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+    def train_one(self) -> None:  # pragma: no cover - subclass hook
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# MF-SGD
+# ---------------------------------------------------------------------------
+
+def _user_storage_rows(model, ext_ids) -> np.ndarray:
+    """External user id → W storage row (dense pads each worker's range
+    to a tile multiple; scatter stores externals directly — the same
+    formula covers both since there u_own == u_bound)."""
+    g = np.asarray(ext_ids, np.int64)
+    return (g // model.u_own) * model.u_bound + g % model.u_own
+
+
+def _item_storage(model, H_ext: np.ndarray) -> np.ndarray:
+    """External item table → H storage layout (per half-slice padding,
+    the inverse of ``MFSGD.factors``'s strip)."""
+    from harp_tpu.models.mfsgd import rotate_chunks_resolved
+
+    nc = rotate_chunks_resolved(model.cfg)
+    ibc = model.i_bound // nc
+    n = model.mesh.num_workers
+    out = np.zeros((model.i_bound * n, H_ext.shape[1]), np.float32)
+    g = np.arange(model.n_items, dtype=np.int64)
+    out[(g // model.i_own) * ibc + g % model.i_own] = H_ext
+    return out
+
+
+class MFSGDElastic(ElasticAdapter):
+    """MF-SGD on the elastic loop: packs over user ids, loads = rating
+    counts; factor-row moves ride the reshard wire."""
+
+    phase = "mfsgd.epochs"
+
+    def __init__(self, n_users, n_items, cfg=None, mesh=None, seed=0, *,
+                 users, items, vals, packs_per_worker: int = 4,
+                 max_worker_loss: int = 1):
+        from harp_tpu.models.mfsgd import MFSGDConfig
+
+        mesh = mesh or current_mesh()
+        self.users = np.asarray(users, np.int64)
+        self.items = np.asarray(items, np.int64)
+        self.vals = np.asarray(vals, np.float32)
+        self.n_items = int(n_items)
+        self.cfg = cfg or MFSGDConfig()
+        self.seed = seed
+        packs = Packs(int(n_users), mesh.num_workers, packs_per_worker)
+        super().__init__(mesh, packs, packs.loads(self.users),
+                         max_worker_loss=max_worker_loss)
+        self._rebuild(None)
+
+    def _make_model(self, remap: IdRemap):
+        from harp_tpu.models.mfsgd import MFSGD
+
+        model = MFSGD(remap.new_n, self.n_items, self.cfg, self.mesh,
+                      self.seed)
+        model.set_ratings(remap.fwd[self.users], self.items, self.vals)
+        model.skew_units = self.pack_units()
+        return model
+
+    def _rebuild(self, state) -> None:
+        remap = IdRemap(self.packs, self.assignment,
+                        self.mesh.num_workers)
+        model = self._make_model(remap)
+        if state is not None:
+            r = self.cfg.rank
+            W_ext = np.zeros((remap.new_n, r), np.float32)
+            W_ext[remap.fwd] = np.asarray(state["W"], np.float32)
+            W_store = np.zeros((model.u_bound * self.mesh.num_workers, r),
+                               np.float32)
+            g = np.arange(remap.new_n, dtype=np.int64)
+            W_store[_user_storage_rows(model, g)] = W_ext
+            model.W = self.mesh.shard_array(W_store, 0)
+            model.H = self.mesh.shard_array(
+                _item_storage(model, np.asarray(state["H"], np.float32)),
+                0)
+        self.model, self.remap = model, remap
+
+    def apply_assignment(self, assignment) -> None:
+        """Layer-1 move on the SAME mesh: W rows travel DEVICE-side over
+        the reshard wire (one all_gather — the ``elastic.regather``
+        byte sheet); the item slices are untouched, so H is reused
+        as-is, and only the rating layout repacks on host."""
+        old_model, old_remap = self.model, self.remap
+        self.assignment = np.asarray(assignment, np.int64)
+        remap = IdRemap(self.packs, self.assignment,
+                        self.mesh.num_workers)
+        model = self._make_model(remap)
+        n = self.mesh.num_workers
+        orig = np.arange(self.packs.n_ids, dtype=np.int64)
+        rows = np.full(model.u_bound * n, -1, np.int64)
+        rows[_user_storage_rows(model, remap.fwd[orig])] = \
+            _user_storage_rows(old_model, old_remap.fwd[orig])
+        model.W = move.regather_rows(self.mesh, old_model.W, rows)
+        model.H = old_model.H  # item layout unchanged: zero wire
+        self.model, self.remap = model, remap
+
+    def _pack_loads(self) -> np.ndarray:
+        return self.packs.loads(self.users)
+
+    def _extract(self) -> dict:
+        W_pad, H = self.model.factors()
+        return {"W": np.asarray(W_pad)[self.remap.fwd].copy(),
+                "H": np.asarray(H).copy()}
+
+    def train_one(self) -> None:
+        self.last_rmse = self.model.train_epoch()
+
+    def metric(self) -> float:
+        """Training-triple RMSE in the ORIGINAL id space (the flip-gate
+        metric the drills compare at rel 1%)."""
+        return self.model.predict_rmse(self.remap.fwd[self.users],
+                                       self.items, self.vals)
+
+
+# ---------------------------------------------------------------------------
+# LDA
+# ---------------------------------------------------------------------------
+
+class LDAElastic(ElasticAdapter):
+    """LDA-CGS on the elastic loop: packs over doc ids, loads = token
+    counts.  The chain state is the (doc, word, z) token multiset —
+    counts derive exactly from it, so a repartition preserves the chain
+    bit-for-bit at the move (subsequent sweeps differ only by the
+    snapshot boundaries the new layout implies, which is the parallel
+    sampler's normal approximation — gated by log-likelihood)."""
+
+    phase = "lda.epochs"
+
+    def __init__(self, n_docs, vocab_size, cfg=None, mesh=None, seed=0, *,
+                 doc_ids, word_ids, packs_per_worker: int = 4,
+                 max_worker_loss: int = 1):
+        from harp_tpu.models.lda import LDAConfig
+
+        mesh = mesh or current_mesh()
+        self.doc_ids = np.asarray(doc_ids, np.int64)
+        self.word_ids = np.asarray(word_ids, np.int64)
+        self.vocab_size = int(vocab_size)
+        self.cfg = cfg or LDAConfig()
+        self.seed = seed
+        self.key_seed = int(seed)
+        packs = Packs(int(n_docs), mesh.num_workers, packs_per_worker)
+        super().__init__(mesh, packs, packs.loads(self.doc_ids),
+                         max_worker_loss=max_worker_loss)
+        self._rebuild(None)
+
+    def _build_model(self, remap: IdRemap, d, w, z):
+        from harp_tpu.models.lda import LDA
+
+        model = LDA(remap.new_n, self.vocab_size, self.cfg, self.mesh,
+                    self.seed)
+        model._install_pack(model.pack_tokens(remap.fwd[np.asarray(d)],
+                                              np.asarray(w), z0=z))
+        model.skew_units = self.pack_units()
+        return model
+
+    def _rebuild(self, state) -> None:
+        remap = IdRemap(self.packs, self.assignment,
+                        self.mesh.num_workers)
+        if state is None:
+            d, w, z = self.doc_ids, self.word_ids, None
+        else:
+            d, w, z = state["d"], state["w"], state["z"]
+            self.key_seed = int(state["key_seed"])
+        self.model = self._build_model(remap, d, w, z)
+        self.remap = remap
+
+    def _triples(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Current chain state in the ORIGINAL doc-id space."""
+        d_ext, w_ext, z = self.model.token_state()
+        return self.remap.inv[d_ext], w_ext, z
+
+    def apply_assignment(self, assignment) -> None:
+        d, w, z = self._triples()
+        self.assignment = np.asarray(assignment, np.int64)
+        remap = IdRemap(self.packs, self.assignment,
+                        self.mesh.num_workers)
+        self.model = self._build_model(remap, d, w, z)
+        self.remap = remap
+
+    def _pack_loads(self) -> np.ndarray:
+        return self.packs.loads(self.doc_ids)
+
+    def _extract(self) -> dict:
+        d, w, z = self._triples()
+        return {"d": d, "w": w, "z": z, "key_seed": self.key_seed}
+
+    def train_one(self) -> None:
+        # keys re-derived from the adapter's own seed chain so the
+        # canonical state fully determines the next sweep on ANY mesh
+        # (prng.split_keys: a fresh derived seed never costs a compile)
+        self.model._keys = prng.split_keys(self.key_seed,
+                                           self.mesh.num_workers)
+        self.model.sample_epoch()
+        self.key_seed = (self.key_seed * 0x9E3779B1 + 0x5851) % (1 << 31)
+
+    def metric(self) -> float:
+        return self.model.log_likelihood()
+
+
+# ---------------------------------------------------------------------------
+# kmeans-stream
+# ---------------------------------------------------------------------------
+
+class KMeansStreamElastic(ElasticAdapter):
+    """Streaming-kmeans Lloyd on the elastic loop: packs over point
+    rows, loads = rows per pack; the mask-aware accum/finish pair from
+    :mod:`harp_tpu.models.kmeans_stream` makes the padded survivor
+    layout exact (pad rows carry mask 0, so they never touch a sum).
+    Centroids are replicated — the canonical state is mesh-independent
+    by construction, which is why this was the ROADMAP's "second"
+    target: the repartition moves only the points."""
+
+    phase = "kmeans_stream.epochs"
+
+    def __init__(self, points, k: int, mesh=None, seed=0, *,
+                 packs_per_worker: int = 4, max_worker_loss: int = 1):
+        mesh = mesh or current_mesh()
+        self.points = np.asarray(points, np.float32)
+        self.k = int(k)
+        n_pts = self.points.shape[0]
+        packs = Packs(n_pts, mesh.num_workers, packs_per_worker)
+        super().__init__(mesh, packs,
+                         packs.widths().astype(np.float64),
+                         max_worker_loss=max_worker_loss)
+        from harp_tpu.models.kmeans_stream import _init_centroids
+
+        self.centroids = np.asarray(
+            _init_centroids(self.points, n_pts, self.k, seed, "random"),
+            np.float32)
+        self.inertia = float("nan")
+        self._rebuild(None)
+
+    def _rebuild(self, state) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from harp_tpu.models.kmeans_stream import (StreamConfig,
+                                                   _make_accum_fn,
+                                                   _make_finish_fn)
+        from harp_tpu.utils import flightrec
+
+        remap = IdRemap(self.packs, self.assignment,
+                        self.mesh.num_workers)
+        if state is not None:
+            self.centroids = np.asarray(state["centroids"], np.float32)
+        n, d = self.mesh.num_workers, self.points.shape[1]
+        pts = np.zeros((remap.new_n, d), np.float32)
+        mask = np.zeros(remap.new_n, np.float32)
+        pts[remap.fwd] = self.points
+        mask[remap.fwd] = 1.0
+        self._pts = self.mesh.shard_array(pts, 0)
+        self._mask = self.mesh.shard_array(mask, 0)
+        cfg = StreamConfig(k=self.k, chunk_points=remap.new_n)
+        self._accum = flightrec.track(_make_accum_fn(self.mesh, cfg),
+                                      "kmeans_stream.accum")
+        self._finish = flightrec.track(_make_finish_fn(self.mesh),
+                                       "kmeans_stream.finish")
+        sh = self.mesh.sharding(self.mesh.spec(0))
+        self._zeros = (
+            jax.device_put(jnp.zeros((n, self.k, d), jnp.float32), sh),
+            jax.device_put(jnp.zeros((n, self.k), jnp.float32), sh),
+            jax.device_put(jnp.zeros((n,), jnp.float32), sh))
+        self.remap = remap
+        # the skew grains for the sentinel (one execution record/sweep)
+        self._units = self.pack_units()
+
+    def apply_assignment(self, assignment) -> None:
+        self.assignment = np.asarray(assignment, np.int64)
+        self._rebuild({"centroids": self.centroids})
+
+    def _pack_loads(self) -> np.ndarray:
+        return self.packs.widths().astype(np.float64)
+
+    def _extract(self) -> dict:
+        return {"centroids": self.centroids.copy()}
+
+    def train_one(self) -> None:
+        import time
+
+        import jax
+
+        from harp_tpu.utils import flightrec, skew, telemetry
+
+        cents = jax.device_put(self.centroids, self.mesh.replicated())
+        with telemetry.span("kmeans_stream.epoch"), \
+                telemetry.ledger.run(self.phase, steps=1):
+            t0 = time.perf_counter()
+            sums, counts, inertia = self._accum(self._pts, self._mask,
+                                                cents, *self._zeros)
+            new_c, in_tot = self._finish(sums, counts, inertia, cents)
+            st = flightrec.readback(new_c)
+            self.centroids = np.asarray(st, np.float32)
+            self.inertia = float(np.asarray(in_tot))
+            skew.record_execution(
+                self.phase, self.worker_loads(), unit="points",
+                wall_s=time.perf_counter() - t0, units=self._units)
+
+    def metric(self) -> float:
+        return self.inertia
+
+
+# ---------------------------------------------------------------------------
+# The shared superstep loop
+# ---------------------------------------------------------------------------
+
+def elastic_fit(adapter: ElasticAdapter, epochs: int,
+                ckpt_dir: str | None = None, *, ckpt_every: int = 1,
+                max_restarts: int = 3, fault=None,
+                rebalance: bool = True) -> ElasticAdapter:
+    """Run ``epochs`` supersteps elastically (see module doc).
+
+    Layer 1 runs with or without checkpoints (the trigger consumption
+    is between-superstep host work); Layer 2 — surviving a
+    :class:`~harp_tpu.utils.fault.PermanentWorkerLoss` — requires
+    ``ckpt_dir`` (the resume replays from the last crash-atomic
+    checkpoint; a ``fault`` without one is refused, the
+    ``fit_epochs`` contract).  Checkpoints hold the adapter's CANONICAL
+    state, so they restore onto any survivor mesh.
+    """
+
+    def sweep():
+        adapter.train_one()
+        if rebalance:
+            maybe_rebalance(adapter)
+
+    arm = fault.arm() if fault is not None else contextlib.nullcontext()
+    if ckpt_dir is None:
+        if fault is not None:
+            raise ValueError(
+                "fault injection requires ckpt_dir (recovery restarts "
+                "from checkpoints; without one the injector would be "
+                "silently ignored)")
+        with arm:
+            for _ in range(epochs):
+                sweep()
+        return adapter
+
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir)
+
+    def step(i, state):
+        adapter.install(state)
+        sweep()
+        st = adapter.canonical_state()
+        st["step"] = i
+        return st
+
+    with arm:
+        run_with_recovery(adapter.canonical_state, step, epochs, mgr,
+                          ckpt_every=ckpt_every,
+                          max_restarts=max_restarts, fault=fault,
+                          on_permanent=adapter.shrink)
+    return adapter
+
+
+# ---------------------------------------------------------------------------
+# CLI fit entries (the --elastic / --max-worker-loss knobs route here;
+# tests/test_cli.py binds these signatures through stubs so a bad kwarg
+# fails without executing)
+# ---------------------------------------------------------------------------
+
+def mfsgd_elastic_fit(users, items, vals, *, n_users, n_items, cfg=None,
+                      epochs=1, ckpt_dir=None, ckpt_every=1,
+                      max_worker_loss=1, packs_per_worker=4, mesh=None,
+                      seed=0, fault=None) -> MFSGDElastic:
+    ad = MFSGDElastic(n_users, n_items, cfg, mesh, seed, users=users,
+                      items=items, vals=vals,
+                      packs_per_worker=packs_per_worker,
+                      max_worker_loss=max_worker_loss)
+    return elastic_fit(ad, epochs, ckpt_dir, ckpt_every=ckpt_every,
+                       fault=fault)
+
+
+def lda_elastic_fit(doc_ids, word_ids, *, n_docs, vocab_size, cfg=None,
+                    epochs=1, ckpt_dir=None, ckpt_every=1,
+                    max_worker_loss=1, packs_per_worker=4, mesh=None,
+                    seed=0, fault=None) -> LDAElastic:
+    ad = LDAElastic(n_docs, vocab_size, cfg, mesh, seed,
+                    doc_ids=doc_ids, word_ids=word_ids,
+                    packs_per_worker=packs_per_worker,
+                    max_worker_loss=max_worker_loss)
+    return elastic_fit(ad, epochs, ckpt_dir, ckpt_every=ckpt_every,
+                       fault=fault)
+
+
+def kmeans_stream_elastic_fit(points, *, k, iters=1, ckpt_dir=None,
+                              ckpt_every=1, max_worker_loss=1,
+                              packs_per_worker=4, mesh=None, seed=0,
+                              fault=None) -> KMeansStreamElastic:
+    ad = KMeansStreamElastic(points, k, mesh, seed,
+                             packs_per_worker=packs_per_worker,
+                             max_worker_loss=max_worker_loss)
+    return elastic_fit(ad, iters, ckpt_dir, ckpt_every=ckpt_every,
+                       fault=fault)

@@ -1,0 +1,71 @@
+"""Row movement over the reshard wire (PR 15).
+
+A rebalance relabels external ids, so model-state rows must land on
+their packs' new owners.  An arbitrary row permutation is not a
+``ShardSpec``→``ShardSpec`` move, but it IS expressible as the verb's
+always-legal fallback split in two: ``reshard(blocked(0) → replicated)``
+(the one all_gather on the wire) followed by a purely LOCAL gather of
+each worker's new rows — so the whole move rides the existing
+``reshard`` verb, records in the CommLedger like every other collective,
+and the registered ``elastic.regather`` driver program keeps it on the
+CommGraph byte sheet (HL301/HL302-checked on every full lint).  No new
+collectives.
+
+Bit-exact: the exact wire moves f32/int rows untouched, and the local
+gather is a permutation — :func:`regather_rows` equals the host
+``np.take`` path element-for-element (pinned in tests/test_elastic.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from harp_tpu.parallel.collective import ShardSpec, reshard
+from harp_tpu.parallel.mesh import WorkerMesh
+
+
+def make_regather_fn(mesh: WorkerMesh, ndim: int = 2):
+    """The jitted regather program: ``(x blocked(0), rows blocked(0)) →
+    out blocked(0)`` with ``out[i] = full(x)[rows[i]]`` (0 for
+    ``rows[i] < 0`` — the new layout's pad slots own no old row).
+    Registered as the ``elastic.regather`` driver so the lint byte
+    sheet prices the one all_gather the move costs."""
+
+    def gather(xs, rs):
+        full = reshard(xs, ShardSpec.blocked(0), ShardSpec.replicated())
+        safe = jnp.clip(rs, 0, full.shape[0] - 1)
+        out = jnp.take(full, safe, axis=0)
+        keep = (rs >= 0).reshape((-1,) + (1,) * (out.ndim - 1))
+        return jnp.where(keep, out, jnp.zeros((), out.dtype))
+
+    return jax.jit(mesh.shard_map(
+        gather,
+        in_specs=(mesh.spec(0, ndim=ndim), mesh.spec(0, ndim=1)),
+        out_specs=mesh.spec(0, ndim=ndim)))
+
+
+def regather_rows(mesh: WorkerMesh, x, new_rows):
+    """Move table rows into a new dim-0-sharded layout over the reshard
+    wire.
+
+    ``x``: a dim-0-sharded device array (rows divisible by the mesh).
+    ``new_rows``: host int array, one entry per OUTPUT row (length a
+    worker multiple): the global OLD row index that lands there, or -1
+    for a pad slot (zero-filled).  Output length may differ from the
+    input's — a rebalanced layout usually has a different ``bound``.
+    """
+    from harp_tpu.utils import flightrec, telemetry
+
+    nr = np.asarray(new_rows, np.int32)
+    n = mesh.num_workers
+    if nr.ndim != 1 or nr.shape[0] % n:
+        raise ValueError(
+            f"new_rows must be 1-D with length a multiple of {n} "
+            f"workers, got shape {nr.shape}")
+    fn = flightrec.track(make_regather_fn(mesh, ndim=np.ndim(x)),
+                         "elastic.regather")
+    with telemetry.span("elastic.regather", rows=int(nr.shape[0])), \
+            telemetry.ledger.run("elastic.regather", steps=1):
+        return fn(x, mesh.shard_array(nr, 0))

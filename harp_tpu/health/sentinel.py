@@ -179,11 +179,15 @@ class HealthMonitor:
         imb = SkewLedger._imbalance(rec)
         wf = imb.get("wasted_frac")
         st = self._skew.setdefault(
-            phase, {"consec": 0, "supersteps": 0, "latched": False})
+            phase, {"consec": 0, "supersteps": 0, "latched": False,
+                    "consumed": False})
         st["supersteps"] += 1
         if wf is None or wf < WASTED_FRAC_TRIGGER:
             st["consec"] = 0
             st["latched"] = False
+            # the latch release re-arms the handshake: a LATER re-fire
+            # hands a fresh plan to consume_skew_trigger
+            st["consumed"] = False
             return
         st["consec"] += 1
         if st["consec"] < TRIGGER_SUPERSTEPS or st["latched"]:
@@ -195,11 +199,36 @@ class HealthMonitor:
             "max_mean_ratio": imb.get("max_mean_ratio"),
             "supersteps": st["supersteps"],
             "consecutive": st["consec"],
-            # the elastic-execution handoff: apply_rebalance-shaped,
-            # advisory in this PR (tests replay it through
-            # schedule.apply_rebalance to pin the shape)
+            # the elastic-execution handoff: apply_rebalance-shaped;
+            # PR 15's drivers consume it between supersteps via
+            # :meth:`consume_skew_trigger` (harp_tpu.elastic replays it
+            # through schedule.apply_rebalance)
             "plan": ledger.suggest_rebalance(phase),
         })
+
+    def consume_skew_trigger(self, phase: str) -> dict | None:
+        """The sentinel↔driver handshake (PR 15): hand the latched
+        ``skew_trigger`` finding for ``phase`` to the elastic driver
+        EXACTLY ONCE.
+
+        Returns the finding row (inline ``plan`` included) the first
+        time a driver asks after the trigger fired; every later call
+        returns None until the phase recovers below the threshold (the
+        latch release) and a NEW trigger fires — so one fired plan can
+        never be applied twice, and a still-skewed phase cannot spam
+        re-application of a stale plan.  No-op (None) while telemetry
+        is off: the zero-cost contract extends to the acting half.
+        """
+        if not telemetry.enabled():
+            return None
+        st = self._skew.get(phase)
+        if st is None or not st.get("latched") or st.get("consumed"):
+            return None
+        st["consumed"] = True
+        row = self._rows.get(("skew_trigger", phase))
+        if row is not None:
+            row["consumed"] = True  # visible in the exported evidence
+        return row
 
     # -- budget drift -------------------------------------------------------
     def observe_budget(self, tag: str,

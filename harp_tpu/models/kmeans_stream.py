@@ -1150,10 +1150,44 @@ def main(argv=None):
                    help="checkpoint/resume for long runs (rerunning with "
                         "the same dir resumes from the latest epoch)")
     p.add_argument("--ckpt-every", type=int, default=5)
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic Lloyd (PR 15): consume mid-run "
+                        "skew_trigger findings between sweeps (rebalance "
+                        "point packs; masked pads keep the math exact) "
+                        "and checkpoint mesh-independent centroids")
+    p.add_argument("--max-worker-loss", type=int, default=0,
+                   help="elastic: survive up to N permanent worker "
+                        "losses by shrinking to the survivors and "
+                        "replaying the repartition plan from the last "
+                        "checkpoint (implies --elastic; needs --ckpt-dir "
+                        "to actually resume)")
     args = p.parse_args(argv)
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     wire = {"auto": "auto", "none": None}.get(args.wire_dtype,
                                               args.wire_dtype)
+
+    if args.elastic or args.max_worker_loss:
+        # elastic mode materializes the corpus (the repartition relabels
+        # rows), so it pairs with host-sized --n, not the 1B-point path
+        from harp_tpu.elastic.apps import kmeans_stream_elastic_fit
+        from harp_tpu.utils.metrics import benchmark_json
+
+        if args.input:
+            raise SystemExit(
+                "--elastic currently pairs with the synthetic corpus; "
+                "use --n/--d (file inputs ride the non-elastic "
+                "streaming fit)")
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(args.n, args.d)).astype(np.float32)
+        ad = kmeans_stream_elastic_fit(
+            pts, k=args.k, iters=args.iters, ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every,
+            max_worker_loss=max(args.max_worker_loss, 0))
+        print(benchmark_json("kmeans_stream_elastic_cli", {
+            "k": args.k, "iters": args.iters, "n": args.n, "d": args.d,
+            "inertia": ad.metric(), "n_workers": ad.mesh.num_workers,
+            "worker_losses": ad.losses, "ckpt_dir": args.ckpt_dir}))
+        return
 
     if args.input:
         from harp_tpu.fileformat import list_files

@@ -906,6 +906,10 @@ class LDA:
         # per-worker tokens touched in the most recent sweep (numpy [nw];
         # the skew spine's execution counter — see utils/skew.py)
         self.last_work = None
+        # movable pack grains for the skew execution records (PR 15):
+        # the elastic driver sets per-worker [(pack_id, load)] lists so
+        # the sentinel's skew_trigger plan is whole-unit replayable
+        self.skew_units = None
 
     def suggest_pull_cap(self, apply=False):
         """Exact zero-drop ``pull_cap`` for the LOADED corpus (pushpull
@@ -933,13 +937,19 @@ class LDA:
         """Load the token corpus (one entry per token occurrence)."""
         self._install_pack(self.pack_tokens(doc_ids, word_ids))
 
-    def pack_tokens(self, doc_ids, word_ids) -> dict:
+    def pack_tokens(self, doc_ids, word_ids, z0=None) -> dict:
         """Host-side half of :meth:`set_tokens`: partition the corpus into
         this config's device layout and build the initial count tables —
         a plain dict of numpy arrays, so callers can CACHE it
         (``lda.benchmark``'s ``pack_cache``: the enwiki-1M pack costs
         ~675 s on a 1-core host and is identical across sweep variants
-        that share a tiling).  ``_install_pack`` ships it to devices."""
+        that share a tiling).  ``_install_pack`` ships it to devices.
+
+        ``z0`` (PR 15): explicit per-token topic assignments instead of
+        the seeded random init — the elastic repartition extracts the
+        live chain (:meth:`token_state`), remaps doc ids, and repacks
+        WITHOUT resetting it; counts rebuild exactly from ``z0``, so
+        the move itself is chain-preserving."""
         n = self.mesh.num_workers
         K = self.cfg.n_topics
         if self.cfg.ndk_dtype == "int16":
@@ -953,10 +963,17 @@ class LDA:
                     f"ndk_dtype='int16': longest document has {longest} "
                     f"tokens > {np.iinfo(np.int16).max} — counts would "
                     "wrap; use ndk_dtype='float32' or split the document")
-        rng = np.random.default_rng(self._seed)
         # reuse the MF-SGD grid partitioners: "rating value" carries the
         # initial topic assignment
-        z0 = rng.integers(0, K, len(doc_ids)).astype(np.float32)
+        if z0 is None:
+            rng = np.random.default_rng(self._seed)
+            z0 = rng.integers(0, K, len(doc_ids)).astype(np.float32)
+        else:
+            z0 = np.asarray(z0, np.float32)
+            if z0.shape != np.shape(doc_ids):
+                raise ValueError(
+                    f"z0 has shape {z0.shape} but the corpus has "
+                    f"{len(doc_ids)} tokens")
         nc = rotate_chunks_resolved(self.cfg)
         if self.cfg.algo in _TILED_ALGOS:
             ed, ew, ez, od, ow, do, wo, db, wbc = partition_ratings_tiles(
@@ -1097,6 +1114,32 @@ class LDA:
                 .reshape(-1, K)
         return Nwk[: self.vocab_size]
 
+    def token_state(self):
+        """Current chain state as EXTERNAL ``(doc, word, z)`` token
+        triples (PR 15).
+
+        A collapsed-Gibbs chain IS the token-assignment multiset — both
+        count tables derive exactly from it — so these triples are the
+        complete, layout-independent chain state: the elastic
+        repartition extracts them, remaps doc ids, and repacks with
+        ``pack_tokens(..., z0=z)``, and the rebuilt counts equal the
+        live ones bit-for-bit.  Storage row ids (grid padding included)
+        are translated back to external doc/word ids here.
+        """
+        if self._tokens is None:
+            raise RuntimeError("call set_tokens() before token_state()")
+        gd, gw, gm = self._global_token_ids(self._tokens)
+        gz = np.asarray(self.z_grid).reshape(-1)
+        d_st, w_st, z = gd[gm], gw[gm], gz[gm]
+        if self.cfg.algo == "pushpull":
+            # doc storage is unpadded (d_bound == d_own) and word ids
+            # are already global external
+            return d_st, w_st, z
+        wbc = self.w_bound // rotate_chunks_resolved(self.cfg)
+        d_ext = (d_st // self.d_bound) * self.d_own + d_st % self.d_bound
+        w_ext = (w_st // wbc) * self.w_own + w_st % wbc
+        return d_ext, w_ext, z
+
     def compile_epochs(self, epochs: int):
         """AOT-compile the ``epochs``-sweep program WITHOUT sampling —
         benchmark warmup must not double the workload (same contract as
@@ -1154,7 +1197,8 @@ class LDA:
             self._install_epoch_out(out)
             skew.record_execution("lda.epochs", self.last_work,
                                   unit="tokens",
-                                  wall_s=time.perf_counter() - t0)
+                                  wall_s=time.perf_counter() - t0,
+                                  units=self.skew_units)
 
     def sample_epoch(self):
         if self._tokens is None:
@@ -1173,7 +1217,8 @@ class LDA:
             self._install_epoch_out(out)
             skew.record_execution("lda.epochs", self.last_work,
                                   unit="tokens",
-                                  wall_s=time.perf_counter() - t0)
+                                  wall_s=time.perf_counter() - t0,
+                                  units=self.skew_units)
 
     def _advance_keys(self):
         # prng.split_keys builds the base key's bits on host — a fresh
@@ -1523,10 +1568,54 @@ def main(argv=None):
                    help="token files ('doc word [count]' rows) — the Harp "
                         "app's HDFS input; implies sampling mode. --docs/"
                         "--vocab are raised to max id + 1 as needed")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic sampling (PR 15): consume mid-run "
+                        "skew_trigger findings between sweeps (rebalance "
+                        "doc packs, chain preserved) and checkpoint "
+                        "mesh-independent state")
+    p.add_argument("--max-worker-loss", type=int, default=0,
+                   help="elastic: survive up to N permanent worker "
+                        "losses by shrinking to the survivors and "
+                        "replaying the repartition plan from the last "
+                        "checkpoint (implies --elastic; needs --ckpt-dir "
+                        "to actually resume)")
     args = p.parse_args(argv)
     from harp_tpu.utils.fault import resolve_resume
 
     resumed_from = resolve_resume(args.ckpt_dir, args.resume)
+    if args.elastic or args.max_worker_loss:
+        if args.input:
+            raise SystemExit(
+                "--elastic currently pairs with the synthetic corpus; "
+                "use --docs/--vocab/--tokens-per-doc (file inputs ride "
+                "the non-elastic fit)")
+        from harp_tpu.elastic.apps import lda_elastic_fit
+
+        n_docs, vocab = args.docs or 100_000, args.vocab or 50_000
+        d_ids, w_ids = synthetic_corpus(n_docs, vocab,
+                                        max(2, args.topics // 8),
+                                        args.tokens_per_doc)
+        ad = lda_elastic_fit(
+            d_ids, w_ids, n_docs=n_docs, vocab_size=vocab,
+            cfg=_make_cfg(args.topics, args.algo, args.chunk,
+                          args.d_tile, args.w_tile, args.entry_cap,
+                          args.pull_cap, args.ndk_dtype,
+                          False if args.no_dedup_pulls else None,
+                          args.sampler, args.rng_impl,
+                          rotate_chunks=args.rotate_chunks,
+                          rotate_wire=args.rotate_wire),
+            epochs=args.epochs, ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every,
+            max_worker_loss=max(args.max_worker_loss, 0))
+        print(benchmark_json("lda_elastic_cli", {
+            "epochs": args.epochs,
+            "log_likelihood": round(ad.metric(), 4),
+            "n_workers": ad.mesh.num_workers,
+            "worker_losses": ad.losses, "ckpt_dir": args.ckpt_dir}))
+        from harp_tpu.report import maybe_emit
+
+        maybe_emit("lda")
+        return
     if args.input or args.ckpt_dir:
         if args.input:
             from harp_tpu.native.datasource import load_triples_glob

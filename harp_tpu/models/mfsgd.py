@@ -628,6 +628,12 @@ class MFSGD:
                                          "mfsgd.epoch")
         self._multi_fns: dict[int, Any] = {}
         self._blocks = None
+        # movable pack grains for the skew spine's execution records
+        # (PR 15): the elastic driver sets per-worker [(pack_id, load)]
+        # lists here so the health sentinel's skew_trigger carries a
+        # whole-unit, apply_rebalance-replayable plan.  None (default)
+        # keeps the PR-4 per-worker-only records.
+        self.skew_units = None
 
     def set_ratings(self, users, items, vals):
         from harp_tpu.utils import telemetry
@@ -686,7 +692,8 @@ class MFSGD:
                 jnp.concatenate([jnp.stack([se, cnt]), work_w]))
             skew.record_execution("mfsgd.epochs", stats[2:],
                                   unit="ratings",
-                                  wall_s=time.perf_counter() - t0)
+                                  wall_s=time.perf_counter() - t0,
+                                  units=self.skew_units)
             return float(np.sqrt(max(float(stats[0]), 0.0)
                                  / max(float(stats[1]), 1.0)))
 
@@ -738,7 +745,8 @@ class MFSGD:
                 jnp.concatenate([ses, cnts, work_w]))
             skew.record_execution("mfsgd.epochs", stats[2 * epochs:],
                                   unit="ratings",
-                                  wall_s=time.perf_counter() - t0)
+                                  wall_s=time.perf_counter() - t0,
+                                  units=self.skew_units)
             ses, cnts = stats[:epochs], stats[epochs:2 * epochs]
         return [float(np.sqrt(max(s, 0.0) / max(c, 1.0)))
                 for s, c in zip(ses, cnts)]
@@ -944,10 +952,49 @@ def main(argv=None):
                    help="rating triple files ('user item rating' rows, e.g. "
                         "MovieLens) — the Harp app's HDFS input; implies "
                         "training mode. --users/--items default to max id + 1")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic training (PR 15): consume mid-run "
+                        "skew_trigger findings between epochs (rebalance "
+                        "user packs over the reshard wire) and checkpoint "
+                        "mesh-independent state")
+    p.add_argument("--max-worker-loss", type=int, default=0,
+                   help="elastic: survive up to N permanent worker "
+                        "losses by shrinking to the survivors and "
+                        "replaying the repartition plan from the last "
+                        "checkpoint (implies --elastic; needs --ckpt-dir "
+                        "to actually resume)")
     args = p.parse_args(argv)
     from harp_tpu.utils.fault import resolve_resume
 
     resumed_from = resolve_resume(args.ckpt_dir, args.resume)
+    if args.elastic or args.max_worker_loss:
+        if args.input:
+            raise SystemExit(
+                "--elastic currently pairs with the synthetic corpus; "
+                "use --users/--items/--nnz (file inputs ride the "
+                "non-elastic fit)")
+        from harp_tpu.elastic.apps import mfsgd_elastic_fit
+
+        n_users = args.users or 138_493
+        n_items = args.items or 26_744
+        u, i, v = synthetic_ratings(n_users, n_items, args.nnz)
+        ad = mfsgd_elastic_fit(
+            u, i, v, n_users=n_users, n_items=n_items,
+            cfg=_make_config(args.rank, args.chunk, args.algo,
+                             args.u_tile, args.i_tile, args.entry_cap,
+                             rotate_chunks=args.rotate_chunks,
+                             rotate_wire=args.rotate_wire),
+            epochs=args.epochs, ckpt_dir=args.ckpt_dir,
+            ckpt_every=args.ckpt_every,
+            max_worker_loss=max(args.max_worker_loss, 0))
+        print(benchmark_json("mfsgd_elastic_cli", {
+            "epochs": args.epochs, "rmse_final": ad.metric(),
+            "n_workers": ad.mesh.num_workers,
+            "worker_losses": ad.losses, "ckpt_dir": args.ckpt_dir}))
+        from harp_tpu.report import maybe_emit
+
+        maybe_emit("mfsgd")
+        return
     if args.input or args.ckpt_dir:
         if args.input:
             from harp_tpu.native.datasource import load_triples_glob

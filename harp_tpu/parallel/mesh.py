@@ -206,6 +206,22 @@ class WorkerMesh:
             return jax.device_put(x_local, sh)
         return jax.make_array_from_process_local_data(sh, x_local, gshape)
 
+    def survivors(self, lost: int) -> "WorkerMesh":
+        """The submesh excluding worker ``lost`` — the elastic shrink
+        (PR 15): a permanent worker loss rebuilds execution on this
+        mesh instead of killing the job (Harp: YARN retried the whole
+        job; here ``harp_tpu.elastic`` replays the repartition plan
+        over the survivors from the last checkpoint)."""
+        devs = self.devices
+        if not 0 <= lost < len(devs):
+            raise ValueError(
+                f"lost worker {lost} is not on this mesh "
+                f"({len(devs)} workers)")
+        if len(devs) < 2:
+            raise ValueError("cannot shrink a single-worker mesh")
+        return WorkerMesh([d for i, d in enumerate(devs) if i != lost],
+                          axis=self.axis)
+
     def shard_map(
         self,
         f: Callable,

@@ -450,6 +450,7 @@ PROGRAM_CONFIGS = {
     "wdamds.smacof": ("wdamds", "wdamds_coord_bf16",
                       "wdamds_coord_int8"),
     "collective.reshard": (), "collective.reshard_wire": (),
+    "elastic.regather": (),
     "ring_attention": (), "rotate.pipeline_chunked": (),
     "serve.lda_infer": (), "serve.mlp_logits": (),
     "serve.rf_vote": (), "serve.svm_scores": (),

@@ -136,7 +136,8 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               transfer_info: dict | None = None,
               skew_info: dict | None = None,
               trace_info: dict | None = None,
-              health_info: dict | None = None) -> dict:
+              health_info: dict | None = None,
+              elastic_rows: list[dict] | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -161,6 +162,14 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
     # health section (PR 14) only when the sentinel recorded findings
     if health_info and health_info.get("findings"):
         row["health"] = health_info
+    # elastic section (PR 15) only when the run rebalanced/shrank/resumed
+    if elastic_rows:
+        by_event: dict[str, int] = {}
+        for r in elastic_rows:
+            by_event[r.get("event", "?")] = \
+                by_event.get(r.get("event", "?"), 0) + 1
+        row["elastic"] = {"events": len(elastic_rows),
+                          "by_event": by_event, "rows": elastic_rows}
     for t in comm.values():
         execs = max(1, t["executions"])
         for s in t["sites"]:
@@ -306,6 +315,31 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
                 extra = f"  verdict {r.get('verdict')}"
             lines.append(f"  [{r.get('severity')}] "
                          f"{r.get('detector')} {who}{extra}")
+    el = row.get("elastic")
+    if el:
+        lines.append(f"elastic (actions): {el.get('events', 0)} — "
+                     + ", ".join(f"{k}×{v}" for k, v in
+                                 sorted(el.get("by_event", {}).items())))
+        for r in el.get("rows", []):
+            if r.get("event") == "rebalance":
+                lines.append(
+                    f"  [rebalance] {r.get('phase')}: wasted "
+                    f"{r.get('wasted_frac_before')} -> "
+                    f"{r.get('wasted_frac_after')} "
+                    f"({r.get('moves')} move(s))")
+            elif r.get("event") == "shrink":
+                lines.append(
+                    f"  [shrink] {r.get('phase')}: lost worker "
+                    f"{r.get('lost_worker')} ({r.get('site')} #"
+                    f"{r.get('ordinal')}), {r.get('n_workers_before')}"
+                    f" -> {r.get('n_workers_after')} workers "
+                    f"(capacity {r.get('capacity_frac')})")
+            else:
+                lines.append(
+                    f"  [resume] {r.get('phase')}: {r.get('n_workers')}"
+                    f" worker(s), wasted {r.get('wasted_frac')}"
+                    + (", replayed repartition plan"
+                       if r.get("replayed_plan") else ""))
     if "metrics_rows" in row:
         lines.append(f"metrics: {row['metrics_rows']} row(s)")
         if row.get("metrics_last"):
@@ -319,7 +353,7 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
-    from harp_tpu import health
+    from harp_tpu import elastic, health
     from harp_tpu.utils import flightrec, reqtrace, skew
 
     comm = telemetry.ledger.summary()
@@ -330,7 +364,8 @@ def live_report() -> tuple[dict, list[dict]]:
                       skew_info=skew.ledger.summary(),
                       trace_info=reqtrace.summarize_rows(
                           reqtrace.tracer.rows()),
-                      health_info=health.monitor.summary()),
+                      health_info=health.monitor.summary(),
+                      elastic_rows=list(elastic.ledger.ledger.rows)),
             telemetry.tracer.records)
 
 
@@ -385,6 +420,7 @@ def main(argv=None) -> int:
     skew_rows: list[dict] = []
     trace_rows: list[dict] = []
     health_rows: list[dict] = []
+    elastic_rows: list[dict] = []
     if args.telemetry:
         kinds = telemetry.load_rows(args.telemetry)
         span_rows, comm_rows = kinds["span"], kinds["comm"]
@@ -392,6 +428,7 @@ def main(argv=None) -> int:
         skew_rows = kinds["skew"]
         trace_rows = kinds["trace"]
         health_rows = kinds["health"]
+        elastic_rows = kinds["elastic"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -419,7 +456,8 @@ def main(argv=None) -> int:
                                 if trace_rows else None),
                     health_info=(health_mod.summarize_rows(health_rows)
                                  | {"rows": health_rows}
-                                 if health_rows else None))
+                                 if health_rows else None),
+                    elastic_rows=elastic_rows)
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

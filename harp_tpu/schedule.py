@@ -248,6 +248,19 @@ def apply_rebalance(splits: Sequence[Sequence[Any]], plan: dict) -> list[list]:
     return out
 
 
+def rebalance_assignment(splits: Sequence[Sequence[Any]],
+                         plan: dict) -> dict:
+    """:func:`apply_rebalance` flattened to ``{unit_id: worker}`` — the
+    consumption shape of the elastic drivers (PR 15): a fired
+    ``skew_trigger``'s inline plan replays over the current per-worker
+    unit lists, and the resulting assignment drives the repartition
+    (:mod:`harp_tpu.elastic.rebalance`).  Same whole-unit contract as
+    :func:`apply_rebalance` (fractional plans raise)."""
+    return {uid: w
+            for w, lst in enumerate(apply_rebalance(splits, plan))
+            for uid in lst}
+
+
 def device_map(fn: Callable, items, *, batched: bool = True):
     """The TPU-native replacement for thread schedulers on *regular* work.
 

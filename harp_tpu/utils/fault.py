@@ -104,6 +104,28 @@ class InjectedFault(WorkerFailure):
         self.ordinal = ordinal
 
 
+class PermanentWorkerLoss(WorkerFailure):
+    """A :class:`FaultInjector`-scheduled PERMANENT worker loss (PR 15).
+
+    Unlike :class:`InjectedFault` (a transient the retry layers absorb
+    by re-trying on the same mesh), this models a worker that is GONE
+    for the rest of the run: retrying on the full mesh can only fail
+    again.  Deliberately NOT a subclass of :class:`InjectedFault`, so
+    transient-retry layers never swallow it; the elastic handler
+    (:func:`run_with_recovery` ``on_permanent`` /
+    :mod:`harp_tpu.elastic`) shrinks the mesh to the survivors instead.
+    Carries the site, the 1-based event ordinal, and the lost worker's
+    mesh index.
+    """
+
+    def __init__(self, site: str, ordinal: int, worker: int):
+        super().__init__(f"injected permanent loss of worker {worker} "
+                         f"({site} event #{ordinal})")
+        self.site = site
+        self.ordinal = ordinal
+        self.worker = worker
+
+
 def _spec_fires(spec, ordinal: int, rng: np.random.Generator) -> bool:
     """A site schedule is a probability (seeded Bernoulli per event) or a
     collection of 1-based event ordinals (exact, for pinned tests)."""
@@ -136,6 +158,13 @@ class FaultInjector:
       scheduled sites; inside the ``with`` block a due event raises
       :class:`InjectedFault` (``fail``) or sleeps ``delay_s`` seconds
       (``delay``) before the operation proceeds.
+    - **permanent schedule** (PR 15): ``permanent=`` takes the same
+      spec shapes (probability or exact 1-based ordinals — the
+      worker-loss drill pins ``permanent={"dispatch": (2,)}``) but
+      raises :class:`PermanentWorkerLoss` for ``lost_worker`` and fires
+      AT MOST ONCE: the worker is gone for the rest of the run, and
+      only an elastic handler (mesh shrink + repartition replay) can
+      absorb it.
 
     Determinism note: one seeded generator drives every probabilistic
     draw in event order, so a schedule replays exactly for the same
@@ -150,16 +179,32 @@ class FaultInjector:
     def __init__(self, fail_at: tuple[int, ...] = (), *, seed: int = 0,
                  fail: dict[str, float | Collection[int]] | None = None,
                  delay: dict[str, float | Collection[int]] | None = None,
-                 delay_s: float = 0.001, max_faults: int | None = None):
+                 delay_s: float = 0.001, max_faults: int | None = None,
+                 permanent: dict[str, float | Collection[int]] | None = None,
+                 lost_worker: int | None = None):
         self.pending = set(fail_at)
         self.fired: list[int] = []
-        for sched in (fail, delay):
+        for sched in (fail, delay, permanent):
             for site in sched or ():
                 if site not in SITES:
                     raise ValueError(
                         f"unknown fault site {site!r} (sites: {SITES})")
         self.fail = dict(fail or {})
         self.delay = dict(delay or {})
+        # permanent-loss schedule (PR 15): same spec contract as fail= —
+        # a probability (seeded Bernoulli per event) or exact 1-based
+        # event ordinals — but the injected failure is a
+        # PermanentWorkerLoss for `lost_worker`, and it fires at most
+        # once per injector (one schedule kills one worker; chain
+        # injectors for multi-loss chaos).
+        self.permanent = dict(permanent or {})
+        if self.permanent and lost_worker is None:
+            raise ValueError(
+                "permanent= names the schedule but not the casualty: "
+                "pass lost_worker=<mesh index> so the elastic handler "
+                "knows which worker to exclude")
+        self.lost_worker = lost_worker
+        self.permanent_fired = False
         self.delay_s = float(delay_s)
         self.max_faults = max_faults
         self._rng = np.random.default_rng(seed)
@@ -184,6 +229,16 @@ class FaultInjector:
             self.delayed[site] += 1
             self._mark(site, n, "delay")
             time.sleep(self.delay_s)
+        if (not self.permanent_fired
+                and _spec_fires(self.permanent.get(site), n, self._rng)):
+            # permanent loss is not bounded by max_faults (it is not a
+            # transient the run can absorb) and fires exactly once: the
+            # worker is gone, re-killing it models nothing
+            self.permanent_fired = True
+            self.injected[site] += 1
+            self.events.append((site, n))
+            self._mark(site, n, "permanent")
+            raise PermanentWorkerLoss(site, n, self.lost_worker)
         if (self.max_faults is not None
                 and sum(self.injected.values()) >= self.max_faults):
             return
@@ -226,7 +281,8 @@ class FaultInjector:
                 lambda path: self.on_event("ckpt_write")),
         }
         active = {s for s in SITES
-                  if s in self.fail or s in self.delay}
+                  if s in self.fail or s in self.delay
+                  or s in self.permanent}
         with contextlib.ExitStack() as stack:
             for site in active:
                 stack.enter_context(hooks[site]())
@@ -332,6 +388,7 @@ def run_with_recovery(
     ckpt_every: int = 10,
     max_restarts: int = 3,
     fault: FaultInjector | None = None,
+    on_permanent: Callable[[PermanentWorkerLoss], None] | None = None,
 ) -> Any:
     """Fail-fast iterate-with-restart — the YARN retry loop, in-framework.
 
@@ -342,6 +399,17 @@ def run_with_recovery(
     ``make_state()`` if none exists — up to ``max_restarts`` times, then
     re-raises.  Matches Harp's whole-job-retry semantics but resumes from
     the last checkpoint instead of iteration 0.
+
+    ``on_permanent`` (PR 15, the elastic half): a
+    :class:`PermanentWorkerLoss` cannot be absorbed by restarting on the
+    same mesh, so without a handler it re-raises immediately (fail
+    loudly, not a crash loop).  With one, the handler shrinks the
+    execution context to the survivors (``harp_tpu.elastic`` rebuilds
+    the model on a survivor submesh and replays the repartition plan)
+    and the loop resumes from the latest checkpoint like any other
+    restart — the handler's own loss budget (``max_worker_loss``)
+    bounds how many times this can happen, so permanent losses do not
+    consume ``max_restarts``.
     """
     restarts = 0
     while True:
@@ -359,6 +427,13 @@ def run_with_recovery(
                 if (i + 1) % ckpt_every == 0 or i == n_iters - 1:
                     ckpt.save(i, state)
             return state
+        except PermanentWorkerLoss as e:
+            if on_permanent is None:
+                raise  # no elastic handler: a same-mesh retry only re-dies
+            log.warning("permanent loss of worker %s (%s); shrinking to "
+                        "survivors and resuming from step %s",
+                        e.worker, e, ckpt.latest_step())
+            on_permanent(e)  # raises when the loss budget is exhausted
         except Exception as e:  # noqa: BLE001 - the whole point
             restarts += 1
             if restarts > max_restarts:

@@ -115,13 +115,24 @@ class SkewLedger:
                   else None)
 
     def record_execution(self, phase: str, work, *, unit: str,
-                         wall_s: float | None = None) -> None:
+                         wall_s: float | None = None,
+                         units: Sequence[Sequence[tuple]] | None = None
+                         ) -> None:
         """Execution record: ``work[w]`` = work units worker ``w``
         actually processed this superstep (from the driver's stacked
         readback); ``wall_s`` is the measured host wall for the phase,
-        the basis of the wasted-chip-seconds prediction."""
+        the basis of the wasted-chip-seconds prediction.
+
+        ``units`` (PR 15): optional per-worker movable ``(unit_id,
+        size)`` grains, exactly as :meth:`record_partition` takes them.
+        The elastic drivers attach their pack grains here so the health
+        sentinel's ``skew_trigger`` carries a WHOLE-UNIT
+        ``suggest_rebalance`` plan — the shape
+        ``schedule.apply_rebalance`` replays mid-run."""
         self._put(phase, "execution", work, unit,
-                  wall_s=None if wall_s is None else float(wall_s))
+                  wall_s=None if wall_s is None else float(wall_s),
+                  units=[list(u) for u in units] if units is not None
+                  else None)
 
     def record_host(self, phase: str, worker: int, wall_s: float,
                     n_workers: int | None = None) -> None:
@@ -272,7 +283,8 @@ def record_partition(phase: str, work, *, unit: str = "rows",
     Also feeds the health sentinel's skew trigger (PR 14): K consecutive
     records with ``wasted_frac`` over the threshold emit a
     ``kind:"health"`` finding carrying the ``suggest_rebalance`` plan
-    inline — the elastic-execution hook, advisory in this PR."""
+    inline — the elastic-execution hook the PR-15 drivers consume
+    mid-run (:mod:`harp_tpu.elastic`)."""
     if telemetry.enabled():
         ledger.record_partition(phase, work, unit=unit,
                                 padded_total=padded_total, units=units)
@@ -282,12 +294,15 @@ def record_partition(phase: str, work, *, unit: str = "rows",
 
 
 def record_execution(phase: str, work, *, unit: str,
-                     wall_s: float | None = None) -> None:
+                     wall_s: float | None = None, units=None) -> None:
     """Execution hook for the epoch drivers (no-op when telemetry off).
     Feeds the health sentinel's skew trigger like
-    :func:`record_partition` — each call is one superstep's record."""
+    :func:`record_partition` — each call is one superstep's record.
+    ``units`` carries the elastic drivers' movable pack grains (PR 15)
+    so the fired trigger's inline plan is whole-unit replayable."""
     if telemetry.enabled():
-        ledger.record_execution(phase, work, unit=unit, wall_s=wall_s)
+        ledger.record_execution(phase, work, unit=unit, wall_s=wall_s,
+                                units=units)
         from harp_tpu import health
 
         health.monitor.observe_skew(phase, ledger)

@@ -82,13 +82,14 @@ def scope(on: bool = True, *, reset: bool = True):
     if reset:
         ledger.reset()
         tracer.reset()
-        from harp_tpu import health
+        from harp_tpu import elastic, health
         from harp_tpu.utils import flightrec, reqtrace, skew
 
         flightrec.reset()
         skew.reset()
         reqtrace.reset()
         health.reset()
+        elastic.reset()
     try:
         yield
     finally:
@@ -399,10 +400,11 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 def export(path: str) -> None:
     """Write every collected record (spans + ledger + flight recorder +
-    skew ledger + request traces + health findings) as one JSONL file —
-    the input format of ``python -m harp_tpu report``, ``python -m
-    harp_tpu trace``, and ``python -m harp_tpu health``."""
-    from harp_tpu import health
+    skew ledger + request traces + health findings + elastic actions)
+    as one JSONL file — the input format of ``python -m harp_tpu
+    report``, ``python -m harp_tpu trace``, and ``python -m harp_tpu
+    health``."""
+    from harp_tpu import elastic, health
     from harp_tpu.utils import flightrec, reqtrace, skew
 
     with open(path, "w") as fh:
@@ -412,6 +414,7 @@ def export(path: str) -> None:
         skew.export_jsonl(fh)
         reqtrace.tracer.export_jsonl(fh)
         health.export_jsonl(fh)
+        elastic.export_jsonl(fh)
 
 
 def export_timeline(path: str) -> None:
@@ -479,13 +482,15 @@ def export_timeline(path: str) -> None:
 def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
-    [...], "skew": [...], "trace": [...], "health": [...]}`` (unknown
+    [...], "skew": [...], "trace": [...], "health": [...],
+    "elastic": [...]}`` (unknown
     kinds land under ``"comm"`` for backward compatibility with
     pre-flight-recorder exports, whose only unmarked rows were the
     ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
                                   "transfer": [], "skew": [],
-                                  "trace": [], "health": []}
+                                  "trace": [], "health": [],
+                                  "elastic": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

@@ -174,6 +174,22 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     ``phase``, ``moves`` with non-negative worker ids and work, numeric
     before/after ratios) — the elastic-execution hook is only a hook if
     its payload is replayable.
+
+14. **Elastic rows are coherent elasticity evidence** (any file): a
+    ``kind:"elastic"`` row (the PR-15 acting half —
+    :mod:`harp_tpu.elastic`, exported by ``telemetry.export``) must
+    carry the provenance stamp (a CPU-sim drill must never read as
+    relay elasticity evidence), name an event from the frozen
+    vocabulary (``KNOWN_ELASTIC_EVENTS``: rebalance / shrink / resume —
+    sync-pinned against ``harp_tpu.elastic.EVENTS`` by
+    tests/test_check_jsonl.py), carry per-worker load lists of
+    non-negative numbers that SUM to the row's ``total``, and per
+    event: a ``rebalance`` row must carry ``wasted_frac_before``/
+    ``wasted_frac_after`` in [0, 1] with after ≤ before (a "rebalance"
+    that made the imbalance worse is not rebalance evidence), and a
+    ``shrink`` row must show the survivor count strictly below the
+    pre-fault count (``n_workers_after < n_workers_before``) — a
+    shrink that lost no worker describes a fault that did not happen.
 """
 
 from __future__ import annotations
@@ -310,6 +326,7 @@ LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
 # not evidence about THIS repo's communication schedule.
 KNOWN_LINT_PROGRAMS = (
     "collective.reshard", "collective.reshard_wire",
+    "elastic.regather",
     "ingest.accum_chunk", "ingest.finish_epoch", "kmeans.fit",
     "kmeans.fit_hier", "lda.epoch",
     "mfsgd.epoch", "ring_attention", "rotate.pipeline_chunked",
@@ -865,6 +882,90 @@ def _check_rebalance_plan(name: str, i: int, plan) -> list[str]:
     return errs
 
 
+# the elastic-row vocabulary (invariant 14), FROZEN standalone like the
+# health vocabularies and sync-pinned by tests/test_check_jsonl.py
+# against harp_tpu.elastic.EVENTS
+KNOWN_ELASTIC_EVENTS = ("rebalance", "shrink", "resume")
+ELASTIC_LOAD_FIELDS = ("loads", "loads_before", "loads_after")
+ELASTIC_COUNT_FIELDS = ("n_workers", "moves", "lost_worker", "ordinal",
+                        "from_step", "trigger_supersteps",
+                        "n_workers_before", "n_workers_after")
+
+
+def _check_elastic_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 14: elastic rows must be coherent elasticity evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: elastic row missing provenance field(s) "
+            f"{missing} — export through telemetry.export, which "
+            "stamps them")
+    ev = row.get("event")
+    if ev not in KNOWN_ELASTIC_EVENTS:
+        errs.append(f"{name}:{i}: elastic row event={ev!r} not in "
+                    f"{KNOWN_ELASTIC_EVENTS}")
+    total = row.get("total")
+    for k in ELASTIC_LOAD_FIELDS:
+        v = row.get(k)
+        if v is None:
+            continue
+        if not (isinstance(v, list) and v
+                and all(_num(x) and x >= 0 for x in v)):
+            errs.append(
+                f"{name}:{i}: elastic row {k}={v!r} must be a non-empty "
+                "list of non-negative per-worker loads")
+        elif not _num(total):
+            errs.append(
+                f"{name}:{i}: elastic row carries {k} but "
+                f"total={total!r} — per-worker loads must state the "
+                "total they sum to")
+        elif abs(sum(v) - total) > 1e-4 * max(1.0, abs(total)):
+            errs.append(
+                f"{name}:{i}: elastic row {k} sums to {sum(v)} but "
+                f"total claims {total} — a move must conserve work")
+    for k in ELASTIC_COUNT_FIELDS:
+        v = row.get(k)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"{name}:{i}: elastic row count {k}={v!r} must "
+                        "be a non-negative integer")
+    wb, wa = row.get("wasted_frac_before"), row.get("wasted_frac_after")
+    for k, v in (("wasted_frac_before", wb), ("wasted_frac_after", wa),
+                 ("wasted_frac", row.get("wasted_frac")),
+                 ("capacity_frac", row.get("capacity_frac"))):
+        if v is not None and (not _num(v) or not 0.0 <= v <= 1.0):
+            errs.append(f"{name}:{i}: elastic row {k}={v!r} must lie "
+                        "in [0, 1]")
+    if ev == "rebalance":
+        if not (_num(wb) and _num(wa)):
+            errs.append(
+                f"{name}:{i}: rebalance elastic row must carry numeric "
+                "wasted_frac_before AND wasted_frac_after — the whole "
+                "point is before/after evidence")
+        elif wa > wb + 1e-9:
+            errs.append(
+                f"{name}:{i}: rebalance elastic row wasted_frac_after="
+                f"{wa} > before={wb} — a move that made the imbalance "
+                "worse must be refused, not committed as evidence")
+        for k in ("loads_before", "loads_after"):
+            if row.get(k) is None:
+                errs.append(f"{name}:{i}: rebalance elastic row "
+                            f"missing {k}")
+    if ev == "shrink":
+        nb, na = row.get("n_workers_before"), row.get("n_workers_after")
+        ok = (isinstance(nb, int) and isinstance(na, int)
+              and not isinstance(nb, bool) and not isinstance(na, bool)
+              and nb >= 1 and na >= 1)
+        if not ok or na >= nb:
+            errs.append(
+                f"{name}:{i}: shrink elastic row needs survivor count "
+                f"n_workers_after < n_workers_before (>= 1), got "
+                f"{na!r} / {nb!r}")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -936,6 +1037,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_model_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "health":
             errors += _check_health_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "elastic":
+            errors += _check_elastic_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -2225,3 +2225,138 @@ print(f"health: chaos run {_hl_res['served_requests']}/"
       f"pruning gate open, driver record {len(_hl_line)} B <= "
       f"{_hl_b.RECORD_CAP_BYTES}")
 print(f"DRIVE OK round-34 ({mode})")
+
+# ---------------------------------------------------------------------------
+# round 35 — elastic execution (PR 15): the whole loop through the
+# PUBLIC surface, numpy-checked.  (a) a skewed corpus fires the PR-14
+# trigger, the elastic MF-SGD driver consumes it EXACTLY once and the
+# rebalanced per-worker loads match a straight-line numpy LPT over the
+# pack grains; (b) the reshard-wire row move equals numpy fancy
+# indexing bit-for-bit; (c) an injected permanent worker loss at a
+# seeded ordinal shrinks 8 -> 7 and the continued training is
+# BIT-identical to a survivors-only run from the same checkpoint;
+# (d) the full telemetry export (skew + health + elastic rows) passes
+# scripts/check_jsonl.py, and the elastic CLI knob round-trips end to
+# end in a subprocess.
+# ---------------------------------------------------------------------------
+import json as _el_json
+import subprocess as _el_sp
+import tempfile as _el_tmp
+
+from harp_tpu import health as _el_h
+from harp_tpu.elastic import ledger as _el_led
+from harp_tpu.elastic.apps import MFSGDElastic as _ElMF
+from harp_tpu.elastic.apps import elastic_fit as _el_fit
+from harp_tpu.elastic.move import regather_rows as _el_regather
+from harp_tpu.elastic.rebalance import wasted_frac as _el_wf
+from harp_tpu.models.mfsgd import MFSGDConfig as _ElCfg
+from harp_tpu.utils import telemetry as _el_tm
+from harp_tpu.utils.checkpoint import CheckpointManager as _ElCkpt
+from harp_tpu.utils.fault import FaultInjector as _ElInj
+
+_el_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_el_root, "scripts"))
+import check_jsonl as _el_cj  # noqa: E402
+
+_el_rng = np.random.default_rng(0)
+_el_users = np.concatenate([_el_rng.integers(0, 2 * (64 // nw), 4000),
+                            _el_rng.integers(2 * (64 // nw), 64, 1000)])
+_el_rng.shuffle(_el_users)
+_el_items = _el_rng.integers(0, 48, _el_users.shape[0])
+_el_vals = _el_rng.normal(size=_el_users.shape[0]).astype(np.float32)
+_el_cfg = _ElCfg(rank=4, algo="dense", u_tile=8, i_tile=8, entry_cap=64)
+
+with _el_tm.scope(True):
+    _el_ad = _ElMF(64, 48, _el_cfg, mesh, 0, users=_el_users,
+                   items=_el_items, vals=_el_vals, packs_per_worker=8)
+    _el_before = _el_ad.worker_loads().copy()
+    assert _el_wf(_el_before) > _el_h.WASTED_FRAC_TRIGGER
+    _el_fit(_el_ad, 4)
+    # (a) numpy model of the rebalanced loads: greedy LPT (size-desc,
+    # argmin-load placement) over the measured pack loads — the exact
+    # rule SkewLedger.suggest_rebalance applies
+    _el_pl = _el_ad.packs.loads(_el_users)
+    _el_lpt = np.zeros(nw)
+    for _el_pid in sorted(range(len(_el_pl)),
+                          key=lambda p: (-_el_pl[p], p)):
+        _el_lpt[int(_el_lpt.argmin())] += _el_pl[_el_pid]
+    np.testing.assert_allclose(sorted(_el_ad.worker_loads()),
+                               sorted(_el_lpt))
+    assert _el_wf(_el_ad.worker_loads()) < _el_h.WASTED_FRAC_TRIGGER
+    (_el_reb,) = [r for r in _el_led.ledger.rows
+                  if r["event"] == "rebalance"]
+    assert _el_reb["wasted_frac_after"] < _el_reb["wasted_frac_before"]
+    assert sum(_el_reb["loads_after"]) == sum(_el_reb["loads_before"])
+    # the handshake spent the fire: nothing left to consume
+    assert _el_h.monitor.consume_skew_trigger(_el_ad.phase) is None
+
+    # (b) reshard-wire row move vs numpy fancy indexing
+    _el_x = mesh.shard_array(
+        _el_rng.normal(size=(8 * nw, 3)).astype(np.float32), 0)
+    _el_rows = _el_rng.integers(-1, 8 * nw, 2 * 8 * nw)
+    _el_got = np.asarray(_el_regather(mesh, _el_x, _el_rows))
+    _el_ref = np.where((_el_rows >= 0)[:, None],
+                       np.asarray(_el_x)[np.maximum(_el_rows, 0)], 0.0)
+    np.testing.assert_array_equal(_el_got, _el_ref)
+
+    # (c) permanent loss at seeded dispatch ordinal 2 -> shrink -> the
+    # continuation is BIT-identical to survivors-only from the ckpt
+    _el_dir = _el_tmp.mkdtemp()
+    _el_ck = os.path.join(_el_dir, "ck")
+    _el_inj = _ElInj(seed=0, permanent={"dispatch": (2,)},
+                     lost_worker=nw - 1)
+    _el_ad2 = _ElMF(64, 48, _el_cfg, mesh, 0, users=_el_users,
+                    items=_el_items, vals=_el_vals, max_worker_loss=1)
+    _el_fit(_el_ad2, 3, _el_ck, ckpt_every=1, fault=_el_inj,
+            rebalance=False)
+    assert _el_inj.permanent_fired
+    assert _el_ad2.mesh.num_workers == nw - 1
+    _el_events = [r["event"] for r in _el_led.ledger.rows]
+    assert _el_events == ["rebalance", "shrink", "resume"], _el_events
+    _el_step, _el_state = _ElCkpt(_el_ck).restore(0)
+    _el_surv = mesh.survivors(nw - 1)
+    _el_ad3 = _ElMF(64, 48, _el_cfg, _el_surv, 0, users=_el_users,
+                    items=_el_items, vals=_el_vals)
+    _el_ad3.install(_el_state)
+    for _el_i in range(_el_step + 1, 3):
+        _el_ad3.train_one()
+    np.testing.assert_array_equal(_el_ad2.canonical_state()["W"],
+                                  _el_ad3.canonical_state()["W"])
+    np.testing.assert_array_equal(_el_ad2.canonical_state()["H"],
+                                  _el_ad3.canonical_state()["H"])
+    # the comparison adapter's install adds its OWN resume row (it is
+    # the same restore path) — the export below carries all four
+    assert [r["event"] for r in _el_led.ledger.rows][-1] == "resume"
+
+    # (d) the export passes EVERY checker invariant as one file
+    _el_out = os.path.join(_el_dir, "run.jsonl")
+    _el_tm.export(_el_out)
+_el_errs = _el_cj.check_file(_el_out, provenance=True)
+assert _el_errs == [], _el_errs
+
+# CLI round trip in a subprocess (the --elastic knob end to end)
+_el_env = dict(os.environ)
+_el_env["JAX_PLATFORMS"] = ""
+_el_code = (
+    "import os\n"
+    "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS','') + "
+    "' --xla_force_host_platform_device_count=8'\n"
+    "import jax\n"
+    "jax.config.update('jax_platforms','cpu')\n"
+    "import harp_tpu.__main__ as cli\n"
+    "raise SystemExit(cli.main(['kmeans-stream', '--elastic', '--n',"
+    " '256', '--d', '4', '--k', '3', '--iters', '2']))\n")
+_el_cli = _el_sp.run([sys.executable, "-c", _el_code],
+                     capture_output=True, text=True, timeout=600,
+                     env=_el_env, cwd=_el_root)
+assert _el_cli.returncode == 0, _el_cli.stderr[-800:]
+_el_row = _el_json.loads(_el_cli.stdout.strip().splitlines()[-1])
+assert _el_row["config"] == "kmeans_stream_elastic_cli"
+assert _el_row["worker_losses"] == 0 and np.isfinite(_el_row["inertia"])
+
+print(f"elastic: rebalance {round(_el_wf(_el_before), 3)} -> "
+      f"{round(_el_wf(_el_ad.worker_loads()), 4)} (numpy LPT match), "
+      f"regather bit-exact, loss at ordinal 2 shrank {nw} -> {nw - 1} "
+      "bit-identical to survivors-only, export checker-clean, CLI "
+      f"inertia {round(_el_row['inertia'], 1)}")
+print(f"DRIVE OK round-35 ({mode})")

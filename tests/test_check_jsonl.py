@@ -953,3 +953,110 @@ def test_golden_health_fixture_is_clean_and_summarizes():
     assert s["findings"] == 4
     assert s["worst_severity"] == "page"
     assert s["actionable"] == 3  # page + warn + warn; confirmed is info
+
+
+# ---------------------------------------------------------------------------
+# Invariant 14: elastic rows (PR 15)
+# ---------------------------------------------------------------------------
+
+_ESTAMP = {"backend": "cpu", "date": "2026-08-05", "commit": "abc1234"}
+
+
+def _elastic_row(event="rebalance", **over):
+    base = {
+        "rebalance": {"kind": "elastic", "event": "rebalance",
+                      "phase": "mfsgd.epochs", "n_workers": 8, "moves": 3,
+                      "loads_before": [4000.0] + [150.0] * 7,
+                      "loads_after": [640.0, 630.0] + [630.0] * 6,
+                      "total": 5050.0, "wasted_frac_before": 0.84,
+                      "wasted_frac_after": 0.02, "trigger_supersteps": 3,
+                      **_ESTAMP},
+        "shrink": {"kind": "elastic", "event": "shrink",
+                   "phase": "mfsgd.epochs", "lost_worker": 3,
+                   "site": "dispatch", "ordinal": 2,
+                   "n_workers_before": 8, "n_workers_after": 7,
+                   "capacity_frac": 0.875, **_ESTAMP},
+        "resume": {"kind": "elastic", "event": "resume",
+                   "phase": "mfsgd.epochs", "n_workers": 7, "from_step": 0,
+                   "loads": [721.0] * 7, "total": 5047.0,
+                   "wasted_frac": 0.0, "replayed_plan": True, **_ESTAMP},
+    }[event]
+    base = dict(base)
+    base.update(over)
+    return base
+
+
+def _elastic_errs(row):
+    return check_jsonl._check_elastic_row("t", 1, row)
+
+
+def test_elastic_rows_valid_round_trip(tmp_path):
+    # fix the resume loads to actually sum to total
+    resume = _elastic_row("resume", loads=[721.0] * 7, total=5047.0)
+    rows = [_elastic_row("rebalance",
+                         loads_before=[4000.0] + [150.0] * 7,
+                         loads_after=[631.25] * 8, total=5050.0),
+            _elastic_row("shrink"), resume]
+    p = tmp_path / "rows.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_elastic_row_requires_stamp_and_event_vocab():
+    row = _elastic_row("shrink")
+    del row["backend"]
+    assert any("provenance" in e for e in _elastic_errs(row))
+    grow = _elastic_row("shrink")
+    grow["event"] = "grow"
+    assert any("event='grow'" in e for e in _elastic_errs(grow))
+
+
+def test_elastic_rebalance_row_forgeries_fire():
+    ok = _elastic_row("rebalance",
+                      loads_before=[4000.0] + [150.0] * 7,
+                      loads_after=[631.25] * 8, total=5050.0)
+    assert _elastic_errs(ok) == []
+    # loads not summing to total
+    assert any("conserve work" in e for e in _elastic_errs(
+        _elastic_row("rebalance", loads_after=[1.0] * 8)))
+    # loads without a total
+    bad = _elastic_row("rebalance")
+    del bad["total"]
+    assert any("total" in e for e in _elastic_errs(bad))
+    # negative / non-list loads
+    assert any("non-negative" in e for e in _elastic_errs(
+        _elastic_row("rebalance", loads_before=[-1.0] * 8,
+                     total=-8.0)))
+    assert any("non-empty list" in e for e in _elastic_errs(
+        _elastic_row("rebalance", loads_before="heavy")))
+    # a "rebalance" that made things worse
+    assert any("worse" in e for e in _elastic_errs(
+        _elastic_row("rebalance", wasted_frac_before=0.1,
+                     wasted_frac_after=0.5,
+                     loads_before=[631.25] * 8,
+                     loads_after=[631.25] * 8)))
+    # missing before/after evidence entirely
+    nofrac = _elastic_row("rebalance", loads_before=[631.25] * 8,
+                          loads_after=[631.25] * 8)
+    del nofrac["wasted_frac_before"]
+    assert any("before/after" in e.lower() or "before AND after" in e
+               for e in _elastic_errs(nofrac))
+    # fractions outside [0, 1]
+    assert any("[0, 1]" in e for e in _elastic_errs(
+        _elastic_row("rebalance", wasted_frac_after=1.5)))
+
+
+def test_elastic_shrink_row_needs_strictly_fewer_survivors():
+    assert _elastic_errs(_elastic_row("shrink")) == []
+    assert any("survivor count" in e for e in _elastic_errs(
+        _elastic_row("shrink", n_workers_after=8)))
+    assert any("survivor count" in e for e in _elastic_errs(
+        _elastic_row("shrink", n_workers_before=None)))
+    assert any("lost_worker=-1" in e for e in _elastic_errs(
+        _elastic_row("shrink", lost_worker=-1)))
+
+
+def test_elastic_vocab_in_sync_with_elastic_module():
+    import harp_tpu.elastic as E
+
+    assert tuple(E.EVENTS) == check_jsonl.KNOWN_ELASTIC_EVENTS

@@ -617,3 +617,77 @@ def test_health_cli_grade_model_emits_checker_clean_row(capsys):
     assert row["verdict"] == "confirmed"
     assert check_jsonl._check_health_row("t", 1, row) == []
     health.monitor.reset()
+
+
+def test_elastic_cli_knobs_bind_without_executing(capsys, monkeypatch):
+    """PR-15 satellite: --elastic / --max-worker-loss on the mfsgd /
+    lda / kmeans-stream apps forward into the elastic fit entries.
+    Each entry is stubbed with a signature-binding stub (the
+    measure_all full-mode pattern), so a typo'd or removed kwarg in the
+    CLI wiring fails HERE — without training anything."""
+    import inspect
+
+    import harp_tpu.elastic.apps as EA
+
+    calls = []
+
+    def stubbed(attr):
+        real = getattr(EA, attr)
+        sig = inspect.signature(real)
+
+        class _Ad:
+            losses = 0
+
+            class mesh:
+                num_workers = 8
+
+            def metric(self):
+                return 1.0
+
+        def stub(*a, **kw):
+            sig.bind(*a, **kw)  # TypeError on any rejected kwarg
+            calls.append(attr)
+            return _Ad()
+
+        monkeypatch.setattr(EA, attr, stub)
+
+    for attr in ("mfsgd_elastic_fit", "lda_elastic_fit",
+                 "kmeans_stream_elastic_fit"):
+        stubbed(attr)
+
+    assert cli.main(["mfsgd", "--elastic", "--users", "32", "--items",
+                     "16", "--nnz", "64", "--epochs", "1",
+                     "--max-worker-loss", "1"]) == 0
+    assert "mfsgd_elastic_cli" in capsys.readouterr().out
+    assert cli.main(["lda", "--elastic", "--docs", "16", "--vocab",
+                     "16", "--topics", "2", "--tokens-per-doc", "4",
+                     "--epochs", "1"]) == 0
+    assert "lda_elastic_cli" in capsys.readouterr().out
+    assert cli.main(["kmeans-stream", "--elastic", "--n", "64", "--d",
+                     "4", "--k", "2", "--iters", "1"]) == 0
+    assert "kmeans_stream_elastic_cli" in capsys.readouterr().out
+    assert calls == ["mfsgd_elastic_fit", "lda_elastic_fit",
+                     "kmeans_stream_elastic_fit"]
+
+    # --elastic refuses file inputs loudly (no silent non-elastic fit)
+    import pytest
+
+    with pytest.raises(SystemExit, match="synthetic"):
+        cli.main(["mfsgd", "--elastic", "--input", "nope.txt"])
+
+
+def test_elastic_cli_kmeans_stream_smoke(capsys, tmp_path):
+    """One real end-to-end elastic CLI run (the cheapest app): prints a
+    JSON row with the elastic fields."""
+    import json
+
+    rc = cli.main(["kmeans-stream", "--elastic", "--n", "256", "--d",
+                   "4", "--k", "3", "--iters", "2",
+                   "--ckpt-dir", str(tmp_path / "ck")])
+    assert rc == 0
+    import numpy as np
+
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["config"] == "kmeans_stream_elastic_cli"
+    assert row["n_workers"] == 8 and row["worker_losses"] == 0
+    assert np.isfinite(row["inertia"])

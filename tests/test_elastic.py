@@ -1,0 +1,503 @@
+"""Elastic execution (harp_tpu/elastic, PR 15) — acting on the skew
+trigger mid-run and surviving permanent worker loss without a restart.
+
+Evidence layers, all on the 8-worker CPU sim:
+
+1. pack/remap machinery: home assignment reproduces the non-elastic
+   layout exactly; remaps are bijections; the reshard-wire row move
+   equals the host gather bit-for-bit;
+2. the sentinel↔driver handshake: a latched ``skew_trigger`` is
+   consumed EXACTLY once per fire (no double-apply), re-arms on latch
+   release, and no-ops with telemetry off (the PR-3 zero-cost pin);
+3. THE skew drill (ISSUE 15): on a deliberately skewed corpus the
+   driver consumes the fired trigger, the SkewLedger after-evidence
+   drops below the 0.25 trigger threshold, and the final model metric
+   stays within the app's flip-decision gate (rmse rel 1% / LL abs
+   0.05) vs the non-elastic run — for BOTH flagship rotation apps;
+4. THE worker-loss drill (ISSUE 15): an injected permanent fault at a
+   seeded ordinal shrinks the mesh to the survivors, the resume
+   replays the repartition plan from the last crash-atomic checkpoint,
+   training completes, and the result is BIT-identical to an
+   uninterrupted survivors-only run from the same checkpoint;
+5. the evidence: every drill's ``kind:"elastic"`` rows pass
+   scripts/check_jsonl.py invariant 14 inside a full telemetry export.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from harp_tpu import health
+from harp_tpu.elastic import ledger as eledger
+from harp_tpu.elastic.rebalance import (IdRemap, Packs, maybe_rebalance,
+                                        wasted_frac)
+from harp_tpu.utils import skew, telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import check_jsonl  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Packs / IdRemap / regather
+# ---------------------------------------------------------------------------
+
+def test_packs_home_assignment_is_identity_remap():
+    """The home assignment must reproduce the partitioners' block
+    layout EXACTLY — elastic mode with no trigger is the plain fit."""
+    packs = Packs(64, 8, per_worker=2)
+    assert packs.n_packs == 16 and packs.width == 4
+    rm = IdRemap(packs, packs.home_assignment(), 8)
+    np.testing.assert_array_equal(rm.fwd, np.arange(64))
+    np.testing.assert_array_equal(rm.inv, np.arange(64))
+    assert rm.new_n == 64
+
+
+def test_idremap_is_a_bijection_under_any_assignment():
+    rng = np.random.default_rng(3)
+    packs = Packs(61, 8, per_worker=3)  # ragged id space
+    asg = rng.integers(0, 5, packs.n_packs)  # onto FEWER workers
+    rm = IdRemap(packs, asg, 5)
+    assert (np.sort(rm.inv[rm.fwd]) == np.arange(61)).all()
+    # every id lands on its pack's planned owner under block partition
+    owner = rm.fwd // rm.bound
+    np.testing.assert_array_equal(owner, asg[packs.pack_of(np.arange(61))])
+
+
+def test_regather_rows_matches_host_gather(mesh):
+    """The reshard-wire move is bit-exact vs the host permutation, pads
+    (-1) zero-fill, and the CommLedger sees exactly one reshard site."""
+    from harp_tpu.elastic.move import regather_rows
+
+    rng = np.random.default_rng(0)
+    host = rng.normal(size=(32, 4)).astype(np.float32)
+    x = mesh.shard_array(host, 0)
+    rows = np.array([5, -1, 0, 31, 7, 7, -1, 2] * 5, np.int64)  # 40 rows
+    with telemetry.scope(True):
+        out = np.asarray(regather_rows(mesh, x, rows))
+        assert telemetry.ledger.bytes_per_execution("elastic.regather") > 0
+    ref = np.where((rows >= 0)[:, None], host[np.maximum(rows, 0)], 0.0)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_regather_rejects_non_worker_multiple(mesh):
+    from harp_tpu.elastic.move import regather_rows
+
+    x = mesh.shard_array(np.zeros((16, 2), np.float32), 0)
+    with pytest.raises(ValueError, match="multiple"):
+        regather_rows(mesh, x, np.arange(9))
+
+
+# ---------------------------------------------------------------------------
+# The sentinel↔driver handshake
+# ---------------------------------------------------------------------------
+
+def _fire_trigger(phase="p", units=None):
+    for _ in range(health.TRIGGER_SUPERSTEPS):
+        skew.record_execution(phase, [10, 2, 2, 2], unit="u",
+                              units=units)
+
+
+def test_consume_skew_trigger_exactly_once_then_rearms():
+    with telemetry.scope(True):
+        assert health.monitor.consume_skew_trigger("p") is None  # unfired
+        _fire_trigger()
+        row = health.monitor.consume_skew_trigger("p")
+        assert row is not None and row["detector"] == "skew_trigger"
+        assert row["consumed"] is True
+        # exactly once: a still-latched phase hands nothing more out
+        assert health.monitor.consume_skew_trigger("p") is None
+        skew.record_execution("p", [10, 2, 2, 2], unit="u")  # still skewed
+        assert health.monitor.consume_skew_trigger("p") is None
+        # latch release re-arms: a NEW fire hands a fresh plan
+        skew.record_execution("p", [4, 4, 4, 4], unit="u")
+        _fire_trigger()
+        assert health.monitor.consume_skew_trigger("p") is not None
+        assert health.monitor.consume_skew_trigger("p") is None
+
+
+def test_consume_skew_trigger_noop_with_telemetry_off():
+    """The zero-cost pin (PR-3 pattern): the acting half no-ops too."""
+    with telemetry.scope(True):
+        _fire_trigger()
+    telemetry.enable(False)
+    try:
+        assert health.monitor.consume_skew_trigger("p") is None
+    finally:
+        telemetry.enable(False)
+
+
+def test_execution_units_make_the_trigger_plan_whole_unit():
+    """record_execution(units=...) (PR 15) gives the fired plan 'id'
+    moves — the shape apply_rebalance replays; without units the plan
+    stays fractional (the PR-14 behavior, unchanged)."""
+    from harp_tpu import schedule
+
+    units = [[("a", 6.0), ("b", 4.0)], [("c", 2.0)], [("d", 2.0)],
+             [("e", 2.0)]]
+    with telemetry.scope(True):
+        _fire_trigger("pu", units=units)
+        plan = health.monitor.consume_skew_trigger("pu")["plan"]
+        assert plan["moves"] and all("id" in m for m in plan["moves"])
+        new = schedule.apply_rebalance(
+            [["a", "b"], ["c"], ["d"], ["e"]], plan)
+        assert sorted(x for lst in new for x in lst) == list("abcde")
+
+
+# ---------------------------------------------------------------------------
+# THE skew drill — Layer 1 acceptance
+# ---------------------------------------------------------------------------
+
+def _skewed_ratings(n_users=64, n_items=48, rng=None):
+    """Rating rows concentrated on the first two workers' users (the
+    powerlaw pattern): worker loads ~[2000, 2000, 160, ...]."""
+    rng = rng or np.random.default_rng(0)
+    hot = rng.integers(0, 16, 4000)
+    cold = rng.integers(16, n_users, 1000)
+    users = np.concatenate([hot, cold])
+    rng.shuffle(users)
+    items = rng.integers(0, n_items, users.shape[0])
+    vals = rng.normal(size=users.shape[0]).astype(np.float32)
+    return users, items, vals
+
+
+def test_mfsgd_skew_drill_rebalances_below_threshold(mesh, tmp_path):
+    """ISSUE 15 acceptance: trigger fired -> consumed -> wasted_frac
+    below the 0.25 threshold in the SkewLedger after-evidence -> final
+    rmse within the flip gate (rel 1%) of the non-elastic run -> the
+    full export (skew + health + elastic rows) passes the checker."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig
+
+    users, items, vals = _skewed_ratings()
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    epochs = 5
+    with telemetry.scope(True):
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals, packs_per_worker=8)
+        assert wasted_frac(ad.worker_loads()) > health.WASTED_FRAC_TRIGGER
+        elastic_fit(ad, epochs)
+        # the trigger fired, was consumed, and the move landed
+        rows = [r for r in eledger.ledger.rows if r["event"] == "rebalance"]
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["wasted_frac_before"] > health.WASTED_FRAC_TRIGGER
+        assert r["wasted_frac_after"] < health.WASTED_FRAC_TRIGGER
+        assert sum(r["loads_after"]) == sum(r["loads_before"])
+        # the SkewLedger AFTER-evidence: the post-rebalance supersteps
+        # recorded balanced per-worker work
+        after = skew.ledger.summary()["mfsgd.epochs"]
+        assert after["wasted_frac"] < health.WASTED_FRAC_TRIGGER
+        rmse_elastic = ad.metric()
+        p = tmp_path / "drill.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+    # flip-gate parity vs the non-elastic run (rmse rel 1%)
+    m = MFSGD(64, 48, cfg, mesh, 0)
+    m.set_ratings(users, items, vals)
+    for _ in range(epochs):
+        m.train_epoch()
+    rmse_plain = m.predict_rmse(users, items, vals)
+    assert abs(rmse_elastic - rmse_plain) / rmse_plain < 0.01
+
+
+def test_lda_skew_drill_rebalances_below_threshold(mesh):
+    """The LDA arm of the acceptance drill: powerlaw doc lengths, chain
+    preserved across the move (counts rebuild exactly from the token
+    multiset), final LL within the flip gate (abs 0.05)."""
+    from harp_tpu.elastic.apps import LDAElastic, elastic_fit
+    from harp_tpu.models.lda import LDA, LDAConfig
+
+    rng = np.random.default_rng(0)
+    n_docs, vocab = 64, 64
+    lens = np.where(np.arange(n_docs) < 16, 200, 20)  # 10x-long docs
+    d_ids = np.repeat(np.arange(n_docs), lens).astype(np.int32)
+    w_ids = rng.integers(0, vocab, d_ids.shape[0]).astype(np.int32)
+    cfg = LDAConfig(n_topics=4, algo="dense", d_tile=8, w_tile=8,
+                    entry_cap=64, sampler="gumbel", rng_impl="threefry")
+    epochs = 5
+    with telemetry.scope(True):
+        ad = LDAElastic(n_docs, vocab, cfg, mesh, 0, doc_ids=d_ids,
+                        word_ids=w_ids, packs_per_worker=8)
+        assert wasted_frac(ad.worker_loads()) > health.WASTED_FRAC_TRIGGER
+        elastic_fit(ad, epochs)
+        rows = [r for r in eledger.ledger.rows if r["event"] == "rebalance"]
+        assert len(rows) == 1
+        assert rows[0]["wasted_frac_after"] < health.WASTED_FRAC_TRIGGER
+        after = skew.ledger.summary()["lda.epochs"]
+        assert after["wasted_frac"] < health.WASTED_FRAC_TRIGGER
+        ll_elastic = ad.metric()
+
+    m = LDA(n_docs, vocab, cfg, mesh, 0)
+    m.set_tokens(d_ids, w_ids)
+    for _ in range(epochs):
+        m.sample_epoch()
+    assert abs(ll_elastic - m.log_likelihood()) < 0.05
+
+
+def test_rebalance_refused_when_packs_too_coarse(mesh):
+    """A plan that cannot improve (one giant indivisible pack) is
+    consumed but NOT applied — no thrash, no lying evidence row."""
+    from harp_tpu.elastic.apps import MFSGDElastic
+
+    rng = np.random.default_rng(0)
+    users = np.concatenate([rng.integers(0, 8, 4000),       # ONE pack
+                            rng.integers(8, 64, 200)])
+    items = rng.integers(0, 48, users.shape[0])
+    vals = rng.normal(size=users.shape[0]).astype(np.float32)
+    from harp_tpu.models.mfsgd import MFSGDConfig
+
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    with telemetry.scope(True):
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals, packs_per_worker=1)
+        before = ad.assignment.copy()
+        for _ in range(health.TRIGGER_SUPERSTEPS):
+            ad.train_one()
+        assert maybe_rebalance(ad) is None  # consumed, refused
+        np.testing.assert_array_equal(ad.assignment, before)
+        assert eledger.ledger.rows == []
+        # and the handshake already spent the fire: no double-consume
+        assert health.monitor.consume_skew_trigger(ad.phase) is None
+
+
+def test_elastic_home_layout_matches_plain_fit_bitwise(mesh):
+    """With no trigger (balanced corpus), the elastic adapter IS the
+    plain driver: identical factors after the same epochs."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGD, MFSGDConfig
+
+    rng = np.random.default_rng(5)
+    users = rng.integers(0, 64, 1500)
+    items = rng.integers(0, 48, 1500)
+    vals = rng.normal(size=1500).astype(np.float32)
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                      vals=vals)
+    elastic_fit(ad, 3)
+    m = MFSGD(64, 48, cfg, mesh, 0)
+    m.set_ratings(users, items, vals)
+    for _ in range(3):
+        m.train_epoch()
+    W_e = ad.canonical_state()["W"]
+    W_p, H_p = m.factors()
+    np.testing.assert_array_equal(W_e, W_p)
+    np.testing.assert_array_equal(ad.canonical_state()["H"], H_p)
+
+
+# ---------------------------------------------------------------------------
+# THE worker-loss drill — Layer 2 acceptance
+# ---------------------------------------------------------------------------
+
+def _uniform_ratings(rng):
+    users = rng.integers(0, 64, 2000)
+    items = rng.integers(0, 48, 2000)
+    vals = rng.normal(size=2000).astype(np.float32)
+    return users, items, vals
+
+
+def test_mfsgd_worker_loss_drill_bit_identical(mesh, tmp_path):
+    """ISSUE 15 acceptance: permanent fault at a seeded dispatch
+    ordinal -> mesh shrinks to the survivors -> resume replays the
+    repartition plan from the last crash-atomic checkpoint -> training
+    completes BIT-identical (assert_array_equal) to an uninterrupted
+    survivors-only run from the same checkpoint; the run's elastic
+    rows pass invariant 14."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGDConfig
+    from harp_tpu.parallel.mesh import WorkerMesh
+    from harp_tpu.utils.checkpoint import CheckpointManager
+    from harp_tpu.utils.fault import FaultInjector
+
+    users, items, vals = _uniform_ratings(np.random.default_rng(1))
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    ck = str(tmp_path / "ck")
+    lost = 3
+    with telemetry.scope(True):
+        inj = FaultInjector(seed=0, permanent={"dispatch": (2,)},
+                            lost_worker=lost)
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals, max_worker_loss=1)
+        elastic_fit(ad, 3, ck, ckpt_every=1, fault=inj, rebalance=False)
+        assert inj.permanent_fired and ad.losses == 1
+        assert ad.mesh.num_workers == mesh.num_workers - 1
+        events = [r["event"] for r in eledger.ledger.rows]
+        assert events == ["shrink", "resume"]
+        shrink = eledger.ledger.rows[0]
+        assert shrink["lost_worker"] == lost
+        assert shrink["n_workers_after"] == shrink["n_workers_before"] - 1
+        resume = eledger.ledger.rows[1]
+        assert resume["replayed_plan"] is True
+        assert resume["n_workers"] == 7
+        st_e = ad.canonical_state()
+        p = tmp_path / "loss.jsonl"
+        telemetry.export(str(p))
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+    # the uninterrupted survivors-only run from the SAME checkpoint:
+    # the fault fired during epoch 1, so the last checkpoint is step 0
+    step, state = CheckpointManager(ck).restore(0)
+    assert step == 0
+    surv = WorkerMesh([d for i, d in enumerate(mesh.devices)
+                       if i != lost])
+    ad2 = MFSGDElastic(64, 48, cfg, surv, 0, users=users, items=items,
+                       vals=vals)
+    ad2.install(state)
+    for _ in range(step + 1, 3):
+        ad2.train_one()
+    st_c = ad2.canonical_state()
+    np.testing.assert_array_equal(st_e["W"], st_c["W"])
+    np.testing.assert_array_equal(st_e["H"], st_c["H"])
+
+
+def test_lda_worker_loss_drill_bit_identical(mesh, tmp_path):
+    """The LDA arm: the canonical token-multiset state (z + key chain)
+    restores onto the survivor mesh and the continued chain is
+    bit-identical to the survivors-only continuation."""
+    from harp_tpu.elastic.apps import LDAElastic, elastic_fit
+    from harp_tpu.models.lda import LDAConfig
+    from harp_tpu.parallel.mesh import WorkerMesh
+    from harp_tpu.utils.checkpoint import CheckpointManager
+    from harp_tpu.utils.fault import FaultInjector
+
+    rng = np.random.default_rng(2)
+    d_ids = np.repeat(np.arange(48), 24).astype(np.int32)
+    w_ids = rng.integers(0, 48, d_ids.shape[0]).astype(np.int32)
+    cfg = LDAConfig(n_topics=4, algo="dense", d_tile=8, w_tile=8,
+                    entry_cap=64, sampler="gumbel", rng_impl="threefry")
+    ck = str(tmp_path / "ck")
+    with telemetry.scope(True):
+        inj = FaultInjector(seed=0, permanent={"dispatch": (2,)},
+                            lost_worker=5)
+        ad = LDAElastic(48, 48, cfg, mesh, 0, doc_ids=d_ids,
+                        word_ids=w_ids, max_worker_loss=1)
+        elastic_fit(ad, 3, ck, ckpt_every=1, fault=inj, rebalance=False)
+        assert inj.permanent_fired and ad.mesh.num_workers == 7
+        st_e = ad.canonical_state()
+
+    step, state = CheckpointManager(ck).restore(0)
+    surv = WorkerMesh([d for i, d in enumerate(mesh.devices) if i != 5])
+    ad2 = LDAElastic(48, 48, cfg, surv, 0, doc_ids=d_ids, word_ids=w_ids)
+    ad2.install(state)
+    for _ in range(step + 1, 3):
+        ad2.train_one()
+    st_c = ad2.canonical_state()
+    for k in ("d", "w", "z"):
+        np.testing.assert_array_equal(st_e[k], st_c[k])
+    np.testing.assert_array_equal(ad.model.doc_topic_table(),
+                                  ad2.model.doc_topic_table())
+
+
+def test_worker_loss_budget_exhausted_fails_loudly(mesh, tmp_path):
+    """max_worker_loss=0: the handler refuses and the loss propagates —
+    elasticity is opt-in capacity, not silent degradation."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGDConfig
+    from harp_tpu.utils.fault import FaultInjector, PermanentWorkerLoss
+
+    users, items, vals = _uniform_ratings(np.random.default_rng(4))
+    ad = MFSGDElastic(64, 48, MFSGDConfig(rank=4, algo="dense", u_tile=8,
+                                          i_tile=8, entry_cap=64),
+                      mesh, 0, users=users, items=items, vals=vals,
+                      max_worker_loss=0)
+    inj = FaultInjector(seed=0, permanent={"dispatch": (1,)},
+                        lost_worker=0)
+    with pytest.raises(PermanentWorkerLoss):
+        elastic_fit(ad, 2, str(tmp_path / "ck"), fault=inj,
+                    rebalance=False)
+
+
+def test_elastic_fit_refuses_fault_without_ckpt(mesh):
+    from harp_tpu.elastic.apps import KMeansStreamElastic, elastic_fit
+    from harp_tpu.utils.fault import FaultInjector
+
+    ad = KMeansStreamElastic(np.zeros((64, 4), np.float32), 2, mesh, 0)
+    with pytest.raises(ValueError, match="requires ckpt_dir"):
+        elastic_fit(ad, 1, None, fault=FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# kmeans-stream adapter
+# ---------------------------------------------------------------------------
+
+def test_kmeans_stream_elastic_matches_plain_and_survives(mesh, tmp_path):
+    """Home layout reproduces fit_streaming exactly; a rebalanced
+    (masked, padded) layout still computes exact Lloyd; a permanent
+    loss shrinks and finishes."""
+    from harp_tpu.elastic.apps import KMeansStreamElastic, elastic_fit
+    from harp_tpu.models.kmeans_stream import fit_streaming
+    from harp_tpu.utils.fault import FaultInjector
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(512, 8)).astype(np.float32)
+    ad = KMeansStreamElastic(pts, 4, mesh, 0)
+    elastic_fit(ad, 3)
+    _, inertia = fit_streaming(pts, 4, 3, 512, mesh=mesh, seed=0)
+    assert ad.metric() == pytest.approx(inertia, rel=1e-6)
+
+    # an arbitrary (uneven) assignment changes nothing numerically:
+    # pads carry mask 0, Lloyd sums are permutation-invariant
+    ad2 = KMeansStreamElastic(pts, 4, mesh, 0, packs_per_worker=2)
+    asg = ad2.packs.home_assignment()
+    asg[:3] = 7  # pile three packs onto the last worker
+    ad2.apply_assignment(asg)
+    for _ in range(3):
+        ad2.train_one()
+    assert ad2.metric() == pytest.approx(inertia, rel=1e-5)
+
+    inj = FaultInjector(seed=0, permanent={"dispatch": (3,)},
+                        lost_worker=2)
+    with telemetry.scope(True):
+        ad3 = KMeansStreamElastic(pts, 4, mesh, 0, max_worker_loss=1)
+        elastic_fit(ad3, 3, str(tmp_path / "ck"), fault=inj)
+        assert inj.permanent_fired and ad3.mesh.num_workers == 7
+    assert ad3.metric() == pytest.approx(inertia, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ledger mechanics
+# ---------------------------------------------------------------------------
+
+def test_elastic_ledger_vocab_and_export(tmp_path):
+    import harp_tpu.elastic as E
+
+    assert E.EVENTS == check_jsonl.KNOWN_ELASTIC_EVENTS
+    eledger.ledger.reset()
+    with pytest.raises(ValueError, match="event"):
+        eledger.record("grow", "p")
+    eledger.record("shrink", "p", lost_worker=1, site="dispatch",
+                   ordinal=2, n_workers_before=8, n_workers_after=7,
+                   capacity_frac=0.875)
+    p = tmp_path / "e.jsonl"
+    with open(p, "w") as fh:
+        E.export_jsonl(fh)
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+    eledger.ledger.reset()
+
+
+def test_report_grows_elastic_section(mesh):
+    """The run report carries the elastic actions (the report surface
+    of the acting half, mirroring the PR-14 health section)."""
+    from harp_tpu import report
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGDConfig
+
+    users, items, vals = _skewed_ratings()
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    with telemetry.scope(True):
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals, packs_per_worker=8)
+        elastic_fit(ad, 4)
+        row, _ = report.live_report()
+        assert row["elastic"]["by_event"] == {"rebalance": 1}
+        text = report.render(row)
+        assert "elastic (actions)" in text and "[rebalance]" in text

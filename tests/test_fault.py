@@ -520,3 +520,112 @@ def test_ccd_fit_checkpoint_resume(mesh, tmp_path):
 
     with pytest.raises(ValueError, match="refusing to resume"):
         make_model(rank=8).fit(4, ckpt, ckpt_every=2)
+
+
+# ---------------------------------------------------------------------------
+# Permanent-fault site (PR 15)
+# ---------------------------------------------------------------------------
+
+def test_permanent_exact_ordinal_fires_once_and_reproduces():
+    """The permanent schedule honors the fail= contract's exact
+    1-based ordinals (the worker-loss drill pin), fires AT MOST once,
+    and replays identically for the same seed + event sequence."""
+    from harp_tpu.utils.fault import FaultInjector, PermanentWorkerLoss
+
+    def run():
+        inj = FaultInjector(seed=3, permanent={"dispatch": (4,)},
+                            lost_worker=2)
+        fired = []
+        for i in range(1, 9):
+            try:
+                inj.on_event("dispatch")
+            except PermanentWorkerLoss as e:
+                fired.append((e.site, e.ordinal, e.worker))
+        return fired, inj
+
+    fired, inj = run()
+    assert fired == [("dispatch", 4, 2)]  # exactly once, at ordinal 4
+    assert inj.permanent_fired and inj.injected["dispatch"] == 1
+    assert run()[0] == fired  # seeded reproducibility
+
+
+def test_permanent_probability_spec_is_seed_reproducible():
+    from harp_tpu.utils.fault import FaultInjector, PermanentWorkerLoss
+
+    def first_fire(seed):
+        inj = FaultInjector(seed=seed, permanent={"dispatch": 0.3},
+                            lost_worker=1)
+        for i in range(1, 64):
+            try:
+                inj.on_event("dispatch")
+            except PermanentWorkerLoss as e:
+                return e.ordinal
+        return None
+
+    a = first_fire(7)
+    assert a is not None and a == first_fire(7)
+    assert {first_fire(s) for s in range(5)} != {a}  # seed matters
+
+
+def test_permanent_spec_validation():
+    from harp_tpu.utils.fault import FaultInjector
+
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultInjector(permanent={"nope": (1,)}, lost_worker=0)
+    with pytest.raises(ValueError, match="lost_worker"):
+        FaultInjector(permanent={"dispatch": (1,)})
+
+
+def test_permanent_is_not_a_transient_injected_fault():
+    """Serve retry layers classify InjectedFault as transient; a
+    permanent loss must never match that except clause."""
+    from harp_tpu.utils.fault import (InjectedFault, PermanentWorkerLoss,
+                                      WorkerFailure)
+
+    e = PermanentWorkerLoss("dispatch", 2, 5)
+    assert isinstance(e, WorkerFailure)
+    assert not isinstance(e, InjectedFault)
+    assert e.worker == 5
+
+
+def test_run_with_recovery_reraises_permanent_without_handler(tmp_path):
+    """Without on_permanent, a permanent loss must NOT burn restarts in
+    a same-mesh crash loop — it re-raises immediately."""
+    from harp_tpu.utils.checkpoint import CheckpointManager
+    from harp_tpu.utils.fault import (PermanentWorkerLoss,
+                                      run_with_recovery)
+
+    calls = []
+
+    def step(i, state):
+        calls.append(i)
+        raise PermanentWorkerLoss("dispatch", i + 1, 0)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with pytest.raises(PermanentWorkerLoss):
+        run_with_recovery(lambda: 0, step, 3, mgr, max_restarts=3)
+    assert calls == [0]  # no retry happened
+
+
+def test_run_with_recovery_on_permanent_resumes(tmp_path):
+    """With a handler, the loop resumes from the latest checkpoint and
+    permanent losses do not consume max_restarts."""
+    from harp_tpu.utils.checkpoint import CheckpointManager
+    from harp_tpu.utils.fault import (PermanentWorkerLoss,
+                                      run_with_recovery)
+
+    handled = []
+    fire = {"armed": True}
+
+    def step(i, state):
+        if i == 1 and fire["armed"]:
+            fire["armed"] = False
+            raise PermanentWorkerLoss("dispatch", 2, 4)
+        return state + 1
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    out = run_with_recovery(lambda: 0, step, 3, mgr, ckpt_every=1,
+                            max_restarts=0,  # a plain restart would raise
+                            on_permanent=handled.append)
+    assert out == 3
+    assert [e.worker for e in handled] == [4]

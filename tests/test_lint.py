@@ -539,6 +539,60 @@ def test_hl303_sabotaged_retry_redispatching_donated_buffer_fires(mesh):
                for v in audit.violations)
 
 
+def test_hl303_elastic_rebalance_restage_protocol_is_clean():
+    """The PR-15 elastic survival protocol: a permanent worker loss
+    mid-loop, shrink to survivors, every post-shrink dispatch through a
+    FRESHLY restaged buffer — clean under the donation audit, and the
+    drive asserts the loss fired (never vacuously green)."""
+    from harp_tpu.analysis.drivers import PROTOCOLS
+
+    assert "elastic.rebalance_restage" in PROTOCOLS
+    drive = PROTOCOLS["elastic.rebalance_restage"]()
+    vs = commgraph.audit_protocol("elastic.rebalance_restage", drive)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_hl303_sabotaged_shrink_reusing_preloss_buffer_fires(mesh):
+    """The sabotaged twin of elastic.rebalance_restage: after the
+    permanent loss, the 'obvious' continuation re-dispatches the
+    PRE-SHRINK staged buffer on the survivor mesh — but that buffer was
+    already donated to the dead dispatch (and lives on a mesh that no
+    longer exists).  The CPU sim passes it silently; HL303 must not."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.parallel.mesh import WorkerMesh
+    from harp_tpu.utils import flightrec
+    from harp_tpu.utils.fault import FaultInjector, PermanentWorkerLoss
+
+    audit = commgraph.DonationAudit("protocol:sabotaged_shrink")
+
+    def build(m, tag):
+        fn = jax.jit(lambda c, x: (c + x.sum(), x * 2.0),
+                     donate_argnums=(1,))
+        return audit.wrap(flightrec.track(fn, tag), (1,), tag)
+
+    rng = np.random.default_rng(0)
+    exe = build(mesh, "b_full")
+    carry = jax.device_put(jnp.float32(0.0), mesh.replicated())
+    inj = FaultInjector(seed=0, permanent={"dispatch": (1,)},
+                        lost_worker=mesh.num_workers - 1)
+    with audit, inj.arm():
+        staged = mesh.shard_array(
+            rng.normal(size=(56, 4)).astype(np.float32), 0)
+        with contextlib.suppress(PermanentWorkerLoss):
+            exe(carry, staged)  # donated here, then the loss fires
+        surv = WorkerMesh(mesh.devices[:-1])
+        exe2 = build(surv, "b_surv")
+        carry2 = jax.device_put(jnp.float32(0.0), surv.replicated())
+        # the sabotage: continue on the survivors WITHOUT restaging
+        with contextlib.suppress(Exception):
+            exe2(carry2, staged)
+    assert any(v.rule == "HL303" and "already donated" in v.message
+               for v in audit.violations), \
+        [v.format() for v in audit.violations]
+
+
 def test_commgraph_registry_is_clean_and_covers_the_surface():
     """Every registered driver extracts a clean CommGraph (no untracked
     wire, no lying sheet, no hoistable collective), the registry covers
