@@ -48,6 +48,10 @@ APPS = {
     "predict": ("harp_tpu.perfmodel.cli",
                 "offline predictive cost model: price configs/programs, "
                 "rank flip candidates, self-grade vs committed evidence"),
+    "profile": ("harp_tpu.profile.cli",
+                "wall-attribution observatory: capture a driver run, "
+                "bucket every op into the mechanism vocabulary, "
+                "reconcile against the flightrec/CommLedger spines"),
 }
 
 

@@ -480,6 +480,68 @@ def _wdamds_smacof():
     return fn, (delta, mask, x0, n_real)
 
 
+@register_driver("rf.grow")
+def _rf_grow():
+    """Per-worker forest growth + the tree allgather (PR 16): the
+    level-wise one-hot histogram matmuls (the dense MXU formulation the
+    perfmodel's rf term prices against the 25 GB/s scatter wall,
+    measured 2026-07-30 on 1x v5e) and the forest allgather wire.
+    Gives rf a Layer-2/Layer-4 byte sheet and the wall-attribution
+    observatory a capture target."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.rf import RFConfig, make_train_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_train_fn(mesh, RFConfig(n_trees=2 * nw, max_depth=2,
+                                      n_bins=8, seed=0), n_features=8)
+    sh0 = mesh.sharding(mesh.spec(0))
+    bins = jax.ShapeDtypeStruct((16 * nw, 8), jnp.int32, sharding=sh0)
+    y = jax.ShapeDtypeStruct((16 * nw,), jnp.int32, sharding=sh0)
+    keys = jax.ShapeDtypeStruct((nw, 2, 2), jnp.uint32, sharding=sh0)
+    return fn, (bins, y, keys)
+
+
+@register_driver("subgraph.count")
+def _subgraph_count():
+    """One color-coding DP chunk over the padded CSR + exact segment
+    overflow tail, ending in the counts allreduce (PR 16).  The fn
+    comes back flightrec-tracked (tag "subgraph.count"), matching the
+    real driver loop; colors ride spec(1), everything else spec(0) —
+    the traversal gather pattern the perfmodel's subgraph term prices.
+
+    Two lint-facing constraints: the model's `_FN_CACHE` is cleared so
+    every analysis layer re-traces (a cache hit skips the Python body
+    and the CommLedger never records — HL301 fires on a wire that IS
+    verb-routed); and the trial chunk is 1 because the per-trial DP
+    allgather sits under `jax.vmap`, where the ledger records the
+    UNBATCHED payload — any larger chunk makes the static (batched)
+    sheet disagree with the ledger by exactly the chunk factor
+    (HL302)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models import subgraph as SG
+    from harp_tpu.models.subgraph import TEMPLATES, make_colorful_count_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    n_pad, deg = 8 * nw, 4
+    SG._FN_CACHE.clear()
+    fn = make_colorful_count_fn(TEMPLATES["u3-path"], 3, mesh, "segment")
+    sh0 = mesh.sharding(mesh.spec(0))
+    nbr = jax.ShapeDtypeStruct((n_pad, deg), jnp.int32, sharding=sh0)
+    msk = jax.ShapeDtypeStruct((n_pad, deg), jnp.float32, sharding=sh0)
+    o_nbr = jax.ShapeDtypeStruct((nw,), jnp.int32, sharding=sh0)
+    o_row = jax.ShapeDtypeStruct((nw,), jnp.int32, sharding=sh0)
+    o_msk = jax.ShapeDtypeStruct((nw,), jnp.float32, sharding=sh0)
+    colors = jax.ShapeDtypeStruct(
+        (1, n_pad), jnp.int32, sharding=mesh.sharding(mesh.spec(1)))
+    return fn, (nbr, msk, o_nbr, o_row, o_msk, colors)
+
+
 # ---------------------------------------------------------------------------
 # Donation-audit protocols (Layer 4, HL303)
 # ---------------------------------------------------------------------------

@@ -68,6 +68,16 @@ def _render(rows: list[dict], summary: dict) -> str:
                            if r.get("incumbent") is not None else "")
                         + (f" [model factor {r.get('model_factor')}x]"
                            if r.get("model_factor") is not None else ""))
+        elif det == "profile_drift":
+            who = r.get("app") or who
+            bits.append(
+                ("bound FLIPPED "
+                 f"{r.get('committed_bound')} -> {r.get('bound')}; "
+                 if r.get("bound_flipped") else
+                 f"bound {r.get('bound')} unchanged; ")
+                + f"worst bucket {r.get('worst_bucket')} moved "
+                  f"{r.get('share_delta')} of the wall vs committed "
+                  "attribution")
         lines.append(f"  [{sev:<4s}] {det:<20s} {who}: "
                      + "; ".join(bits))
     if not rows:
@@ -127,6 +137,7 @@ def main(argv=None) -> int:
 
     health_rows: list[dict] = []
     latest_bench: dict[str, dict] = {}
+    latest_profile: dict[str, dict] = {}
     for line in lines:
         line = line.strip()
         if not line:
@@ -139,15 +150,23 @@ def main(argv=None) -> int:
             continue
         if row.get("kind") == "health":
             health_rows.append(row)
+        elif row.get("kind") == "profile" and row.get("app"):
+            latest_profile[row["app"]] = row  # last row per app wins
         elif "config" in row:
             latest_bench[row["config"]] = row  # last row per config wins
 
     graded: list[dict] = []
-    if latest_bench and not args.no_grade_bench:
+    if (latest_bench or latest_profile) and not args.no_grade_bench:
         from harp_tpu.health import grade as HG
 
         for cfg in sorted(latest_bench):
             f = HG.grade_bench_row(latest_bench[cfg], repo)
+            if f is not None:
+                graded.append(f)
+        committed = HG.committed_profiles(repo) if latest_profile else {}
+        for app in sorted(latest_profile):
+            f = HG.grade_profile_row(latest_profile[app], repo,
+                                     committed=committed)
             if f is not None:
                 graded.append(f)
 

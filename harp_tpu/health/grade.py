@@ -130,6 +130,89 @@ def grade_bench_row(row: dict, repo: str, *, bench: dict | None = None,
     return sentinel._public(out)
 
 
+#: bucket-share drift (absolute points of the wall) at or above which a
+#: fresh profile row's attribution is a ``profile_drift`` warn — the
+#: same 10-point margin as :data:`REL_TOL`, applied to shares.
+PROFILE_SHARE_DRIFT = 0.10
+
+
+def committed_profiles(repo: str) -> dict[str, dict]:
+    """Latest committed ``kind:"profile"`` row per app
+    (PROFILE_attrib.jsonl — the PR-16 attribution baseline)."""
+    import json
+
+    out: dict[str, dict] = {}
+    path = os.path.join(repo, "PROFILE_attrib.jsonl")
+    try:
+        lines = open(path).read().splitlines()
+    except OSError:
+        return out
+    for line in lines:
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(row, dict) and row.get("kind") == "profile" \
+                and row.get("app"):
+            out[row["app"]] = row
+    return out
+
+
+def _bucket_shares(row: dict) -> dict[str, float] | None:
+    wall = row.get("wall_s")
+    terms = row.get("terms")
+    if not isinstance(terms, dict) or not wall:
+        return None
+    try:
+        return {k: float(v) / float(wall) for k, v in terms.items()}
+    except (TypeError, ValueError, ZeroDivisionError):
+        return None
+
+
+def grade_profile_row(row: dict, repo: str, *,
+                      committed: dict | None = None) -> dict | None:
+    """Judge one fresh ``kind:"profile"`` attribution row against the
+    committed baseline for its app; register and return a
+    ``profile_drift`` finding when the mechanism mix moved, or None
+    when there is no baseline or nothing drifted.
+
+    Drift = the ``bound`` (largest bucket) flipped, or any bucket's
+    share of the wall moved more than :data:`PROFILE_SHARE_DRIFT`
+    points.  Either means the perfmodel terms calibrated against the
+    old attribution are describing a program this repo no longer runs.
+    Unreconciled rows are never graded (invariant 15 already fails
+    them — grading a broken capture would attribute the breakage).
+    """
+    app = row.get("app")
+    if not app or row.get("reconciled") is not True:
+        return None
+    if committed is None:
+        committed = committed_profiles(repo)
+    base = committed.get(app)
+    if base is None or base is row:
+        return None
+    shares, base_shares = _bucket_shares(row), _bucket_shares(base)
+    if shares is None or base_shares is None:
+        return None
+    deltas = {k: shares.get(k, 0.0) - base_shares.get(k, 0.0)
+              for k in set(shares) | set(base_shares)}
+    worst = max(deltas, key=lambda k: abs(deltas[k]))
+    bound_flipped = (row.get("bound") != base.get("bound"))
+    if not bound_flipped and abs(deltas[worst]) <= PROFILE_SHARE_DRIFT:
+        return None
+    out = sentinel.monitor.upsert("profile_drift", app, severity="warn")
+    out.update({
+        "app": app, "bound": row.get("bound"),
+        "committed_bound": base.get("bound"),
+        "bound_flipped": bound_flipped,
+        "worst_bucket": worst.removesuffix("_s"),
+        "share_delta": round(abs(deltas[worst]), 4),
+        "wall_s": row.get("wall_s"),
+        "committed_wall_s": base.get("wall_s"),
+    })
+    return sentinel._public(out)
+
+
 def model_gate(repo: str) -> tuple[bool, dict]:
     """ROADMAP autotuning item (3), closed: re-run the perfmodel's full
     self-grade (``perfmodel.grade.grade`` — flip-pair directions, sweep

@@ -61,9 +61,14 @@ from typing import Any
 from harp_tpu.utils import telemetry
 
 #: frozen detector vocabulary — check_jsonl KNOWN_HEALTH_DETECTORS
-#: mirrors this tuple (drift fails tier-1)
+#: mirrors this tuple (drift fails tier-1).  ``profile_drift`` (PR 16)
+#: grades fresh ``kind:"profile"`` attribution rows against the
+#: committed PROFILE_attrib.jsonl: a flipped ``bound`` or a bucket
+#: share moving more than :data:`harp_tpu.health.grade.
+#: PROFILE_SHARE_DRIFT` points is a warn — the mechanism mix changed,
+#: so every perfmodel term calibrated against the old mix is suspect.
 DETECTORS = ("slo_burn", "skew_trigger", "budget_drift",
-             "evidence_regression")
+             "evidence_regression", "profile_drift")
 
 #: frozen severity vocabulary, mildest first.  ``info`` = recorded, no
 #: action; ``warn`` = degradation that needs a look; ``page`` = the SLO

@@ -44,11 +44,21 @@ class SVMConfig:
     # gate on train_acc (flip_decision.py); default stays exact until
     # a relay window measures them.
     sv_wire: str = "exact"
+    # dtype the [n, d] feature matrix is STAGED in (PR 16: the profile
+    # pass found the committed svm_cli wall is relay-H2D-staging-bound
+    # at ~30 MB/s, so halving staged bytes is the model's top-ranked
+    # lever — flip candidate svm_x_bf16).  Dots promote back to f32, so
+    # only the stored feature precision changes; train_acc gates the
+    # flip.  Default stays f32 until a relay window measures it.
+    x_dtype: str = "f32"
 
     def __post_init__(self):
         if self.sv_wire not in ("exact", "bf16", "int8"):
             raise ValueError(
                 f"sv_wire must be exact|bf16|int8, got {self.sv_wire!r}")
+        if self.x_dtype not in ("f32", "bf16"):
+            raise ValueError(
+                f"x_dtype must be f32|bf16, got {self.x_dtype!r}")
 
 
 def _pegasos(w, b, x, y, sample_w, cfg: SVMConfig):
@@ -190,6 +200,11 @@ class SVM:
         x = np.asarray(x, np.float32)
         y = np.asarray(y, np.float32)
         assert set(np.unique(y)) <= {-1.0, 1.0}, "labels must be ±1"
+        if self.cfg.x_dtype == "bf16":
+            # cast BEFORE sharding so the staged H2D bytes halve (the
+            # point of the knob — the wall is the staging wire, not the
+            # MXU); jnp.bfloat16 is a real numpy dtype here
+            x = x.astype(jnp.bfloat16)
         # padded rows get y=0 with weight 0: zero hinge gradient, never
         # selected as SVs (their margin is masked to +inf)
         xd, yd, sample_wd = _shard_rows(self.mesh, x, y)
@@ -223,19 +238,20 @@ class SVM:
         return float((self.predict(x) == np.asarray(y)).mean())
 
 
-def benchmark(n=500_000, d=128, mesh=None, seed=0, sv_wire="exact"):
+def benchmark(n=500_000, d=128, mesh=None, seed=0, sv_wire="exact",
+              x_dtype="f32"):
     rng = np.random.default_rng(seed)
     true_w = rng.normal(size=d).astype(np.float32)
     x = rng.normal(size=(n, d)).astype(np.float32)
     y = np.sign(x @ true_w + 0.1 * rng.normal(size=n)).astype(np.float32)
-    model = SVM(SVMConfig(sv_wire=sv_wire), mesh=mesh)
+    model = SVM(SVMConfig(sv_wire=sv_wire, x_dtype=x_dtype), mesh=mesh)
     model.fit(x, y)  # warmup: compile at full shape
     t0 = time.perf_counter()
     model.fit(x, y)
     dt = time.perf_counter() - t0
     return {"fit_sec": dt, "samples_per_sec": n / dt,
             "train_acc": model.accuracy(x[:50_000], y[:50_000]),
-            "n": n, "d": d, "sv_wire": sv_wire}
+            "n": n, "d": d, "sv_wire": sv_wire, "x_dtype": x_dtype}
 
 
 def main(argv=None):

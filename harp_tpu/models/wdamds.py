@@ -50,11 +50,21 @@ class MDSConfig:
     # wdamds_coord_bf16/_int8 gate on final_stress (flip_decision.py);
     # default stays exact until a relay window measures them.
     coord_wire: str = "exact"
+    # dtype the n² dissimilarity matrix is STAGED in (PR 16: the profile
+    # pass found the committed wdamds_cli wall is relay-H2D-staging-bound
+    # at ~30 MB/s and Δ is the dominant staged buffer — flip candidate
+    # wdamds_delta_bf16).  Arithmetic promotes back to f32 (only the
+    # stored δ precision changes); final_stress gates the flip.  Default
+    # stays f32 until a relay window measures it.
+    delta_dtype: str = "f32"
 
     def __post_init__(self):
         if self.coord_wire not in ("exact", "bf16", "int8"):
             raise ValueError(f"coord_wire must be exact|bf16|int8, got "
                              f"{self.coord_wire!r}")
+        if self.delta_dtype not in ("f32", "bf16"):
+            raise ValueError(f"delta_dtype must be f32|bf16, got "
+                             f"{self.delta_dtype!r}")
 
 
 def make_smacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
@@ -225,6 +235,11 @@ def mds(delta, cfg: MDSConfig | None = None, mesh: WorkerMesh | None = None,
     n_pad = -(-n // nw) * nw
     rows = np.zeros((n_pad, n_pad), np.float32)
     rows[:n, :n] = delta
+    if cfg.delta_dtype == "bf16":
+        # cast BEFORE sharding so the staged H2D bytes halve (the point
+        # of the knob); jnp.bfloat16 is a real numpy dtype here, and the
+        # in-program arithmetic promotes δ back to f32
+        rows = rows.astype(jnp.bfloat16)
     mask = np.zeros(n_pad, np.float32)
     mask[:n] = 1.0
     X0 = np.random.default_rng(seed).normal(size=(n_pad, cfg.dim)).astype(np.float32)
@@ -251,7 +266,8 @@ def mds(delta, cfg: MDSConfig | None = None, mesh: WorkerMesh | None = None,
     return np.asarray(X)[:n], float(np.asarray(stress))
 
 
-def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact"):
+def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact",
+              delta_dtype="f32"):
     rng = np.random.default_rng(seed)
     # 4-D points embedded into dim=3: genuinely LOSSY, so final_stress
     # is bounded away from 0 and the coord_wire flip gate's 2% relative
@@ -260,13 +276,15 @@ def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact"):
     # against ~0 refuses every wire unconditionally (vacuous gate)
     pts = rng.normal(size=(n, 4)).astype(np.float32)
     delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
-    cfg = MDSConfig(dim=3, iters=30, coord_wire=coord_wire)
+    cfg = MDSConfig(dim=3, iters=30, coord_wire=coord_wire,
+                    delta_dtype=delta_dtype)
     mds(delta, cfg, mesh, seed)  # warmup/compile
     t0 = time.perf_counter()
     X, stress = mds(delta, cfg, mesh, seed)
     dt = time.perf_counter() - t0
     return {"sec_total": dt, "iters_per_sec": cfg.iters / dt,
-            "final_stress": stress, "n": n, "coord_wire": coord_wire}
+            "final_stress": stress, "n": n, "coord_wire": coord_wire,
+            "delta_dtype": delta_dtype}
 
 
 def main(argv=None):

@@ -80,6 +80,20 @@ FAMILY_PAIRS = {
                            "iters_per_sec"),
     "mlp_grad_bf16": ("mlp", "samples_per_sec", None),
     "mlp_grad_int8": ("mlp", "samples_per_sec", None),
+    # PR 16: the attribution observatory priced the remaining half —
+    # these pairs were in CANDIDATES all along but unpriceable until
+    # the profile pass named their walls (H2D staging + the rf
+    # hist/subgraph overflow mechanisms).
+    "svm_sv_bf16": ("svm", "samples_per_sec", None),
+    "svm_sv_int8": ("svm", "samples_per_sec", None),
+    "svm_x_bf16": ("svm", "samples_per_sec", None),
+    "wdamds_coord_bf16": ("wdamds", "iters_per_sec", None),
+    "wdamds_coord_int8": ("wdamds", "iters_per_sec", None),
+    "wdamds_delta_bf16": ("wdamds", "iters_per_sec", None),
+    "rf_dense_hist": ("rf_scatter_hist", "trees_per_sec", None),
+    "subgraph_csr32": ("subgraph", "vertices_per_sec", None),
+    "subgraph_onehot": ("subgraph_pl", "vertices_per_sec", None),
+    "subgraph_1m_onehot": ("subgraph_1m", "vertices_per_sec", None),
 }
 
 #: the committed knob sweeps: name -> (config, knob, [(value, measured
@@ -288,9 +302,12 @@ def grade(repo: str | None = None, topo=None) -> dict:
 
     # 3. magnitude band ----------------------------------------------------
     for cfg, row in sorted(bench.items()):
-        if cfg not in M.CONFIG_MODELS:
+        # *_cli rows (the app CLIs' committed 2026-08-01 evidence) grade
+        # through their incumbent's model (PR 16)
+        cfg_model = M.CLI_ROW_ALIASES.get(cfg, cfg)
+        if cfg_model not in M.CONFIG_MODELS:
             continue
-        p = price(cfg, row, topo)
+        p = price(cfg_model, row, topo)
         mv = _metric_value(row, p.metric, None)
         if mv is None or mv <= 0:
             continue

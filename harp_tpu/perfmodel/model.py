@@ -126,6 +126,47 @@ LDA_ENTRY_OVERHEAD_BYTES = float(1 << 20)
 #: 4-point ranking.
 MFSGD_GRID_OVERHEAD_BYTES = float(24 << 10)
 
+#: relay-tunnel host→device staging rate — MEASURED by the committed
+#: probe_h2d row (2026-08-01: 29.9–40.5 MB/s across the 16–157 MB
+#: probes; same 30 MB/s flightrec.CALIBRATED_OVERHEADS["h2d_gbs"]
+#: pins).  The PR-16 attribution pass (python -m harp_tpu profile)
+#: priced the unpriced half of the codebase by exposing WHERE this
+#: term belongs: svm/wdamds/subgraph/rf committed metrics time
+#: fit()/count() INCLUDING the per-run shard_array staging, so their
+#: models must charge it — while the kmeans/mfsgd/lda epoch metrics
+#: stage once outside the timed region and never pay it.
+RELAY_H2D_GBS = float(CALIBRATED_OVERHEADS["h2d_gbs"])
+
+#: svm pegasos x-shard passes per (outer × inner) step: the margin
+#: read and the violator-gradient read (models/svm._pegasos) — storing
+#: the shard bf16 (x_dtype knob) halves both.
+SVM_X_PASSES_PER_STEP = 2.0
+
+#: wdamds SMACOF [n_loc, N] elementwise passes per iteration (distance
+#: write+read, ratio write+read, the two delta reads, sqrt mask) —
+#: counted from models/wdamds.make_smacof_fn; the delta reads (2 of
+#: the passes) shrink with the staged dtype (delta_dtype knob).
+WDAMDS_NN_PASSES = 7.0
+#: VPU flops per [n_loc, N] entry (sqrt + div + where + guards).
+WDAMDS_VPU_FLOPS_PER_ENTRY = 16.0
+
+#: subgraph overflow-arm constants, CALIBRATED once against the two
+#: committed segment-vs-onehot A/B deltas (BENCH_local 2026-08-01): at
+#: 100k powerlaw (719,074 overflow entries) onehot won by 0.330
+#: s/trial; at graded 1M (3,682,709 entries) segment won by 0.456
+#: s/trial.  Solving the two-term model for both deltas gives the
+#: per-overflow-entry segment-sum cost and the per-tile onehot program
+#: cost; grade.py pins the resulting direction at both scales (the
+#: round-5 joint gate refused the flip for exactly this crossover).
+SUBGRAPH_SEG_ENTRY_S = 2.162e-6
+SUBGRAPH_ONEHOT_TILE_S = 2.244e-3
+SUBGRAPH_ROW_TILE = 512.0       # models/subgraph row_tile default
+SUBGRAPH_ENTRY_TILE = 2048.0    # onehot tile entry capacity
+#: DP traversal gather width per vertex per trial: one [deg] neighbor
+#: row per template child, ~20 effective DP columns for graded u5-tree.
+SUBGRAPH_DP_COLS = 20.0
+
+
 #: per-grid-program centroid-operand reload of the fused int8 kmeans
 #: kernel: the 5·kp·d term of ``_tile_rows_int8``'s OOM-calibrated
 #: byte model (bigger tiles amortize it — the mechanism behind the
@@ -174,12 +215,16 @@ class Price:
 
 def _mk_price(config, metric, *, mxu_flops=0.0, mxu_peak="bf16_flops",
               vpu_flops=0.0, hbm_bytes=0.0, scatter_bytes=0.0,
-              wire_s=0.0, units_per_run=1.0, compiles=0.0) -> Price:
+              wire_s=0.0, units_per_run=1.0, compiles=0.0,
+              h2d_bytes=0.0) -> Price:
     compute = mxu_flops / V5E_PEAKS[mxu_peak] + vpu_flops / VPU_FLOPS
     memory = hbm_bytes / HBM_GBS + scatter_bytes / SCATTER_GBS
+    # h2d_bytes: per-RUN staging over the relay tunnel, charged only by
+    # families whose committed metric times it (see RELAY_H2D_GBS)
     ovh = (CALIBRATED_OVERHEADS["dispatch_s"]
            + CALIBRATED_OVERHEADS["readback_s"]
-           + compiles * CALIBRATED_OVERHEADS["compile_s"]) / units_per_run
+           + compiles * CALIBRATED_OVERHEADS["compile_s"]
+           + h2d_bytes / RELAY_H2D_GBS) / units_per_run
     return Price(config, metric, compute, memory, wire_s, ovh)
 
 
@@ -324,6 +369,144 @@ def _price_mlp(row, topo, *, wire=None, config, metric="samples_per_sec"):
                      units_per_run=batch * steps)
 
 
+def _price_rf(row, topo, *, hist="dense", config, metric="trees_per_sec"):
+    """Per grown tree (models/rf: level-synchronous growth + forest
+    allgather).  The hist knob makes CLAUDE.md's 25 GB/s scatter-wall
+    claim (measured 2026-07-30 on 1x v5e) a priced A/B on THIS app:
+    the dense arm is one int8 one-hot MXU matmul per level (node count
+    doubles per level, so the flop sum telescopes to ``2^depth - 1``
+    node-columns) re-reading the [n, f·B] bin-onehot operand each
+    level; the scatter arm moves the same ``depth·n·f`` histogram
+    updates at SCATTER_GBS instead."""
+    nw = max(int(row.get("num_workers") or 1), 1)
+    n = float(row.get("n", 200_000)) / nw
+    f = float(row.get("features", 64))
+    bins = float(row.get("n_bins", 32))
+    classes = float(row.get("n_classes", 2))
+    depth = float(row.get("depth", 6))
+    n_trees = float(row.get("n_trees", 32))
+    nodes = 2.0 ** depth - 1.0
+    mxu, hbm, scat = 0.0, 0.0, 0.0
+    if hist == "dense":
+        mxu = 2.0 * n * classes * f * bins * nodes
+        hbm = depth * n * f * bins
+    else:
+        scat = depth * n * f * 4.0
+    tree_bytes = (2.0 ** depth) * 4.0 * 4.0   # feat/thresh/route/leaf
+    wire = wire_cost_s(topo, "all_gather", "keep",
+                       int(n_trees * tree_bytes / nw)) / n_trees
+    # fit() stages the binned shard + labels per run; the committed rf
+    # row's fit_sec times that staging (see RELAY_H2D_GBS)
+    return _mk_price(config, metric, mxu_flops=mxu, mxu_peak="int8_ops",
+                     hbm_bytes=hbm, scatter_bytes=scat, wire_s=wire,
+                     units_per_run=n_trees,
+                     h2d_bytes=n * nw * (f * 4.0 + 4.0))
+
+
+def _price_svm(row, topo, *, x_dtype="f32", wire=None, config,
+               metric="samples_per_sec"):
+    """Per training sample over the full dataset (models/svm: the whole
+    multi-round pegasos run is ONE jit; ``fit`` re-stages the x shard
+    per call, so the committed samples_per_sec includes the staging —
+    at the relay tunnel rate that term dominates, which is why the
+    bf16-shard knob is the flip candidate)."""
+    nw = max(int(row.get("num_workers") or 1), 1)
+    n = float(row.get("n", 500_000))
+    d = float(row.get("d", 128))
+    steps = (float(row.get("inner_steps", 200))
+             * float(row.get("outer_rounds", 5)))
+    sv = float(row.get("sv_per_worker", 256))
+    xsize = 2.0 if x_dtype == "bf16" else 4.0
+    sv_bytes = int(sv * d * 4 * nw)           # SV exchange, all shards
+    wire_s = (float(row.get("outer_rounds", 5))
+              * (wire_cost_s(topo, "ppermute", _wire_schedule(wire),
+                             sv_bytes)
+                 + wire_cost_s(topo, "psum", "keep", int(d * 4)))) / n
+    return _mk_price(config, metric,
+                     mxu_flops=steps * 4.0 * d / nw,
+                     hbm_bytes=steps * SVM_X_PASSES_PER_STEP * d * xsize
+                     / nw,
+                     wire_s=wire_s, units_per_run=n,
+                     h2d_bytes=n * (d * xsize + 4.0))
+
+
+def _price_wdamds(row, topo, *, delta_dtype="f32", wire=None, config,
+                  metric="iters_per_sec"):
+    """Per SMACOF iteration (models/wdamds: one jit scan over iters;
+    ``fit`` stages the [n, n] delta per run — at the relay tunnel rate
+    that staging IS the committed wall, so the bf16-delta knob that
+    halves it is the flip candidate)."""
+    nw = max(int(row.get("num_workers") or 1), 1)
+    n = float(row.get("n", 4096))
+    dim = float(row.get("dim", 3))
+    iters = float(row.get("iters", 30))
+    dsize = 2.0 if delta_dtype == "bf16" else 4.0
+    n_loc = n / nw
+    wire_s = (wire_cost_s(topo, "ppermute", _wire_schedule(wire),
+                          int(n * dim * 4))
+              + wire_cost_s(topo, "psum", "keep", 4))
+    return _mk_price(config, metric,
+                     # distance + Guttman-transform matmuls
+                     mxu_flops=4.0 * n_loc * n * dim,
+                     vpu_flops=WDAMDS_VPU_FLOPS_PER_ENTRY * n_loc * n,
+                     hbm_bytes=n_loc * n * ((WDAMDS_NN_PASSES - 2.0)
+                                            * 4.0 + 2.0 * dsize),
+                     wire_s=wire_s, units_per_run=iters,
+                     h2d_bytes=n * n * dsize)
+
+
+def _price_subgraph(row, topo, *, overflow="segment", deg=64.0,
+                    ovf_default=0.0, config, metric="vertices_per_sec"):
+    """Per vertex per color-coding trial (models/subgraph).  The padded
+    [n, deg] CSR (nbr int32 + msk f32) ships per run — the dominant
+    committed term — plus the calibrated overflow arm: segment-sum cost
+    linear in overflow entries vs the onehot arm's per-tile program
+    cost (tiles grow with BOTH n/row_tile windows and entries/tile
+    capacity — the crossover the 1M A/B measured)."""
+    n = float(row.get("n_vertices", 100_000))
+    ovf = float(row.get("overflow_edges", ovf_default))
+    base = _mk_price(config, metric,
+                     hbm_bytes=deg * 4.0 * SUBGRAPH_DP_COLS,
+                     wire_s=wire_cost_s(topo, "psum", "keep", 8) / n,
+                     units_per_run=n,
+                     h2d_bytes=n * deg * 8.0 + ovf * 12.0)
+    if overflow == "onehot":
+        tiles = n / SUBGRAPH_ROW_TILE + ovf / SUBGRAPH_ENTRY_TILE
+        extra = SUBGRAPH_ONEHOT_TILE_S * tiles / n
+    else:
+        extra = SUBGRAPH_SEG_ENTRY_S * ovf / n
+    return dataclasses.replace(base, memory_s=base.memory_s + extra)
+
+
+def _price_serve(row, topo, *, app="kmeans", batch_default=64.0,
+                 config, metric="qps"):
+    """Per served request — the serve-plane queueing term: one
+    dispatch+readback per batch window amortized over its rows, plus
+    the app's per-row executor work (state reload amortized per
+    window).  Batch shapes come from the row's own
+    ``n_requests/steady_dispatches`` when present; defaults are
+    CALIBRATED from the committed sustained rows (2026-08-04 CPU sim:
+    serve_kmeans_sustained 4096 req / 23 dispatches ≈ 178 rows/window
+    at 30,183 qps; serve_mfsgd_sustained 4096/15 ≈ 273 at 7,011 qps)
+    and the burst rung (burst_admit=64).  CPU rows are excluded from
+    magnitude grading — this term RANKS batching configs against the
+    relay-calibrated dispatch cost, it does not reproduce CPU walls."""
+    nr, sd = row.get("n_requests"), row.get("steady_dispatches")
+    batch = (float(nr) / float(sd)) if nr and sd else float(batch_default)
+    rows = float(row.get("rows_per_request", 1))
+    if app == "kmeans":
+        k, d = float(row.get("k", 100)), float(row.get("d", 300))
+        mxu = 2.0 * d * k * rows
+        hbm = (d + k) * 4.0 * rows + k * d * 4.0 / batch
+    else:                                     # mfsgd top-k scorer
+        rank = float(row.get("rank", 64))
+        items = float(row.get("n_items", 26_744))
+        mxu = 2.0 * rank * items * rows
+        hbm = items * 4.0 * rows + items * rank * 4.0 / batch
+    return _mk_price(config, metric, mxu_flops=mxu, hbm_bytes=hbm,
+                     units_per_run=batch)
+
+
 # ---------------------------------------------------------------------------
 # The config table
 # ---------------------------------------------------------------------------
@@ -344,10 +527,33 @@ def _p(**kw):
     return ("mlp", kw)
 
 
-#: config -> (family, variant kwargs).  Configs absent here are
-#: UNPRICEABLE (irregular access patterns with no committed mechanism
-#: evidence — subgraph, rf, serve latency, svm/wdamds compute): no
-#: number beats a wrong one, the same rule as roofline.WORK_MODELS.
+def _r(**kw):
+    return ("rf", kw)
+
+
+def _s(**kw):
+    return ("svm", kw)
+
+
+def _w(**kw):
+    return ("wdamds", kw)
+
+
+def _g(**kw):
+    return ("subgraph", kw)
+
+
+def _q(**kw):
+    return ("serve", kw)
+
+
+#: config -> (family, variant kwargs).  PR 16's attribution pass
+#: (``python -m harp_tpu profile``) priced the previously-UNPRICEABLE
+#: half — rf/svm/wdamds/subgraph and the serve plane now carry
+#: mechanism terms — so the only configs still absent are the
+#: host-bound ingest twins (kmeans_ingest*: disk generation dominates,
+#: no device mechanism to rank): no number beats a wrong one, the same
+#: rule as roofline.WORK_MODELS.
 CONFIG_MODELS = {
     "kmeans": _k(),
     "kmeans_int8": _k(quantize="int8"),
@@ -379,10 +585,45 @@ CONFIG_MODELS = {
     "mlp": _p(),
     "mlp_grad_bf16": _p(wire="bf16"),
     "mlp_grad_int8": _p(wire="int8"),
+    # PR 16: the attribution observatory's newly priced half.
+    "rf": _r(),
+    "rf_dense_hist": _r(),                    # the hist_algo A/B, dense arm
+    "rf_scatter_hist": _r(hist="scatter"),
+    "svm": _s(),
+    "svm_sv_bf16": _s(wire="bf16"),
+    "svm_sv_int8": _s(wire="int8"),
+    "svm_x_bf16": _s(x_dtype="bf16"),         # halve the staged shard
+    "wdamds": _w(),
+    "wdamds_coord_bf16": _w(wire="bf16"),
+    "wdamds_coord_int8": _w(wire="int8"),
+    "wdamds_delta_bf16": _w(delta_dtype="bf16"),
+    "subgraph": _g(deg=64),
+    "subgraph_csr32": _g(deg=32),             # halve the padded-CSR ship
+    "subgraph_pl": _g(deg=16, ovf_default=719_074),
+    "subgraph_onehot": _g(deg=16, ovf_default=719_074,
+                          overflow="onehot"),
+    "subgraph_1m": _g(deg=16, ovf_default=3_682_709),
+    "subgraph_1m_onehot": _g(deg=16, ovf_default=3_682_709,
+                             overflow="onehot"),
+    "serve_kmeans": _q(app="kmeans"),
+    "serve_kmeans_sustained": _q(app="kmeans", batch_default=178.0),
+    "serve_mfsgd_topk": _q(app="mfsgd"),
+    "serve_mfsgd_sustained": _q(app="mfsgd", batch_default=273.0),
 }
 
+#: committed BENCH_local rows whose config name is a CLI metrics tag,
+#: not a sprint config (svm_cli/wdamds_cli landed 2026-08-01 via the
+#: app CLIs) — the magnitude band grades them through the incumbent's
+#: model.  CONFIG_MODELS itself stays ⊆ measure_all.SPRINT_ORDER
+#: (tests/test_perfmodel.py): a predict row must never name a config
+#: the sprint cannot run.
+CLI_ROW_ALIASES = {"svm_cli": "svm", "wdamds_cli": "wdamds"}
+
 _FAMILY_FNS = {"kmeans": _price_kmeans, "mfsgd": _price_mfsgd,
-               "lda": _price_lda, "mlp": _price_mlp}
+               "lda": _price_lda, "mlp": _price_mlp,
+               "rf": _price_rf, "svm": _price_svm,
+               "wdamds": _price_wdamds, "subgraph": _price_subgraph,
+               "serve": _price_serve}
 
 #: full-shape overrides for configs whose graded shape differs from the
 #: family benchmark defaults (mirrors measure_all.py's full kwargs);
@@ -403,6 +644,8 @@ FULL_SHAPES = {
                      "epochs": 1},
     "lda_scale_1m_pallas": {"n_docs": 1_000_000, "n_tokens": 100_000_000,
                             "epochs": 1},
+    "subgraph_1m": {"n_vertices": 1_000_000},
+    "subgraph_1m_onehot": {"n_vertices": 1_000_000},
 }
 
 
@@ -446,9 +689,13 @@ PROGRAM_CONFIGS = {
                   "lda_planner_wire", "lda_scatter"),
     "serve.kmeans_assign": ("serve_kmeans", "serve_kmeans_sustained"),
     "serve.mfsgd_topk": ("serve_mfsgd_topk", "serve_mfsgd_sustained"),
-    "svm.train": ("svm", "svm_sv_bf16", "svm_sv_int8"),
+    "svm.train": ("svm", "svm_sv_bf16", "svm_sv_int8", "svm_x_bf16"),
     "wdamds.smacof": ("wdamds", "wdamds_coord_bf16",
-                      "wdamds_coord_int8"),
+                      "wdamds_coord_int8", "wdamds_delta_bf16"),
+    "rf.grow": ("rf", "rf_dense_hist", "rf_scatter_hist"),
+    "subgraph.count": ("subgraph", "subgraph_csr32", "subgraph_pl",
+                       "subgraph_onehot", "subgraph_1m",
+                       "subgraph_1m_onehot"),
     "collective.reshard": (), "collective.reshard_wire": (),
     "elastic.regather": (),
     "ring_attention": (), "rotate.pipeline_chunked": (),

@@ -1,0 +1,15 @@
+"""Wall-attribution observatory (PR 16): ``python -m harp_tpu profile``.
+
+See :mod:`harp_tpu.profile.attribution` for the frozen bucket vocabulary
+and the capture/reconciliation contract (check_jsonl invariant 15).
+"""
+
+from harp_tpu.profile.attribution import (  # noqa: F401
+    BUCKETS,
+    PROFILE_APPS,
+    SUM_REL_TOL,
+    attribute,
+    capture,
+    capture_all,
+    classify,
+)

@@ -70,3 +70,9 @@ SMOKE["lda_planner_wire"] = SMOKE["lda_pallas"]
 # exchange wire differs) — aliases so the pairs can never drift apart
 SMOKE["svm_sv_bf16"] = SMOKE["svm_sv_int8"] = SMOKE["svm"]
 SMOKE["wdamds_coord_bf16"] = SMOKE["wdamds_coord_int8"] = SMOKE["wdamds"]
+# PR 16 profile-priced candidates measure their incumbents' shapes (only
+# a dtype / histogram formulation / CSR width differs) — aliases again
+SMOKE["rf_dense_hist"] = SMOKE["rf_scatter_hist"] = SMOKE["rf"]
+SMOKE["svm_x_bf16"] = SMOKE["svm"]
+SMOKE["wdamds_delta_bf16"] = SMOKE["wdamds"]
+SMOKE["subgraph_csr32"] = SMOKE["subgraph"]

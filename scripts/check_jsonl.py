@@ -190,6 +190,29 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     ``shrink`` row must show the survivor count strictly below the
     pre-fault count (``n_workers_after < n_workers_before``) — a
     shrink that lost no worker describes a fault that did not happen.
+
+15. **Profile rows are coherent attribution evidence** (any file): a
+    ``kind:"profile"`` row (the PR-16 wall-attribution observatory —
+    ``python -m harp_tpu profile``, :mod:`harp_tpu.profile`) must carry
+    the provenance stamp (a CPU-sim attribution must never read as
+    silicon wall evidence), name an app and driver program from the
+    frozen vocabularies (``KNOWN_PROFILE_APPS`` /
+    ``KNOWN_LINT_PROGRAMS`` — sync-pinned against
+    ``harp_tpu.profile.attribution.PROFILE_APPS`` by
+    tests/test_check_jsonl.py), carry exactly the six frozen mechanism
+    buckets (``KNOWN_PROFILE_BUCKETS``) as non-negative ``*_s`` terms
+    that SUM to the measured ``wall_s`` (the whole contract: every
+    wall second is attributed to a mechanism, residual in overhead),
+    name as ``bound`` the largest bucket (the wall the row claims),
+    keep ``sum_rel_err`` within ``PROFILE_SUM_REL_TOL`` (sync-pinned
+    against ``attribution.SUM_REL_TOL``), and reconcile against the
+    other spines fail-closed: ``dispatches == reps *
+    dispatches_per_rep`` (flight recorder), ``compiles_in_window ==
+    0`` (a row that compiled mid-capture timed the compiler),
+    ``wire_unmatched == 0`` (every static collective site carries a
+    CommLedger verb match), and ``reconciled`` literally true — an
+    unreconciled attribution committed as evidence is exactly the
+    hand-read-profile ritual this row type replaces.
 """
 
 from __future__ import annotations
@@ -204,7 +227,8 @@ import sys
 # 2 (never from check 1).  Bump ONLY when deliberately rewriting history.
 GRANDFATHERED = {"BENCH_local.jsonl": 73}
 
-PARSE_ONLY = ("PROFILE_local.jsonl", "FLIP_DECISIONS.jsonl")
+PARSE_ONLY = ("PROFILE_local.jsonl", "FLIP_DECISIONS.jsonl",
+              "PROFILE_attrib.jsonl")
 PROVENANCE_FIELDS = ("backend", "date", "commit")
 
 # CommLedger rows (telemetry exports, teed into committed JSONL by
@@ -329,10 +353,11 @@ KNOWN_LINT_PROGRAMS = (
     "elastic.regather",
     "ingest.accum_chunk", "ingest.finish_epoch", "kmeans.fit",
     "kmeans.fit_hier", "lda.epoch",
-    "mfsgd.epoch", "ring_attention", "rotate.pipeline_chunked",
+    "mfsgd.epoch", "rf.grow", "ring_attention",
+    "rotate.pipeline_chunked",
     "serve.kmeans_assign", "serve.lda_infer", "serve.mfsgd_topk",
     "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores",
-    "svm.train", "wdamds.smacof")
+    "subgraph.count", "svm.train", "wdamds.smacof")
 KNOWN_COMM_PRIMITIVES = ("all_gather", "all_to_all", "pmax", "pmin",
                          "ppermute", "psum", "reduce_scatter")
 KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_hier",
@@ -708,11 +733,13 @@ KNOWN_MODEL_CONFIGS = (
     "lda_rotate_int8", "lda_scale", "lda_scale_1m", "lda_scale_1m_pallas",
     "lda_scatter", "mfsgd", "mfsgd_carry", "mfsgd_chunked_rotate",
     "mfsgd_pallas", "mfsgd_scatter", "mlp", "mlp_grad_bf16",
-    "mlp_grad_int8", "rf", "serve_kmeans", "serve_kmeans_sustained",
+    "mlp_grad_int8", "rf", "rf_dense_hist", "rf_scatter_hist",
+    "serve_kmeans", "serve_kmeans_sustained",
     "serve_mfsgd_sustained", "serve_mfsgd_topk", "subgraph",
-    "subgraph_1m", "subgraph_1m_onehot", "subgraph_onehot", "subgraph_pl",
-    "svm", "svm_sv_bf16", "svm_sv_int8", "wdamds", "wdamds_coord_bf16",
-    "wdamds_coord_int8")
+    "subgraph_1m", "subgraph_1m_onehot", "subgraph_csr32",
+    "subgraph_onehot", "subgraph_pl",
+    "svm", "svm_sv_bf16", "svm_sv_int8", "svm_x_bf16", "wdamds",
+    "wdamds_coord_bf16", "wdamds_coord_int8", "wdamds_delta_bf16")
 MODEL_TERM_FIELDS = ("compute_s", "memory_s", "wire_s", "overhead_s")
 
 
@@ -784,7 +811,7 @@ def _check_model_row(name: str, i: int, row: dict) -> list[str]:
 # plan/model vocabularies and sync-pinned by tests/test_check_jsonl.py
 # against harp_tpu.health (DETECTORS / SEVERITIES / VERDICTS)
 KNOWN_HEALTH_DETECTORS = ("slo_burn", "skew_trigger", "budget_drift",
-                          "evidence_regression")
+                          "evidence_regression", "profile_drift")
 KNOWN_HEALTH_SEVERITIES = ("info", "warn", "page")
 KNOWN_HEALTH_VERDICTS = ("confirmed", "improved", "regressed",
                          "model_invalidated")
@@ -966,6 +993,111 @@ def _check_elastic_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the profile-row vocabularies (invariant 15), FROZEN standalone like
+# the model/health vocabularies and sync-pinned by
+# tests/test_check_jsonl.py against harp_tpu.profile.attribution
+# (BUCKETS / PROFILE_APPS / SUM_REL_TOL)
+KNOWN_PROFILE_BUCKETS = ("mxu", "elementwise", "gather_dus", "scatter",
+                         "wire", "overhead")
+KNOWN_PROFILE_APPS = ("kmeans", "mfsgd", "lda", "rf", "svm", "wdamds",
+                      "subgraph", "serve")
+PROFILE_SUM_REL_TOL = 0.75
+PROFILE_COUNT_FIELDS = ("reps", "n_devices", "wire_bytes", "wire_sites",
+                        "wire_unmatched", "dispatches",
+                        "dispatches_per_rep", "compiles_in_window")
+
+
+def _check_profile_row(name: str, i: int, row: dict) -> list[str]:
+    """Invariant 15: profile rows must be coherent attribution evidence."""
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: profile row missing provenance field(s) "
+            f"{missing} — emit it through harp_tpu.profile.cli / "
+            "attribution.capture, which stamp them")
+    app = row.get("app")
+    if app not in KNOWN_PROFILE_APPS:
+        errs.append(f"{name}:{i}: profile row app={app!r} not in "
+                    f"{KNOWN_PROFILE_APPS}")
+    prog = row.get("program")
+    if prog not in KNOWN_LINT_PROGRAMS:
+        errs.append(
+            f"{name}:{i}: profile row for unregistered program {prog!r} "
+            "— programs must come from harp_tpu.analysis.drivers.DRIVERS")
+    for k in PROFILE_COUNT_FIELDS:
+        v = row.get(k)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"{name}:{i}: profile row count {k}={v!r} must "
+                        "be a non-negative integer")
+    wall = row.get("wall_s")
+    if not _num(wall) or wall <= 0:
+        errs.append(f"{name}:{i}: profile row wall_s={wall!r} must be a "
+                    "positive number — an attribution needs a wall to "
+                    "attribute")
+    term_keys = tuple(f"{b}_s" for b in KNOWN_PROFILE_BUCKETS)
+    terms = row.get("terms")
+    if (not isinstance(terms, dict)
+            or sorted(terms) != sorted(term_keys)
+            or not all(_num(terms[k]) and terms[k] >= 0
+                       for k in term_keys)):
+        errs.append(
+            f"{name}:{i}: profile row terms={terms!r} must carry exactly "
+            f"{term_keys} as non-negative numbers — the frozen mechanism "
+            "vocabulary is what lets the perfmodel consume the row")
+    else:
+        if _num(wall) and wall > 0:
+            total = sum(terms.values())
+            # terms are rounded to 6 decimals per bucket in the exporter
+            if abs(total - wall) > 1e-3 * wall + 1e-5:
+                errs.append(
+                    f"{name}:{i}: profile row buckets sum to {total} but "
+                    f"wall_s claims {wall} — every wall second must be "
+                    "attributed to a mechanism (residual in overhead)")
+        bound = row.get("bound")
+        if bound not in KNOWN_PROFILE_BUCKETS:
+            errs.append(f"{name}:{i}: profile row bound={bound!r} not in "
+                        f"{KNOWN_PROFILE_BUCKETS}")
+        elif terms[f"{bound}_s"] < max(terms.values()) - 1e-12:
+            errs.append(
+                f"{name}:{i}: profile row bound={bound!r} is not the "
+                "largest bucket — the bound names the wall the row "
+                "claims the app is against")
+    sre = row.get("sum_rel_err")
+    if not _num(sre) or sre < 0 or sre > PROFILE_SUM_REL_TOL:
+        errs.append(
+            f"{name}:{i}: profile row sum_rel_err={sre!r} must lie in "
+            f"[0, {PROFILE_SUM_REL_TOL}] — beyond the documented "
+            "concurrency-blur tolerance the capture is broken, not blurry")
+    reps, per = row.get("reps"), row.get("dispatches_per_rep")
+    disp = row.get("dispatches")
+    if (isinstance(reps, int) and isinstance(per, int)
+            and isinstance(disp, int)
+            and not any(isinstance(x, bool) for x in (reps, per, disp))
+            and disp != reps * per):
+        errs.append(
+            f"{name}:{i}: profile row dispatches={disp} != reps={reps} * "
+            f"dispatches_per_rep={per} — the attribution window "
+            "disagrees with the flight recorder about what ran")
+    for k in ("compiles_in_window", "wire_unmatched"):
+        v = row.get(k)
+        if isinstance(v, int) and not isinstance(v, bool) and v != 0:
+            errs.append(
+                f"{name}:{i}: profile row {k}={v} must be exactly 0 — "
+                + ("a capture that compiled mid-window timed the "
+                   "compiler, not the program"
+                   if k == "compiles_in_window" else
+                   "every static collective site must carry a "
+                   "CommLedger verb match"))
+    if row.get("reconciled") is not True:
+        errs.append(
+            f"{name}:{i}: profile row reconciled="
+            f"{row.get('reconciled')!r} must be literally true — an "
+            "unreconciled attribution is a hand-read profile wearing a "
+            "row format")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -1039,6 +1171,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_health_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "elastic":
             errors += _check_elastic_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "profile":
+            errors += _check_profile_row(name, i, row)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:

@@ -2360,3 +2360,110 @@ print(f"elastic: rebalance {round(_el_wf(_el_before), 3)} -> "
       "bit-identical to survivors-only, export checker-clean, CLI "
       f"inertia {round(_el_row['inertia'], 1)}")
 print(f"DRIVE OK round-35 ({mode})")
+
+# ---------------------------------------------------------------------------
+# round-36: wall-attribution observatory (PR 16).  Classifier vs a
+# hand-labelled span table, attribute() vs a straight-line numpy model,
+# one REAL capture cross-reconciled through check_jsonl invariant 15 and
+# the lint's CommGraph byte sheet, profile_drift grading (quiet on
+# itself, fires on a forged bound flip), and the newly priced perfmodel
+# half (rf/svm/wdamds/subgraph + the serve queueing term).
+from harp_tpu.profile import attribution as _pf
+
+# (a) classifier priority: collective names never read as gather/mxu,
+# runtime/infra spans land in overhead, the residue is elementwise.
+_pf_expect = {
+    "all-gather.7": "wire", "all-reduce": "wire",
+    "collective-permute.2": "wire",
+    "dot_general.1": "mxu", "conv.3": "mxu",
+    "convert.9": "elementwise",                # conv(?!ert) guard
+    "scatter-add.4": "scatter", "segment_sum": "scatter",
+    "gather.5": "gather_dus", "dynamic-update-slice.8": "gather_dus",
+    "TfrtCpuExecutable::Execute": "overhead",
+    "PjitFunction(fit)": "overhead",
+    "fusion.12": "elementwise", "broadcast.2": "elementwise",
+}
+for _pf_name, _pf_want in _pf_expect.items():
+    _pf_got = _pf.classify(_pf_name)
+    assert _pf_got == _pf_want, (_pf_name, _pf_got, _pf_want)
+
+# (b) attribute() vs numpy: under-attribution fills overhead exactly;
+# over-attribution rescales to the wall and reports the residual;
+# device-count normalization divides attributed seconds by N.
+_pf_bd = [("dot.1", 0, 0.40), ("fusion.2", 1, 0.20),
+          ("all-gather.3", 0, 0.10), ("scatter.4", 1, 0.05),
+          ("dynamic-update-slice.5", 0, 0.05)]
+_pf_a = _pf.attribute(_pf_bd, 1.0, 1)
+assert _pf_a["bound"] == "mxu" and _pf_a["sum_rel_err"] == 0.0
+assert abs(sum(_pf_a["terms"].values()) - 1.0) < 1e-5
+assert abs(_pf_a["terms"]["overhead_s"] - 0.2) < 1e-5      # 1.0 - 0.8
+_pf_o = _pf.attribute(_pf_bd, 0.5, 1)       # 0.8 attributed over 0.5 wall
+assert abs(_pf_o["sum_rel_err"] - 0.6) < 1e-6
+assert abs(sum(_pf_o["terms"].values()) - 0.5) < 1e-5
+_pf_n = _pf.attribute(_pf_bd, 1.0, 2)       # halve per-device seconds
+assert abs(sum(_pf_v for _pf_k, _pf_v in _pf_n["terms"].items()
+               if _pf_k != "overhead_s") - 0.4) < 1e-5
+
+# (c) one real capture end to end: reconciled, invariant-15 clean, and
+# the wire column agrees with an independent CommGraph walk.
+_pf_row = _pf.capture("kmeans", reps=2)
+assert _pf_row["reconciled"] is True and _pf_row["bound"] in _pf.BUCKETS
+import check_jsonl as _pf_cj
+
+_pf_errs = _pf_cj._check_profile_row("drive", 0, _pf_row)
+assert _pf_errs == [], _pf_errs
+from harp_tpu.analysis import commgraph as _pf_cg
+from harp_tpu.analysis.drivers import DRIVERS as _PF_DRV
+
+_pf_fn, _pf_fargs = _PF_DRV["kmeans.fit"]()
+assert _pf_row["wire_bytes"] == int(
+    _pf_cg.extract("kmeans.fit", _pf_fn, _pf_fargs).amplified_bytes())
+
+# (d) drift grading: the row graded against itself is quiet; moving the
+# bound bucket's whole share to another bucket fires a warn finding.
+from harp_tpu.health import grade as _pf_hg
+from harp_tpu.health import sentinel as _pf_sn
+
+_pf_sn.reset()
+_pf_base = {_pf_row["app"]: _pf_row}
+assert _pf_hg.grade_profile_row(dict(_pf_row), "/root/repo",
+                                committed=_pf_base) is None
+_pf_other = "mxu" if _pf_row["bound"] != "mxu" else "wire"
+_pf_flip = dict(_pf_row, terms=dict(_pf_row["terms"]),
+                bound=_pf_other)
+_pf_flip["terms"][_pf_other + "_s"] += \
+    _pf_flip["terms"][_pf_row["bound"] + "_s"]
+_pf_flip["terms"][_pf_row["bound"] + "_s"] = 0.0
+_pf_f = _pf_hg.grade_profile_row(_pf_flip, "/root/repo",
+                                 committed=_pf_base)
+assert _pf_f is not None and _pf_f["detector"] == "profile_drift"
+assert _pf_f["bound_flipped"] is True and _pf_f["severity"] == "warn"
+assert _pf_f["share_delta"] > _pf_hg.PROFILE_SHARE_DRIFT
+_pf_sn.reset()
+
+# (e) the newly priced half prices: every PR-16 flip candidate plus the
+# serve queueing term yields a finite positive predicted wall, and the
+# deliberately unpriced kmeans_ingest still raises.
+from harp_tpu.perfmodel import model as _pf_pm
+from harp_tpu.plan.topology import v4_32 as _pf_v432
+
+_pf_topo = _pf_v432()
+for _pf_cfg in ("rf_dense_hist", "svm_x_bf16", "wdamds_delta_bf16",
+                "subgraph_csr32", "serve_kmeans_sustained"):
+    _pf_price = _pf_pm.price(_pf_cfg, None, _pf_topo)
+    _pf_mrow = _pf_pm.model_row(_pf_price, _pf_topo, config=_pf_cfg)
+    assert _pf_mrow["predicted_s"] > 0 and np.isfinite(
+        _pf_mrow["predicted_s"]), _pf_cfg
+try:
+    _pf_pm.price("kmeans_ingest", None, _pf_topo)
+    raise AssertionError("kmeans_ingest must stay unpriced")
+except KeyError:
+    pass
+
+print(f"profile: {len(_pf_expect)} span labels classified, attribute() "
+      "== numpy (overhead fill / rescale / device split), kmeans "
+      f"capture reconciled bound={_pf_row['bound']} "
+      f"wire={_pf_row['wire_bytes']} B == CommGraph, drift quiet-on-self "
+      f"and fires on flip (delta {_pf_f['share_delta']}), 5 new terms "
+      "priced + ingest still refuses")
+print(f"DRIVE OK round-36 ({mode})")

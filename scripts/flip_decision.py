@@ -192,6 +192,33 @@ CANDIDATES = {
         "incumbent": "subgraph_1m", "metric": "vertices_per_sec",
         "quality": "estimate", "sense": "equal", "rel_tol": 1e-3,
         "flips": "SubgraphConfig.overflow_algo='onehot' (graded scale)"},
+    # PR 16: one flip candidate per app the attribution observatory
+    # newly priced.  rf's pair makes CLAUDE.md's 25 GB/s scatter-wall
+    # claim a measured verdict on THIS app (the dense one-hot MXU
+    # histogram vs the scatter arm — same counts bit-identically, so
+    # train_acc gates a genuinely equal chain); the svm/wdamds dtype
+    # knobs halve the H2D staging the profile pass named as their
+    # walls; subgraph_csr32 halves the padded-CSR ship on the graded
+    # uniform shape (Poisson(16) degrees rarely exceed 32 — the
+    # overflow path absorbs the tail, so estimate must hold).
+    "rf_dense_hist": {
+        "incumbent": "rf_scatter_hist", "metric": "trees_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "RFConfig.hist_algo='dense' (confirms the one-hot MXU "
+                 "default against the scatter arm)"},
+    "svm_x_bf16": {
+        "incumbent": "svm", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "SVMConfig.x_dtype='bf16'"},
+    "wdamds_delta_bf16": {
+        "incumbent": "wdamds", "metric": "iters_per_sec",
+        "quality": "final_stress", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MDSConfig.delta_dtype='bf16'"},
+    "subgraph_csr32": {
+        "incumbent": "subgraph", "metric": "vertices_per_sec",
+        "quality": "estimate", "sense": "equal", "rel_tol": 1e-3,
+        "flips": "subgraph benchmark default max_degree=32 (padded-CSR "
+                 "width; the overflow path absorbs the tail)"},
 }
 
 WIN_THRESHOLD = 1.10  # "wins >=10%" half of the rule

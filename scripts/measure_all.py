@@ -105,6 +105,16 @@ SPRINT_ORDER = [
     # rows ride the remaining-apps block below.
     "svm_sv_bf16", "svm_sv_int8",
     "wdamds_coord_bf16", "wdamds_coord_int8",
+    # PR 16: the wall-attribution observatory priced the four previously
+    # unpriced apps, and each gets ≥1 flip candidate here.  rf's pair is
+    # the dense-one-hot-MXU vs scatter histogram A/B (the measured
+    # 25 GB/s scatter wall, CLAUDE.md); svm/wdamds flip the STAGED data
+    # dtype (the committed walls are relay-H2D-bound at ~30 MB/s, so
+    # halving staged bytes is the model's top-ranked lever); subgraph
+    # flips the padded-CSR width (32 columns stage half the bytes of the
+    # 64-wide default; the overflow path absorbs the clipped tail).
+    "rf_dense_hist", "rf_scatter_hist",
+    "svm_x_bf16", "wdamds_delta_bf16", "subgraph_csr32",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -444,14 +454,30 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
             sv_wire="bf16", **(SMOKE["svm"] if smoke else {})),
         "svm_sv_int8": lambda: svm.benchmark(
             sv_wire="int8", **(SMOKE["svm"] if smoke else {})),
+        # PR 16: bf16-staged X (half the H2D bytes on the staging-bound
+        # committed wall; dots promote to f32 so only the stored feature
+        # precision changes — train_acc gates the flip)
+        "svm_x_bf16": lambda: svm.benchmark(
+            x_dtype="bf16", **(SMOKE["svm_x_bf16"] if smoke else {})),
         "wdamds": lambda: wdamds.benchmark(
             **(SMOKE["wdamds"] if smoke else {})),
         "wdamds_coord_bf16": lambda: wdamds.benchmark(
             coord_wire="bf16", **(SMOKE["wdamds"] if smoke else {})),
         "wdamds_coord_int8": lambda: wdamds.benchmark(
             coord_wire="int8", **(SMOKE["wdamds"] if smoke else {})),
+        # PR 16: bf16-staged dissimilarity matrix (the n² delta is the
+        # dominant staged buffer; final_stress gates the flip)
+        "wdamds_delta_bf16": lambda: wdamds.benchmark(
+            delta_dtype="bf16",
+            **(SMOKE["wdamds_delta_bf16"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
             **(SMOKE["subgraph"] if smoke else {})),
+        # PR 16: half-width padded CSR on the graded uniform graph — the
+        # staged adjacency halves, the clipped tail rides the exact
+        # overflow segment path (estimate equality gates the flip)
+        "subgraph_csr32": lambda: subgraph.benchmark(
+            max_degree=32,
+            **(SMOKE["subgraph_csr32"] if smoke else {})),
         # overflow-tail A/B pair (r2 verdict item 7): POWERLAW graph so
         # the tail carries real mass (the uniform graded config's
         # ~Poisson(16) degrees never exceed max_degree=64 — segment vs
@@ -482,6 +508,19 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
                 "max_degree": 16, "template": "u5-tree"})),
         "rf": lambda: rf.benchmark(
             **({**SMOKE["rf"], "n_trees": 2 * jax.device_count()}
+               if smoke else {})),
+        # PR 16: the histogram-formulation A/B the profile pass priced —
+        # dense one-hot MXU (the incumbent default's mechanism) vs the
+        # 25 GB/s scatter wall; counts are bit-identical int32, so
+        # train_acc gates only against harness drift
+        "rf_dense_hist": lambda: rf.benchmark(
+            hist_algo="dense",
+            **({**SMOKE["rf_dense_hist"], "n_trees": 2 * jax.device_count()}
+               if smoke else {})),
+        "rf_scatter_hist": lambda: rf.benchmark(
+            hist_algo="scatter",
+            **({**SMOKE["rf_scatter_hist"],
+                "n_trees": 2 * jax.device_count()}
                if smoke else {})),
         # the REAL-ingest half of the north-star (disk npy memmap through
         # fit_streaming; VERDICT r2 item 2) — full mode keeps a 12 GB

@@ -1060,3 +1060,124 @@ def test_elastic_vocab_in_sync_with_elastic_module():
     import harp_tpu.elastic as E
 
     assert tuple(E.EVENTS) == check_jsonl.KNOWN_ELASTIC_EVENTS
+
+
+# ---------------------------------------------------------------------------
+# Invariant 15: profile attribution rows (PR 16)
+# ---------------------------------------------------------------------------
+
+_PSTAMP = {"backend": "cpu", "date": "2026-08-06", "commit": "abc1234"}
+
+
+def _profile_row(**over):
+    base = {
+        "kind": "profile", "app": "lda", "program": "lda.epoch",
+        "wall_s": 0.04, "reps": 4, "n_devices": 8,
+        "terms": {"mxu_s": 0.001, "elementwise_s": 0.002,
+                  "gather_dus_s": 0.0, "scatter_s": 0.0,
+                  "wire_s": 0.033, "overhead_s": 0.004},
+        "bound": "wire", "sum_rel_err": 0.02, "wire_bytes": 2308,
+        "wire_sites": 3, "wire_unmatched": 0, "dispatches": 4,
+        "dispatches_per_rep": 1, "dispatch_reconciled": True,
+        "compiles_in_window": 0, "reconciled": True, **_PSTAMP}
+    base.update(over)
+    return base
+
+
+def _profile_errs(row):
+    return check_jsonl._check_profile_row("t", 1, row)
+
+
+def test_profile_row_valid_round_trip(tmp_path):
+    assert _profile_errs(_profile_row()) == []
+    p = tmp_path / "PROFILE_attrib.jsonl"
+    p.write_text(json.dumps(_profile_row()) + "\n")
+    assert check_jsonl.check_file(str(p)) == []
+
+
+def test_profile_row_requires_provenance_and_vocabularies():
+    row = _profile_row()
+    del row["backend"]
+    assert any("provenance" in e for e in _profile_errs(row))
+    assert any("app=" in e for e in _profile_errs(
+        _profile_row(app="word2vec")))
+    # program must be a registered lint driver, not free text
+    assert any("unregistered program" in e for e in _profile_errs(
+        _profile_row(program="lda.mystery")))
+
+
+def test_profile_row_buckets_must_sum_to_wall():
+    assert any("sum to" in e for e in _profile_errs(
+        _profile_row(terms={"mxu_s": 0.001, "elementwise_s": 0.002,
+                            "gather_dus_s": 0.0, "scatter_s": 0.0,
+                            "wire_s": 0.01, "overhead_s": 0.004})))
+
+
+def test_profile_row_rejects_unknown_bucket_name():
+    bad = _profile_row()
+    bad["terms"] = dict(bad["terms"])
+    bad["terms"]["dma_s"] = bad["terms"].pop("wire_s")
+    assert any("frozen mechanism" in e for e in _profile_errs(bad))
+
+
+def test_profile_row_bound_must_name_the_largest_bucket():
+    assert any("largest bucket" in e for e in _profile_errs(
+        _profile_row(bound="mxu")))
+    assert any("bound=" in e for e in _profile_errs(
+        _profile_row(bound="hbm")))
+
+
+def test_profile_row_fails_closed_on_reconciliation():
+    # cross-check counters must be literally clean, not merely present
+    assert any("exactly 0" in e for e in _profile_errs(
+        _profile_row(compiles_in_window=1)))
+    assert any("exactly 0" in e for e in _profile_errs(
+        _profile_row(wire_unmatched=2)))
+    assert any("dispatches=" in e for e in _profile_errs(
+        _profile_row(dispatches=7)))
+    assert any("sum_rel_err" in e for e in _profile_errs(
+        _profile_row(sum_rel_err=0.9)))
+
+
+def test_profile_vocabularies_in_sync_with_profile_module():
+    """check_jsonl freezes the attribution vocabularies standalone;
+    drift from the live harp_tpu.profile module fails here (tier-1)."""
+    from harp_tpu.health import sentinel
+    from harp_tpu.profile import attribution
+
+    assert tuple(attribution.BUCKETS) == check_jsonl.KNOWN_PROFILE_BUCKETS
+    assert tuple(attribution.PROFILE_APPS) == check_jsonl.KNOWN_PROFILE_APPS
+    assert attribution.SUM_REL_TOL == check_jsonl.PROFILE_SUM_REL_TOL
+    assert "profile_drift" in sentinel.DETECTORS
+
+
+def test_golden_profile_fixture_is_clean_and_grades():
+    """The committed golden profile fixture (tests/data) passes the
+    checker, and the health grader reads it as drift-free against
+    itself — the fixture the profile CLI smoke drives."""
+    p = os.path.join(os.path.dirname(__file__), "data",
+                     "golden_profile.jsonl")
+    assert check_jsonl.check_file(p) == []
+    import json as _json
+
+    from harp_tpu.health import grade as HG
+
+    rows = [_json.loads(l) for l in open(p)]
+    committed = {r["app"]: r for r in rows}
+    assert sorted(committed) == ["kmeans", "lda"]
+    for r in rows:
+        fresh = dict(r, terms=dict(r["terms"]))
+        assert HG.grade_profile_row(fresh, ".", committed=committed) is None
+
+
+def test_committed_profile_attribution_covers_every_app():
+    """PROFILE_attrib.jsonl (the committed baseline the profile_drift
+    detector grades against) carries one reconciled row per app in the
+    frozen vocabulary — including the four PR-16 newly priced apps."""
+    p = os.path.join(os.path.dirname(__file__), "..",
+                     "PROFILE_attrib.jsonl")
+    assert check_jsonl.check_file(p) == []
+    rows = [json.loads(l) for l in open(p)]
+    apps = {r["app"] for r in rows if r.get("kind") == "profile"}
+    assert apps == set(check_jsonl.KNOWN_PROFILE_APPS)
+    assert all(r["reconciled"] is True for r in rows)

@@ -619,6 +619,91 @@ def test_health_cli_grade_model_emits_checker_clean_row(capsys):
     health.monitor.reset()
 
 
+def test_dispatch_profile_cli_smoke(capsys, monkeypatch):
+    """python -m harp_tpu profile (PR 16): a real single-app capture
+    emits one invariant-15-clean kind:'profile' row under --json (the
+    PROFILE_attrib.jsonl regeneration path), --all iterates the frozen
+    app vocabulary, an unknown app exits 2, and any unreconciled row
+    exits 1."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import check_jsonl
+
+    assert cli.main(["profile", "kmeans", "--json"]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["kind"] == "profile" and row["app"] == "kmeans"
+    assert check_jsonl._check_profile_row("t", 1, row) == []
+
+    # human rendering names the bound and the reconciliation verdict
+    assert cli.main(["profile", "kmeans"]) == 0
+    out = capsys.readouterr().out
+    assert "bound=" in out and "[ok]" in out
+
+    # unknown app exits 2 and lists the vocabulary; no app exits 2
+    assert cli.main(["profile", "word2vec"]) == 2
+    assert "unknown app" in capsys.readouterr().err
+    assert cli.main(["profile"]) == 2
+    capsys.readouterr()
+
+    # --all iterates every registered app (capture stubbed so the smoke
+    # stays in seconds); an unreconciled row turns exit 0 into 1
+    from harp_tpu.profile import attribution
+
+    golden = os.path.join(os.path.dirname(__file__), "data",
+                          "golden_profile.jsonl")
+    template = json.loads(open(golden).readline())
+    calls = []
+
+    def fake_capture(app, reps=4):
+        calls.append(app)
+        return dict(template, app=app)
+
+    monkeypatch.setattr(attribution, "capture", fake_capture)
+    assert cli.main(["profile", "--all", "--json"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert calls == list(attribution.PROFILE_APPS)
+    assert len(lines) == len(attribution.PROFILE_APPS)
+
+    monkeypatch.setattr(
+        attribution, "capture",
+        lambda app, reps=4: dict(template, app=app, reconciled=False))
+    assert cli.main(["profile", "kmeans"]) == 1
+    assert "FAILED" in capsys.readouterr().out
+
+
+def test_health_cli_grades_profile_rows(capsys, tmp_path):
+    """PR-16 satellite: a fresh kind:'profile' row whose bound flipped
+    vs the committed PROFILE_attrib.jsonl baseline is a warn-severity
+    profile_drift finding (exit 1); the committed baseline grades
+    drift-free against itself (exit 0)."""
+    import json
+    import os
+
+    from harp_tpu import health
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = os.path.join(root, "PROFILE_attrib.jsonl")
+    health.monitor.reset()
+    assert cli.main(["health", committed, "--repo", root]) == 0
+    capsys.readouterr()
+
+    rows = [json.loads(l) for l in open(committed)]
+    r = next(x for x in rows if x["app"] == "lda")
+    t = dict(r["terms"])
+    t["mxu_s"], t["wire_s"] = t["mxu_s"] + t["wire_s"], 0.0
+    drifted = tmp_path / "drifted.jsonl"
+    drifted.write_text(json.dumps(dict(r, terms=t, bound="mxu")) + "\n")
+    health.monitor.reset()
+    assert cli.main(["health", str(drifted), "--repo", root]) == 1
+    out = capsys.readouterr().out
+    assert "profile_drift" in out and "FLIPPED" in out
+    health.monitor.reset()
+
+
 def test_elastic_cli_knobs_bind_without_executing(capsys, monkeypatch):
     """PR-15 satellite: --elastic / --max-worker-loss on the mfsgd /
     lda / kmeans-stream apps forward into the elastic fit entries.

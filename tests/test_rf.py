@@ -91,3 +91,38 @@ def test_grow_level_histogram_matches_numpy(mesh):
     exp_right = (bins[np.arange(n), np.asarray(sf)[node_id]]
                  > np.asarray(sb)[node_id]).astype(np.int32)
     np.testing.assert_array_equal(np.asarray(new_id), node_id * 2 + exp_right)
+
+
+def test_hist_algo_scatter_matches_dense(mesh):
+    """PR-16 flip candidate: the scatter-add histogram formulation must
+    pick bit-identical splits to the dense one-hot matmul incumbent
+    (integer counts, two exact formulations — any divergence is a bug,
+    not noise), so the rf_dense_hist/rf_scatter_hist pair's flip gate
+    can demand equal train_acc."""
+    import jax.numpy as jnp
+    from harp_tpu.models.rf import RFConfig, _grow_level, bins_onehot
+
+    rng = np.random.default_rng(3)
+    n, f, B, C = 300, 5, 8, 3
+    bins = rng.integers(0, B, (n, f)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    w = rng.poisson(1.0, n).astype(np.float32)
+    level = 2
+    node_id = rng.integers(0, 2 ** level, n).astype(np.int32)
+    feat_mask = np.ones(f, np.float32)
+    BO = bins_onehot(jnp.asarray(bins), B)
+
+    outs = {}
+    for algo in ("dense", "scatter"):
+        cfg = RFConfig(n_bins=B, n_classes=C, max_depth=3,
+                       hist_algo=algo)
+        outs[algo] = _grow_level(
+            BO, jnp.asarray(bins), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(node_id), level, jnp.asarray(feat_mask), cfg)
+    for a, b in zip(outs["dense"], outs["scatter"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hist_algo_validated():
+    with pytest.raises(ValueError, match="hist_algo"):
+        RF.RFConfig(hist_algo="sparse")
