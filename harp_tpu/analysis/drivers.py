@@ -453,6 +453,33 @@ def _svm_train():
     return fn, (x, y, sw)
 
 
+@register_driver("svm.train_pallas")
+def _svm_train_pallas():
+    """The PR-17 kernelized inner solve (SVMConfig.algo='pallas' —
+    ops/svm_kernel.py, flip candidate svm_kernel_pallas): same outer
+    wires as svm.train, but the per-round Pegasos scan dispatches the
+    fused hinge-gradient pallas_call instead of the two-pass XLA dots.
+    Registered so the jaxpr sweep and the Layer-4 byte sheet cover the
+    kernel arm's program — the sheet must match svm.train's (the kernel
+    changes the memory schedule, not the wires)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.svm import SVMConfig, make_train_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    n_loc = 8
+    fn = make_train_fn(mesh, SVMConfig(algo="pallas", inner_steps=4,
+                                       outer_rounds=2, sv_per_worker=4),
+                       d=16, n_loc=n_loc)
+    sh0 = mesh.sharding(mesh.spec(0))
+    x = jax.ShapeDtypeStruct((n_loc * nw, 16), jnp.float32, sharding=sh0)
+    y = jax.ShapeDtypeStruct((n_loc * nw,), jnp.float32, sharding=sh0)
+    sw = jax.ShapeDtypeStruct((n_loc * nw,), jnp.float32, sharding=sh0)
+    return fn, (x, y, sw)
+
+
 @register_driver("wdamds.smacof")
 def _wdamds_smacof():
     """The unweighted SMACOF run (PR 12): the per-iteration coordinate
@@ -469,6 +496,34 @@ def _wdamds_smacof():
     nw = mesh.num_workers
     n_pad = 4 * nw
     fn = make_smacof_fn(mesh, MDSConfig(dim=2, iters=2), n_pad)
+    sh0 = mesh.sharding(mesh.spec(0))
+    delta = jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32,
+                                 sharding=sh0)
+    mask = jax.ShapeDtypeStruct((n_pad,), jnp.float32, sharding=sh0)
+    x0 = jax.ShapeDtypeStruct((n_pad, 2), jnp.float32,
+                              sharding=mesh.replicated())
+    n_real = jax.ShapeDtypeStruct((), jnp.float32,
+                                  sharding=mesh.replicated())
+    return fn, (delta, mask, x0, n_real)
+
+
+@register_driver("wdamds.smacof_pallas")
+def _wdamds_smacof_pallas():
+    """The PR-17 fused Guttman step (MDSConfig.algo='pallas' —
+    ops/wdamds_kernel.py, flip candidate wdamds_dist_pallas).  n_pad is
+    16·nw = 128 here, NOT the xla driver's 4·nw: the pallas branch
+    engages only on 128-multiple N (smaller shapes fall back to XLA and
+    the sweep would silently re-trace the incumbent program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.wdamds import MDSConfig, make_smacof_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    n_pad = 16 * nw
+    fn = make_smacof_fn(mesh, MDSConfig(algo="pallas", dim=2, iters=2),
+                        n_pad)
     sh0 = mesh.sharding(mesh.spec(0))
     delta = jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32,
                                  sharding=sh0)
@@ -499,6 +554,32 @@ def _rf_grow():
                                       n_bins=8, seed=0), n_features=8)
     sh0 = mesh.sharding(mesh.spec(0))
     bins = jax.ShapeDtypeStruct((16 * nw, 8), jnp.int32, sharding=sh0)
+    y = jax.ShapeDtypeStruct((16 * nw,), jnp.int32, sharding=sh0)
+    keys = jax.ShapeDtypeStruct((nw, 2, 2), jnp.uint32, sharding=sh0)
+    return fn, (bins, y, keys)
+
+
+@register_driver("rf.grow_pallas")
+def _rf_grow_pallas():
+    """The PR-17 on-chip histogram arm (RFConfig.hist_algo='pallas' —
+    ops/rf_kernel.py, flip candidate rf_hist_pallas).  n_features=16 at
+    n_bins=8 gives fB = 128: the pallas branch engages only on
+    128-multiple f·B (odd widths fall through to dense and the sweep
+    would silently re-trace the incumbent program).  Counts are
+    bit-identical to rf.grow's dense arm, so the byte sheet must match
+    it too — only the memory schedule differs."""
+    import jax
+    import jax.numpy as jnp
+
+    from harp_tpu.models.rf import RFConfig, make_train_fn
+
+    mesh = _mesh()
+    nw = mesh.num_workers
+    fn = make_train_fn(mesh, RFConfig(hist_algo="pallas", n_trees=2 * nw,
+                                      max_depth=2, n_bins=8, seed=0),
+                       n_features=16)
+    sh0 = mesh.sharding(mesh.spec(0))
+    bins = jax.ShapeDtypeStruct((16 * nw, 16), jnp.int32, sharding=sh0)
     y = jax.ShapeDtypeStruct((16 * nw,), jnp.int32, sharding=sh0)
     keys = jax.ShapeDtypeStruct((nw, 2, 2), jnp.uint32, sharding=sh0)
     return fn, (bins, y, keys)

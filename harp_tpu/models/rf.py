@@ -53,14 +53,21 @@ class RFConfig:
     # 2026-07-30 — XLA scatter of small rows runs ~25 GB/s on v5e, see
     # CLAUDE.md); "scatter" = the scatter-add arm kept for the A/B
     # (bit-identical int32 counts, tests/test_rf.py).  PR 16 flip
-    # candidate pair: rf_dense_hist vs rf_scatter_hist.
+    # candidate pair: rf_dense_hist vs rf_scatter_hist.  "pallas"
+    # (PR 17) = the same dense math as a real kernel with on-chip bin
+    # accumulation (ops/rf_kernel.py) — the per-level [n, node·C]
+    # one-hot never round-trips HBM; counts stay BIT-identical to
+    # "dense".  perfmodel.presize picked a 2048-sample tile at the
+    # graded 200k×64 shape (2026-08-06, predicted only — NOT yet
+    # measured; flip candidate rf_hist_pallas).  Falls back to "dense"
+    # when f·n_bins is not a 128 multiple.
     hist_algo: str = "dense"
     seed: int = 0
 
     def __post_init__(self):
-        if self.hist_algo not in ("dense", "scatter"):
+        if self.hist_algo not in ("dense", "scatter", "pallas"):
             raise ValueError(
-                f"hist_algo must be 'dense' or 'scatter', got "
+                f"hist_algo must be 'dense', 'scatter' or 'pallas', got "
                 f"{self.hist_algo!r}")
 
 
@@ -164,6 +171,18 @@ def _grow_level(BO, bins, y, weights, node_id, level, feat_mask, cfg):
         hist = jnp.zeros((n_nodes * C_, f * B), jnp.int32).at[
             jnp.broadcast_to(rows[:, None], cols.shape), cols].add(
             jnp.broadcast_to(w[:, None], cols.shape))
+    elif cfg.hist_algo == "pallas" and (f * B) % 128 == 0:
+        # the dense arm as a real kernel (ops/rf_kernel.py): same int8
+        # MXU products accumulated in int32 on-chip — bit-identical
+        # counts, so the Gini/split/route below sees the same numbers.
+        # The kernel runs under the tree vmap (batching adds a leading
+        # grid dimension); odd f·B shapes fall through to dense.
+        from harp_tpu.ops import rf_kernel
+        from harp_tpu.ops.pallas_compat import interpret_default
+
+        hist = rf_kernel.hist_bins(
+            BO, node_id * C_ + y, jnp.clip(weights, 0, 127).astype(jnp.int32),
+            n_nodes * C_, interpret=interpret_default())
     else:
         nc = jax.nn.one_hot(node_id * C_ + y, n_nodes * C_, dtype=jnp.int8)
         nc = nc * jnp.clip(weights, 0, 127).astype(jnp.int8)[:, None]
@@ -391,7 +410,7 @@ def benchmark(n=200_000, f=64, n_trees=32, max_depth=6, mesh=None, seed=0,
         "predict_sec_20k": pred_dt,
         "train_acc": acc,
         "n": n, "features": f, "n_trees": n_trees, "depth": max_depth,
-        "num_workers": mesh.num_workers,
+        "num_workers": mesh.num_workers, "hist_algo": hist_algo,
     }
 
 
@@ -403,11 +422,17 @@ def main(argv=None):
     p.add_argument("--features", type=int, default=64)
     p.add_argument("--trees", type=int, default=32)
     p.add_argument("--depth", type=int, default=6)
+    p.add_argument("--hist-algo", choices=("dense", "scatter", "pallas"),
+                   default="dense",
+                   help="histogram formulation (pallas = the on-chip "
+                        "one-hot kernel, flip candidate rf_hist_pallas; "
+                        "bit-identical counts)")
     args = p.parse_args(argv)
     from harp_tpu.utils.metrics import benchmark_json
 
     print(benchmark_json("rf_cli", benchmark(
-        args.n, args.features, args.trees, args.depth)))
+        args.n, args.features, args.trees, args.depth,
+        hist_algo=args.hist_algo)))
 
 
 if __name__ == "__main__":

@@ -51,6 +51,16 @@ class SVMConfig:
     # only the stored feature precision changes; train_acc gates the
     # flip.  Default stays f32 until a relay window measures it.
     x_dtype: str = "f32"
+    # inner-solve schedule (PR 17): "xla" = the 2-pass _pegasos scan;
+    # "pallas" = the fused single-pass hinge-gradient kernel
+    # (ops/svm_kernel.py) — one feature read per step instead of two,
+    # composing with x_dtype (a bf16-staged x streams half the tile
+    # bytes through the same kernel).  perfmodel.presize picked an
+    # 8192-sample tile at the graded 500k×128 shape (2026-08-06,
+    # predicted only — NOT yet measured; flip candidate
+    # svm_kernel_pallas gates on train_acc).  Dense rows only: the
+    # ELL sparse path always solves via XLA.
+    algo: str = "xla"
 
     def __post_init__(self):
         if self.sv_wire not in ("exact", "bf16", "int8"):
@@ -59,6 +69,9 @@ class SVMConfig:
         if self.x_dtype not in ("f32", "bf16"):
             raise ValueError(
                 f"x_dtype must be f32|bf16, got {self.x_dtype!r}")
+        if self.algo not in ("xla", "pallas"):
+            raise ValueError(
+                f"algo must be xla|pallas, got {self.algo!r}")
 
 
 def _pegasos(w, b, x, y, sample_w, cfg: SVMConfig):
@@ -75,6 +88,48 @@ def _pegasos(w, b, x, y, sample_w, cfg: SVMConfig):
 
     (w, b), _ = jax.lax.scan(step, (w, b), jnp.arange(cfg.inner_steps))
     return w, b
+
+
+def _pegasos_pallas(w, b, x, y, sample_w, cfg: SVMConfig):
+    """:func:`_pegasos` on the fused Pallas kernel (ops/svm_kernel.py):
+    the margin pass and the gradient contraction read each feature tile
+    ONCE per step instead of XLA's two passes.  Same update sequence —
+    matches the XLA arm to accumulation-order rounding (tests/
+    test_svm_kernel.py pins it at rtol 1e-4).  Padding (d → 128-lane
+    multiple, n → tile multiple with sw = 0) is invisible: pad features
+    start at w = 0 and receive zero gradient, pad samples carry zero
+    weight."""
+    from harp_tpu.ops import svm_kernel
+    from harp_tpu.ops.pallas_compat import interpret_default
+
+    n, d = x.shape
+    interp = interpret_default()
+    dp = 128 * -(-d // 128)
+    xsize = jnp.dtype(x.dtype).itemsize
+    tn = svm_kernel.pick_tile(n, d, xsize)
+    n_pad = tn * -(-n // tn)
+    # transpose ONCE per outer round (x is scan-invariant inside the
+    # inner solve); the kernel streams [dp, tn] tiles off this layout
+    xT = jnp.pad(x, ((0, n_pad - n), (0, dp - d))).T        # [dp, n_pad]
+    yp = jnp.pad(y, (0, n_pad - n))
+    swp = jnp.pad(sample_w, (0, n_pad - n))
+    denom = jnp.maximum(sample_w.sum(), 1.0)
+    cd = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+    wp0 = jnp.pad(w, (0, dp - d))
+
+    def step(carry, t):
+        wp, b = carry
+        gw, gs = svm_kernel.pegasos_grad(
+            wp, b, xT, yp, swp, tn=tn, compute_dtype=cd, interpret=interp)
+        lr = cfg.lr / (1.0 + 0.01 * t)
+        # identical to _pegasos: gw here is Σ coef·x (un-normalised) and
+        # gs = Σ coef = −denom·gb
+        wp = wp - lr * (cfg.l2 * wp - gw / denom)
+        b = b + lr * gs / denom
+        return (wp, b), None
+
+    (wp, b), _ = jax.lax.scan(step, (wp0, b), jnp.arange(cfg.inner_steps))
+    return wp[:d], b
 
 
 def _pegasos_ell(w, b, ids, vals, msk, y, sample_w, cfg: SVMConfig):
@@ -136,6 +191,8 @@ def _make_train_prog(cfg: SVMConfig, d: int, k: int, sparse: bool):
             am = jnp.concatenate([sample_w, sv_m], 0)
             if sparse:
                 w, b = _pegasos_ell(w, b, *arows, ay, am, cfg)
+            elif cfg.algo == "pallas":
+                w, b = _pegasos_pallas(w, b, arows, ay, am, cfg)
             else:
                 w, b = _pegasos(w, b, arows, ay, am, cfg)
             # margin violators of the LOCAL shard → top-k by closeness
@@ -239,19 +296,21 @@ class SVM:
 
 
 def benchmark(n=500_000, d=128, mesh=None, seed=0, sv_wire="exact",
-              x_dtype="f32"):
+              x_dtype="f32", algo="xla"):
     rng = np.random.default_rng(seed)
     true_w = rng.normal(size=d).astype(np.float32)
     x = rng.normal(size=(n, d)).astype(np.float32)
     y = np.sign(x @ true_w + 0.1 * rng.normal(size=n)).astype(np.float32)
-    model = SVM(SVMConfig(sv_wire=sv_wire, x_dtype=x_dtype), mesh=mesh)
+    model = SVM(SVMConfig(sv_wire=sv_wire, x_dtype=x_dtype, algo=algo),
+                mesh=mesh)
     model.fit(x, y)  # warmup: compile at full shape
     t0 = time.perf_counter()
     model.fit(x, y)
     dt = time.perf_counter() - t0
     return {"fit_sec": dt, "samples_per_sec": n / dt,
             "train_acc": model.accuracy(x[:50_000], y[:50_000]),
-            "n": n, "d": d, "sv_wire": sv_wire, "x_dtype": x_dtype}
+            "n": n, "d": d, "sv_wire": sv_wire, "x_dtype": x_dtype,
+            "algo": algo}
 
 
 def main(argv=None):
@@ -267,6 +326,10 @@ def main(argv=None):
                         "native input format) instead of synthetic data")
     p.add_argument("--zero-based", action="store_true",
                    help="file indices start at 0 (default: 1-based)")
+    p.add_argument("--algo", choices=("xla", "pallas"), default="xla",
+                   help="inner-solve schedule (pallas = the fused "
+                        "hinge-gradient kernel, flip candidate "
+                        "svm_kernel_pallas; dense rows only)")
     args = p.parse_args(argv)
     if args.libsvm:
         from harp_tpu.native.datasource import csr_to_ell, load_libsvm
@@ -289,7 +352,8 @@ def main(argv=None):
         print(benchmark_json("svm_fit_cli", {"file": args.libsvm, "n": len(labels), "d": nf,
                "classes": classes.tolist(), "train_acc": acc}))
     else:
-        print(benchmark_json("svm_cli", benchmark(args.n, args.d)))
+        print(benchmark_json("svm_cli",
+                             benchmark(args.n, args.d, algo=args.algo)))
 
 
 if __name__ == "__main__":

@@ -57,6 +57,17 @@ class MDSConfig:
     # stored δ precision changes); final_stress gates the flip.  Default
     # stays f32 until a relay window measures it.
     delta_dtype: str = "f32"
+    # Guttman-step schedule (PR 17), UNWEIGHTED path only: "xla" = the
+    # reference body (D and ratio round-trip HBM between fusions);
+    # "pallas" = the fused distance + B·X row-block kernel
+    # (ops/wdamds_kernel.py) — D/ratio never leave VMEM, composing with
+    # delta_dtype (a bf16-staged δ streams half the tile bytes).
+    # perfmodel.presize picked a 128-row tile at the graded n=4096
+    # shape (2026-08-06, predicted only — NOT yet measured; flip
+    # candidate wdamds_dist_pallas gates on final_stress).  Falls back
+    # to the XLA body when n_pad is not a 128 multiple; the weighted CG
+    # path and the final stress pass always run XLA.
+    algo: str = "xla"
 
     def __post_init__(self):
         if self.coord_wire not in ("exact", "bf16", "int8"):
@@ -65,10 +76,20 @@ class MDSConfig:
         if self.delta_dtype not in ("f32", "bf16"):
             raise ValueError(f"delta_dtype must be f32|bf16, got "
                              f"{self.delta_dtype!r}")
+        if self.algo not in ("xla", "pallas"):
+            raise ValueError(f"algo must be xla|pallas, got {self.algo!r}")
 
 
 def make_smacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
     """One jitted run of SMACOF over the row-sharded Δ (unweighted)."""
+    # the fused kernel needs the replicated axis to be a whole number of
+    # lane registers; odd n_pad falls back to the (bitwise-equivalent in
+    # outcome, slower in schedule) XLA body rather than erroring
+    use_pallas = cfg.algo == "pallas" and n_pad % 128 == 0
+    if use_pallas:
+        from harp_tpu.ops.pallas_compat import interpret_default
+
+        interp = interpret_default()
 
     def run(delta_rows, row_mask, X0, n_real):
         # delta_rows: [n_loc, N]; row_mask: [n_loc] (0 for padded rows);
@@ -83,18 +104,28 @@ def make_smacof_fn(mesh: WorkerMesh, cfg: MDSConfig, n_pad: int):
             return jnp.sqrt(jnp.maximum(d2, 0.0)), Xl
 
         def body(X, _):
-            D, Xl = dist_block(X)                       # [n_loc, N]
-            live = row_mask[:, None] * jnp.where(
-                jnp.arange(n_pad)[None, :] < n_real, 1.0, 0.0)
-            # B entries: -δ/d off-diagonal (guarded), diagonal fixes row sum 0
-            ratio = jnp.where(D > cfg.eps, delta_rows / jnp.maximum(D, cfg.eps), 0.0)
-            ratio = ratio * live
-            row_idx = me0 + jnp.arange(delta_rows.shape[0])
-            off = -ratio
-            diag_fix = ratio.sum(1)                     # so rows sum to zero
-            BX_rows = off @ X + diag_fix[:, None] * Xl  # [n_loc, d]
-            # Guttman transform (unweighted): X ← B(X) X / n_real
-            Xl_new = BX_rows / jnp.maximum(n_real, 1.0)
+            if use_pallas:
+                from harp_tpu.ops import wdamds_kernel
+
+                Xl = jax.lax.dynamic_slice_in_dim(
+                    X, me0, delta_rows.shape[0], 0)
+                Xl_new = wdamds_kernel.smacof_bx(
+                    delta_rows, row_mask, Xl, X, n_real, eps=cfg.eps,
+                    interpret=interp)
+            else:
+                D, Xl = dist_block(X)                       # [n_loc, N]
+                live = row_mask[:, None] * jnp.where(
+                    jnp.arange(n_pad)[None, :] < n_real, 1.0, 0.0)
+                # B entries: -δ/d off-diagonal (guarded), diagonal fixes
+                # row sum 0
+                ratio = jnp.where(
+                    D > cfg.eps, delta_rows / jnp.maximum(D, cfg.eps), 0.0)
+                ratio = ratio * live
+                off = -ratio
+                diag_fix = ratio.sum(1)                 # so rows sum to zero
+                BX_rows = off @ X + diag_fix[:, None] * Xl  # [n_loc, d]
+                # Guttman transform (unweighted): X ← B(X) X / n_real
+                Xl_new = BX_rows / jnp.maximum(n_real, 1.0)
             # coordinate exchange via the general reshard verb
             # (blocked→replicated = the same tiled all_gather the old
             # C.allgather emitted, bit-exact on the exact wire) so
@@ -267,7 +298,7 @@ def mds(delta, cfg: MDSConfig | None = None, mesh: WorkerMesh | None = None,
 
 
 def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact",
-              delta_dtype="f32"):
+              delta_dtype="f32", algo="xla"):
     rng = np.random.default_rng(seed)
     # 4-D points embedded into dim=3: genuinely LOSSY, so final_stress
     # is bounded away from 0 and the coord_wire flip gate's 2% relative
@@ -277,14 +308,14 @@ def benchmark(n=4096, mesh=None, seed=0, coord_wire="exact",
     pts = rng.normal(size=(n, 4)).astype(np.float32)
     delta = np.sqrt(((pts[:, None] - pts[None]) ** 2).sum(-1))
     cfg = MDSConfig(dim=3, iters=30, coord_wire=coord_wire,
-                    delta_dtype=delta_dtype)
+                    delta_dtype=delta_dtype, algo=algo)
     mds(delta, cfg, mesh, seed)  # warmup/compile
     t0 = time.perf_counter()
     X, stress = mds(delta, cfg, mesh, seed)
     dt = time.perf_counter() - t0
     return {"sec_total": dt, "iters_per_sec": cfg.iters / dt,
             "final_stress": stress, "n": n, "coord_wire": coord_wire,
-            "delta_dtype": delta_dtype}
+            "delta_dtype": delta_dtype, "algo": algo}
 
 
 def main(argv=None):
@@ -292,10 +323,14 @@ def main(argv=None):
 
     p = argparse.ArgumentParser(description="harp-tpu WDA-MDS (edu.iu.wdamds parity)")
     p.add_argument("--n", type=int, default=4096)
+    p.add_argument("--algo", choices=("xla", "pallas"), default="xla",
+                   help="Guttman-step schedule (pallas = the fused "
+                        "distance + B·X kernel, flip candidate "
+                        "wdamds_dist_pallas; unweighted path only)")
     args = p.parse_args(argv)
     from harp_tpu.utils.metrics import benchmark_json
 
-    print(benchmark_json("wdamds_cli", benchmark(args.n)))
+    print(benchmark_json("wdamds_cli", benchmark(args.n, algo=args.algo)))
 
 
 if __name__ == "__main__":

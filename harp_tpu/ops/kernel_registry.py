@@ -197,3 +197,82 @@ def _flash():
     fn = functools.partial(flash_attention, causal=True, interpret=False)
     q = jnp.zeros((2, 256, 128), jnp.float32)
     return fn, (q, q, q)
+
+
+# svm.kernel_row at (dp=128, n_pad=512, tn=128): fused Pegasos hinge
+# gradient — two MXU dots (score + gradient contraction) = 4·dp·n
+# flops; min bytes = x^T read once (the fusion's whole point: ONE pass,
+# not SVM_X_PASSES_PER_STEP=2) + w/b/y/sw streams + gw/gs out; vmem =
+# the kernel's own byte model (svm_kernel.vmem_bytes) at tn=128.
+@register_kernel("svm.kernel_row",
+                 flops=4 * 128 * 512,
+                 min_hbm_bytes=4 * (128 * 512 + 2 * 512 + 2 * 128 + 2),
+                 vmem_bytes=2 * 128 * 128 * 4 + 6 * 128 * 4
+                 + 2 * 128 * 4 + (64 << 10))
+def _svm_kernel_row():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.svm_kernel import pegasos_grad
+
+    # the small proven shape pinned in tests/test_svm_kernel.py
+    fn = functools.partial(pegasos_grad, tn=128, interpret=False)
+    return fn, (jnp.zeros((128,), jnp.float32),
+                jnp.float32(0.0),
+                jnp.zeros((128, 512), jnp.float32),
+                jnp.zeros((512,), jnp.float32),
+                jnp.zeros((512,), jnp.float32))
+
+
+# wdamds.smacof_dist at (N=256, n_loc=32, tn=32, dim=2): fused distance
+# + Guttman B·X row block — two MXU matmuls (cross + ratio·X) =
+# 4·n_loc·N·dimp flops at the padded dimp=128; min bytes = δ rows + the
+# real (unpadded) X/Xl/out coordinates (D and ratio never touch HBM —
+# the fusion's point); vmem = the kernel's own byte model
+# (wdamds_kernel.vmem_bytes) at tn=32.
+@register_kernel("wdamds.smacof_dist",
+                 flops=4 * 32 * 256 * 128,
+                 min_hbm_bytes=4 * (32 * 256 + 256 * 2 + 2 * 32 * 2),
+                 vmem_bytes=128 * 256 * 4 + 2 * 32 * 256 * 4
+                 + 3 * 32 * 256 * 4 + 4 * 32 * 128 * 4 + (64 << 10))
+def _wdamds_smacof_dist():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.wdamds_kernel import smacof_bx
+
+    # the small proven shape pinned in tests/test_wdamds_kernel.py
+    fn = functools.partial(smacof_bx, eps=1e-9, tn=32, interpret=False)
+    return fn, (jnp.zeros((32, 256), jnp.float32),
+                jnp.zeros((32,), jnp.float32),
+                jnp.zeros((32, 2), jnp.float32),
+                jnp.zeros((256, 2), jnp.float32),
+                jnp.float32(256.0))
+
+
+# rf.hist_bins at (n=512, fB=512, tn=128, nodeC=8): on-chip one-hot
+# histogram — one int8 MXU dot per tile = 2·n·nodeCp·fB OPs (the
+# transposed one-hot build is VPU); min bytes = int8 BO read once +
+# row-code/weight streams + int32 histogram out (the [nodeCp, tn]
+# one-hot never touches HBM — the fusion's point); vmem = the kernel's
+# own byte model (rf_kernel.vmem_bytes) at tn=128.
+@register_kernel("rf.hist_bins",
+                 flops=2 * 512 * 8 * 512,
+                 min_hbm_bytes=512 * 512 + 2 * 4 * 512 + 4 * 8 * 512,
+                 vmem_bytes=2 * 128 * 512 + 4 * 128 * 4 + 8 * 128
+                 + 8 * 128 * 4 + 8 * 512 * 4 + (64 << 10))
+def _rf_hist_bins():
+    import functools
+
+    import jax.numpy as jnp
+
+    from harp_tpu.ops.rf_kernel import hist_bins
+
+    # the small proven shape pinned in tests/test_rf_kernel.py
+    fn = functools.partial(hist_bins, n_node_classes=8, tn=128,
+                           interpret=False)
+    return fn, (jnp.zeros((512, 512), jnp.int8),
+                jnp.zeros((512,), jnp.int32),
+                jnp.zeros((512,), jnp.int32))

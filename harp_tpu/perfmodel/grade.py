@@ -91,6 +91,12 @@ FAMILY_PAIRS = {
     "wdamds_coord_int8": ("wdamds", "iters_per_sec", None),
     "wdamds_delta_bf16": ("wdamds", "iters_per_sec", None),
     "rf_dense_hist": ("rf_scatter_hist", "trees_per_sec", None),
+    # PR 17: the kernelized arms — priced from birth (presize-predicted
+    # tiles, no silicon rows yet, so they report "unmeasured" until a
+    # relay window runs their flip candidates).
+    "svm_kernel_pallas": ("svm", "samples_per_sec", None),
+    "wdamds_dist_pallas": ("wdamds", "iters_per_sec", None),
+    "rf_hist_pallas": ("rf_dense_hist", "trees_per_sec", None),
     "subgraph_csr32": ("subgraph", "vertices_per_sec", None),
     "subgraph_onehot": ("subgraph_pl", "vertices_per_sec", None),
     "subgraph_1m_onehot": ("subgraph_1m", "vertices_per_sec", None),

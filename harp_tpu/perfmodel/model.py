@@ -376,8 +376,11 @@ def _price_rf(row, topo, *, hist="dense", config, metric="trees_per_sec"):
     the dense arm is one int8 one-hot MXU matmul per level (node count
     doubles per level, so the flop sum telescopes to ``2^depth - 1``
     node-columns) re-reading the [n, f·B] bin-onehot operand each
-    level; the scatter arm moves the same ``depth·n·f`` histogram
-    updates at SCATTER_GBS instead."""
+    level — PLUS the [n, node·C] one-hot operand it materialises in HBM
+    between the fusion and the contraction; the scatter arm moves the
+    same ``depth·n·f`` histogram updates at SCATTER_GBS instead; the
+    pallas arm (PR 17, ops/rf_kernel.py) builds the one-hot in VMEM, so
+    only the per-grid-program fixed cost remains of that term."""
     nw = max(int(row.get("num_workers") or 1), 1)
     n = float(row.get("n", 200_000)) / nw
     f = float(row.get("features", 64))
@@ -387,9 +390,17 @@ def _price_rf(row, topo, *, hist="dense", config, metric="trees_per_sec"):
     n_trees = float(row.get("n_trees", 32))
     nodes = 2.0 ** depth - 1.0
     mxu, hbm, scat = 0.0, 0.0, 0.0
-    if hist == "dense":
+    if hist in ("dense", "pallas"):
         mxu = 2.0 * n * classes * f * bins * nodes
         hbm = depth * n * f * bins
+        if hist == "pallas":
+            # presize-predicted default tile (2026-08-06, unmeasured)
+            tn = float(row.get("tile") or 2048)
+            hbm += depth * (n / tn) * MFSGD_GRID_OVERHEAD_BYTES
+        else:
+            # the [n, node·C] one-hot write + MXU read-back, telescoped
+            # over levels — the traffic the kernel keeps in VMEM
+            hbm += 2.0 * n * classes * nodes
     else:
         scat = depth * n * f * 4.0
     tree_bytes = (2.0 ** depth) * 4.0 * 4.0   # feat/thresh/route/leaf
@@ -403,13 +414,15 @@ def _price_rf(row, topo, *, hist="dense", config, metric="trees_per_sec"):
                      h2d_bytes=n * nw * (f * 4.0 + 4.0))
 
 
-def _price_svm(row, topo, *, x_dtype="f32", wire=None, config,
+def _price_svm(row, topo, *, x_dtype="f32", algo="xla", wire=None, config,
                metric="samples_per_sec"):
     """Per training sample over the full dataset (models/svm: the whole
     multi-round pegasos run is ONE jit; ``fit`` re-stages the x shard
     per call, so the committed samples_per_sec includes the staging —
     at the relay tunnel rate that term dominates, which is why the
-    bf16-shard knob is the flip candidate)."""
+    bf16-shard knob is the flip candidate).  The pallas arm (PR 17,
+    ops/svm_kernel.py) fuses the two per-step feature passes into one
+    plus the sequential grid's per-program cost."""
     nw = max(int(row.get("num_workers") or 1), 1)
     n = float(row.get("n", 500_000))
     d = float(row.get("d", 128))
@@ -417,6 +430,12 @@ def _price_svm(row, topo, *, x_dtype="f32", wire=None, config,
              * float(row.get("outer_rounds", 5)))
     sv = float(row.get("sv_per_worker", 256))
     xsize = 2.0 if x_dtype == "bf16" else 4.0
+    if algo == "pallas":
+        # presize-predicted default tile (2026-08-06, unmeasured)
+        tn = float(row.get("tile") or 8192)
+        hbm = steps * (d * xsize + MFSGD_GRID_OVERHEAD_BYTES / tn) / nw
+    else:
+        hbm = steps * SVM_X_PASSES_PER_STEP * d * xsize / nw
     sv_bytes = int(sv * d * 4 * nw)           # SV exchange, all shards
     wire_s = (float(row.get("outer_rounds", 5))
               * (wire_cost_s(topo, "ppermute", _wire_schedule(wire),
@@ -424,24 +443,34 @@ def _price_svm(row, topo, *, x_dtype="f32", wire=None, config,
                  + wire_cost_s(topo, "psum", "keep", int(d * 4)))) / n
     return _mk_price(config, metric,
                      mxu_flops=steps * 4.0 * d / nw,
-                     hbm_bytes=steps * SVM_X_PASSES_PER_STEP * d * xsize
-                     / nw,
+                     hbm_bytes=hbm,
                      wire_s=wire_s, units_per_run=n,
                      h2d_bytes=n * (d * xsize + 4.0))
 
 
-def _price_wdamds(row, topo, *, delta_dtype="f32", wire=None, config,
-                  metric="iters_per_sec"):
+def _price_wdamds(row, topo, *, delta_dtype="f32", algo="xla", wire=None,
+                  config, metric="iters_per_sec"):
     """Per SMACOF iteration (models/wdamds: one jit scan over iters;
     ``fit`` stages the [n, n] delta per run — at the relay tunnel rate
     that staging IS the committed wall, so the bf16-delta knob that
-    halves it is the flip candidate)."""
+    halves it is the flip candidate).  The pallas arm (PR 17,
+    ops/wdamds_kernel.py) fuses the D/ratio blocks into VMEM: δ streams
+    once, X^T loads once, only the per-grid-program cost remains of the
+    WDAMDS_NN_PASSES round-trips."""
     nw = max(int(row.get("num_workers") or 1), 1)
     n = float(row.get("n", 4096))
     dim = float(row.get("dim", 3))
     iters = float(row.get("iters", 30))
     dsize = 2.0 if delta_dtype == "bf16" else 4.0
     n_loc = n / nw
+    if algo == "pallas":
+        # presize-predicted default tile (2026-08-06, unmeasured)
+        tn = float(row.get("tile") or 128)
+        hbm = (n_loc * n * dsize            # the one δ stream
+               + n * 128.0 * 4.0            # resident X^T load
+               + (n_loc / tn) * MFSGD_GRID_OVERHEAD_BYTES)
+    else:
+        hbm = n_loc * n * ((WDAMDS_NN_PASSES - 2.0) * 4.0 + 2.0 * dsize)
     wire_s = (wire_cost_s(topo, "ppermute", _wire_schedule(wire),
                           int(n * dim * 4))
               + wire_cost_s(topo, "psum", "keep", 4))
@@ -449,8 +478,7 @@ def _price_wdamds(row, topo, *, delta_dtype="f32", wire=None, config,
                      # distance + Guttman-transform matmuls
                      mxu_flops=4.0 * n_loc * n * dim,
                      vpu_flops=WDAMDS_VPU_FLOPS_PER_ENTRY * n_loc * n,
-                     hbm_bytes=n_loc * n * ((WDAMDS_NN_PASSES - 2.0)
-                                            * 4.0 + 2.0 * dsize),
+                     hbm_bytes=hbm,
                      wire_s=wire_s, units_per_run=iters,
                      h2d_bytes=n * n * dsize)
 
@@ -589,14 +617,19 @@ CONFIG_MODELS = {
     "rf": _r(),
     "rf_dense_hist": _r(),                    # the hist_algo A/B, dense arm
     "rf_scatter_hist": _r(hist="scatter"),
+    # PR 17: the kernelized arms (presize-predicted, unmeasured — flip
+    # candidates in SPRINT_ORDER; silicon verdicts pending)
+    "rf_hist_pallas": _r(hist="pallas"),
     "svm": _s(),
     "svm_sv_bf16": _s(wire="bf16"),
     "svm_sv_int8": _s(wire="int8"),
     "svm_x_bf16": _s(x_dtype="bf16"),         # halve the staged shard
+    "svm_kernel_pallas": _s(algo="pallas"),   # PR 17: one fused x pass
     "wdamds": _w(),
     "wdamds_coord_bf16": _w(wire="bf16"),
     "wdamds_coord_int8": _w(wire="int8"),
     "wdamds_delta_bf16": _w(delta_dtype="bf16"),
+    "wdamds_dist_pallas": _w(algo="pallas"),  # PR 17: fused D/ratio
     "subgraph": _g(deg=64),
     "subgraph_csr32": _g(deg=32),             # halve the padded-CSR ship
     "subgraph_pl": _g(deg=16, ovf_default=719_074),
@@ -690,9 +723,12 @@ PROGRAM_CONFIGS = {
     "serve.kmeans_assign": ("serve_kmeans", "serve_kmeans_sustained"),
     "serve.mfsgd_topk": ("serve_mfsgd_topk", "serve_mfsgd_sustained"),
     "svm.train": ("svm", "svm_sv_bf16", "svm_sv_int8", "svm_x_bf16"),
+    "svm.train_pallas": ("svm_kernel_pallas",),
     "wdamds.smacof": ("wdamds", "wdamds_coord_bf16",
                       "wdamds_coord_int8", "wdamds_delta_bf16"),
+    "wdamds.smacof_pallas": ("wdamds_dist_pallas",),
     "rf.grow": ("rf", "rf_dense_hist", "rf_scatter_hist"),
+    "rf.grow_pallas": ("rf_hist_pallas",),
     "subgraph.count": ("subgraph", "subgraph_csr32", "subgraph_pl",
                        "subgraph_onehot", "subgraph_1m",
                        "subgraph_1m_onehot"),
@@ -815,5 +851,64 @@ def presize(kernel: str, **shape) -> dict:
         return {"kernel": kernel, "tile": best,
                 "fits": fits, "vmem_model":
                 "mfsgd_kernel resident-H + scratch budget"}
+    if kernel == "svm.kernel_row":
+        from harp_tpu.ops import svm_kernel
+
+        d = shape["d"]
+        xsize = 2 if shape.get("x_dtype") == "bf16" else 4
+        fits = svm_kernel.fit_tiles(d, xsize)
+        if not fits:
+            return {"kernel": kernel, "tile": None,
+                    "reason": "no lane-aligned sample tile fits the "
+                              "VMEM budget; use algo='xla'"}
+        row = {"tile": None, "n": shape.get("n"), "d": d,
+               "num_workers": shape.get("num_workers")}
+        best = min(fits, key=lambda t: price(
+            "svm_kernel_pallas", {**row, "tile": t}).predicted_s)
+        return {"kernel": kernel, "tile": best, "fits": fits,
+                "vmem_model": "svm_kernel.vmem_bytes (analytic, "
+                              "2026-08-06 — unmeasured)"}
+    if kernel == "wdamds.smacof_dist":
+        from harp_tpu.ops import wdamds_kernel
+
+        n = shape["n"]
+        dsize = 2 if shape.get("delta_dtype") == "bf16" else 4
+        fits = wdamds_kernel.fit_tiles(n, dsize)
+        if not fits:
+            return {"kernel": kernel, "tile": None,
+                    "reason": "no row tile fits the [tn, N] working set "
+                              "under the VMEM budget; use algo='xla' or "
+                              "shard over more workers"}
+        row = {"tile": None, "n": n, "dim": shape.get("dim"),
+               "num_workers": shape.get("num_workers")}
+        best = min(fits, key=lambda t: price(
+            "wdamds_dist_pallas", {**row, "tile": t}).predicted_s)
+        return {"kernel": kernel, "tile": best, "fits": fits,
+                "vmem_model": "wdamds_kernel.vmem_bytes (analytic, "
+                              "2026-08-06 — unmeasured)"}
+    if kernel == "rf.hist_bins":
+        from harp_tpu.ops import rf_kernel
+
+        f, bins = shape["f"], shape["n_bins"]
+        classes = int(shape.get("n_classes", 2))
+        depth = int(shape.get("depth", 6))
+        fB = f * bins
+        # the deepest grown level holds the most node-classes resident:
+        # 2^(depth-1) nodes × C labels, sublane-padded
+        nodeCp = 8 * -(-(2 ** (depth - 1) * classes) // 8)
+        fits = rf_kernel.fit_tiles(fB, nodeCp)
+        if not fits:
+            return {"kernel": kernel, "tile": None,
+                    "reason": "no sample tile fits fB plus the deepest "
+                              "level's histogram under the VMEM budget; "
+                              "use hist_algo='dense'"}
+        row = {"tile": None, "n": shape.get("n"), "features": f,
+               "n_bins": bins, "n_classes": classes, "depth": depth,
+               "num_workers": shape.get("num_workers")}
+        best = min(fits, key=lambda t: price(
+            "rf_hist_pallas", {**row, "tile": t}).predicted_s)
+        return {"kernel": kernel, "tile": best, "fits": fits,
+                "vmem_model": "rf_kernel.vmem_bytes (analytic, "
+                              "2026-08-06 — unmeasured)"}
     raise KeyError(f"no pre-size model for kernel {kernel!r} — register "
                    "one here when the kernel lands (see module doc)")

@@ -76,3 +76,11 @@ SMOKE["rf_dense_hist"] = SMOKE["rf_scatter_hist"] = SMOKE["rf"]
 SMOKE["svm_x_bf16"] = SMOKE["svm"]
 SMOKE["wdamds_delta_bf16"] = SMOKE["wdamds"]
 SMOKE["subgraph_csr32"] = SMOKE["subgraph"]
+# PR 17 kernelized arms measure their incumbents' shapes (only the
+# kernel schedule differs) — aliases again.  The shared shapes keep the
+# pallas branches ENGAGED in smoke mode: svm pads d to 128 lanes
+# regardless; wdamds n=256 pads to a 128-multiple; rf f=16 × 32 bins
+# gives fB = 512 (odd widths would silently fall back to the XLA arms).
+SMOKE["svm_kernel_pallas"] = SMOKE["svm"]
+SMOKE["wdamds_dist_pallas"] = SMOKE["wdamds"]
+SMOKE["rf_hist_pallas"] = SMOKE["rf"]

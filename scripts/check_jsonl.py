@@ -353,11 +353,12 @@ KNOWN_LINT_PROGRAMS = (
     "elastic.regather",
     "ingest.accum_chunk", "ingest.finish_epoch", "kmeans.fit",
     "kmeans.fit_hier", "lda.epoch",
-    "mfsgd.epoch", "rf.grow", "ring_attention",
+    "mfsgd.epoch", "rf.grow", "rf.grow_pallas", "ring_attention",
     "rotate.pipeline_chunked",
     "serve.kmeans_assign", "serve.lda_infer", "serve.mfsgd_topk",
     "serve.mlp_logits", "serve.rf_vote", "serve.svm_scores",
-    "subgraph.count", "svm.train", "wdamds.smacof")
+    "subgraph.count", "svm.train", "svm.train_pallas",
+    "wdamds.smacof", "wdamds.smacof_pallas")
 KNOWN_COMM_PRIMITIVES = ("all_gather", "all_to_all", "pmax", "pmin",
                          "ppermute", "psum", "reduce_scatter")
 KNOWN_COMM_VERBS = ("allgather", "allreduce", "allreduce_hier",
@@ -733,13 +734,15 @@ KNOWN_MODEL_CONFIGS = (
     "lda_rotate_int8", "lda_scale", "lda_scale_1m", "lda_scale_1m_pallas",
     "lda_scatter", "mfsgd", "mfsgd_carry", "mfsgd_chunked_rotate",
     "mfsgd_pallas", "mfsgd_scatter", "mlp", "mlp_grad_bf16",
-    "mlp_grad_int8", "rf", "rf_dense_hist", "rf_scatter_hist",
-    "serve_kmeans", "serve_kmeans_sustained",
+    "mlp_grad_int8", "rf", "rf_dense_hist", "rf_hist_pallas",
+    "rf_scatter_hist", "serve_kmeans", "serve_kmeans_sustained",
     "serve_mfsgd_sustained", "serve_mfsgd_topk", "subgraph",
     "subgraph_1m", "subgraph_1m_onehot", "subgraph_csr32",
     "subgraph_onehot", "subgraph_pl",
-    "svm", "svm_sv_bf16", "svm_sv_int8", "svm_x_bf16", "wdamds",
-    "wdamds_coord_bf16", "wdamds_coord_int8", "wdamds_delta_bf16")
+    "svm", "svm_kernel_pallas", "svm_sv_bf16",
+    "svm_sv_int8", "svm_x_bf16", "wdamds",
+    "wdamds_coord_bf16", "wdamds_coord_int8", "wdamds_delta_bf16",
+    "wdamds_dist_pallas")
 MODEL_TERM_FIELDS = ("compute_s", "memory_s", "wire_s", "overhead_s")
 
 

@@ -2467,3 +2467,118 @@ print(f"profile: {len(_pf_expect)} span labels classified, attribute() "
       f"and fires on flip (delta {_pf_f['share_delta']}), 5 new terms "
       "priced + ingest still refuses")
 print(f"DRIVE OK round-36 ({mode})")
+
+# --------------------------------------------------------------- round 37
+# PR 17: the kernelized half — drive all three Pallas arms end to end.
+# (a) CLI knob -> bench row: the three flip candidates run through the
+#     REAL measurement harness (scripts/measure_all.py --smoke on the
+#     forced-CPU 8-device sim) and emit non-error rows with a finite
+#     metric + quality field and the pallas knob recorded on the row;
+# (b) the gates fail closed IN CODE: a forged 2x-faster-but-degraded
+#     candidate is refused with the QUALITY DEGRADED reason (never the
+#     literal "FLIP:" marker an operator greps for), and a winning
+#     rf_hist_pallas whose anchor chain is incomplete (rf_dense_hist
+#     measured but ITS incumbent rf_scatter_hist missing) exits 1 with
+#     the conditional-gate UNMEASURED veto;
+# (c) attribution re-capture: the rf/svm/wdamds profile rows still
+#     reconcile (dispatch count, zero in-window compiles, CommLedger
+#     match) with the new kernels registered.
+import contextlib as _k17_ctx
+import io as _k17_io
+import json as _k17_json
+import subprocess as _k17_sp
+import tempfile as _k17_tf
+
+import flip_decision as _k17_fd
+from harp_tpu.profile import attribution as _k17_attr
+
+_k17_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_k17_cands = ("svm_kernel_pallas", "wdamds_dist_pallas", "rf_hist_pallas")
+
+# (a) the measurement harness itself, in a subprocess (fresh jax with 8
+# forced host devices — the parent's backend choice must not leak in)
+_k17_env = dict(os.environ)
+_k17_env["XLA_FLAGS"] = (_k17_env.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8")
+_k17_proc = _k17_sp.run(
+    [sys.executable, os.path.join(_k17_root, "scripts", "measure_all.py"),
+     "--smoke", "--platform", "cpu", "--only", *_k17_cands],
+    env=_k17_env, capture_output=True, text=True, timeout=1800)
+assert _k17_proc.returncode == 0, _k17_proc.stderr[-2000:]
+_k17_rows = {}
+for _k17_line in _k17_proc.stdout.splitlines():
+    _k17_line = _k17_line.strip()
+    if not _k17_line.startswith("{"):
+        continue
+    try:
+        _k17_row = _k17_json.loads(_k17_line)
+    except ValueError:
+        continue
+    if _k17_row.get("config") in _k17_cands:
+        _k17_rows[_k17_row["config"]] = _k17_row
+assert set(_k17_rows) == set(_k17_cands), sorted(_k17_rows)
+for _k17_name, _k17_metric, _k17_qual in (
+        ("svm_kernel_pallas", "samples_per_sec", "train_acc"),
+        ("wdamds_dist_pallas", "iters_per_sec", "final_stress"),
+        ("rf_hist_pallas", "trees_per_sec", "train_acc")):
+    _k17_row = _k17_rows[_k17_name]
+    assert "error" not in _k17_row, _k17_row
+    assert _k17_row.get(_k17_metric, 0) > 0 and np.isfinite(
+        _k17_row[_k17_metric]), _k17_row
+    assert np.isfinite(_k17_row[_k17_qual]), _k17_row
+assert _k17_rows["svm_kernel_pallas"]["algo"] == "pallas"
+assert _k17_rows["wdamds_dist_pallas"]["algo"] == "pallas"
+assert _k17_rows["rf_hist_pallas"]["hist_algo"] == "pallas"
+
+# (b1) quality gate: 2x speed never outruns a degraded quality field
+_k17_spec = _k17_fd.CANDIDATES["rf_hist_pallas"]
+_k17_bad = _k17_fd.decide(
+    {"config": "rf_hist_pallas", "trees_per_sec": 200.0, "train_acc": 0.80},
+    {"config": "rf_dense_hist", "trees_per_sec": 100.0, "train_acc": 0.99},
+    _k17_spec)
+assert _k17_bad["flip"] is False and _k17_bad["quality_ok"] is False
+assert "QUALITY DEGRADED" in _k17_bad["reason"], _k17_bad
+assert "FLIP:" not in _k17_bad["reason"], _k17_bad
+
+# (b2) conditional gate: a winning pallas row with rf_dense_hist
+# measured but the anchor's OWN incumbent (rf_scatter_hist) missing is
+# not a verdict — main() must veto AND signal exit 1 (rerun the benches)
+with _k17_tf.NamedTemporaryFile(
+        "w", suffix=".jsonl", delete=False) as _k17_f:
+    for _k17_forged in (
+            {"config": "rf_hist_pallas", "backend": "tpu",
+             "trees_per_sec": 200.0, "train_acc": 0.99},
+            {"config": "rf_dense_hist", "backend": "tpu",
+             "trees_per_sec": 100.0, "train_acc": 0.99}):
+        _k17_f.write(_k17_json.dumps(_k17_forged) + "\n")
+    _k17_bench = _k17_f.name
+_k17_out = _k17_io.StringIO()
+with _k17_ctx.redirect_stdout(_k17_out):
+    _k17_rc = _k17_fd.main(
+        ["--bench", _k17_bench, "--only", "rf_hist_pallas"])
+os.unlink(_k17_bench)
+assert _k17_rc == 1, _k17_out.getvalue()
+_k17_verdicts = [_k17_json.loads(ln)
+                 for ln in _k17_out.getvalue().splitlines() if ln.strip()]
+assert len(_k17_verdicts) == 1, _k17_verdicts
+_k17_v = _k17_verdicts[0]
+assert _k17_v["flip_decision"] == "rf_hist_pallas"
+assert _k17_v["flip"] is False
+assert "VETOED by conditional gate" in _k17_v["reason"], _k17_v
+assert "UNMEASURED" in _k17_v["reason"], _k17_v
+assert "FLIP:" not in _k17_v["reason"], _k17_v
+
+# (c) the newly priced apps still reconcile with the kernels registered
+for _k17_app in ("rf", "svm", "wdamds"):
+    _k17_prow = _k17_attr.capture(_k17_app, reps=2)
+    assert _k17_prow["reconciled"] is True, (
+        _k17_app, _k17_prow.get("checks"))
+    _k17_errs = _pf_cj._check_profile_row("drive", 0, _k17_prow)
+    assert _k17_errs == [], (_k17_app, _k17_errs)
+
+print("kernels: 3 pallas flip candidates measured through the real "
+      "harness (svm_kernel_pallas/wdamds_dist_pallas/rf_hist_pallas, "
+      "finite metric+quality, knob on the row), quality veto says "
+      "QUALITY DEGRADED not FLIP:, conditional gate exits 1 on the "
+      "unmeasured anchor chain, rf/svm/wdamds captures reconciled")
+print(f"DRIVE OK round-37 ({mode})")

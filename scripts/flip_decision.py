@@ -219,6 +219,27 @@ CANDIDATES = {
         "quality": "estimate", "sense": "equal", "rel_tol": 1e-3,
         "flips": "subgraph benchmark default max_degree=32 (padded-CSR "
                  "width; the overflow path absorbs the tail)"},
+    # PR 17: the kernelized arms of the newly priced half (presized
+    # offline, Mosaic-proven via HL201 — no silicon rows yet).  svm
+    # gates on train_acc at the wire-knob tolerance: the fused kernel
+    # replays the same Pegasos sums, so a miss means a broken fusion.
+    # wdamds gates on final_stress at the kernels' 2% band (the fused
+    # D/ratio block reassociates float sums only).  rf's kernel is
+    # bit-identical to the dense arm by construction (tests assert it),
+    # so its incumbent is rf_dense_hist — the arm that HOLDS the
+    # hist_algo slot — and the pair is EXCLUSIVE below.
+    "svm_kernel_pallas": {
+        "incumbent": "svm", "metric": "samples_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "SVMConfig.algo='pallas'"},
+    "wdamds_dist_pallas": {
+        "incumbent": "wdamds", "metric": "iters_per_sec",
+        "quality": "final_stress", "sense": "lower", "rel_tol": 0.02,
+        "flips": "MDSConfig.algo='pallas'"},
+    "rf_hist_pallas": {
+        "incumbent": "rf_dense_hist", "metric": "trees_per_sec",
+        "quality": "train_acc", "sense": "higher", "abs_tol": 0.005,
+        "flips": "RFConfig.hist_algo='pallas'"},
 }
 
 WIN_THRESHOLD = 1.10  # "wins >=10%" half of the rule
@@ -251,6 +272,12 @@ EXCLUSIVE_GATES = [("mfsgd_pallas", "mfsgd_carry"),
 CONDITIONAL_GATES = {
     "lda_pallas_carry": ("requires", "lda_pallas"),
     "lda_carry": ("requires_not", "lda_pallas"),
+    # PR 17: the rf kernel's evidence row measures pallas against the
+    # DENSE arm — it authorizes hist_algo='pallas' only on the stack
+    # where dense itself held the slot against scatter (an EXCLUSIVE
+    # gate would compare the two speedups raw, but they have different
+    # incumbents — dense-vs-scatter would veto a winning pallas flip)
+    "rf_hist_pallas": ("requires", "rf_dense_hist"),
 }
 
 

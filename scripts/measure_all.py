@@ -115,6 +115,13 @@ SPRINT_ORDER = [
     # 64-wide default; the overflow path absorbs the clipped tail).
     "rf_dense_hist", "rf_scatter_hist",
     "svm_x_bf16", "wdamds_delta_bf16", "subgraph_csr32",
+    # PR 17: the kernelized arms of the newly priced half — Pallas
+    # kernels for svm/wdamds/rf (ops/{svm,wdamds,rf}_kernel.py),
+    # presized offline (perfmodel.presize) and Mosaic-proven (HL201)
+    # before first silicon contact.  Gates: train_acc (svm/rf) /
+    # final_stress (wdamds); rf_hist_pallas is CONDITIONAL on
+    # rf_dense_hist holding the hist_algo slot.
+    "svm_kernel_pallas", "wdamds_dist_pallas", "rf_hist_pallas",
     # post-compaction subgraph rows (the committed 117.3k vertices/s
     # predates the compact-DP rewrite) + the overflow A/B pairs
     "subgraph_1m", "subgraph_1m_onehot",
@@ -459,6 +466,13 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         # precision changes — train_acc gates the flip)
         "svm_x_bf16": lambda: svm.benchmark(
             x_dtype="bf16", **(SMOKE["svm_x_bf16"] if smoke else {})),
+        # PR 17: the fused Pegasos kernel arm (ops/svm_kernel.py) —
+        # same shapes as the incumbent "svm" row, only the inner-solve
+        # schedule differs (one feature pass per step instead of two;
+        # train_acc gates the flip)
+        "svm_kernel_pallas": lambda: svm.benchmark(
+            algo="pallas",
+            **(SMOKE["svm_kernel_pallas"] if smoke else {})),
         "wdamds": lambda: wdamds.benchmark(
             **(SMOKE["wdamds"] if smoke else {})),
         "wdamds_coord_bf16": lambda: wdamds.benchmark(
@@ -470,6 +484,13 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "wdamds_delta_bf16": lambda: wdamds.benchmark(
             delta_dtype="bf16",
             **(SMOKE["wdamds_delta_bf16"] if smoke else {})),
+        # PR 17: the fused SMACOF kernel arm (ops/wdamds_kernel.py) —
+        # same shapes as the incumbent "wdamds" row, only the Guttman
+        # step schedule differs (D/ratio stay in VMEM; final_stress
+        # gates the flip)
+        "wdamds_dist_pallas": lambda: wdamds.benchmark(
+            algo="pallas",
+            **(SMOKE["wdamds_dist_pallas"] if smoke else {})),
         "subgraph": lambda: subgraph.benchmark(
             **(SMOKE["subgraph"] if smoke else {})),
         # PR 16: half-width padded CSR on the graded uniform graph — the
@@ -520,6 +541,15 @@ def run_all(smoke: bool, only, watchdog=None, skip=None):
         "rf_scatter_hist": lambda: rf.benchmark(
             hist_algo="scatter",
             **({**SMOKE["rf_scatter_hist"],
+                "n_trees": 2 * jax.device_count()}
+               if smoke else {})),
+        # PR 17: the on-chip histogram kernel arm (ops/rf_kernel.py) —
+        # bit-identical counts to the dense arm (tests assert it), only
+        # the memory schedule differs; CONDITIONAL on rf_dense_hist in
+        # flip_decision
+        "rf_hist_pallas": lambda: rf.benchmark(
+            hist_algo="pallas",
+            **({**SMOKE["rf_hist_pallas"],
                 "n_trees": 2 * jax.device_count()}
                if smoke else {})),
         # the REAL-ingest half of the north-star (disk npy memmap through

@@ -263,6 +263,45 @@ def test_presizer_reports_vmem_wall():
     assert out["tile"] is None and "budget" in out["reason"]
 
 
+# -- PR 17: the three newly kernelized arms are presized OFFLINE (no
+# silicon evidence yet) — these pins are the tiles the sprint will try
+# FIRST, and the ranking rationale in the config comments cites them.
+
+def test_presizer_picks_the_svm_sample_tile():
+    """Whole-d resident w/x-tile: the grid-overhead term is monotone in
+    1/tn, so the largest VMEM-fitting sample tile must win (8192 at the
+    graded 500k x 128 f32 shape)."""
+    out = perfmodel.presize("svm.kernel_row", n=500_000, d=128)
+    assert out["tile"] == 8192, out
+    assert set(out["fits"]) >= {8192, 4096, 2048}
+
+
+def test_presizer_picks_the_wdamds_column_tile():
+    """X (all N rows) stays resident; the column tile only bounds the
+    delta/dist working set — largest fitting tile (128 at the graded
+    4096-point shape) wins on the same 1/tn overhead argument."""
+    out = perfmodel.presize("wdamds.smacof_dist",
+                            n=4096, num_workers=8, dim=3)
+    assert out["tile"] == 128, out
+    assert set(out["fits"]) >= {128, 64, 32}
+
+
+def test_presizer_reports_wdamds_vmem_wall():
+    """At 200k points the resident [N, dim] + [tn, N] blocks cannot fit
+    any lane-aligned tile — the pre-sizer must say so offline instead of
+    letting the sprint discover it as a Mosaic OOM."""
+    out = perfmodel.presize("wdamds.smacof_dist",
+                            n=200_000, num_workers=8, dim=3)
+    assert out["tile"] is None and "budget" in out["reason"]
+
+
+def test_presizer_picks_the_rf_row_tile():
+    out = perfmodel.presize("rf.hist_bins", n=200_000, f=64, n_bins=32,
+                            n_classes=2, depth=6, num_workers=8)
+    assert out["tile"] == 2048, out
+    assert set(out["fits"]) >= {2048, 1024, 512}
+
+
 # -- 4. sprint pruning respects the gates -----------------------------------
 
 def test_gate_closure_never_drops_a_partner():

@@ -1,0 +1,175 @@
+"""On-chip one-hot histogram kernel (ops/rf_kernel.py) vs the dense arm.
+
+Counts are integers accumulated exactly (int8 products ≤ 127 summed in
+int32), so every comparison here is BIT-IDENTICAL — `assert_array_equal`
+throughout, never allclose.  A single off-by-one count can change a Gini
+argmin, so "close" is not a meaningful notion for this kernel.  The
+tests pin the kernel against a numpy scatter-add golden, the dense XLA
+arm through _grow_level, the tree-vmap batching the model runs under,
+the full forest under the 8-worker mesh, and the offline guarantees
+(VMEM rejection + Mosaic lowering at the registry/graded shapes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.models import rf as RF
+from harp_tpu.ops import rf_kernel as K
+
+
+def _golden(bins, y, w, node_id, f, B, nodeC, C):
+    """Exact numpy scatter-add: hist[node·C + y, feat·B + bin] += w."""
+    hist = np.zeros((nodeC, f * B), np.int64)
+    for i in range(len(y)):
+        for j in range(f):
+            hist[node_id[i] * C + y[i], j * B + bins[i, j]] += w[i]
+    return hist.astype(np.int32)
+
+
+def _bo(bins, B):
+    return np.asarray(RF.bins_onehot(jnp.asarray(bins), B))
+
+
+def test_hist_matches_numpy_scatter():
+    rng = np.random.default_rng(0)
+    n, f, B, C, level = 300, 16, 8, 3, 2       # fB = 128, pads n → tn
+    bins = rng.integers(0, B, (n, f)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    w = rng.poisson(1.0, n).astype(np.int32)
+    node_id = rng.integers(0, 2 ** level, n).astype(np.int32)
+    nodeC = 2 ** level * C
+    hist = K.hist_bins(jnp.asarray(_bo(bins, B)),
+                       jnp.asarray(node_id * C + y), jnp.asarray(w),
+                       nodeC, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(hist), _golden(bins, y, w, node_id, f, B, nodeC, C))
+
+
+def test_multi_tile_grid_accumulates_exactly():
+    """n > tn drives the sequential-grid accumulation and the pad
+    sentinel (rowcode = nodeCp, weight 0) — pad samples must count ZERO
+    times, not once, and tiles must accumulate, not overwrite."""
+    rng = np.random.default_rng(1)
+    n, f, B, C = 700, 16, 8, 2                 # 700 → n_pad 768 at tn=128
+    bins = rng.integers(0, B, (n, f)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    w = rng.integers(1, 5, n).astype(np.int32)
+    hist = K.hist_bins(jnp.asarray(_bo(bins, B)), jnp.asarray(y),
+                       jnp.asarray(w), C, tn=128, interpret=True)
+    exp = _golden(bins, y, w, np.zeros(n, np.int32), f, B, C, C)
+    np.testing.assert_array_equal(np.asarray(hist), exp)
+    assert int(np.asarray(hist).sum()) == int(w.sum()) * f  # pads add 0
+
+
+def test_vmaps_like_the_tree_axis():
+    """The model calls the kernel under the per-tree vmap — batching
+    must add a grid dimension, not corrupt the accumulator."""
+    rng = np.random.default_rng(2)
+    T, n, f, B, C = 3, 200, 16, 8, 2
+    bins = rng.integers(0, B, (T, n, f)).astype(np.int32)
+    y = rng.integers(0, C, (T, n)).astype(np.int32)
+    w = rng.integers(0, 4, (T, n)).astype(np.int32)
+    BO = jnp.stack([jnp.asarray(_bo(b, B)) for b in bins])
+    out = jax.vmap(lambda a, r, ww: K.hist_bins(a, r, ww, C,
+                                                interpret=True))(
+        BO, jnp.asarray(y), jnp.asarray(w))
+    for t in range(T):
+        np.testing.assert_array_equal(
+            np.asarray(out[t]),
+            _golden(bins[t], y[t], w[t], np.zeros(n, np.int32), f, B, C, C))
+
+
+def test_grow_level_pallas_bit_identical_to_dense(mesh):
+    """The hist_algo="pallas" arm through _grow_level must pick
+    bit-identical splits and routes to the dense incumbent (same int8
+    products, different memory schedule), so the rf_hist_pallas flip
+    gate can demand equal train_acc."""
+    rng = np.random.default_rng(3)
+    n, f, B, C, level = 300, 16, 8, 3, 2       # fB = 128 engages pallas
+    bins = rng.integers(0, B, (n, f)).astype(np.int32)
+    y = rng.integers(0, C, n).astype(np.int32)
+    w = rng.poisson(1.0, n).astype(np.float32)
+    node_id = rng.integers(0, 2 ** level, n).astype(np.int32)
+    feat_mask = np.ones(f, np.float32)
+    BO = RF.bins_onehot(jnp.asarray(bins), B)
+    outs = {}
+    for algo in ("dense", "pallas"):
+        cfg = RF.RFConfig(n_bins=B, n_classes=C, max_depth=3,
+                          hist_algo=algo)
+        outs[algo] = RF._grow_level(
+            BO, jnp.asarray(bins), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(node_id), level, jnp.asarray(feat_mask), cfg)
+    for a, b in zip(outs["dense"], outs["pallas"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_forest_pallas_bit_identical_to_dense(mesh):
+    """Whole-forest fit under the 8-worker mesh (f=16 × 32 bins → the
+    smoke fB=512): identical trees, identical predictions."""
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(512, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    preds = {}
+    for algo in ("dense", "pallas"):
+        m = RF.RandomForest(RF.RFConfig(n_trees=8, max_depth=3,
+                                        hist_algo=algo), mesh)
+        m.fit(x, y)
+        preds[algo] = m.predict(x)
+    np.testing.assert_array_equal(preds["pallas"], preds["dense"])
+    assert (preds["dense"] == y).mean() > 0.9
+
+
+def test_odd_width_falls_back_to_dense(mesh):
+    """f·B not a 128 multiple must fall back to the dense arm (not
+    error): f=5, B=8 → fB=40."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(256, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    m = RF.RandomForest(RF.RFConfig(n_trees=8, max_depth=3, n_bins=8,
+                                    hist_algo="pallas"), mesh)
+    m.fit(x, y)
+    assert (m.predict(x) == y).mean() > 0.8
+
+
+def test_pick_tile_is_largest_fitting():
+    # the presize pin: graded 64 features × 32 bins, depth 6, 2 classes
+    assert K.pick_tile(200_000, 64 * 32, 64) == 2048
+    assert K.pick_tile(100, 2048, 64) == 128      # capped by n_pad
+    with pytest.raises(ValueError, match="VMEM budget"):
+        K.pick_tile(4096, 1 << 17, 8)             # no tile fits
+
+
+def test_rejects_tile_over_vmem_budget():
+    n, fB, tn = 2048, 4096, 2048        # 2·2048·4096 B ≈ 16.8 MB
+    with pytest.raises(ValueError, match="VMEM budget"):
+        K.hist_bins(jnp.zeros((n, fB), jnp.int8), jnp.zeros(n, jnp.int32),
+                    jnp.zeros(n, jnp.int32), 8, tn=tn, interpret=True)
+
+
+def test_rejects_unaligned_width_for_tpu():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        K.hist_bins(jnp.zeros((128, 96), jnp.int8),
+                    jnp.zeros(128, jnp.int32), jnp.zeros(128, jnp.int32),
+                    8, tn=128, interpret=False)
+
+
+@pytest.mark.parametrize("n,fB,tn,nodeC", [
+    (512, 512, 128, 8),       # the registry-proven shape
+    (4096, 2048, 2048, 64),   # the graded presized tile (64f × 32 bins,
+                              # depth-6 frame: 32 nodes × 2 classes)
+])
+def test_kernel_lowers_for_tpu(n, fB, tn, nodeC):
+    """Cross-platform lowering runs the Pallas->Mosaic verification
+    (int8 one-hot build, iota compare, int32 MXU accumulation) without
+    hardware (HL201 idiom)."""
+    import functools
+
+    f = functools.partial(K.hist_bins, n_node_classes=nodeC, tn=tn,
+                          interpret=False)
+    lowered = jax.jit(f).trace(
+        jnp.zeros((n, fB), jnp.int8), jnp.zeros(n, jnp.int32),
+        jnp.zeros(n, jnp.int32)).lower(lowering_platforms=("tpu",))
+    assert "tpu_custom_call" in lowered.as_text()

@@ -1,0 +1,174 @@
+"""Fused Pegasos hinge-gradient kernel (ops/svm_kernel.py) vs the XLA arm.
+
+The kernel promises the SAME per-step sums as `models/svm.py:_pegasos`
+(gw = Σ coef·x, gs = Σ coef) — these tests pin the fused pass against a
+numpy golden, the full inner solve against the XLA scan, the bf16 arm's
+composition with ``x_dtype``, and the offline guarantees (presized VMEM
+rejection + Mosaic lowering at the registry/graded shapes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from harp_tpu.models import svm as SV
+from harp_tpu.ops import svm_kernel as K
+
+
+def _golden(w, b, x, y, sw):
+    """The per-step sums of _pegasos, un-normalised (numpy, f64-free:
+    integer-free f32 math matches the kernel's f32 accumulation)."""
+    margin = y * (x @ w + b)
+    coef = np.where(margin < 1.0, sw, 0.0) * y
+    return coef @ x, coef.sum()
+
+
+def _call(w, b, x, y, sw, tn, dtype=np.float32, cd=jnp.float32):
+    n, d = x.shape
+    dp = 128 * -(-d // 128)
+    n_pad = tn * -(-n // tn)
+    xT = np.zeros((dp, n_pad), dtype)
+    xT[:d, :n] = x.T
+    yp = np.zeros(n_pad, np.float32)
+    yp[:n] = y
+    swp = np.zeros(n_pad, np.float32)        # pad samples: sw = 0
+    swp[:n] = sw
+    gw, gs = K.pegasos_grad(
+        jnp.pad(jnp.asarray(w), (0, dp - d)), jnp.float32(b),
+        jnp.asarray(xT), jnp.asarray(yp), jnp.asarray(swp),
+        tn=tn, compute_dtype=cd, interpret=True)
+    return np.asarray(gw)[:d], float(gs)
+
+
+def test_fused_grad_matches_numpy():
+    rng = np.random.default_rng(0)
+    n, d = 100, 20                       # pads d → 128, n → tn
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.sign(rng.normal(size=n)).astype(np.float32)
+    sw = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    gw, gs = _call(w, 0.3, x, y, sw, tn=128)
+    egw, egs = _golden(w, 0.3, x, y, sw)
+    np.testing.assert_allclose(gw, egw, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gs, egs, rtol=1e-5)
+
+
+def test_multi_tile_grid_accumulates():
+    """n_pad/tn > 1 drives the sequential-grid accumulation path (the
+    zero-init-at-step-0 contract) — a wrong index map or a missing
+    @pl.when would double-count or drop tiles here."""
+    rng = np.random.default_rng(1)
+    n, d = 500, 48                       # 500 → n_pad 512 = 4 tiles
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.sign(rng.normal(size=n)).astype(np.float32)
+    sw = rng.uniform(0.0, 2.0, n).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    gw, gs = _call(w, -0.1, x, y, sw, tn=128)
+    egw, egs = _golden(w, -0.1, x, y, sw)
+    np.testing.assert_allclose(gw, egw, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(gs, egs, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_arm_matches_bf16_golden():
+    """The bf16 arm (x staged bf16, dots bf16×bf16→f32) must match the
+    numpy golden computed on the SAME bf16-rounded features — precision
+    loss comes from the rounding, not the kernel schedule."""
+    rng = np.random.default_rng(2)
+    n, d = 128, 16
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.sign(rng.normal(size=n)).astype(np.float32)
+    sw = np.ones(n, np.float32)
+    w = (0.1 * rng.normal(size=d)).astype(np.float32)  # margins far from 1
+    x_bf = np.asarray(jnp.asarray(x).astype(jnp.bfloat16))
+    gw, gs = _call(w, 0.0, x_bf, y, sw, tn=128,
+                   dtype=jnp.bfloat16, cd=jnp.bfloat16)
+    egw, egs = _golden(w, 0.0, x_bf.astype(np.float32), y, sw)
+    np.testing.assert_allclose(gw, egw, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(gs, egs, rtol=1e-4, atol=1e-4)
+
+
+def test_inner_solve_matches_xla_scan():
+    """_pegasos_pallas runs the same update sequence as _pegasos — the
+    whole inner solve must agree to accumulation-order rounding."""
+    rng = np.random.default_rng(3)
+    n, d = 300, 24
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.sign(x[:, 0] + 0.1 * rng.normal(size=n)).astype(np.float32)
+    y[y == 0] = 1.0
+    sw = rng.uniform(0.0, 2.0, n).astype(np.float32)
+    cfg = SV.SVMConfig(inner_steps=12, algo="pallas")
+    w0 = jnp.zeros(d, jnp.float32)
+    wx, bx = SV._pegasos(w0, jnp.float32(0.0), jnp.asarray(x),
+                         jnp.asarray(y), jnp.asarray(sw), cfg)
+    wp, bp = SV._pegasos_pallas(w0, jnp.float32(0.0), jnp.asarray(x),
+                                jnp.asarray(y), jnp.asarray(sw), cfg)
+    np.testing.assert_allclose(np.asarray(wp), np.asarray(wx),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(bp), float(bx), rtol=1e-4, atol=1e-6)
+
+
+def test_model_pallas_matches_xla(mesh):
+    """End-to-end under the 8-worker mesh: the algo="pallas" model must
+    learn the same separable task to the same weights (the SV exchange,
+    padding and round structure all ride along)."""
+    rng = np.random.default_rng(4)
+    d = 16
+    true_w = rng.normal(size=d).astype(np.float32)
+    x = rng.normal(size=(1024, d)).astype(np.float32)
+    y = np.sign(x @ true_w).astype(np.float32)
+    y[y == 0] = 1.0
+    out = {}
+    for algo in ("xla", "pallas"):
+        m = SV.SVM(SV.SVMConfig(inner_steps=60, outer_rounds=2,
+                                sv_per_worker=32, algo=algo), mesh)
+        m.fit(x, y)
+        out[algo] = (m.w, m.b, m.accuracy(x, y))
+    assert out["pallas"][2] > 0.93
+    np.testing.assert_allclose(out["pallas"][0], out["xla"][0],
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(out["pallas"][1], out["xla"][1],
+                               rtol=1e-3, atol=1e-6)
+
+
+def test_pick_tile_is_largest_fitting():
+    assert K.pick_tile(500_000, 128, 4) == 8192   # the presize pin
+    assert K.pick_tile(100, 128, 4) == 128        # capped by n_pad
+    # bf16 halves tile bytes → same largest tile fits with room
+    assert set(K.fit_tiles(128, 2)) >= set(K.fit_tiles(128, 4))
+
+
+def test_rejects_tile_over_vmem_budget():
+    d, tn = 1024, 2048                  # 2·1024·2048·4 B ≈ 16.8 MB
+    with pytest.raises(ValueError, match="VMEM budget"):
+        K.pegasos_grad(jnp.zeros(d), jnp.float32(0.0),
+                       jnp.zeros((d, tn)), jnp.zeros(tn), jnp.zeros(tn),
+                       tn=tn, interpret=True)
+
+
+def test_rejects_unaligned_shapes_for_tpu():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        K.pegasos_grad(jnp.zeros(64), jnp.float32(0.0),
+                       jnp.zeros((64, 128)), jnp.zeros(128),
+                       jnp.zeros(128), tn=128, interpret=False)
+
+
+@pytest.mark.parametrize("dp,n_pad,tn,dtype", [
+    (128, 512, 128, jnp.float32),    # the registry-proven shape
+    (128, 8192, 8192, jnp.float32),  # the graded presized tile
+    (128, 8192, 8192, jnp.bfloat16),  # the x_dtype-composed bf16 arm
+])
+def test_kernel_lowers_for_tpu(dp, n_pad, tn, dtype):
+    """Cross-platform lowering runs the Pallas->Mosaic verification
+    (layouts, block shapes, casts) without hardware (HL201 idiom)."""
+    import functools
+
+    cd = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+    f = functools.partial(K.pegasos_grad, tn=tn, compute_dtype=cd,
+                          interpret=False)
+    lowered = jax.jit(f).trace(
+        jnp.zeros(dp), jnp.float32(0.0), jnp.zeros((dp, n_pad), dtype),
+        jnp.zeros(n_pad), jnp.zeros(n_pad)).lower(
+        lowering_platforms=("tpu",))
+    assert "tpu_custom_call" in lowered.as_text()
