@@ -38,6 +38,9 @@ APPS = {
     "trace": ("harp_tpu.utils.reqtrace",
               "request-level timeline: validate/summarize a trace JSONL, "
               "export Chrome/Perfetto trace.json"),
+    "timeline": ("harp_tpu.utils.steptrace",
+                 "training-plane timeline: validate/summarize kind:'steptrace' "
+                 "superstep rows, export Chrome/Perfetto trace.json"),
     "health": ("harp_tpu.health.cli",
                "health sentinel: summarize kind:'health' findings, grade "
                "fresh bench rows, run the fail-closed model gate"),

@@ -479,6 +479,8 @@ def elastic_fit(adapter: ElasticAdapter, epochs: int,
         if rebalance:
             maybe_rebalance(adapter)
 
+    from harp_tpu.utils import steptrace
+
     arm = fault.arm() if fault is not None else contextlib.nullcontext()
     if ckpt_dir is None:
         if fault is not None:
@@ -486,9 +488,10 @@ def elastic_fit(adapter: ElasticAdapter, epochs: int,
                 "fault injection requires ckpt_dir (recovery restarts "
                 "from checkpoints; without one the injector would be "
                 "silently ignored)")
-        with arm:
-            for _ in range(epochs):
-                sweep()
+        with arm, steptrace.run(adapter.phase):
+            for i in range(epochs):
+                with steptrace.superstep(adapter.phase, i):
+                    sweep()
         return adapter
 
     from harp_tpu.utils.checkpoint import CheckpointManager
@@ -496,13 +499,17 @@ def elastic_fit(adapter: ElasticAdapter, epochs: int,
     mgr = CheckpointManager(ckpt_dir)
 
     def step(i, state):
+        # install() stays OUTSIDE the span: a genuine restore emits its
+        # elastic "resume" row there, which steptrace latches onto the
+        # NEXT span as outcome "resumed" (the timeline's restart seam)
         adapter.install(state)
-        sweep()
+        with steptrace.superstep(adapter.phase, i):
+            sweep()
         st = adapter.canonical_state()
         st["step"] = i
         return st
 
-    with arm:
+    with arm, steptrace.run(adapter.phase):
         run_with_recovery(adapter.canonical_state, step, epochs, mgr,
                           ckpt_every=ckpt_every,
                           max_restarts=max_restarts, fault=fault,

@@ -48,9 +48,19 @@ class ElasticLedger:
     def record(self, event: str, phase: str, **fields) -> dict:
         if event not in EVENTS:
             raise ValueError(f"event {event!r} not in {EVENTS}")
+        # on_timeline stamps whether a steptrace run covered this event
+        # (PR 18) — invariant 16 reconciles covered rows against the
+        # timeline's elastic marks EXACTLY in both directions, while a
+        # row recorded outside any run (e.g. a manual install() for a
+        # bit-identity comparison) is legitimately unmarked
+        from harp_tpu.utils import steptrace
+
+        covered = steptrace.tracer._run is not None
         row = {"kind": "elastic", "event": event, "phase": phase,
-               **fields}
+               "on_timeline": covered, **fields}
         self.rows.append(row)
+        if covered:
+            steptrace.tracer.on_elastic(event, phase, row)
         return row
 
     def export_jsonl(self, fh, stamp: dict | None = None) -> None:

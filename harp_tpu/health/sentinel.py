@@ -157,6 +157,12 @@ class HealthMonitor:
             row = {"kind": "health", "detector": detector,
                    "severity": severity, "_seq": self._seq}
             self._rows[k] = row
+            # the superstep timeline's health mark (PR 18) — one mark
+            # per NEW finding only (updates mutate the row in place)
+            from harp_tpu.utils import steptrace
+
+            if steptrace.tracer._run is not None:
+                steptrace.tracer.on_health(detector, key)
         elif _SEV_RANK[severity] > _SEV_RANK[row["severity"]]:
             row["severity"] = severity
         return row
@@ -233,6 +239,12 @@ class HealthMonitor:
         row = self._rows.get(("skew_trigger", phase))
         if row is not None:
             row["consumed"] = True  # visible in the exported evidence
+        from harp_tpu.utils import steptrace
+
+        if steptrace.tracer._run is not None:
+            # actuation mark (PR 18): the handshake firing lands on the
+            # superstep timeline next to the rebalance it triggers
+            steptrace.tracer.on_skew_consume(phase)
         return row
 
     # -- budget drift -------------------------------------------------------

@@ -231,6 +231,7 @@ class CCD:
             get_state, set_state,
             epochs, ckpt_dir, ckpt_every=ckpt_every,
             max_restarts=max_restarts, fault=fault,
+            phase="ccd.epochs",
         )
         return rmses
 

@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 from harp_tpu.ops.pallas_compat import interpret_default
 from harp_tpu.parallel import collective as C
 from harp_tpu.parallel.mesh import WorkerMesh, current_mesh
-from harp_tpu.utils import flightrec, prng, skew, telemetry
+from harp_tpu.utils import flightrec, prng, skew, steptrace, telemetry
 from harp_tpu.utils.timing import device_sync
 
 
@@ -498,8 +498,13 @@ def fit(points, k=100, iters=10, mesh: WorkerMesh | None = None, seed=0,
     # per-iteration comm sites execute cfg.iters times per invocation;
     # the flight recorder sees that one dispatch plus exactly two
     # readbacks (inertia scalar + final centroids)
+    # steptrace (PR 18): the whole-run dispatch is ONE superstep — the
+    # timeline shows the single-dispatch discipline literally (one span,
+    # flight {dispatches: 1})
     with telemetry.span("kmeans.fit", iters=cfg.iters, k=k), \
-            telemetry.ledger.run("kmeans.fit", steps=cfg.iters):
+            telemetry.ledger.run("kmeans.fit", steps=cfg.iters), \
+            steptrace.run("kmeans.fit"), \
+            steptrace.superstep("kmeans.fit", 0):
         t0 = time.perf_counter()
         new_c, stats = fit_fn(pts, centroids)
         st = flightrec.readback(stats)  # [nw, 2]: per-worker rows, inertia
@@ -542,13 +547,15 @@ def _fit_ckpt(mesh, cfg, pts, centroids, iters, ckpt_dir, *,
                 "stats": jnp.zeros((nw, 2), jnp.float32)}
 
     def step(ci, state):
-        c = state["centroids"]
-        if not isinstance(c, jax.Array):  # numpy from a fresh restore
-            c = place(c)
-        new_c, stats = chunk_fn(lens[ci])(pts, c)
-        return {"centroids": new_c, "stats": stats}
+        with steptrace.superstep("kmeans.fit_ckpt", ci):
+            c = state["centroids"]
+            if not isinstance(c, jax.Array):  # numpy from a fresh restore
+                c = place(c)
+            new_c, stats = chunk_fn(lens[ci])(pts, c)
+            return {"centroids": new_c, "stats": stats}
 
-    with telemetry.span("kmeans.fit_ckpt", iters=iters, k=cfg.k):
+    with telemetry.span("kmeans.fit_ckpt", iters=iters, k=cfg.k), \
+            steptrace.run("kmeans.fit_ckpt"):
         final = run_with_recovery(make_state, step, len(lens), mgr,
                                   ckpt_every=1, max_restarts=max_restarts,
                                   fault=fault)

@@ -493,7 +493,7 @@ def _stream_train(mesh, cfg, pipe, n_chunks, centroids, iters, dtype,
     try:
         fit_epochs(train_one, get_state, set_state, iters, ckpt_dir,
                    ckpt_every=ckpt_every, max_restarts=max_restarts,
-                   fault=fault)
+                   fault=fault, phase="kmeans_stream.iters")
     finally:
         pipe.close()  # reap the stage threads on every exit path
     final = np.asarray(jnp.stack(history))  # ONE readback for all epochs

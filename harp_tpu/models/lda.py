@@ -1266,7 +1266,8 @@ class LDA:
 
         fit_epochs(self.sample_epoch, get_state, set_state, epochs,
                    ckpt_dir, ckpt_every=ckpt_every,
-                   max_restarts=max_restarts, fault=fault)
+                   max_restarts=max_restarts, fault=fault,
+                   phase="lda.epochs")
 
     def log_likelihood(self):
         """Mean per-token predictive log-likelihood of current assignments."""

@@ -773,6 +773,7 @@ class MFSGD:
             get_state, set_state,
             epochs, ckpt_dir, ckpt_every=ckpt_every,
             max_restarts=max_restarts, fault=fault,
+            phase="mfsgd.epochs",
         )
         return rmses
 

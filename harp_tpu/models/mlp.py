@@ -518,6 +518,7 @@ class MLPTrainer:
             set_state,
             epochs, ckpt_dir, ckpt_every=ckpt_every,
             max_restarts=max_restarts, fault=fault,
+            phase="mlp.epochs",
         )
         return history
 

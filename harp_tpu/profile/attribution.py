@@ -78,6 +78,12 @@ PROFILE_APPS = {
     "wdamds": "wdamds.smacof",
     "subgraph": "subgraph.count",
     "serve": "serve.kmeans_assign",
+    # PR-17 kernelized arms (PR 18 closes the coverage gap): the flip
+    # candidates priced off the dense rows' attribution now carry their
+    # own — a kernel that moved the bound shows up here first.
+    "rf_pallas": "rf.grow_pallas",
+    "svm_pallas": "svm.train_pallas",
+    "wdamds_pallas": "wdamds.smacof_pallas",
 }
 
 # -- the classifier ---------------------------------------------------------

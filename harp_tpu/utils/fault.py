@@ -253,15 +253,18 @@ class FaultInjector:
         """Fault-plane events ride the unified timeline (PR 12): a
         ``source:"fault"`` mark on the SpanTracer clock, so an injected
         failure shows up next to the batch that absorbed it in
-        ``telemetry.export_timeline`` / the Perfetto view.  No-op while
-        telemetry is off, like every other spine hook."""
-        from harp_tpu.utils import reqtrace, telemetry
+        ``telemetry.export_timeline`` / the Perfetto view — and, inside
+        a training run, the same fire lands on the superstep timeline
+        (PR 18).  No-op while telemetry is off, like every other spine
+        hook."""
+        from harp_tpu.utils import reqtrace, steptrace, telemetry
 
         if telemetry.enabled():
             reqtrace.tracer.mark(
                 "fault", f"injected_{action}",
                 time.perf_counter() - telemetry.tracer._t0,
                 site=site, ordinal=ordinal)
+            steptrace.tracer.on_fault(site, ordinal, action)
 
     @contextlib.contextmanager
     def arm(self):
@@ -330,6 +333,7 @@ def fit_epochs(
     ckpt_every: int = 5,
     max_restarts: int = 3,
     fault: "FaultInjector | None" = None,
+    phase: str = "fit",
 ) -> None:
     """Epoch-loop driver with optional checkpoint/resume — shared by the
     model ``fit`` methods (MF-SGD, LDA).
@@ -342,15 +346,24 @@ def fit_epochs(
       call's entry (snapshotted host-side), never from crash-time state;
     - a resume with no epochs left still installs the restored state;
     - ``fault`` without ``ckpt_dir`` is refused rather than ignored.
+
+    ``phase`` names the run on the superstep timeline (PR 18): with
+    telemetry on, the whole call is one :func:`harp_tpu.utils.steptrace.
+    run` and every ``train_one`` a terminated superstep span; zero-cost
+    and span-free when telemetry is off.
     """
+    from harp_tpu.utils import steptrace
+
     if ckpt_dir is None:
         if fault is not None:
             raise ValueError(
                 "fault injection requires ckpt_dir (recovery restarts from "
                 "checkpoints; without one the injector would be silently "
                 "ignored)")
-        for _ in range(epochs):
-            train_one()
+        with steptrace.run(phase):
+            for i in range(epochs):
+                with steptrace.superstep(phase, i):
+                    train_one()
         return
 
     import numpy as np
@@ -369,12 +382,14 @@ def fit_epochs(
 
     def step(i, state):
         set_state(state)
-        train_one()
+        with steptrace.superstep(phase, i):
+            train_one()
         return get_state()
 
-    final = run_with_recovery(lambda: init, step, epochs, mgr,
-                              ckpt_every=ckpt_every,
-                              max_restarts=max_restarts, fault=fault)
+    with steptrace.run(phase):
+        final = run_with_recovery(lambda: init, step, epochs, mgr,
+                                  ckpt_every=ckpt_every,
+                                  max_restarts=max_restarts, fault=fault)
     # a resume that had nothing left to run still must land in the model
     set_state(final)
 
@@ -412,6 +427,7 @@ def run_with_recovery(
     consume ``max_restarts``.
     """
     restarts = 0
+    first = True
     while True:
         latest = ckpt.latest_step()
         if latest is None:
@@ -419,6 +435,14 @@ def run_with_recovery(
         else:
             start, state = ckpt.restore()
             start += 1
+            if not first:
+                # any restart's restore (transient or post-shrink) is a
+                # ckpt:restore mark on the superstep timeline (PR 18);
+                # a fresh call resuming a populated dir is not a restart
+                from harp_tpu.utils import steptrace
+
+                steptrace.tracer.note_restore(start)
+        first = False
         try:
             for i in range(start, n_iters):
                 if fault is not None:

@@ -107,6 +107,10 @@ class CompileWatch:
                              "span": telemetry.tracer.current_path(),
                              "t": round(time.perf_counter()
                                         - telemetry.tracer._t0, 6)})
+        from harp_tpu.utils import steptrace
+
+        if steptrace.tracer._run is not None:  # PR 18 superstep mark
+            steptrace.tracer.on_compile(duration)
 
     def summary(self) -> dict:
         """{"count", "total_s", "by_span": {span_path: {count, total_s}}}."""

@@ -411,72 +411,55 @@ def perfetto(rows: list[dict]) -> dict:
     pipeline-depth-folded track (pid 2, tid = seq % 4 so the depth-2
     overlap is visible instead of stacked), and degradation/fault
     events are instants (``i``).  Timestamps are microseconds from the
-    earliest row (the Trace Event format's unit).
+    earliest row (the Trace Event format's unit).  The event plumbing
+    is the shared :mod:`harp_tpu.utils.perfetto` builder (PR 18).
     """
+    from harp_tpu.utils import perfetto as pft
+
     trace_rows = [r for r in rows if r.get("kind") == "trace"]
     if not trace_rows:
-        return {"traceEvents": [], "displayTimeUnit": "ms"}
-    t0 = min(float(r["ts"]) for r in trace_rows)
-
-    def us(ts: float) -> float:
-        return round((float(ts) - t0) * 1e6, 3)
-
-    events: list[dict] = [
-        {"name": "process_name", "ph": "M", "pid": _PID_REQ,
-         "args": {"name": "requests"}},
-        {"name": "process_name", "ph": "M", "pid": _PID_BATCH,
-         "args": {"name": "batches"}},
-        {"name": "process_name", "ph": "M", "pid": _PID_MARK,
-         "args": {"name": "events"}},
-    ]
+        return pft.empty()
+    b = pft.TraceBuilder(min(float(r["ts"]) for r in trace_rows))
+    b.process(_PID_REQ, "requests")
+    b.process(_PID_BATCH, "batches")
+    b.process(_PID_MARK, "events")
     by_req: dict[int, list[dict]] = {}
     for r in trace_rows:
         ev = r.get("ev")
         if ev == "event" and "req" in r:
             by_req.setdefault(r["req"], []).append(r)
         elif ev == "request":
-            dur = max(float(r["ts"]) - float(r.get("t0", r["ts"])), 0.0)
-            events.append({
-                "name": f"req {r['req']} [{r.get('outcome')}]",
-                "ph": "X", "pid": _PID_REQ, "tid": int(r["req"]),
-                "ts": us(r.get("t0", r["ts"])), "dur": round(dur * 1e6, 3),
-                "args": {"outcome": r.get("outcome"),
-                         "n_events": r.get("n_events")}})
+            b.complete(f"req {r['req']} [{r.get('outcome')}]",
+                       _PID_REQ, r["req"], r.get("t0", r["ts"]), r["ts"],
+                       args={"outcome": r.get("outcome"),
+                             "n_events": r.get("n_events")})
         elif ev == "batch":
             evs = r.get("events") or []
             t_open = float(r.get("t0", r["ts"]))
             t_close = max((float(e["ts"]) for e in evs),
                           default=float(r["ts"]))
-            events.append({
-                "name": f"batch {r['seq']} rung={r.get('rung')}",
-                "ph": "X", "pid": _PID_BATCH, "tid": int(r["seq"]) % 4,
-                "ts": us(t_open),
-                "dur": round(max(t_close - t_open, 0.0) * 1e6, 3),
-                "args": {"rows": r.get("rows"),
-                         "padding_frac": r.get("padding_frac"),
-                         "members": r.get("members")}})
+            b.complete(f"batch {r['seq']} rung={r.get('rung')}",
+                       _PID_BATCH, int(r["seq"]) % 4, t_open, t_close,
+                       args={"rows": r.get("rows"),
+                             "padding_frac": r.get("padding_frac"),
+                             "members": r.get("members")})
             for e in evs:
                 if e["name"] in ("retry", "engine_failure"):
-                    events.append({
-                        "name": f"{e['name']} (batch {r['seq']})",
-                        "ph": "i", "s": "g", "pid": _PID_BATCH,
-                        "tid": int(r["seq"]) % 4, "ts": us(e["ts"])})
+                    b.instant(f"{e['name']} (batch {r['seq']})",
+                              _PID_BATCH, int(r["seq"]) % 4, e["ts"])
         elif ev == "mark":
-            events.append({
-                "name": f"{r.get('source')}:{r.get('name')}", "ph": "i",
-                "s": "g", "pid": _PID_MARK, "tid": 1, "ts": us(r["ts"]),
-                "args": {k: v for k, v in r.items()
-                         if k not in ("kind", "ev", "ts")}})
+            b.instant(f"{r.get('source')}:{r.get('name')}", _PID_MARK, 1,
+                      r["ts"],
+                      args={k: v for k, v in r.items()
+                            if k not in ("kind", "ev", "ts")})
     # per-request instants for the interesting intermediate hops
     for rid, evs in by_req.items():
         for e in evs:
             if e["name"] in ("shed", "failed", "batch", "deliver"):
-                events.append({
-                    "name": e["name"], "ph": "i", "s": "t",
-                    "pid": _PID_REQ, "tid": int(rid), "ts": us(e["ts"]),
-                    "args": {k: v for k, v in e.items()
-                             if k not in ("kind", "ev", "ts", "name")}})
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+                b.instant(e["name"], _PID_REQ, rid, e["ts"], scope="t",
+                          args={k: v for k, v in e.items()
+                                if k not in ("kind", "ev", "ts", "name")})
+    return b.build()
 
 
 # ---------------------------------------------------------------------------

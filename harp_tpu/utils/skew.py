@@ -303,6 +303,12 @@ def record_execution(phase: str, work, *, unit: str,
     if telemetry.enabled():
         ledger.record_execution(phase, work, unit=unit, wall_s=wall_s,
                                 units=units)
+        from harp_tpu.utils import steptrace
+
+        if steptrace.tracer._run is not None:
+            # the per-worker lane for the covering superstep (PR 18)
+            steptrace.tracer.on_execution(phase, work, unit=unit,
+                                          wall_s=wall_s)
         from harp_tpu import health
 
         health.monitor.observe_skew(phase, ledger)

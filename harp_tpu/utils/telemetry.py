@@ -83,13 +83,14 @@ def scope(on: bool = True, *, reset: bool = True):
         ledger.reset()
         tracer.reset()
         from harp_tpu import elastic, health
-        from harp_tpu.utils import flightrec, reqtrace, skew
+        from harp_tpu.utils import flightrec, reqtrace, skew, steptrace
 
         flightrec.reset()
         skew.reset()
         reqtrace.reset()
         health.reset()
         elastic.reset()
+        steptrace.reset()
     try:
         yield
     finally:
@@ -396,16 +397,20 @@ def record_comm(verb: str, tree: Any, *, axis: str,
         return
     ledger.record(verb, tree, axis=axis, combiner=combiner,
                   wire_dtype=wire_dtype)
+    from harp_tpu.utils import steptrace
+
+    if steptrace.tracer._run is not None:
+        steptrace.tracer.on_comm(verb, _call_site())
 
 
 def export(path: str) -> None:
     """Write every collected record (spans + ledger + flight recorder +
     skew ledger + request traces + health findings + elastic actions)
     as one JSONL file — the input format of ``python -m harp_tpu
-    report``, ``python -m harp_tpu trace``, and ``python -m harp_tpu
-    health``."""
+    report``, ``python -m harp_tpu trace``, ``python -m harp_tpu
+    timeline``, and ``python -m harp_tpu health``."""
     from harp_tpu import elastic, health
-    from harp_tpu.utils import flightrec, reqtrace, skew
+    from harp_tpu.utils import flightrec, reqtrace, skew, steptrace
 
     with open(path, "w") as fh:
         tracer.export_jsonl(fh)
@@ -415,6 +420,7 @@ def export(path: str) -> None:
         reqtrace.tracer.export_jsonl(fh)
         health.export_jsonl(fh)
         elastic.export_jsonl(fh)
+        steptrace.export_jsonl(fh)
 
 
 def export_timeline(path: str) -> None:
@@ -434,8 +440,14 @@ def export_timeline(path: str) -> None:
     scripts/check_jsonl.py invariant 11 and loads in
     ``python -m harp_tpu trace`` / Perfetto via :func:`harp_tpu.utils.
     reqtrace.perfetto`.
+
+    Training-plane spans (PR 18): any collected ``kind:"steptrace"``
+    rows ride the same file after the trace rows, unmodified (they are
+    already one causal block on the SpanTracer clock and pass
+    invariant 16 as exported) — ``python -m harp_tpu timeline`` reads
+    them out of the merged file directly.
     """
-    from harp_tpu.utils import flightrec, reqtrace, skew
+    from harp_tpu.utils import flightrec, reqtrace, skew, steptrace
 
     def _normalized(rows: list[dict]) -> list[dict]:
         if not rows:
@@ -477,20 +489,21 @@ def export_timeline(path: str) -> None:
     with open(path, "w") as fh:
         for row in rows:
             fh.write(json.dumps({**row, **stamp}) + "\n")
+        steptrace.tracer.export_jsonl(fh, stamp)
 
 
 def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
     [...], "skew": [...], "trace": [...], "health": [...],
-    "elastic": [...]}`` (unknown
+    "elastic": [...], "steptrace": [...]}`` (unknown
     kinds land under ``"comm"`` for backward compatibility with
     pre-flight-recorder exports, whose only unmarked rows were the
     ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
                                   "transfer": [], "skew": [],
                                   "trace": [], "health": [],
-                                  "elastic": []}
+                                  "elastic": [], "steptrace": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

@@ -213,6 +213,35 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     CommLedger verb match), and ``reconciled`` literally true — an
     unreconciled attribution committed as evidence is exactly the
     hand-read-profile ritual this row type replaces.
+
+16. **Steptrace rows are a complete causal training timeline** (any
+    file): a ``kind:"steptrace"`` row (the PR-18 superstep flightpath —
+    :mod:`harp_tpu.utils.steptrace`, exported by ``telemetry.export`` /
+    ``export_timeline``) must carry the provenance stamp (a CPU-sim
+    training timeline must never read as relay evidence), declare a
+    known row shape (``ev`` ∈ ``KNOWN_STEPTRACE_EVS``), and carry a
+    numeric non-negative ``ts`` MONOTONE non-decreasing across the
+    file's steptrace rows.  Every superstep span must terminate with an
+    outcome from ``KNOWN_STEPTRACE_OUTCOMES`` and attribute exactly the
+    frozen flight counters (``KNOWN_STEPTRACE_FLIGHT_KEYS``); every
+    run id seen in span/mark/lane rows must close in exactly one
+    ``ev:"run"`` row, whose declared ``supersteps`` / per-outcome
+    counts / ``span_flight`` sums / ``marks`` / ``lanes`` are
+    re-derived from the rows and must match EXACTLY.  Cross-spine,
+    fail closed: each run's ``flight.dispatches`` must equal its
+    dispatch-mark count (the flightrec observer path vs the
+    TransferLedger counters — two independent spines), the file's runs
+    cannot attribute more dispatches than its ``kind:"transfer"``
+    dispatch rows record, elastic marks must match the file's
+    timeline-covered ``kind:"elastic"`` rows (``on_timeline: true``)
+    event-for-event (a rebalance on the timeline that the elastic
+    ledger never recorded — or vice versa — means one spine is lying;
+    rows recorded outside any run are legitimately unmarked), every
+    health mark must name a detector
+    with a ``kind:"health"`` row, and every ``consume_skew_trigger``
+    actuation mark must point at a CONSUMED ``skew_trigger`` finding —
+    the exactly-once handshake leaves ledger evidence or it did not
+    happen.
 """
 
 from __future__ import annotations
@@ -1003,7 +1032,8 @@ def _check_elastic_row(name: str, i: int, row: dict) -> list[str]:
 KNOWN_PROFILE_BUCKETS = ("mxu", "elementwise", "gather_dus", "scatter",
                          "wire", "overhead")
 KNOWN_PROFILE_APPS = ("kmeans", "mfsgd", "lda", "rf", "svm", "wdamds",
-                      "subgraph", "serve")
+                      "subgraph", "serve", "rf_pallas", "svm_pallas",
+                      "wdamds_pallas")
 PROFILE_SUM_REL_TOL = 0.75
 PROFILE_COUNT_FIELDS = ("reps", "n_devices", "wire_bytes", "wire_sites",
                         "wire_unmatched", "dispatches",
@@ -1101,6 +1131,267 @@ def _check_profile_row(name: str, i: int, row: dict) -> list[str]:
     return errs
 
 
+# the steptrace vocabularies (invariant 16), FROZEN standalone like the
+# trace vocabularies and sync-pinned by tests/test_check_jsonl.py
+# against harp_tpu.utils.steptrace (EVS / OUTCOMES / SOURCES /
+# FLIGHT_KEYS)
+KNOWN_STEPTRACE_EVS = ("run", "superstep", "mark", "lane")
+KNOWN_STEPTRACE_OUTCOMES = ("completed", "faulted", "rebalanced",
+                            "resumed")
+KNOWN_STEPTRACE_SOURCES = ("flight", "wire", "ckpt", "fault", "elastic",
+                           "health")
+KNOWN_STEPTRACE_FLIGHT_KEYS = ("dispatches", "readbacks", "h2d_calls",
+                               "compiles")
+
+
+def _steptrace_flight_ok(fl) -> bool:
+    """Exactly the frozen counter keys, all non-negative integers."""
+    return (isinstance(fl, dict)
+            and sorted(fl) == sorted(KNOWN_STEPTRACE_FLIGHT_KEYS)
+            and all(isinstance(fl[k], int) and not isinstance(fl[k], bool)
+                    and fl[k] >= 0 for k in KNOWN_STEPTRACE_FLIGHT_KEYS))
+
+
+def _check_steptrace_row(name: str, i: int, row: dict,
+                         state: dict) -> list[str]:
+    """Invariant 16, per-row half: stamp, row shape, monotone ts.
+
+    ``state`` accumulates the per-run evidence the end-of-file half
+    (:func:`_finish_steptrace_checks`) re-derives: span/mark/lane
+    counts, outcome tallies, span flight sums, dispatch-mark counts,
+    and the elastic/health marks for the cross-spine reconciliation.
+    """
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: steptrace row missing provenance field(s) "
+            f"{missing} — export through telemetry.export / "
+            "telemetry.export_timeline, which stamp them")
+    ev = row.get("ev")
+    if ev not in KNOWN_STEPTRACE_EVS:
+        errs.append(f"{name}:{i}: steptrace row ev={ev!r} not in "
+                    f"{KNOWN_STEPTRACE_EVS}")
+    ts = row.get("ts")
+    if not _num(ts) or ts < 0:
+        errs.append(f"{name}:{i}: steptrace row ts={ts!r} must be a "
+                    "non-negative number — a timeline row without a "
+                    "timestamp cannot be causally ordered")
+    else:
+        last = state.get("last_ts")
+        if last is not None and ts < last:
+            errs.append(
+                f"{name}:{i}: steptrace row ts={ts} decreased from "
+                f"{last} — timeline rows must be monotone (interleaved "
+                "exports?)")
+        state["last_ts"] = ts
+    rid = row.get("run")
+    if isinstance(rid, bool) or not isinstance(rid, int) or rid < 1:
+        errs.append(f"{name}:{i}: steptrace row run={rid!r} must be a "
+                    "positive integer run id")
+        return errs
+    per = state.setdefault("per", {}).setdefault(rid, {
+        "spans": 0,
+        "outcomes": {o: 0 for o in KNOWN_STEPTRACE_OUTCOMES},
+        "span_flight": {k: 0 for k in KNOWN_STEPTRACE_FLIGHT_KEYS},
+        "marks": 0, "lanes": 0, "dispatch_marks": 0,
+        "elastic_marks": {}, "health_marks": [], "consume_marks": []})
+    if ev == "run":
+        runs = state.setdefault("runs", {})
+        if rid in runs:
+            errs.append(f"{name}:{i}: duplicate steptrace run row for "
+                        f"run {rid} — every run terminates exactly once")
+        runs[rid] = (i, row)
+        outcomes = row.get("outcomes")
+        if (not isinstance(outcomes, dict)
+                or sorted(outcomes) != sorted(KNOWN_STEPTRACE_OUTCOMES)
+                or not all(isinstance(outcomes[o], int)
+                           and not isinstance(outcomes[o], bool)
+                           and outcomes[o] >= 0
+                           for o in KNOWN_STEPTRACE_OUTCOMES)):
+            errs.append(
+                f"{name}:{i}: steptrace run row outcomes={outcomes!r} "
+                f"must carry exactly {KNOWN_STEPTRACE_OUTCOMES} as "
+                "non-negative integers")
+        for k in ("supersteps", "marks", "lanes"):
+            v = row.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: steptrace run row {k}={v!r} "
+                            "must be a non-negative integer")
+        for fname in ("flight", "span_flight"):
+            if not _steptrace_flight_ok(row.get(fname)):
+                errs.append(
+                    f"{name}:{i}: steptrace run row {fname}="
+                    f"{row.get(fname)!r} must carry exactly "
+                    f"{KNOWN_STEPTRACE_FLIGHT_KEYS} as non-negative "
+                    "integers")
+        t0 = row.get("t0")
+        if not _num(t0) or (_num(ts) and t0 > ts):
+            errs.append(f"{name}:{i}: steptrace run row t0={t0!r} must "
+                        "be a number not after its close ts")
+    elif ev == "superstep":
+        per["spans"] += 1
+        outcome = row.get("outcome")
+        if outcome not in KNOWN_STEPTRACE_OUTCOMES:
+            errs.append(
+                f"{name}:{i}: steptrace span run={rid} seq="
+                f"{row.get('seq')!r} has outcome={outcome!r} — every "
+                f"opened superstep must terminate with one of "
+                f"{KNOWN_STEPTRACE_OUTCOMES}")
+        else:
+            per["outcomes"][outcome] += 1
+        for k in ("seq", "step"):
+            v = row.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: steptrace span {k}={v!r} "
+                            "must be a non-negative integer")
+        t0 = row.get("t0")
+        if not _num(t0) or (_num(ts) and t0 > ts):
+            errs.append(f"{name}:{i}: steptrace span t0={t0!r} must be "
+                        "a number not after its close ts")
+        fl = row.get("flight")
+        if not _steptrace_flight_ok(fl):
+            errs.append(
+                f"{name}:{i}: steptrace span flight={fl!r} must carry "
+                f"exactly {KNOWN_STEPTRACE_FLIGHT_KEYS} as non-negative "
+                "integers")
+        else:
+            for k in KNOWN_STEPTRACE_FLIGHT_KEYS:
+                per["span_flight"][k] += fl[k]
+    elif ev == "mark":
+        per["marks"] += 1
+        src = row.get("source")
+        if src not in KNOWN_STEPTRACE_SOURCES:
+            errs.append(f"{name}:{i}: steptrace mark source={src!r} not "
+                        f"in {KNOWN_STEPTRACE_SOURCES}")
+        nm = row.get("name")
+        if src == "flight" and nm == "dispatch":
+            per["dispatch_marks"] += 1
+        elif src == "elastic":
+            per["elastic_marks"][nm] = per["elastic_marks"].get(nm, 0) + 1
+        elif src == "health":
+            if nm == "consume_skew_trigger":
+                per["consume_marks"].append((i, row.get("phase")))
+            else:
+                per["health_marks"].append((i, nm))
+    elif ev == "lane":
+        per["lanes"] += 1
+        work = row.get("work")
+        if not (isinstance(work, list) and work
+                and all(_num(x) and x >= 0 for x in work)):
+            errs.append(
+                f"{name}:{i}: steptrace lane work={work!r} must be a "
+                "non-empty list of non-negative per-worker loads")
+    return errs
+
+
+def _finish_steptrace_checks(name: str, state: dict,
+                             elastic_counts: dict,
+                             health_rows: list[dict],
+                             transfer_dispatches: int | None
+                             ) -> list[str]:
+    """Invariant 16, file-level half: run termination, re-derived run
+    summaries, and the cross-spine reconciliations (runs after the
+    whole file was scanned)."""
+    per = state.get("per") or {}
+    if not per:
+        return []
+    errs: list[str] = []
+    runs = state.get("runs") or {}
+    unterminated = sorted(r for r in per if r not in runs)
+    if unterminated:
+        errs.append(
+            f"{name}: steptrace has {len(unterminated)} run(s) with "
+            f"spans/marks but no terminating run row: "
+            f"{unterminated[:8]} — every opened run must close")
+    total_dispatch = 0
+    for rid, (i, rrow) in sorted(runs.items()):
+        agg = per[rid]
+        ss = rrow.get("supersteps")
+        if isinstance(ss, int) and agg["spans"] != ss:
+            errs.append(
+                f"{name}:{i}: steptrace run {rid} claims {ss} "
+                f"superstep(s) but the file carries {agg['spans']} span "
+                "row(s)")
+        outcomes = rrow.get("outcomes")
+        if (isinstance(outcomes, dict)
+                and sorted(outcomes) == sorted(KNOWN_STEPTRACE_OUTCOMES)
+                and agg["outcomes"] != outcomes):
+            errs.append(
+                f"{name}:{i}: steptrace run {rid} span outcomes "
+                f"{agg['outcomes']} do not match the run row's "
+                f"{outcomes}")
+        sf, fl = rrow.get("span_flight"), rrow.get("flight")
+        if _steptrace_flight_ok(sf) and agg["span_flight"] != sf:
+            errs.append(
+                f"{name}:{i}: steptrace run {rid} span flight sums "
+                f"{agg['span_flight']} do not match the run row's "
+                f"span_flight {sf}")
+        if _steptrace_flight_ok(sf) and _steptrace_flight_ok(fl):
+            over = [k for k in KNOWN_STEPTRACE_FLIGHT_KEYS
+                    if sf[k] > fl[k]]
+            if over:
+                errs.append(
+                    f"{name}:{i}: steptrace run {rid} span_flight "
+                    f"exceeds the run's flight delta for {over} — spans "
+                    "cannot own more ops than the run recorded")
+        for k in ("marks", "lanes"):
+            v = rrow.get(k)
+            if isinstance(v, int) and not isinstance(v, bool) \
+                    and v != agg[k]:
+                errs.append(
+                    f"{name}:{i}: steptrace run {rid} claims {v} "
+                    f"{k} but the file carries {agg[k]}")
+        if _steptrace_flight_ok(fl):
+            total_dispatch += fl["dispatches"]
+            if agg["dispatch_marks"] != fl["dispatches"]:
+                errs.append(
+                    f"{name}:{i}: steptrace run {rid} has "
+                    f"{agg['dispatch_marks']} dispatch mark(s) but its "
+                    f"flight delta counted {fl['dispatches']} — the "
+                    "observer spine and the TransferLedger must agree "
+                    "EXACTLY")
+    if transfer_dispatches is not None \
+            and total_dispatch > transfer_dispatches:
+        errs.append(
+            f"{name}: steptrace runs attribute {total_dispatch} "
+            f"dispatch(es) but the file's transfer rows record only "
+            f"{transfer_dispatches} — a timeline cannot own more "
+            "dispatches than the flight recorder counted")
+    emarks: dict = {}
+    for agg in per.values():
+        for nm, n in agg["elastic_marks"].items():
+            emarks[nm] = emarks.get(nm, 0) + n
+    for evn in KNOWN_ELASTIC_EVENTS:
+        if emarks.get(evn, 0) != elastic_counts.get(evn, 0):
+            errs.append(
+                f"{name}: steptrace carries {emarks.get(evn, 0)} "
+                f"elastic {evn!r} mark(s) but the file has "
+                f"{elastic_counts.get(evn, 0)} timeline-covered "
+                f"kind:'elastic' {evn!r} row(s) — the timeline and the "
+                "elastic ledger must tell one story")
+    detectors = {r.get("detector") for r in health_rows}
+    for agg in per.values():
+        for i, nm in agg["health_marks"]:
+            if nm not in detectors:
+                errs.append(
+                    f"{name}:{i}: steptrace health mark names detector "
+                    f"{nm!r} with no kind:'health' row in the file — a "
+                    "finding on the timeline must exist in the "
+                    "sentinel export")
+        for i, phase in agg["consume_marks"]:
+            if not any(r.get("detector") == "skew_trigger"
+                       and r.get("phase") == phase
+                       and r.get("consumed") is True
+                       for r in health_rows):
+                errs.append(
+                    f"{name}:{i}: steptrace consume_skew_trigger mark "
+                    f"for phase {phase!r} has no consumed skew_trigger "
+                    "health row — the exactly-once handshake leaves "
+                    "ledger evidence or it did not happen")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -1141,6 +1432,10 @@ def check_file(path: str, grandfathered: int = 0,
     flight_state: dict = {}
     trace_state: dict = {}
     degraded_rows: list[tuple[int, dict]] = []
+    steptrace_state: dict = {}
+    elastic_counts: dict = {}
+    health_rows: list[dict] = []
+    transfer_dispatches: int | None = None
     for i, line in enumerate(lines, 1):
         if not line.strip():
             continue
@@ -1154,6 +1449,12 @@ def check_file(path: str, grandfathered: int = 0,
         if isinstance(row, dict) and row.get("kind") in ("compile",
                                                          "transfer"):
             errors += _check_flight_row(name, i, row, flight_state)
+            if (row.get("kind") == "transfer"
+                    and row.get("op") == "dispatch"
+                    and isinstance(row.get("calls"), int)
+                    and not isinstance(row.get("calls"), bool)):
+                transfer_dispatches = ((transfer_dispatches or 0)
+                                       + row["calls"])
         if isinstance(row, dict) and row.get("kind") == "skew":
             errors += _check_skew_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "lint":
@@ -1172,10 +1473,20 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_model_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "health":
             errors += _check_health_row(name, i, row)
+            health_rows.append(row)
         if isinstance(row, dict) and row.get("kind") == "elastic":
             errors += _check_elastic_row(name, i, row)
+            # only timeline-covered rows enter the invariant-16 mark
+            # reconciliation — a row recorded outside any steptrace run
+            # (manual install, pre-PR-18 evidence) is legitimately
+            # unmarked
+            if row.get("on_timeline") is True:
+                evn = row.get("event")
+                elastic_counts[evn] = elastic_counts.get(evn, 0) + 1
         if isinstance(row, dict) and row.get("kind") == "profile":
             errors += _check_profile_row(name, i, row)
+        if isinstance(row, dict) and row.get("kind") == "steptrace":
+            errors += _check_steptrace_row(name, i, row, steptrace_state)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:
@@ -1187,6 +1498,9 @@ def check_file(path: str, grandfathered: int = 0,
                 f"missing provenance field(s) {missing} — print it "
                 "through harp_tpu.utils.metrics.benchmark_json")
     errors += _finish_trace_checks(name, trace_state, degraded_rows)
+    errors += _finish_steptrace_checks(name, steptrace_state,
+                                       elastic_counts, health_rows,
+                                       transfer_dispatches)
     return errors
 
 

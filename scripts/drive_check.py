@@ -2582,3 +2582,141 @@ print("kernels: 3 pallas flip candidates measured through the real "
       "QUALITY DEGRADED not FLIP:, conditional gate exits 1 on the "
       "unmeasured anchor chain, rf/svm/wdamds captures reconciled")
 print(f"DRIVE OK round-37 ({mode})")
+
+# ---------------------------------------------------------------------------
+# round 38 — superstep flightpath (PR 18): one causal training-plane
+# timeline across all seven spines, hand-checked.  (a) THE chaos drill
+# through the PUBLIC elastic surface — a seeded transient dispatch
+# fault, a fired-and-consumed skew rebalance, and a permanent worker
+# loss in ONE run — yields a timeline whose span-outcome multiset,
+# cause-adjacency (every faulted span's seq carries the injector's own
+# mark), elastic mark sequence, and EXACT dispatch-mark==flight-delta
+# reconciliation are re-derived by hand from the raw rows; (b) the
+# export passes scripts/check_jsonl.py whole-file (invariant 16 on top
+# of 13/14), INCLUDING an elastic resume row recorded OUTSIDE any run
+# (the round-35 manual-install comparison pattern, on_timeline=False —
+# exactly the scenario that caught the first cut of this invariant in
+# this drive); (c) the timeline CLI round-trips in a subprocess
+# (exit 0, stamped --json row, --perfetto Chrome-Trace JSON with only
+# M/X/i phases); (d) zero-cost off: with telemetry disabled the tracer
+# stays EMPTY through a full instrumented driver run and kmeans.fit
+# returns bit-identical centroids vs the traced run.
+# ---------------------------------------------------------------------------
+import json as _st_json
+import subprocess as _st_sp
+import tempfile as _st_tmp
+
+from harp_tpu.elastic import ledger as _st_led
+from harp_tpu.elastic.apps import MFSGDElastic as _StMF
+from harp_tpu.elastic.apps import elastic_fit as _st_fit
+from harp_tpu.models import kmeans as _st_km
+from harp_tpu.models.mfsgd import MFSGDConfig as _StCfg
+from harp_tpu.utils import steptrace as _st_st
+from harp_tpu.utils import telemetry as _st_tm
+from harp_tpu.utils.checkpoint import CheckpointManager as _StCkpt
+from harp_tpu.utils.fault import FaultInjector as _StInj
+
+_st_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_st_root, "scripts"))
+import check_jsonl as _st_cj  # noqa: E402
+
+_st_rng = np.random.default_rng(0)
+_st_users = np.concatenate([_st_rng.integers(0, 2 * (64 // nw), 4000),
+                            _st_rng.integers(2 * (64 // nw), 64, 1000)])
+_st_rng.shuffle(_st_users)
+_st_items = _st_rng.integers(0, 48, _st_users.shape[0])
+_st_vals = _st_rng.normal(size=_st_users.shape[0]).astype(np.float32)
+_st_cfg = _StCfg(rank=4, algo="dense", u_tile=8, i_tile=8, entry_cap=64)
+_st_dir = _st_tmp.mkdtemp()
+_st_out = os.path.join(_st_dir, "run.jsonl")
+
+with _st_tm.scope(True):
+    # (a) transient at dispatch ordinal 5, permanent at 7 — the skewed
+    # corpus fires the trigger first, so the narrative is
+    # rebalance -> transient+restart -> loss+shrink, one run id
+    _st_inj = _StInj(seed=0, fail={"dispatch": (5,)},
+                     permanent={"dispatch": (7,)}, lost_worker=nw - 1)
+    _st_ad = _StMF(64, 48, _st_cfg, mesh, 0, users=_st_users,
+                   items=_st_items, vals=_st_vals, packs_per_worker=8,
+                   max_worker_loss=1)
+    _st_fit(_st_ad, 6, os.path.join(_st_dir, "ck"), ckpt_every=1,
+            fault=_st_inj)
+    assert _st_inj.permanent_fired and _st_ad.losses == 1
+    _st_ev = [r["event"] for r in _st_led.ledger.rows]
+    assert _st_ev == ["rebalance", "resume", "shrink", "resume"], _st_ev
+    assert all(r["on_timeline"] for r in _st_led.ledger.rows)
+    _st_rows = _st_st.tracer.rows()
+
+    # hand re-derivation from the raw rows: one run, every span
+    # terminated, outcome multiset matches the injector script
+    (_st_rn,) = [r for r in _st_rows if r["ev"] == "run"]
+    _st_sp_rows = [r for r in _st_rows if r["ev"] == "superstep"]
+    assert len(_st_sp_rows) == _st_rn["supersteps"]
+    _st_oc = {o: sum(1 for s in _st_sp_rows if s["outcome"] == o)
+              for o in _st_st.OUTCOMES}
+    assert _st_oc == {"completed": 3, "faulted": 2, "rebalanced": 1,
+                      "resumed": 2}, _st_oc
+    # cause-adjacency: the injector's marks sit on the faulted seqs
+    _st_marks = [r for r in _st_rows if r["ev"] == "mark"]
+    _st_fm = {m["seq"] for m in _st_marks if m["source"] == "fault"}
+    assert _st_fm == {s["seq"] for s in _st_sp_rows
+                      if s["outcome"] == "faulted"}
+    assert [m["name"] for m in _st_marks if m["source"] == "elastic"] \
+        == _st_ev
+    assert {"skew_trigger", "consume_skew_trigger"} <= {
+        m["name"] for m in _st_marks if m["source"] == "health"}
+    # the two-spine dispatch reconciliation, EXACT
+    _st_dm = sum(1 for m in _st_marks
+                 if (m["source"], m["name"]) == ("flight", "dispatch"))
+    assert _st_dm == _st_rn["flight"]["dispatches"]
+
+    # (b) an elastic action OUTSIDE any run: restore the ckpt into a
+    # fresh survivors-mesh adapter (the round-35 bit-identity pattern)
+    # — its resume row must stamp on_timeline=False and the export must
+    # STAY invariant-16 clean
+    _st_step, _st_state = _StCkpt(os.path.join(_st_dir, "ck")).restore()
+    _st_cmp = _StMF(64, 48, _st_cfg, mesh.survivors(nw - 1), 0,
+                    users=_st_users, items=_st_items, vals=_st_vals)
+    _st_cmp.install(_st_state)
+    assert _st_led.ledger.rows[-1]["event"] == "resume"
+    assert _st_led.ledger.rows[-1]["on_timeline"] is False
+    _st_tm.export(_st_out)
+_st_errs = _st_cj.check_file(_st_out, provenance=True)
+assert _st_errs == [], _st_errs
+
+# (c) the CLI in a subprocess: exit 0, stamped JSON row, Perfetto shape
+_st_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+_st_pf = os.path.join(_st_dir, "trace.json")
+_st_cli = _st_sp.run(
+    [sys.executable, "-m", "harp_tpu", "timeline", _st_out, "--json",
+     "--perfetto", _st_pf],
+    capture_output=True, text=True, timeout=300, env=_st_env,
+    cwd=_st_root)
+assert _st_cli.returncode == 0, _st_cli.stderr[-800:]
+_st_row = _st_json.loads(_st_cli.stdout.strip().splitlines()[-1])
+assert _st_row["runs"] == 1 and _st_row["supersteps"] == len(_st_sp_rows)
+assert _st_row["unterminated"] == [] and _st_row["dispatch_mismatch"] == []
+assert all(k in _st_row for k in ("backend", "date", "commit"))
+_st_doc = _st_json.load(open(_st_pf))
+assert {e["ph"] for e in _st_doc["traceEvents"]} <= {"M", "X", "i"}
+assert any(e["ph"] == "X" and e["dur"] >= 0
+           for e in _st_doc["traceEvents"])
+
+# (d) zero-cost off: empty tracer + bit-identical traced/untraced fit
+_st_pts = np.random.default_rng(3).normal(size=(32 * nw, 8)) \
+    .astype(np.float32)
+_st_st.reset()
+_st_c0, _st_i0 = _st_km.fit(_st_pts, k=4, iters=3, mesh=mesh, seed=0)
+assert _st_st.tracer.rows() == [] and _st_st.tracer._run is None
+with _st_tm.scope(True):
+    _st_c1, _st_i1 = _st_km.fit(_st_pts, k=4, iters=3, mesh=mesh, seed=0)
+    assert _st_st.tracer.rows() != []
+np.testing.assert_array_equal(np.asarray(_st_c0), np.asarray(_st_c1))
+assert _st_i0 == _st_i1
+
+print(f"steptrace: chaos run {_st_rn['supersteps']} spans {_st_oc} on "
+      "one run id, fault marks on the faulted seqs, elastic marks == "
+      f"ledger {_st_ev}, dispatch marks == flight ({_st_dm}), "
+      "uncovered manual-install resume row exports clean, CLI+Perfetto "
+      "round trip, tracer zero-cost off (bit-identical kmeans)")
+print(f"DRIVE OK round-38 ({mode})")

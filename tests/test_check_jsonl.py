@@ -1181,3 +1181,197 @@ def test_committed_profile_attribution_covers_every_app():
     apps = {r["app"] for r in rows if r.get("kind") == "profile"}
     assert apps == set(check_jsonl.KNOWN_PROFILE_APPS)
     assert all(r["reconciled"] is True for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Invariant 16: steptrace rows (PR 18)
+# ---------------------------------------------------------------------------
+
+_TSTAMP = {"backend": "cpu", "date": "2026-08-06", "commit": "abc1234"}
+
+
+def _st_flight(**over):
+    fl = {"dispatches": 0, "readbacks": 0, "h2d_calls": 0, "compiles": 0}
+    fl.update(over)
+    return fl
+
+
+def _st_rows():
+    """A minimal valid forged timeline: one run, one completed span,
+    one dispatch mark, one skew lane — internally reconciled."""
+    fl = _st_flight(dispatches=1, readbacks=1)
+    return [
+        {"kind": "steptrace", "ev": "mark", "run": 1, "ts": 0.01,
+         "source": "flight", "name": "dispatch", "seq": 0,
+         "site": "kmeans.fit", **_TSTAMP},
+        {"kind": "steptrace", "ev": "lane", "run": 1, "ts": 0.015,
+         "seq": 0, "phase": "kmeans.fit", "work": [1.0] * 8,
+         "unit": "points", **_TSTAMP},
+        {"kind": "steptrace", "ev": "superstep", "run": 1, "seq": 0,
+         "step": 0, "phase": "kmeans.fit", "outcome": "completed",
+         "t0": 0.005, "ts": 0.02, "flight": fl, **_TSTAMP},
+        {"kind": "steptrace", "ev": "run", "run": 1,
+         "phase": "kmeans.fit", "t0": 0.0, "ts": 0.03, "supersteps": 1,
+         "marks": 1, "lanes": 1,
+         "outcomes": {"completed": 1, "faulted": 0, "rebalanced": 0,
+                      "resumed": 0},
+         "flight": dict(fl), "span_flight": dict(fl), **_TSTAMP},
+    ]
+
+
+def _st_check(rows, tmp_path, extra=()):
+    p = tmp_path / "steptrace.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n"
+                         for r in list(extra) + list(rows)))
+    return check_jsonl.check_file(str(p), provenance=True)
+
+
+def test_steptrace_rows_valid_round_trip(tmp_path):
+    assert _st_check(_st_rows(), tmp_path) == []
+
+
+def test_steptrace_row_requires_provenance_and_vocabularies(tmp_path):
+    rows = _st_rows()
+    del rows[0]["backend"]
+    assert any("provenance" in e for e in _st_check(rows, tmp_path))
+    rows = _st_rows()
+    rows[0]["ev"] = "epoch"
+    assert any("ev='epoch'" in e for e in _st_check(rows, tmp_path))
+    rows = _st_rows()
+    rows[0]["source"] = "vibes"
+    assert any("source='vibes'" in e for e in _st_check(rows, tmp_path))
+
+
+def test_steptrace_rows_must_be_monotone(tmp_path):
+    rows = _st_rows()
+    rows[1]["ts"] = 0.001  # lane stamped before the preceding mark
+    assert any("monotone" in e for e in _st_check(rows, tmp_path))
+
+
+def test_steptrace_every_run_must_terminate(tmp_path):
+    rows = _st_rows()[:-1]  # drop the terminating run row
+    assert any("no terminating run row" in e
+               for e in _st_check(rows, tmp_path))
+    # and a run row may appear exactly once
+    rows = _st_rows() + [_st_rows()[-1]]
+    assert any("duplicate steptrace run row" in e
+               for e in _st_check(rows, tmp_path))
+
+
+def test_steptrace_span_outcome_vocabulary_enforced(tmp_path):
+    rows = _st_rows()
+    rows[2]["outcome"] = "exploded"
+    errs = _st_check(rows, tmp_path)
+    assert any("outcome='exploded'" in e for e in errs)
+
+
+def test_steptrace_run_summary_must_rederive(tmp_path):
+    # claimed superstep count vs actual span rows
+    rows = _st_rows()
+    rows[-1]["supersteps"] = 2
+    assert any("claims 2 superstep(s)" in e
+               for e in _st_check(rows, tmp_path))
+    # claimed outcome tally vs span outcomes
+    rows = _st_rows()
+    rows[-1]["outcomes"] = {"completed": 0, "faulted": 1,
+                            "rebalanced": 0, "resumed": 0}
+    assert any("do not match the run row's" in e
+               for e in _st_check(rows, tmp_path))
+    # span flight sums exceeding the run's own flight delta
+    rows = _st_rows()
+    rows[2]["flight"] = _st_flight(dispatches=3, readbacks=1)
+    rows[-1]["span_flight"] = _st_flight(dispatches=3, readbacks=1)
+    assert any("cannot own more ops than the run recorded" in e
+               for e in _st_check(rows, tmp_path))
+
+
+def test_steptrace_dispatch_marks_must_match_flight_exactly(tmp_path):
+    # drop the dispatch mark but keep the run's flight delta at 1
+    rows = [r for r in _st_rows()
+            if not (r["ev"] == "mark" and r["name"] == "dispatch")]
+    rows[-1]["marks"] = 0
+    assert any("must agree EXACTLY" in e for e in _st_check(rows, tmp_path))
+
+
+def test_steptrace_cannot_outclaim_the_transfer_ledger(tmp_path):
+    """A timeline attributing more dispatches than the file's own
+    kind:'transfer' rows recorded is forged."""
+    transfer = {"kind": "transfer", "op": "dispatch", "calls": 0,
+                "bytes": 0, "site": "forged", **_TSTAMP}
+    errs = _st_check(_st_rows(), tmp_path, extra=[transfer])
+    assert any("cannot own more dispatches" in e for e in errs)
+
+
+def test_steptrace_elastic_marks_reconcile_event_for_event(tmp_path):
+    # an elastic mark with no kind:'elastic' row
+    rows = _st_rows()
+    rows.insert(1, {"kind": "steptrace", "ev": "mark", "run": 1,
+                    "ts": 0.012, "source": "elastic",
+                    "name": "rebalance", "seq": 0, "phase": "kmeans.fit",
+                    **_TSTAMP})
+    rows[-1]["marks"] = 2
+    assert any("one story" in e for e in _st_check(rows, tmp_path))
+    # and the converse: a timeline-covered elastic row with no mark
+    erow = _elastic_row("rebalance",
+                        loads_before=[4000.0] + [150.0] * 7,
+                        loads_after=[631.25] * 8, total=5050.0,
+                        on_timeline=True)
+    errs = _st_check(_st_rows(), tmp_path, extra=[erow])
+    assert any("one story" in e for e in errs)
+    # an UNCOVERED row (manual install outside any run) is legitimate
+    erow_off = dict(erow, on_timeline=False)
+    assert _st_check(_st_rows(), tmp_path, extra=[erow_off]) == []
+
+
+def test_steptrace_health_marks_need_sentinel_rows(tmp_path):
+    # a finding mark with no kind:'health' row in the file
+    rows = _st_rows()
+    rows.insert(1, {"kind": "steptrace", "ev": "mark", "run": 1,
+                    "ts": 0.012, "source": "health", "name": "slo_burn",
+                    "seq": 0, **_TSTAMP})
+    rows[-1]["marks"] = 2
+    assert any("must exist in the sentinel export" in e
+               for e in _st_check(rows, tmp_path))
+    # with the matching health row the same file is clean
+    assert _st_check(rows, tmp_path, extra=[_health_row()]) == []
+
+
+def test_steptrace_consume_mark_needs_consumed_trigger_row(tmp_path):
+    consume = {"kind": "steptrace", "ev": "mark", "run": 1, "ts": 0.012,
+               "source": "health", "name": "consume_skew_trigger",
+               "seq": 0, "phase": "p", **_TSTAMP}
+    rows = _st_rows()
+    rows.insert(1, consume)
+    rows[-1]["marks"] = 2
+    # no skew_trigger row at all
+    assert any("exactly-once handshake" in e
+               for e in _st_check(rows, tmp_path))
+    # a trigger row that was never consumed does not cover it either
+    errs = _st_check(rows, tmp_path, extra=[_skew_trigger_row()])
+    assert any("exactly-once handshake" in e for e in errs)
+    # the consumed row closes the loop
+    consumed = dict(_skew_trigger_row(), consumed=True)
+    assert _st_check(rows, tmp_path, extra=[consumed]) == []
+
+
+def test_steptrace_vocab_in_sync_with_steptrace_module():
+    from harp_tpu.utils import steptrace as ST
+
+    assert ST.EVS == check_jsonl.KNOWN_STEPTRACE_EVS
+    assert ST.OUTCOMES == check_jsonl.KNOWN_STEPTRACE_OUTCOMES
+    assert ST.SOURCES == check_jsonl.KNOWN_STEPTRACE_SOURCES
+    assert ST.FLIGHT_KEYS == check_jsonl.KNOWN_STEPTRACE_FLIGHT_KEYS
+
+
+def test_golden_steptrace_fixture_is_clean_and_summarizes():
+    """The committed golden timeline fixture (tests/data) passes the
+    checker — the fixture the timeline CLI smoke drives."""
+    p = os.path.join(os.path.dirname(__file__), "data",
+                     "golden_steptrace.jsonl")
+    assert check_jsonl.check_file(p) == []
+    from harp_tpu.utils import steptrace, telemetry
+
+    rows = telemetry.load_rows(p)["steptrace"]
+    s = steptrace.summarize_rows(rows)
+    assert s["runs"] == 1 and s["unterminated"] == []
+    assert s["supersteps"] >= 2 and s["dispatch_mismatch"] == []

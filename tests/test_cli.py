@@ -526,6 +526,47 @@ def test_dispatch_trace_cli_smoke(capsys, tmp_path):
     assert cli.main(["trace", str(tmp_path / "nope.jsonl")]) == 2
 
 
+def test_dispatch_timeline_cli_smoke(capsys, tmp_path):
+    """python -m harp_tpu timeline (PR 18): the committed golden
+    2-superstep fixture summarizes clean (exit 0) in human and JSON
+    modes, exports a loadable Perfetto trace.json, and the failure
+    exits are honest — 1 for an unterminated timeline, 2 for an
+    unreadable file."""
+    import json
+    import os
+
+    golden = os.path.join(os.path.dirname(__file__), "data",
+                          "golden_steptrace.jsonl")
+    assert cli.main(["timeline", golden]) == 0
+    out = capsys.readouterr().out
+    assert "1 run(s), 2 superstep(s)" in out
+    assert "2 completed / 0 faulted / 0 rebalanced / 0 resumed" in out
+    assert "[mfsgd.epochs]" in out          # the run header
+    assert "flight:dispatch" in out         # a threaded spine mark
+
+    pf = tmp_path / "trace.json"
+    assert cli.main(["timeline", golden, "--json",
+                     "--perfetto", str(pf)]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert (row["runs"], row["supersteps"], row["completed"]) == (1, 2, 2)
+    assert row["unterminated"] == [] and row["dispatch_mismatch"] == []
+    assert all(k in row for k in ("backend", "date", "commit"))
+    perf = json.loads(pf.read_text())
+    assert perf["traceEvents"] and all(
+        "ph" in e and "name" in e for e in perf["traceEvents"])
+    assert any(e["ph"] == "X" for e in perf["traceEvents"])
+
+    # a timeline whose run row was lost (killed mid-export) exits 1
+    lines = [ln for ln in open(golden) if '"ev": "run"' not in ln]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("".join(lines))
+    assert cli.main(["timeline", str(bad)]) == 1
+    assert "unterminated" in capsys.readouterr().err
+
+    # unreadable input exits 2
+    assert cli.main(["timeline", str(tmp_path / "nope.jsonl")]) == 2
+
+
 def test_dispatch_health_cli_smoke(capsys, tmp_path):
     """python -m harp_tpu health (PR 14): the committed golden fixture
     summarizes with exit 1 (actionable findings), a healthy file exits

@@ -1,0 +1,328 @@
+"""Superstep flightpath (harp_tpu/utils/steptrace, PR 18) — one causal
+training-plane timeline across all six spines.
+
+Evidence layers, all on the 8-worker CPU sim:
+
+1. span mechanics: runs/supersteps terminate in ``finally`` with the
+   frozen outcome vocabulary; reentrant entries are no-ops (outermost
+   wins); marks outside a run are dropped, not orphaned;
+2. THE chaos drill (ISSUE 18 acceptance): a seeded transient fault, a
+   fired-and-consumed skew rebalance, and a permanent worker loss in
+   ONE elastic run produce ONE timeline — every span terminated, every
+   abnormal termination carrying its cause as an adjacent mark, the
+   export invariant-16 clean (which reconciles it against the elastic
+   ledger, the health sentinel, and the TransferLedger), and the
+   Perfetto conversion loadable (trace-event shape, no NaNs);
+3. the healthy control: the same driver on a balanced corpus shows
+   zero abnormal terminations;
+4. the PR-3 contract: with telemetry off the tracer stays empty and
+   traced results are bit-identical; with tracing ARMED the flagship
+   flight budgets (1 dispatch / 1 stacked readback / 0 steady
+   compiles) pass UNCHANGED — the timeline is an observer, never a
+   participant.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from harp_tpu import health
+from harp_tpu.elastic import ledger as eledger
+from harp_tpu.utils import flightrec, steptrace, telemetry
+from harp_tpu.utils.fault import FaultInjector
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import check_jsonl  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# span mechanics
+# ---------------------------------------------------------------------------
+
+def test_vocab_sync_with_check_jsonl():
+    """The frozen invariant-16 vocabularies must mirror the module's —
+    drift fails tier-1 (the sync-pin pattern of invariants 11/13/14)."""
+    assert check_jsonl.KNOWN_STEPTRACE_EVS == steptrace.EVS
+    assert check_jsonl.KNOWN_STEPTRACE_OUTCOMES == steptrace.OUTCOMES
+    assert check_jsonl.KNOWN_STEPTRACE_SOURCES == steptrace.SOURCES
+    assert (check_jsonl.KNOWN_STEPTRACE_FLIGHT_KEYS
+            == steptrace.FLIGHT_KEYS)
+    # the flight keys must stay a subset of what flightrec can delta
+    assert set(steptrace.FLIGHT_KEYS) <= set(flightrec.snapshot())
+
+
+def test_run_and_superstep_rows_reconcile():
+    with telemetry.scope(True):
+        with steptrace.run("unit.phase"):
+            for i in range(3):
+                with steptrace.superstep("unit.phase", i):
+                    steptrace.tracer.mark("wire", "allreduce",
+                                          site="unit.py:1")
+        rows = steptrace.tracer.rows()
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    runs = [r for r in rows if r["ev"] == "run"]
+    assert len(spans) == 3 and len(runs) == 1
+    assert [s["outcome"] for s in spans] == ["completed"] * 3
+    assert [s["seq"] for s in spans] == [0, 1, 2]
+    assert runs[0]["supersteps"] == 3
+    assert runs[0]["outcomes"]["completed"] == 3
+    assert runs[0]["marks"] == 3
+    # ts-monotone by construction (spans close before the run row)
+    ts = [r["ts"] for r in rows]
+    assert ts == sorted(ts)
+
+
+def test_exception_terminates_span_faulted_and_propagates():
+    with telemetry.scope(True):
+        with pytest.raises(RuntimeError):
+            with steptrace.run("unit.phase"):
+                with steptrace.superstep("unit.phase", 0):
+                    raise RuntimeError("boom")
+        rows = steptrace.tracer.rows()
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    runs = [r for r in rows if r["ev"] == "run"]
+    assert spans[0]["outcome"] == "faulted"
+    # the run row still terminates (finally) — no unterminated run even
+    # when the driver dies
+    assert len(runs) == 1 and runs[0]["outcomes"]["faulted"] == 1
+
+
+def test_reentrant_run_and_superstep_are_noops():
+    """kmeans.fit inside elastic_fit (or any nested driver) must not
+    double-count: the outermost run/span wins."""
+    with telemetry.scope(True):
+        with steptrace.run("outer"):
+            with steptrace.run("inner"):          # no-op
+                with steptrace.superstep("outer", 0):
+                    with steptrace.superstep("inner", 99):  # no-op
+                        pass
+        rows = steptrace.tracer.rows()
+    runs = [r for r in rows if r["ev"] == "run"]
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    assert len(runs) == 1 and runs[0]["phase"] == "outer"
+    assert len(spans) == 1 and spans[0]["step"] == 0
+
+
+def test_marks_outside_a_run_are_dropped():
+    with telemetry.scope(True):
+        steptrace.tracer.mark("wire", "allreduce", site="unit.py:1")
+        steptrace.tracer.on_elastic("rebalance", "unit.phase")
+        assert steptrace.tracer.rows() == []
+
+
+# ---------------------------------------------------------------------------
+# THE chaos drill — ISSUE 18 acceptance
+# ---------------------------------------------------------------------------
+
+def _skewed_ratings(rng):
+    hot = rng.integers(0, 16, 4000)
+    cold = rng.integers(16, 64, 1000)
+    users = np.concatenate([hot, cold])
+    rng.shuffle(users)
+    items = rng.integers(0, 48, users.shape[0])
+    vals = rng.normal(size=users.shape[0]).astype(np.float32)
+    return users, items, vals
+
+
+def _assert_perfetto_loadable(doc):
+    """Chrome Trace Event JSON shape: serializable, M/X/i phases only,
+    X spans with non-negative µs durations."""
+    json.dumps(doc)  # round-trippable
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("M", "X", "i")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] >= 0
+
+
+def test_chaos_drill_one_timeline(mesh, tmp_path):
+    """Transient fault + fired rebalance + permanent worker loss in ONE
+    run -> one invariant-16-clean, Perfetto-loadable timeline whose
+    spans reconcile with the elastic/health rows."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGDConfig
+
+    users, items, vals = _skewed_ratings(np.random.default_rng(0))
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    ck = str(tmp_path / "ck")
+    with telemetry.scope(True):
+        # dispatch ordinal 5 = a transient mid-epoch-3 (retry absorbs),
+        # ordinal 7 = permanent loss of worker 3 (elastic shrink); the
+        # skewed corpus fires the skew trigger at superstep 3
+        inj = FaultInjector(seed=0, fail={"dispatch": (5,)},
+                            permanent={"dispatch": (7,)}, lost_worker=3)
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals, packs_per_worker=8,
+                          max_worker_loss=1)
+        elastic_fit(ad, 6, ck, ckpt_every=1, fault=inj)
+        assert inj.permanent_fired and ad.losses == 1
+        elastic_events = [r["event"] for r in eledger.ledger.rows]
+        assert elastic_events == ["rebalance", "resume", "shrink",
+                                  "resume"]
+        rows = steptrace.tracer.rows()
+        p = tmp_path / "chaos.jsonl"
+        telemetry.export(str(p))
+    # ONE run; every span terminated; each chaos mode on the timeline
+    runs = [r for r in rows if r["ev"] == "run"]
+    assert len(runs) == 1
+    rn = runs[0]
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    assert len(spans) == rn["supersteps"]
+    outcomes = [s["outcome"] for s in spans]
+    assert outcomes.count("rebalanced") == 1
+    assert outcomes.count("faulted") == 2       # transient + permanent
+    assert outcomes.count("resumed") == 2       # restart + post-shrink
+    # cause-adjacency: the faulted spans carry the injector's marks
+    marks = [r for r in rows if r["ev"] == "mark"]
+    fault_marks = [m for m in marks if m["source"] == "fault"]
+    assert {m["name"] for m in fault_marks} == {"injected_fail",
+                                                "injected_permanent"}
+    assert {m["seq"] for m in fault_marks} == {
+        s["seq"] for s in spans if s["outcome"] == "faulted"}
+    # the timeline's elastic marks mirror the ledger event-for-event
+    assert [m["name"] for m in marks if m["source"] == "elastic"] \
+        == elastic_events
+    # the actuation pair: trigger finding + exactly-once consume
+    health_marks = {m["name"] for m in marks if m["source"] == "health"}
+    assert {"skew_trigger", "consume_skew_trigger"} <= health_marks
+    # two-spine dispatch reconciliation, exact
+    n_dispatch_marks = sum(1 for m in marks
+                           if (m["source"], m["name"])
+                           == ("flight", "dispatch"))
+    assert n_dispatch_marks == rn["flight"]["dispatches"]
+    # the full export passes invariant 16 (plus 13/14's own checks)
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+    _assert_perfetto_loadable(steptrace.perfetto(rows))
+    summary = steptrace.summarize_rows(rows)
+    assert summary["unterminated"] == []
+    assert summary["dispatch_mismatch"] == []
+    assert summary["faulted"] == 2 and summary["rebalanced"] == 1
+
+
+def test_healthy_control_zero_abnormal_terminations(mesh, tmp_path):
+    """Balanced corpus, no injector: every span completes, no fault or
+    elastic marks, and the export is invariant-16 clean."""
+    from harp_tpu.elastic.apps import MFSGDElastic, elastic_fit
+    from harp_tpu.models.mfsgd import MFSGDConfig
+
+    rng = np.random.default_rng(5)
+    users = rng.integers(0, 64, 1500)
+    items = rng.integers(0, 48, 1500)
+    vals = rng.normal(size=1500).astype(np.float32)
+    cfg = MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                      entry_cap=64)
+    with telemetry.scope(True):
+        ad = MFSGDElastic(64, 48, cfg, mesh, 0, users=users, items=items,
+                          vals=vals)
+        elastic_fit(ad, 3)
+        rows = steptrace.tracer.rows()
+        p = tmp_path / "healthy.jsonl"
+        telemetry.export(str(p))
+    runs = [r for r in rows if r["ev"] == "run"]
+    assert len(runs) == 1
+    assert runs[0]["outcomes"] == {"completed": 3, "faulted": 0,
+                                   "rebalanced": 0, "resumed": 0}
+    assert not any(r["ev"] == "mark"
+                   and r["source"] in ("fault", "elastic")
+                   for r in rows)
+    # one lane per superstep (skew.record_execution fires per epoch)
+    assert runs[0]["lanes"] == 3
+    assert check_jsonl.check_file(str(p), provenance=True) == []
+
+
+def test_kmeans_fit_is_one_single_dispatch_superstep(mesh):
+    """The whole-run-in-one-jit discipline reads literally off the
+    timeline: kmeans.fit is one run, one span, flight dispatches=1."""
+    from harp_tpu.models import kmeans
+
+    pts = np.random.default_rng(0).normal(size=(256, 8)) \
+        .astype(np.float32)
+    with telemetry.scope(True):
+        kmeans.fit(pts, k=4, iters=3, mesh=mesh, seed=0)
+        rows = steptrace.tracer.rows()
+    runs = [r for r in rows if r["ev"] == "run"]
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    assert len(runs) == 1 and runs[0]["phase"] == "kmeans.fit"
+    assert len(spans) == 1
+    assert spans[0]["flight"]["dispatches"] == 1
+    assert spans[0]["flight"]["readbacks"] == 2  # inertia + centroids
+    lanes = [r for r in rows if r["ev"] == "lane"]
+    assert len(lanes) == 1 and len(lanes[0]["work"]) == mesh.num_workers
+
+
+# ---------------------------------------------------------------------------
+# the PR-3 contract: zero-cost off, zero-flight-cost armed
+# ---------------------------------------------------------------------------
+
+def test_zero_cost_with_telemetry_off(mesh):
+    """With telemetry off the tracer must stay EMPTY through a full
+    instrumented driver run — and the result must be bit-identical to
+    the traced run (the observer never participates)."""
+    from harp_tpu.models import kmeans
+
+    pts = np.random.default_rng(0).normal(size=(256, 8)) \
+        .astype(np.float32)
+    steptrace.reset()
+    c_off, inertia_off = kmeans.fit(pts, k=4, iters=3, mesh=mesh, seed=0)
+    assert steptrace.tracer.rows() == []
+    assert steptrace.tracer._run is None
+    with telemetry.scope(True):
+        c_on, inertia_on = kmeans.fit(pts, k=4, iters=3, mesh=mesh,
+                                      seed=0)
+        assert steptrace.tracer.rows() != []
+    np.testing.assert_array_equal(np.asarray(c_off), np.asarray(c_on))
+    assert inertia_off == inertia_on
+
+
+def test_flagship_budget_pins_unchanged_with_tracing_armed(mesh):
+    """The PR-3/PR-17 flagship budget — 1 dispatch, 1 stacked readback,
+    0 steady compiles, 0 H2D — must hold bit-for-bit INSIDE an armed
+    steptrace run: tracing adds marks, never flight traffic."""
+    import harp_tpu.models.mfsgd as MF
+
+    cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                         entry_cap=32)
+    with telemetry.scope():
+        m = MF.MFSGD(64, 48, cfg, mesh, seed=3)
+        u, i, v = MF.synthetic_ratings(64, 48, 600, rank=4, seed=3)
+        m.set_ratings(u, i, v)
+        m.train_epoch()       # warmup
+        m.compile_epochs(3)
+        m.train_epochs(3)     # steady (stacked-readback ops compiled)
+        with steptrace.run("mfsgd.epochs"):
+            with flightrec.budget(compiles=0, dispatches=1, readbacks=1,
+                                  h2d_bytes=0,
+                                  tag="mfsgd.train_epochs.traced") as b:
+                with steptrace.superstep("mfsgd.epochs", 0):
+                    m.train_epochs(3)
+            assert b.spent()["dispatches"] == 1
+            assert b.spent()["readbacks"] == 1
+        rows = steptrace.tracer.rows()
+    spans = [r for r in rows if r["ev"] == "superstep"]
+    assert spans[-1]["flight"] == {"dispatches": 1, "readbacks": 1,
+                                   "h2d_calls": 0, "compiles": 0}
+
+
+def test_export_timeline_merges_steptrace_rows(mesh, tmp_path):
+    """export_timeline must append the steptrace spine so ONE file
+    holds the whole training-plane story (the merge the timeline CLI
+    reads)."""
+    from harp_tpu.models import kmeans
+
+    pts = np.random.default_rng(0).normal(size=(128, 4)) \
+        .astype(np.float32)
+    p = tmp_path / "merged.jsonl"
+    with telemetry.scope(True):
+        kmeans.fit(pts, k=4, iters=2, mesh=mesh, seed=0)
+        telemetry.export_timeline(str(p))
+    kinds = {json.loads(line).get("kind") for line in open(p)}
+    assert "steptrace" in kinds
+    loaded = telemetry.load_rows(str(p))
+    assert steptrace.summarize_rows(
+        loaded["steptrace"])["unterminated"] == []
