@@ -512,8 +512,10 @@ def main():
     # flight recorder (HARP_TELEMETRY=1): each config gets a span plus a
     # per-config delta of the execution counters in its submetric — a
     # silent recompile or an extra readback inside a measured config is
-    # visible in the driver record, not re-derived from wall-clock
-    from harp_tpu.utils import flightrec, telemetry
+    # visible in the driver record, not re-derived from wall-clock.
+    # The memory ledger (PR 19) rides the same pattern: per-config peak
+    # HBM + headroom beside the flight delta.
+    from harp_tpu.utils import flightrec, memrec, telemetry
 
     watchdog = HangWatchdog(on_fire=emit_hang_record)  # HARP_BENCH_TIMEOUT
     watchdog.arm("backend init")  # first backend use is inside _configs
@@ -522,6 +524,7 @@ def main():
             continue
         watchdog.arm(f"bench.py {name}")
         flight_base = flightrec.snapshot() if telemetry.enabled() else None
+        mem_base = memrec.snapshot() if telemetry.enabled() else None
         try:
             with telemetry.span(f"bench.{name}"):
                 res, timeout_err = _run_with_timeout(thunk, max_seconds)
@@ -551,6 +554,8 @@ def main():
                                      round(value / base, 4)), **roof}
         if flight_base is not None:
             sub[name]["flight"] = flightrec.delta_since(flight_base)
+        if mem_base is not None:
+            sub[name]["memory"] = memrec.delta_since(mem_base)
     watchdog.cancel()
     done.set()
     print(json.dumps(record()), flush=True)

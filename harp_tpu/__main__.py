@@ -41,6 +41,9 @@ APPS = {
     "timeline": ("harp_tpu.utils.steptrace",
                  "training-plane timeline: validate/summarize kind:'steptrace' "
                  "superstep rows, export Chrome/Perfetto trace.json"),
+    "memory": ("harp_tpu.utils.memrec",
+               "device-memory ledger: validate/summarize kind:'memory' "
+               "buffer-lifecycle rows, re-derive the HBM watermark"),
     "health": ("harp_tpu.health.cli",
                "health sentinel: summarize kind:'health' findings, grade "
                "fresh bench rows, run the fail-closed model gate"),

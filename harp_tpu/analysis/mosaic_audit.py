@@ -125,8 +125,70 @@ def audit_kernel(name: str, fn, args) -> list[Violation]:
     return out
 
 
+def _declared_vmem_models() -> dict[str, int]:
+    """Kernel-name → the kernel's OWN byte model evaluated at the
+    registry's registered shape — the cross-check source for HL205.
+
+    Only kernels exposing an analytic scoped-VMEM function participate;
+    shapes mirror the registry builders' comments (a registry shape
+    change must update BOTH or the audit fires, which is the point)."""
+    from harp_tpu.ops import (kmeans_kernel, rf_kernel, svm_kernel,
+                              wdamds_kernel)
+
+    return {
+        # tn=128, d=256, kp=128 (kmeans.partials_int8 builder shape)
+        "kmeans.partials_int8": kmeans_kernel.vmem_bytes_int8(128, 256,
+                                                              128),
+        # dp=128, tn=128, xsize=4 (f32 operand)
+        "svm.kernel_row": svm_kernel.vmem_bytes(128, 128, 4),
+        # dimp=128, N=256, tn=32, dsize=4
+        "wdamds.smacof_dist": wdamds_kernel.vmem_bytes(128, 256, 32, 4),
+        # tn=128, fB=512, nodeCp=8
+        "rf.hist_bins": rf_kernel.vmem_bytes(128, 512, 8),
+    }
+
+
+def check_work_declarations() -> list[Violation]:
+    """HL205 — registry ``vmem_bytes`` declarations vs the kernels' own
+    byte models.  A declaration must sit within ``memrec.PRESIZE_BAND``
+    of the model at the registered shape (stale = mis-priced sprints
+    AND a lying memrec VMEM gate) and under the 16 MB/core ceiling."""
+    from harp_tpu.ops.kernel_registry import KERNEL_WORK
+    from harp_tpu.utils import memrec
+
+    out: list[Violation] = []
+    for name, model in sorted(_declared_vmem_models().items()):
+        work = KERNEL_WORK.get(name)
+        if work is None:
+            out.append(Violation(
+                "HL205", f"kernel:{name}", 0,
+                "kernel has an analytic VMEM byte model but no registry "
+                "entry — register it (kernel_registry.py) so the audit "
+                "and the perfmodel see one source of truth"))
+            continue
+        declared = work["vmem_bytes"]
+        if not model <= declared <= model * memrec.PRESIZE_BAND:
+            out.append(Violation(
+                "HL205", f"kernel:{name}", 0,
+                f"registry vmem_bytes={declared} is stale against the "
+                f"kernel's own byte model ({model} B at the registered "
+                f"shape; allowed band [{model}, "
+                f"{int(model * memrec.PRESIZE_BAND)}]) — re-derive the "
+                "declaration (perfmodel.presize) when the kernel "
+                "changes"))
+        if declared > memrec.VMEM_CEILING:
+            out.append(Violation(
+                "HL205", f"kernel:{name}", 0,
+                f"registry vmem_bytes={declared} exceeds the "
+                f"{memrec.VMEM_CEILING >> 20} MB/core VMEM ceiling — "
+                "the registered shape itself cannot launch"))
+    return out
+
+
 def audit_registry(names: list[str] | None = None) -> list[Violation]:
-    """Audit every registered kernel (or the named subset)."""
+    """Audit every registered kernel (or the named subset).  A full
+    sweep (names=None) also cross-checks the registry work declarations
+    against the kernels' own byte models (HL205)."""
     from harp_tpu.ops.kernel_registry import KERNELS
 
     out: list[Violation] = []
@@ -139,6 +201,8 @@ def audit_registry(names: list[str] | None = None) -> list[Violation]:
                                  f"{type(e).__name__}: {e}"))
             continue
         out.extend(audit_kernel(name, fn, args))
+    if names is None:
+        out.extend(check_work_declarations())
     return out
 
 

@@ -92,6 +92,13 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "a block shape whose second-to-last dim is neither a multiple "
          "of 8 nor the full array dim fails the real Mosaic layout rules "
          "— pad or retile (CLAUDE.md Mosaic limits)"),
+    Rule("HL205", "mosaic", "stale kernel work declaration",
+         "a kernel-registry vmem_bytes declaration that no longer "
+         "matches the kernel's own byte model at the registered shape "
+         "mis-prices every perfmodel ranking and memrec VMEM gate "
+         "built on it — declarations must sit within memrec.PRESIZE_BAND "
+         "of the model (and under the 16 MB/core VMEM ceiling); "
+         "re-derive with perfmodel.presize when the kernel changes"),
     Rule("HL301", "commgraph", "collective with no CommLedger record",
          "a collective primitive in a driver jaxpr whose call site has "
          "no trace-time CommLedger record is an untracked wire — every "

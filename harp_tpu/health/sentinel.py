@@ -67,8 +67,13 @@ from harp_tpu.utils import telemetry
 #: share moving more than :data:`harp_tpu.health.grade.
 #: PROFILE_SHARE_DRIFT` points is a warn — the mechanism mix changed,
 #: so every perfmodel term calibrated against the old mix is suspect.
+#: ``memory_pressure`` (PR 19) rides the memrec spine: the run's peak
+#: HBM watermark eating into the topology's declared capacity past
+#: :data:`HEADROOM_WARN_FRAC` remaining, or the watermark drifting more
+#: than :data:`MEM_DRIFT_FRAC` above a committed baseline peak, warns —
+#: the multi-tenant admission controller's "does tenant N fit" signal.
 DETECTORS = ("slo_burn", "skew_trigger", "budget_drift",
-             "evidence_regression", "profile_drift")
+             "evidence_regression", "profile_drift", "memory_pressure")
 
 #: frozen severity vocabulary, mildest first.  ``info`` = recorded, no
 #: action; ``warn`` = degradation that needs a look; ``page`` = the SLO
@@ -112,6 +117,19 @@ WASTED_FRAC_TRIGGER = 0.25
 #: consecutive trigger-eligible records of one phase before the finding
 #: fires (a single skewed superstep is noise; K in a row is a workload).
 TRIGGER_SUPERSTEPS = 3
+
+# -- memory pressure thresholds ----------------------------------------------
+
+#: remaining-HBM fraction below which the memrec watermark is a warn:
+#: a run whose peak leaves <10% headroom has no room for a second
+#: tenant's executables, a donated depth-2 pipeline's second buffer, or
+#: a restage-after-shrink — the admission margin, not an OOM predictor.
+HEADROOM_WARN_FRAC = 0.10
+
+#: fractional growth of the peak watermark over a committed baseline
+#: peak at or above which memory_pressure warns (the profile_drift
+#: analogue for bytes: the footprint mix changed, re-price admission).
+MEM_DRIFT_FRAC = 0.10
 
 
 class HealthMonitor:
@@ -270,6 +288,34 @@ class HealthMonitor:
             row["_worst_ratio"] = ratio(worst)
             row["worst"] = (f"{worst[0]} used {worst[1]} > "
                             f"budget {worst[2]}")
+
+    # -- memory pressure ----------------------------------------------------
+    def observe_memory(self, tag: str, peak_bytes: int, hbm_bytes: int,
+                       *, baseline_peak: int | None = None) -> None:
+        """One memrec watermark observation at ``tag`` (memrec fires
+        this the first time a run's peak crosses the headroom line;
+        graders pass ``baseline_peak`` to check drift against committed
+        evidence).  Warns when remaining headroom drops below
+        :data:`HEADROOM_WARN_FRAC` or the peak grew more than
+        :data:`MEM_DRIFT_FRAC` over the baseline."""
+        if not telemetry.enabled():
+            return
+        if hbm_bytes <= 0:
+            return
+        headroom = max(0.0, 1.0 - peak_bytes / hbm_bytes)
+        drift = (None if not baseline_peak
+                 else (peak_bytes - baseline_peak) / baseline_peak)
+        pressed = headroom < HEADROOM_WARN_FRAC
+        drifted = drift is not None and drift >= MEM_DRIFT_FRAC
+        if not (pressed or drifted):
+            return
+        row = self.upsert("memory_pressure", tag, severity="warn")
+        row["tag"] = tag
+        row["peak_hbm_bytes"] = int(peak_bytes)
+        row["hbm_bytes"] = int(hbm_bytes)
+        row["headroom_frac"] = round(headroom, 6)
+        if drift is not None:
+            row["peak_drift_frac"] = round(drift, 6)
 
     # -- reading / export ---------------------------------------------------
     def summary(self) -> dict:

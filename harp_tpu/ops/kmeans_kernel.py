@@ -196,6 +196,24 @@ def _kernel_int8(pts_ref, cq_ref, cscale_ref, c2_ref, sums_ref, counts_ref,
     best_ref[:] += best.sum().reshape(1, 1)
 
 
+#: scoped-VMEM budget for the int8 tile search (the OOM-calibrated
+#: headroom under the 16 MB/core ceiling — see vmem_bytes_int8)
+_VMEM_BUDGET_INT8 = 14 << 20
+
+
+def vmem_bytes_int8(tn: int, d: int, kp: int) -> int:
+    """The int8 kernel's scoped-VMEM byte model at point tile ``tn``.
+
+    Calibrated by the 2026-08-01 silicon OOM (10000-row tiles die at
+    16.23 MB): the compiler's scoped stack is ≈ tn·(2·d + 8·kp) B
+    (double-buffered int8 in-blocks plus the [tn, kp] score/one-hot
+    temporaries), + the [kp, d]-class operands, + a 64 KiB fixed floor.
+    This is the expression the kernel-registry ``vmem_bytes``
+    declaration pins at the registered shape (harplint HL205) and the
+    memrec pre-dispatch VMEM gate prices explicit tiles with."""
+    return tn * (2 * d + 8 * kp) + 5 * kp * d + (64 << 10)
+
+
 def _tile_rows_int8(n: int, d: int, kp: int) -> int | None:
     """Largest sublane-aligned point tile dividing ``n`` that fits VMEM.
 
@@ -203,19 +221,15 @@ def _tile_rows_int8(n: int, d: int, kp: int) -> int | None:
     kernel keeps winning with size until the scoped-VMEM wall: measured
     2026-08-01 (1M×300 k=100, 1× v5e) 557.9 iter/s @8000 vs 537.2
     @4000 / 521.5 @2000 / 464.9 @1000, while 10000 OOMs at 16.23 MB —
-    which calibrates the byte model used here: the compiler's scoped
-    stack is ≈ tn·(2·d + 8·kp) B (double-buffered int8 in-blocks plus
-    the [tn, kp] score/one-hot temporaries), + the [kp, d] operands.
-    14 MB budget leaves the same headroom the LDA kernel's estimator
-    keeps.
+    which calibrates :func:`vmem_bytes_int8`.  14 MB budget leaves the
+    same headroom the LDA kernel's estimator keeps.
     """
     for tn in (64000, 50000, 40000, 32000, 25000, 20000, 16000, 10000,
                8000, 5000, 4000, 2048, 2000, 1024, 1000, 512, 256, 200,
                128, 120, 64, 40, 16, 8):
         if n % tn or tn % 8:
             continue
-        est = tn * (2 * d + 8 * kp) + 5 * kp * d + (64 << 10)
-        if est <= 14 << 20:
+        if vmem_bytes_int8(tn, d, kp) <= _VMEM_BUDGET_INT8:
             return tn
     return None
 
@@ -233,7 +247,8 @@ def int8_supported(n: int, d: int, k: int) -> bool:
 
 
 def kmeans_partials_int8(pts_q, c_q, c_scale, c2, col_scale, *,
-                         interpret: bool = False):
+                         interpret: bool = False,
+                         tile_rows: int | None = None):
     """Fused int8 per-shard partials → (sums [k, d] f32, counts [k] f32,
     best_sum f32 scalar).
 
@@ -245,11 +260,29 @@ def kmeans_partials_int8(pts_q, c_q, c_scale, c2, col_scale, *,
     ``inertia = best_sum + Σ‖x‖²`` where the caller supplies the
     iteration-invariant second term.  int32 exactness bound: a cluster
     may absorb at most 2³¹/127 ≈ 16.9M local rows (same rule as the XLA
-    path's ``_INT8_SUM_ROW_LIMIT``)."""
+    path's ``_INT8_SUM_ROW_LIMIT``).
+
+    ``tile_rows`` overrides the auto tile search (sweeps, tests); an
+    explicit tile is priced through :func:`vmem_bytes_int8` and an
+    over-VMEM choice is REFUSED before dispatch by
+    :func:`harp_tpu.utils.memrec.require_vmem_fit` — the 2026-08-01
+    silicon OOM as a pre-silicon MemoryError naming the predicted
+    bytes."""
     n, d = pts_q.shape
     k = c_q.shape[0]
     kp = -(-k // _LANE) * _LANE
-    tn = _tile_rows_int8(n, d, kp)
+    if tile_rows is not None:
+        tn = int(tile_rows)
+        if n % tn or tn % 8:
+            raise ValueError(
+                f"tile_rows={tn} must divide n={n} and align to 8")
+        from harp_tpu.utils import memrec
+
+        memrec.require_vmem_fit(
+            "kmeans.partials_int8", vmem_bytes_int8(tn, d, kp),
+            budget=_VMEM_BUDGET_INT8)
+    else:
+        tn = _tile_rows_int8(n, d, kp)
     if tn is None:
         raise ValueError(f"no supported tile size divides n={n} "
                          f"within the VMEM budget (d={d}, kp={kp})")

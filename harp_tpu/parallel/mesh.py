@@ -179,7 +179,9 @@ class WorkerMesh:
         """
         spec = P() if dim is None else self.spec(dim, ndim=np.ndim(x))
         # flight recorder: shard_array is THE bulk ingest entry point —
-        # its bytes are what the 30-40 MB/s relay tunnel actually carries
+        # its bytes are what the 30-40 MB/s relay tunnel actually carries;
+        # record_h2d also feeds the same bytes to the memory ledger
+        # (memrec, PR 19) as a 'staged' buffer entering the live set
         flightrec.record_h2d(_nbytes(x))
         return jax.device_put(x, NamedSharding(self.mesh, spec))
 

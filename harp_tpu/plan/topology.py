@@ -38,6 +38,23 @@ import dataclasses
 #: the frozen topology-tag vocabulary (check_jsonl invariant 10 pins it)
 TOPOLOGY_NAMES = ("single_chip", "sim_ring_8", "v4_32")
 
+#: declared per-chip HBM by topology tag (PR 19, the memory spine's
+#: denominator): v4 ships 32 GiB HBM2 per chip (public spec); the CPU
+#: sim targets model a v5e-class 16 GiB so headroom_frac is meaningful
+#: on the test mesh.  DECLARED, like the link rates — a relay window
+#: can overwrite via memrec.set_hbm_capacity.
+HBM_BYTES_PER_CHIP = {
+    "single_chip": 16 << 30,
+    "sim_ring_8": 16 << 30,
+    "v4_32": 32 << 30,
+}
+
+
+def hbm_bytes(name: str) -> int:
+    """Declared per-chip HBM for a topology tag (16 GiB for unknown
+    tags, e.g. sim_ring_N test meshes — conservative, never zero)."""
+    return HBM_BYTES_PER_CHIP.get(name, 16 << 30)
+
 #: per-worker wire-byte multipliers for a ring lowering of each
 #: primitive, as a fraction of the jaxpr operand bytes ``b`` (the byte
 #: sheet's ``per_shard_bytes``).  Ring algebra: psum = reduce-scatter +

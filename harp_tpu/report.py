@@ -137,7 +137,8 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
               skew_info: dict | None = None,
               trace_info: dict | None = None,
               health_info: dict | None = None,
-              elastic_rows: list[dict] | None = None) -> dict:
+              elastic_rows: list[dict] | None = None,
+              memory_info: dict | None = None) -> dict:
     """The machine-readable merge (the dict behind the JSON line)."""
     row: dict[str, Any] = {
         "comm_total_bytes": sum(t["total_bytes"] for t in comm.values()),
@@ -162,6 +163,10 @@ def build_row(comm: dict, spans: dict, span_records: list[dict] | None = None,
     # health section (PR 14) only when the sentinel recorded findings
     if health_info and health_info.get("findings"):
         row["health"] = health_info
+    # memory section (PR 19) only when the run recorded buffer events
+    if memory_info and (memory_info.get("events")
+                        or memory_info.get("rows")):
+        row["memory"] = memory_info
     # elastic section (PR 15) only when the run rebalanced/shrank/resumed
     if elastic_rows:
         by_event: dict[str, int] = {}
@@ -315,6 +320,29 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
                 extra = f"  verdict {r.get('verdict')}"
             lines.append(f"  [{r.get('severity')}] "
                          f"{r.get('detector')} {who}{extra}")
+    mem = row.get("memory")
+    if mem:
+        head = (f"memory (device ledger): peak "
+                f"{_fmt_bytes(mem.get('peak_hbm_bytes', 0))} HBM")
+        if mem.get("headroom_frac") is not None and mem.get("hbm_bytes"):
+            head += (f"  (headroom {100.0 * mem['headroom_frac']:.1f}% "
+                     f"of {_fmt_bytes(mem['hbm_bytes'])})")
+        lines.append(head)
+        lines.append(
+            f"  staged {_fmt_bytes(mem.get('staged_bytes', 0))} / "
+            f"donated {_fmt_bytes(mem.get('donated_bytes', 0))} / "
+            f"freed {_fmt_bytes(mem.get('freed_bytes', 0))} / "
+            f"live {_fmt_bytes(mem.get('live_hbm_bytes', 0))}")
+        if mem.get("executables"):
+            lines.append(
+                f"  {mem['executables']} executable footprint(s), "
+                f"{_fmt_bytes(mem.get('exec_hbm_bytes', 0))} static HBM")
+        if mem.get("vmem_checks"):
+            lines.append(
+                f"  VMEM gate: {mem['vmem_checks']} check(s), "
+                f"{mem.get('vmem_refusals', 0)} refused before dispatch")
+        for e in mem.get("errors", []):
+            lines.append(f"  IRRECONCILED: {e}")
     el = row.get("elastic")
     if el:
         lines.append(f"elastic (actions): {el.get('events', 0)} — "
@@ -354,7 +382,7 @@ def render(row: dict, span_records: list[dict] | None = None) -> str:
 def live_report() -> tuple[dict, list[dict]]:
     """(machine row, span records) from the in-process collectors."""
     from harp_tpu import elastic, health
-    from harp_tpu.utils import flightrec, reqtrace, skew
+    from harp_tpu.utils import flightrec, memrec, reqtrace, skew
 
     comm = telemetry.ledger.summary()
     spans = telemetry.tracer.summary()
@@ -365,7 +393,8 @@ def live_report() -> tuple[dict, list[dict]]:
                       trace_info=reqtrace.summarize_rows(
                           reqtrace.tracer.rows()),
                       health_info=health.monitor.summary(),
-                      elastic_rows=list(elastic.ledger.ledger.rows)),
+                      elastic_rows=list(elastic.ledger.ledger.rows),
+                      memory_info=memrec.live_summary()),
             telemetry.tracer.records)
 
 
@@ -421,6 +450,7 @@ def main(argv=None) -> int:
     trace_rows: list[dict] = []
     health_rows: list[dict] = []
     elastic_rows: list[dict] = []
+    memory_rows: list[dict] = []
     if args.telemetry:
         kinds = telemetry.load_rows(args.telemetry)
         span_rows, comm_rows = kinds["span"], kinds["comm"]
@@ -429,6 +459,7 @@ def main(argv=None) -> int:
         trace_rows = kinds["trace"]
         health_rows = kinds["health"]
         elastic_rows = kinds["elastic"]
+        memory_rows = kinds["memory"]
     metrics_rows = None
     if args.metrics:
         metrics_rows = []
@@ -444,6 +475,7 @@ def main(argv=None) -> int:
         top_ops = op_breakdown(args.trace_logdir, top=args.top)
 
     from harp_tpu import health as health_mod
+    from harp_tpu.utils import memrec
     from harp_tpu.utils.reqtrace import summarize_rows as trace_summary
 
     row = build_row(comm_summary_from_rows(comm_rows),
@@ -457,7 +489,9 @@ def main(argv=None) -> int:
                     health_info=(health_mod.summarize_rows(health_rows)
                                  | {"rows": health_rows}
                                  if health_rows else None),
-                    elastic_rows=elastic_rows)
+                    elastic_rows=elastic_rows,
+                    memory_info=(memrec.summarize_rows(memory_rows)
+                                 if memory_rows else None))
     if not args.json_only:
         print(render(row, span_rows))
     print(benchmark_json("report", row))

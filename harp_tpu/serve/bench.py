@@ -46,7 +46,7 @@ import numpy as np
 from harp_tpu import health as health_mod
 from harp_tpu.serve.engines import ENGINES
 from harp_tpu.serve.server import Server
-from harp_tpu.utils import flightrec, telemetry
+from harp_tpu.utils import flightrec, memrec, telemetry
 from harp_tpu.utils.fault import FaultInjector
 
 DEFAULT_LADDER = (1, 8, 64, 512)
@@ -89,6 +89,10 @@ def benchmark(app: str = "kmeans", n_requests: int = 256,
             t0 = time.perf_counter()
             info = srv.startup()
             startup_s = time.perf_counter() - t0
+            # static HBM footprint of this app's executables (memrec /
+            # AOT sidecar, PR 19) — the multi-tenant admission input;
+            # 0 when the backend exposes no memory_analysis
+            exec_hbm = memrec.ledger.exec_total()
 
             reqs = [srv.engine.synthetic_request(rng, rows_per_request)
                     for _ in range(n_requests)]
@@ -129,6 +133,7 @@ def benchmark(app: str = "kmeans", n_requests: int = 256,
             "startup_compiles": info["compiles"],
             "cache_hits": info["cache_hits"],
             "cache_misses": info["cache_misses"],
+            "exec_hbm_bytes": exec_hbm,
             "n_requests": n_requests,
             "rows_per_request": rows_per_request,
             "burst": burst,
@@ -334,6 +339,7 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
             t0 = time.perf_counter()
             info = srv.startup()
             startup_s = time.perf_counter() - t0
+            exec_hbm = memrec.ledger.exec_total()
 
             # warm EVERY rung off-clock (first dispatch of an executable
             # can transfer constants)
@@ -466,6 +472,7 @@ def benchmark_sustained(app: str = "kmeans", n_requests: int = 512,
             "startup_compiles": info["compiles"],
             "cache_hits": info["cache_hits"],
             "cache_misses": info["cache_misses"],
+            "exec_hbm_bytes": exec_hbm,
             "n_requests": n_requests,
             "rows_per_request": rows_per_request,
             "ladder": list(srv.ladder.rungs),

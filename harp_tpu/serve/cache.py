@@ -33,11 +33,22 @@ models/lda.py: the sprint environment routinely kills processes
 mid-write, and a truncated entry must never poison later restarts).
 A corrupt or stale entry falls back to a fresh compile — the cache can
 lose, never lie.
+
+Memory sidecar (PR 19): each entry persists its ``memory_analysis()``
+HBM footprint (argument/output/temp/generated-code bytes) beside the
+pickle as ``aot_<key>.mem.json`` — the literal input the multi-tenant
+"does tenant N fit" admission check needs, surfaced on ``serve
+--bench`` rows as ``exec_hbm_bytes`` and recorded on the memrec spine
+(``kind:"memory"`` executable rows) on both the compile and the warm
+cache-hit path.  Backends that do not expose the analysis (some CPU
+sims) simply skip the sidecar — the footprint can be absent, never
+wrong.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 import warnings
@@ -110,11 +121,27 @@ class ExecutableCache:
     def _path(self, key: str) -> str:
         return os.path.join(self.cache_dir, f"aot_{key}.pkl")
 
+    def _mem_path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"aot_{key}.mem.json")
+
+    def footprint(self, name: str, args) -> dict | None:
+        """The persisted memory_analysis() footprint for (name, arg
+        shapes), or None (pre-PR-19 entry / backend without the
+        analysis).  Read-only — admission checks call this without
+        loading the executable."""
+        try:
+            with open(self._mem_path(self._key(name, args))) as fh:
+                fp = json.load(fh)
+            return fp if isinstance(fp, dict) else None
+        except (OSError, ValueError):
+            return None
+
     def load(self, name: str, args):
         """The cached executable for (name, arg shapes), or None."""
         from jax.experimental import serialize_executable
 
-        path = self._path(self._key(name, args))
+        key = self._key(name, args)
+        path = self._path(key)
         try:
             with open(path, "rb") as fh:
                 payload = pickle.load(fh)
@@ -132,6 +159,11 @@ class ExecutableCache:
                     RuntimeWarning)
             return None
         self.hits += 1
+        from harp_tpu.utils import memrec
+
+        fp = self.footprint(name, args) \
+            or memrec.footprint_from_analysis(exe)
+        memrec.note_executable(name, fp, source="cache")
         return exe
 
     def compile_and_store(self, name: str, jitted, args):
@@ -146,7 +178,8 @@ class ExecutableCache:
             exe = jitted.trace(*args).lower().compile()
         self.misses += 1
         payload = serialize_executable.serialize(exe)
-        path = self._path(self._key(name, args))
+        key = self._key(name, args)
+        path = self._path(key)
         tmp = f"{path}.{os.getpid()}.tmp"
         try:
             with open(tmp, "wb") as fh:
@@ -159,6 +192,22 @@ class ExecutableCache:
                 os.unlink(tmp)
             except OSError:
                 pass
+        from harp_tpu.utils import memrec
+
+        fp = memrec.footprint_from_analysis(exe)
+        if fp is not None:
+            mem_path = self._mem_path(key)
+            mem_tmp = f"{mem_path}.{os.getpid()}.tmp"
+            try:
+                with open(mem_tmp, "w") as fh:
+                    json.dump(fp, fh)
+                os.replace(mem_tmp, mem_path)
+            except OSError:
+                try:
+                    os.unlink(mem_tmp)
+                except OSError:
+                    pass
+        memrec.note_executable(name, fp, source="compile")
         return exe
 
     def get_or_compile(self, name: str, jitted, args):

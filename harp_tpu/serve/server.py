@@ -115,7 +115,7 @@ class Server:
         telemetry enabled; None otherwise) — on a warm restart it is 0.
         """
         base = flightrec.snapshot() if telemetry.enabled() else None
-        self.engine.state_args()  # resident placement (device_put only)
+        n_state = len(self.engine.state_args())  # resident placement
         jitted = self.engine.jitted()
         tag = self.engine.cache_tag()
         name = f"{self.app}[{tag}]" if tag else self.app
@@ -125,8 +125,12 @@ class Server:
                 exe = self.cache.get_or_compile(name, jitted, args)
             else:
                 exe = self.cache_less_compile(jitted, args)
+            # donate_argnums mirrors engines.jitted(): the batch buffer
+            # (arg n_state) is donated, so the memory ledger sees it
+            # leave the live set at dispatch (runtime twin of HL303)
             self._exec[rung] = flightrec.track(
-                exe, f"serve.{self.app}.b{rung}")
+                exe, f"serve.{self.app}.b{rung}",
+                donate_argnums=(n_state,))
         self.steady.reset()
         return {
             "rungs": list(self.ladder.rungs),

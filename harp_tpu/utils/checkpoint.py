@@ -131,7 +131,18 @@ class CheckpointManager:
     def _restore_step(self, step: int) -> tuple[int, Any]:
         tree = self._ckptr.restore(self._path(step))
         if isinstance(tree, dict) and set(tree) == {"__harp_state__"}:
-            return step, tree["__harp_state__"]
+            state = tree["__harp_state__"]
+            # memory spine (PR 19): restore lands in HOST RAM, so the
+            # ledger records the bytes as a zero-delta "restored" event
+            # — the shard_array H2D that follows is the staged entry
+            from harp_tpu.utils import memrec, telemetry
+
+            if telemetry.enabled():
+                nbytes = sum(
+                    int(getattr(leaf, "nbytes", 0) or 0)
+                    for leaf in jax.tree.leaves(state))
+                memrec.on_restored(nbytes, f"ckpt:step_{step}")
+            return step, state
         raise ValueError(
             f"{self._path(step)} is not a harp-tpu checkpoint "
             f"(missing the __harp_state__ wrapper)")

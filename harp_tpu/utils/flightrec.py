@@ -249,6 +249,9 @@ def record_h2d(nbytes: int, site: str | None = None) -> None:
             cb(nbytes, site)
     if telemetry.enabled():
         transfers.record_h2d(nbytes, site)
+        from harp_tpu.utils import memrec
+
+        memrec.on_staged(nbytes, site or _call_site())
 
 
 def record_readback(nbytes: int = 0, site: str | None = None) -> None:
@@ -357,17 +360,34 @@ class _Tracked:
                 cb(self._label)                    # that never launched
         if telemetry.enabled():
             transfers.record_dispatch(self._label)
+            from harp_tpu.utils import memrec
+
+            memrec.on_dispatch(self._label, args)
+            out = self.__wrapped__(*args, **kw)
+            memrec.on_output(self._label, out)
+            return out
         return self.__wrapped__(*args, **kw)
 
     def __getattr__(self, name):
         return getattr(self.__wrapped__, name)
 
 
-def track(fn: Callable, label: str) -> Callable:
+def track(fn: Callable, label: str,
+          donate_argnums: tuple[int, ...] | None = None) -> Callable:
     """Wrap a jitted callable so each invocation counts one dispatch
     round trip under ``label``.  The wrapper adds one Python ``if`` per
     call and never touches the arguments — the traced program and its
-    dispatch count are identical with telemetry on or off."""
+    dispatch count are identical with telemetry on or off.
+
+    ``donate_argnums`` (PR 19) declares the callable's donation
+    signature to the memory ledger: at each call memrec claims the
+    newest live buffers matching the donated args' byte sizes and
+    records them leaving the live set (the runtime twin of HL303) —
+    metadata only, the args are never materialized."""
+    if donate_argnums is not None:
+        from harp_tpu.utils import memrec
+
+        memrec.register_dispatch(label, donate_argnums)
     return _Tracked(fn, label)
 
 

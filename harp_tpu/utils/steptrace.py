@@ -77,7 +77,8 @@ OUTCOMES = ("completed", "faulted", "rebalanced", "resumed")
 EVS = ("run", "superstep", "mark", "lane")
 
 #: mark sources — frozen as KNOWN_STEPTRACE_SOURCES
-SOURCES = ("flight", "wire", "ckpt", "fault", "elastic", "health")
+SOURCES = ("flight", "wire", "ckpt", "fault", "elastic", "health",
+           "memory")
 
 #: the flight counters a run/span attributes (a subset of
 #: flightrec._BUDGET_KEYS — the integer ones a superstep can own);
@@ -170,6 +171,9 @@ class StepTracer:
         }
         r["resume_pending"] = False
         r["seq"] += 1
+        from harp_tpu.utils import memrec
+
+        memrec.ledger.begin_window()
         outcome = "completed"
         try:
             yield
@@ -188,7 +192,8 @@ class StepTracer:
                 r["span_flight"][k] += flight[k]
             r["outcomes"][outcome] += 1
             r["supersteps"] += 1
-            self._span = None
+            memrec.note_superstep(self)  # before the span closes: the
+            self._span = None            # mark carries this seq/step
             self._rows.append({
                 "kind": "steptrace", "ev": "superstep", "run": r["run"],
                 "seq": sp["seq"], "step": sp["step"], "phase": phase,

@@ -83,7 +83,8 @@ def scope(on: bool = True, *, reset: bool = True):
         ledger.reset()
         tracer.reset()
         from harp_tpu import elastic, health
-        from harp_tpu.utils import flightrec, reqtrace, skew, steptrace
+        from harp_tpu.utils import (flightrec, memrec, reqtrace, skew,
+                                    steptrace)
 
         flightrec.reset()
         skew.reset()
@@ -91,6 +92,7 @@ def scope(on: bool = True, *, reset: bool = True):
         health.reset()
         elastic.reset()
         steptrace.reset()
+        memrec.reset()
     try:
         yield
     finally:
@@ -405,12 +407,14 @@ def record_comm(verb: str, tree: Any, *, axis: str,
 
 def export(path: str) -> None:
     """Write every collected record (spans + ledger + flight recorder +
-    skew ledger + request traces + health findings + elastic actions)
-    as one JSONL file — the input format of ``python -m harp_tpu
-    report``, ``python -m harp_tpu trace``, ``python -m harp_tpu
-    timeline``, and ``python -m harp_tpu health``."""
+    skew ledger + request traces + health findings + elastic actions +
+    memory ledger) as one JSONL file — the input format of ``python -m
+    harp_tpu report``, ``python -m harp_tpu trace``, ``python -m
+    harp_tpu timeline``, ``python -m harp_tpu health``, and ``python -m
+    harp_tpu memory``."""
     from harp_tpu import elastic, health
-    from harp_tpu.utils import flightrec, reqtrace, skew, steptrace
+    from harp_tpu.utils import (flightrec, memrec, reqtrace, skew,
+                                steptrace)
 
     with open(path, "w") as fh:
         tracer.export_jsonl(fh)
@@ -421,6 +425,7 @@ def export(path: str) -> None:
         health.export_jsonl(fh)
         elastic.export_jsonl(fh)
         steptrace.export_jsonl(fh)
+        memrec.export_jsonl(fh)
 
 
 def export_timeline(path: str) -> None:
@@ -496,14 +501,15 @@ def load_rows(path: str) -> dict[str, list[dict]]:
     """Read an :func:`export` file back, keyed by record kind:
     ``{"span": [...], "comm": [...], "compile": [...], "transfer":
     [...], "skew": [...], "trace": [...], "health": [...],
-    "elastic": [...], "steptrace": [...]}`` (unknown
+    "elastic": [...], "steptrace": [...], "memory": [...]}`` (unknown
     kinds land under ``"comm"`` for backward compatibility with
     pre-flight-recorder exports, whose only unmarked rows were the
     ledger's)."""
     out: dict[str, list[dict]] = {"span": [], "comm": [], "compile": [],
                                   "transfer": [], "skew": [],
                                   "trace": [], "health": [],
-                                  "elastic": [], "steptrace": []}
+                                  "elastic": [], "steptrace": [],
+                                  "memory": []}
     with open(path) as fh:
         for line in fh:
             line = line.strip()

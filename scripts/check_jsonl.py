@@ -242,6 +242,28 @@ not bench evidence: they get the parse check only — plus invariants 3/4:
     actuation mark must point at a CONSUMED ``skew_trigger`` finding —
     the exactly-once handshake leaves ledger evidence or it did not
     happen.
+
+17. **Memory rows are a replayable device-memory ledger** (any file): a
+    ``kind:"memory"`` row (the PR-19 memory spine —
+    :mod:`harp_tpu.utils.memrec`, exported by ``telemetry.export``)
+    must carry the provenance stamp (a CPU-sim footprint must never
+    read as silicon HBM evidence), declare a known row shape (``ev`` ∈
+    ``KNOWN_MEMORY_EVS``; buffer rows additionally ``event`` ∈
+    ``KNOWN_MEMORY_EVENTS``) with a strictly increasing ``seq``, and
+    the ledger must REPLAY: re-deriving the live set from the buffer
+    event stream (staged/output add, freed/donated remove — a
+    freed/donated buffer must BE live; ``restored`` is zero-delta by
+    design), every row's ``live_bytes``/``peak_bytes`` must equal the
+    derived watermark EXACTLY; a ``dispatch`` row's donated buffer ids
+    must have left the live set (the runtime twin of the HL303
+    donation audit); an ``executable`` row's four footprint components
+    must sum to its ``exec_hbm_bytes``; a ``vmem_check`` row's
+    ``fits``/``refused`` flags must agree with its own
+    predicted-vs-budget bytes; and the export must terminate in
+    EXACTLY one ``summary`` row whose staged/freed/donated/peak/live
+    totals and ``headroom_frac`` (= 1 − peak/hbm) re-derive from the
+    stream — buffer events after the summary, or a peak the events
+    cannot reproduce, mean the watermark was asserted, not measured.
 """
 
 from __future__ import annotations
@@ -367,7 +389,7 @@ def _check_skew_row(name: str, i: int, row: dict) -> list[str]:
 # with harp_tpu.analysis.rules.rule_ids() so drift fails tier-1
 KNOWN_LINT_RULES = ("HL000", "HL001", "HL002", "HL003", "HL004", "HL005",
                     "HL101", "HL102", "HL201", "HL202", "HL203", "HL204",
-                    "HL301", "HL302", "HL303", "HL304")
+                    "HL205", "HL301", "HL302", "HL303", "HL304")
 LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
                      "stale_allowlist")
 
@@ -843,7 +865,8 @@ def _check_model_row(name: str, i: int, row: dict) -> list[str]:
 # plan/model vocabularies and sync-pinned by tests/test_check_jsonl.py
 # against harp_tpu.health (DETECTORS / SEVERITIES / VERDICTS)
 KNOWN_HEALTH_DETECTORS = ("slo_burn", "skew_trigger", "budget_drift",
-                          "evidence_regression", "profile_drift")
+                          "evidence_regression", "profile_drift",
+                          "memory_pressure")
 KNOWN_HEALTH_SEVERITIES = ("info", "warn", "page")
 KNOWN_HEALTH_VERDICTS = ("confirmed", "improved", "regressed",
                          "model_invalidated")
@@ -1139,7 +1162,7 @@ KNOWN_STEPTRACE_EVS = ("run", "superstep", "mark", "lane")
 KNOWN_STEPTRACE_OUTCOMES = ("completed", "faulted", "rebalanced",
                             "resumed")
 KNOWN_STEPTRACE_SOURCES = ("flight", "wire", "ckpt", "fault", "elastic",
-                           "health")
+                           "health", "memory")
 KNOWN_STEPTRACE_FLIGHT_KEYS = ("dispatches", "readbacks", "h2d_calls",
                                "compiles")
 
@@ -1392,6 +1415,216 @@ def _finish_steptrace_checks(name: str, state: dict,
     return errs
 
 
+# the memory-row vocabularies (invariant 17), FROZEN standalone like the
+# steptrace vocabularies and sync-pinned by tests/test_check_jsonl.py
+# against harp_tpu.utils.memrec (EVS / BUFFER_EVENTS)
+KNOWN_MEMORY_EVS = ("buffer", "dispatch", "executable", "vmem_check",
+                    "summary")
+KNOWN_MEMORY_EVENTS = ("staged", "restored", "output", "freed",
+                       "donated")
+MEMORY_EXEC_COMPONENTS = ("argument_bytes", "output_bytes", "temp_bytes",
+                          "generated_code_bytes")
+MEMORY_SUMMARY_DERIVED = ("peak_hbm_bytes", "live_hbm_bytes",
+                          "staged_bytes", "freed_bytes", "donated_bytes",
+                          "vmem_checks", "vmem_refusals")
+
+
+def _check_memory_row(name: str, i: int, row: dict,
+                      state: dict) -> list[str]:
+    """Invariant 17, per-row half: stamp, row shape, and the live-set
+    replay.
+
+    ``state`` carries the re-derived ledger the end-of-file half
+    (:func:`_finish_memory_checks`) closes out: the live set (buf id →
+    bytes), running live/peak watermarks, staged/freed/donated totals,
+    vmem check/refusal counts, and the summary row once seen — the
+    IDENTICAL replay :func:`harp_tpu.utils.memrec.summarize_rows` runs,
+    so the CLI and the repo gate cannot disagree about a file.
+    """
+    errs: list[str] = []
+    missing = [f for f in PROVENANCE_FIELDS if f not in row]
+    if missing:
+        errs.append(
+            f"{name}:{i}: memory row missing provenance field(s) "
+            f"{missing} — export through telemetry.export, which stamps "
+            "them (a CPU-sim footprint must never read as silicon HBM "
+            "evidence)")
+    ev = row.get("ev")
+    if ev not in KNOWN_MEMORY_EVS:
+        errs.append(f"{name}:{i}: memory row ev={ev!r} not in "
+                    f"{KNOWN_MEMORY_EVS}")
+        return errs
+    seq = row.get("seq")
+    if isinstance(seq, bool) or not isinstance(seq, int) or seq < 1:
+        errs.append(f"{name}:{i}: memory row seq={seq!r} must be a "
+                    "positive integer")
+    else:
+        last = state.get("last_seq", 0)
+        if seq <= last:
+            errs.append(
+                f"{name}:{i}: memory row seq={seq} did not increase "
+                f"from {last} — the ledger is an ordered event stream")
+        state["last_seq"] = seq
+    if state.get("summary") is not None and ev != "summary":
+        errs.append(
+            f"{name}:{i}: memory {ev} row after the summary row — the "
+            "summary terminates the export; a late event means the "
+            "watermark was asserted, not measured")
+    live = state.setdefault("live", {})
+    if ev == "buffer":
+        errs += _replay_memory_buffer(name, i, row, state, live)
+    elif ev == "dispatch":
+        for b in row.get("donated") or []:
+            if b in live:
+                errs.append(
+                    f"{name}:{i}: memory dispatch donated buf {b} is "
+                    "still in the live set — a donated buffer must "
+                    "leave at dispatch (runtime twin of HL303)")
+        if row.get("live_bytes") != state.get("live_bytes", 0):
+            errs.append(
+                f"{name}:{i}: memory dispatch live_bytes="
+                f"{row.get('live_bytes')!r} != derived "
+                f"{state.get('live_bytes', 0)}")
+    elif ev == "executable":
+        parts = []
+        for k in MEMORY_EXEC_COMPONENTS:
+            v = row.get(k)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: memory executable row "
+                            f"{k}={v!r} must be a non-negative integer")
+            else:
+                parts.append(v)
+        if (len(parts) == len(MEMORY_EXEC_COMPONENTS)
+                and row.get("exec_hbm_bytes") != sum(parts)):
+            errs.append(
+                f"{name}:{i}: memory executable row exec_hbm_bytes="
+                f"{row.get('exec_hbm_bytes')!r} != component sum "
+                f"{sum(parts)} — the four memory_analysis components "
+                "must add up")
+        if row.get("source") not in ("compile", "cache"):
+            errs.append(
+                f"{name}:{i}: memory executable row source="
+                f"{row.get('source')!r} must be 'compile' or 'cache'")
+    elif ev == "vmem_check":
+        pb, bb = row.get("predicted_bytes"), row.get("budget_bytes")
+        for k, v in (("predicted_bytes", pb), ("budget_bytes", bb)):
+            if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+                errs.append(f"{name}:{i}: memory vmem_check row "
+                            f"{k}={v!r} must be a non-negative integer")
+        if (isinstance(pb, int) and isinstance(bb, int)
+                and not isinstance(pb, bool) and not isinstance(bb, bool)):
+            fits = pb <= bb
+            if bool(row.get("fits")) != fits:
+                errs.append(
+                    f"{name}:{i}: memory vmem_check fits="
+                    f"{row.get('fits')!r} contradicts predicted={pb} "
+                    f"vs budget={bb} — the gate's verdict must follow "
+                    "its own bytes")
+            if bool(row.get("refused")) == bool(row.get("fits")):
+                errs.append(
+                    f"{name}:{i}: memory vmem_check refused="
+                    f"{row.get('refused')!r} must be the negation of "
+                    f"fits={row.get('fits')!r}")
+        state["vmem_checks"] = state.get("vmem_checks", 0) + 1
+        if row.get("refused"):
+            state["vmem_refusals"] = state.get("vmem_refusals", 0) + 1
+    elif ev == "summary":
+        if state.get("summary") is not None:
+            errs.append(f"{name}:{i}: second memory summary row — the "
+                        "export terminates exactly once")
+        state["summary"] = (i, row)
+    return errs
+
+
+def _replay_memory_buffer(name: str, i: int, row: dict, state: dict,
+                          live: dict) -> list[str]:
+    """Invariant 17, buffer-event half of the live-set replay."""
+    errs: list[str] = []
+    e = row.get("event")
+    if e not in KNOWN_MEMORY_EVENTS:
+        errs.append(f"{name}:{i}: memory buffer row event={e!r} not in "
+                    f"{KNOWN_MEMORY_EVENTS}")
+        return errs
+    nb = row.get("bytes")
+    if isinstance(nb, bool) or not isinstance(nb, int) or nb < 0:
+        errs.append(f"{name}:{i}: memory buffer row bytes={nb!r} must "
+                    "be a non-negative integer")
+        return errs
+    b = row.get("buf")
+    if e in ("staged", "output"):
+        live[b] = nb
+        state["live_bytes"] = state.get("live_bytes", 0) + nb
+        state["peak_bytes"] = max(state.get("peak_bytes", 0),
+                                  state["live_bytes"])
+        if e == "staged":
+            state["staged_bytes"] = state.get("staged_bytes", 0) + nb
+    elif e in ("freed", "donated"):
+        if b not in live:
+            errs.append(
+                f"{name}:{i}: memory buffer row {e} buf {b!r} is not "
+                "in the live set — a buffer must be staged/output "
+                "before it can leave")
+        else:
+            state["live_bytes"] = state.get("live_bytes", 0) - live.pop(b)
+        key = "freed_bytes" if e == "freed" else "donated_bytes"
+        state[key] = state.get(key, 0) + nb
+    # e == "restored" is zero-delta by design (restore lands in host
+    # RAM; the H2D that follows is its own staged event)
+    if row.get("live_bytes") != state.get("live_bytes", 0):
+        errs.append(
+            f"{name}:{i}: memory buffer row live_bytes="
+            f"{row.get('live_bytes')!r} != derived "
+            f"{state.get('live_bytes', 0)} — the watermark must "
+            "re-derive from the event stream EXACTLY")
+    if row.get("peak_bytes") != state.get("peak_bytes", 0):
+        errs.append(
+            f"{name}:{i}: memory buffer row peak_bytes="
+            f"{row.get('peak_bytes')!r} != derived "
+            f"{state.get('peak_bytes', 0)}")
+    return errs
+
+
+def _finish_memory_checks(name: str, state: dict) -> list[str]:
+    """Invariant 17, file-level half: exactly one terminating summary
+    whose totals re-derive from the stream (runs after the whole file
+    was scanned)."""
+    if not state:
+        return []
+    errs: list[str] = []
+    if state.get("summary") is None:
+        return [f"{name}: memory rows with no terminating summary row — "
+                "the export is unterminated (telemetry.export writes "
+                "exactly one)"]
+    i, row = state["summary"]
+    derived = {"peak_hbm_bytes": state.get("peak_bytes", 0),
+               "live_hbm_bytes": state.get("live_bytes", 0),
+               "staged_bytes": state.get("staged_bytes", 0),
+               "freed_bytes": state.get("freed_bytes", 0),
+               "donated_bytes": state.get("donated_bytes", 0),
+               "vmem_checks": state.get("vmem_checks", 0),
+               "vmem_refusals": state.get("vmem_refusals", 0)}
+    for k in MEMORY_SUMMARY_DERIVED:
+        if row.get(k) != derived[k]:
+            errs.append(
+                f"{name}:{i}: memory summary {k}={row.get(k)!r} != "
+                f"derived {derived[k]} — a peak the events cannot "
+                "reproduce was asserted, not measured")
+    hbm, peak = row.get("hbm_bytes"), row.get("peak_hbm_bytes")
+    hf = row.get("headroom_frac")
+    if isinstance(hbm, bool) or not isinstance(hbm, int) or hbm <= 0:
+        errs.append(f"{name}:{i}: memory summary hbm_bytes={hbm!r} must "
+                    "be a positive integer (the topology's declared "
+                    "HBM capacity)")
+    elif isinstance(peak, int) and not isinstance(peak, bool):
+        want = round(max(0.0, 1.0 - peak / hbm), 6)
+        if not _num(hf) or abs(hf - want) > 1e-6:
+            errs.append(
+                f"{name}:{i}: memory summary headroom_frac={hf!r} != "
+                f"1 - peak/hbm = {want} — headroom must be computed, "
+                "not asserted")
+    return errs
+
+
 INGEST_RATE_FIELDS = ("host_gb_per_sec", "points_per_sec")
 
 
@@ -1435,6 +1668,7 @@ def check_file(path: str, grandfathered: int = 0,
     steptrace_state: dict = {}
     elastic_counts: dict = {}
     health_rows: list[dict] = []
+    memory_state: dict = {}
     transfer_dispatches: int | None = None
     for i, line in enumerate(lines, 1):
         if not line.strip():
@@ -1487,6 +1721,8 @@ def check_file(path: str, grandfathered: int = 0,
             errors += _check_profile_row(name, i, row)
         if isinstance(row, dict) and row.get("kind") == "steptrace":
             errors += _check_steptrace_row(name, i, row, steptrace_state)
+        if isinstance(row, dict) and row.get("kind") == "memory":
+            errors += _check_memory_row(name, i, row, memory_state)
         if not provenance or i <= grandfathered:
             continue
         if not isinstance(row, dict) or "config" not in row:
@@ -1501,6 +1737,7 @@ def check_file(path: str, grandfathered: int = 0,
     errors += _finish_steptrace_checks(name, steptrace_state,
                                        elastic_counts, health_rows,
                                        transfer_dispatches)
+    errors += _finish_memory_checks(name, memory_state)
     return errors
 
 

@@ -2720,3 +2720,134 @@ print(f"steptrace: chaos run {_st_rn['supersteps']} spans {_st_oc} on "
       "uncovered manual-install resume row exports clean, CLI+Perfetto "
       "round trip, tracer zero-cost off (bit-identical kmeans)")
 print(f"DRIVE OK round-38 ({mode})")
+
+# ---------------------------------------------------------------------------
+# round 39 — the memory plane (PR 19).  One instrumented scope drives
+# every hook through the PUBLIC surface: (a) shard_array staging +
+# a donate_argnums-tracked dispatch (the donated buffer must LEAVE the
+# live set) + a checkpoint restore + one passing and one REFUSED
+# vmem gate, all inside steptrace supersteps so the peak rides the
+# timeline as memory marks; the export must be invariant-17 clean and
+# the watermark must match a straight-line python replay of the buffer
+# rows; (b) the serve AOT cache persists the memory_analysis()
+# footprint as a .mem.json sidecar and a warm load reports the SAME
+# exec_hbm_bytes without recompiling; (c) the CLI round-trips the
+# export (exit 0, stamped --json row, exit 2 on garbage); (d) zero
+# cost off: with telemetry disabled no hook records anything.
+# ---------------------------------------------------------------------------
+import json as _mr_json
+import subprocess as _mr_sp
+import tempfile as _mr_tmp
+
+from harp_tpu.ops.kmeans_kernel import vmem_bytes_int8 as _mr_vb
+from harp_tpu.serve.cache import ExecutableCache as _MrCache
+from harp_tpu.utils import flightrec as _mr_fr
+from harp_tpu.utils import memrec as _mr
+from harp_tpu.utils import steptrace as _mr_stt
+from harp_tpu.utils import telemetry as _mr_tm
+from harp_tpu.utils.checkpoint import CheckpointManager as _MrCkpt
+
+_mr_dir = _mr_tmp.mkdtemp()
+_mr_out = os.path.join(_mr_dir, "run.jsonl")
+_mr_x = np.arange(nw * 8 * 4, dtype=np.float32).reshape(nw * 8, 4)
+_mr_step = _mr_fr.track(
+    jax.jit(lambda a: a.sum(), donate_argnums=(0,)),
+    "drive.mem.step", donate_argnums=(0,))
+_mr_pred = _mr_vb(8000, 1024, 128)  # the 2026-08-01 relay-OOM shape
+
+with _mr_tm.scope(True):
+    with _mr_stt.run("drive.mem"):
+        with _mr_stt.superstep("drive.mem", 0):
+            _mr_xd = mesh.shard_array(_mr_x)          # staged
+            _mr_res = float(np.asarray(_mr_step(_mr_xd)))  # donated
+            _mr_ck = _MrCkpt(os.path.join(_mr_dir, "ck"))
+            _mr_ck.save(1, {"w": np.float32(_mr_res)})
+            _mr_ck.restore(1)                         # restored
+            _mr.require_vmem_fit("drive.fit", 1 << 20,
+                                 budget=14 << 20)     # fits
+        with _mr_stt.superstep("drive.mem", 1):
+            try:
+                _mr.require_vmem_fit("kmeans.partials_int8", _mr_pred,
+                                     budget=14 << 20)
+                raise AssertionError("over-VMEM config was not refused")
+            except MemoryError as e:
+                assert str(_mr_pred) in str(e) and "refused before " \
+                    "dispatch" in str(e), str(e)
+    _mr_rows = list(_mr.ledger._rows)
+    _mr_marks = [r for r in _mr_stt.tracer.rows()
+                 if r["ev"] == "mark" and r["source"] == "memory"]
+    assert _mr_marks and all(m["name"] == "superstep_peak"
+                             for m in _mr_marks)
+    _mr_tm.export(_mr_out)
+
+# straight-line replay of the buffer rows == every stamped watermark
+_mr_live, _mr_peak, _mr_alive = 0, 0, {}
+for _mr_r in [r for r in _mr_rows if r["ev"] == "buffer"]:
+    if _mr_r["event"] in ("staged", "output"):
+        _mr_alive[_mr_r["buf"]] = _mr_r["bytes"]
+    elif _mr_r["event"] in ("freed", "donated"):
+        _mr_alive.pop(_mr_r["buf"], None)
+    # "restored" is a zero-delta provenance row (ckpt state re-enters
+    # through its own device_put, already counted) — live unchanged
+    _mr_live = sum(_mr_alive.values())
+    _mr_peak = max(_mr_peak, _mr_live)
+    assert _mr_r["live_bytes"] == _mr_live
+    assert _mr_r["peak_bytes"] == _mr_peak
+assert _mr_peak >= _mr_x.nbytes
+# the donated input is GONE from the live set (runtime HL303 twin)
+(_mr_dn,) = [r for r in _mr_rows if r["ev"] == "dispatch"]
+assert _mr_dn["donated_bytes"] == _mr_x.nbytes
+assert _mr_x.nbytes not in _mr_alive.values()
+assert ("restored",) == tuple({r["event"] for r in _mr_rows
+                               if str(r.get("label", "")).startswith("ckpt:")})
+_mr_errs = _st_cj.check_file(_mr_out, provenance=True)
+assert _mr_errs == [], _mr_errs
+
+# (b) AOT cache sidecar: compile writes it, warm load replays it
+_mr_cache = _MrCache(_mr_dir, fingerprint="drive39")
+_mr_jit = jax.jit(lambda v: v * 2.0)
+_mr_args = (jnp.zeros((8, 8), jnp.float32),)
+with _mr_tm.scope(True):
+    _mr_cache.get_or_compile("drive.prog", _mr_jit, _mr_args)
+    (_mr_c,) = [r for r in _mr.ledger._rows if r["ev"] == "executable"]
+    assert _mr_c["source"] == "compile" and _mr_c["exec_hbm_bytes"] > 0
+assert [f for f in os.listdir(_mr_dir) if f.endswith(".mem.json")]
+_mr_fp = _mr_cache.footprint("drive.prog", _mr_args)
+assert _mr_fp["argument_bytes"] == 256
+with _mr_tm.scope(True):
+    _mr_cache.load("drive.prog", _mr_args)
+    (_mr_w,) = [r for r in _mr.ledger._rows if r["ev"] == "executable"]
+    assert _mr_w["source"] == "cache"
+    assert _mr_w["exec_hbm_bytes"] == _mr_c["exec_hbm_bytes"]
+
+# (c) CLI round trip: exit 0 + stamped row matching the replay; exit 2
+_mr_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+_mr_cli = _mr_sp.run(
+    [sys.executable, "-m", "harp_tpu", "memory", _mr_out, "--json"],
+    capture_output=True, text=True, timeout=300, env=_mr_env,
+    cwd=_st_root)
+assert _mr_cli.returncode == 0, _mr_cli.stderr[-800:]
+_mr_row = _mr_json.loads(_mr_cli.stdout.strip().splitlines()[-1])
+assert _mr_row["errors"] == [] and _mr_row["peak_hbm_bytes"] == _mr_peak
+assert _mr_row["vmem_refusals"] == 1
+assert all(k in _mr_row for k in ("backend", "date", "commit"))
+_mr_bad = _mr_sp.run(
+    [sys.executable, "-m", "harp_tpu", "memory",
+     os.path.join(_mr_dir, "nope.jsonl")],
+    capture_output=True, text=True, timeout=300, env=_mr_env,
+    cwd=_st_root)
+assert _mr_bad.returncode == 2, _mr_bad.returncode
+
+# (d) zero-cost off: no hook records anything with telemetry disabled
+_mr.reset()
+_ = mesh.shard_array(_mr_x)
+_ = _mr_step(mesh.shard_array(_mr_x))
+assert _mr.ledger._rows == [] and _mr.snapshot()["events"] == 0
+
+print(f"memrec: lifecycle replay == watermark (peak {_mr_peak} B, "
+      f"donated {_mr_dn['donated_bytes']} B gone at dispatch), ckpt "
+      "restore labeled, over-VMEM refused pre-dispatch naming "
+      f"{_mr_pred} B, export invariant-17 clean with "
+      f"{len(_mr_marks)} superstep memory mark(s), cache sidecar "
+      "compile==warm-load bytes, CLI exit 0/2, zero-cost off")
+print(f"DRIVE OK round-39 ({mode})")

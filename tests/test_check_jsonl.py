@@ -1375,3 +1375,210 @@ def test_golden_steptrace_fixture_is_clean_and_summarizes():
     s = steptrace.summarize_rows(rows)
     assert s["runs"] == 1 and s["unterminated"] == []
     assert s["supersteps"] >= 2 and s["dispatch_mismatch"] == []
+
+
+# ---------------------------------------------------------------------------
+# invariant 17: memory-ledger rows (PR 19)
+# ---------------------------------------------------------------------------
+
+def _mem_rows():
+    """A minimal valid forged ledger: stage → donate → dispatch →
+    output → restore → free → executable → vmem pass → summary,
+    internally reconciled (the exact shape memrec.export_jsonl
+    writes)."""
+    return [
+        {"kind": "memory", "ev": "buffer", "event": "staged", "buf": 1,
+         "bytes": 1024, "label": "mesh.shard_array", "seq": 1,
+         "live_bytes": 1024, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "buffer", "event": "donated", "buf": 1,
+         "bytes": 1024, "label": "mesh.shard_array", "seq": 2,
+         "live_bytes": 0, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "dispatch", "label": "serve.kmeans.b8",
+         "seq": 3, "donated": [1], "donated_bytes": 1024,
+         "live_bytes": 0, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "buffer", "event": "output", "buf": 2,
+         "bytes": 4, "label": "serve.kmeans.b8", "seq": 4,
+         "live_bytes": 4, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "buffer", "event": "restored", "buf": 0,
+         "bytes": 4096, "label": "ckpt:step_1", "seq": 5,
+         "live_bytes": 4, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "buffer", "event": "freed", "buf": 2,
+         "bytes": 4, "label": "serve.kmeans.b8", "seq": 6,
+         "live_bytes": 0, "peak_bytes": 1024, **_TSTAMP},
+        {"kind": "memory", "ev": "executable", "name": "serve.kmeans.b8",
+         "seq": 7, "source": "compile", "argument_bytes": 256,
+         "output_bytes": 256, "temp_bytes": 0,
+         "generated_code_bytes": 0, "exec_hbm_bytes": 512, **_TSTAMP},
+        {"kind": "memory", "ev": "vmem_check",
+         "kernel": "kmeans.partials_int8", "seq": 8,
+         "predicted_bytes": 1048576, "budget_bytes": 14680064,
+         "fits": True, "refused": False, **_TSTAMP},
+        {"kind": "memory", "ev": "summary", "seq": 9, "events": 8,
+         "staged_bytes": 1024, "freed_bytes": 4, "donated_bytes": 1024,
+         "peak_hbm_bytes": 1024, "live_hbm_bytes": 0,
+         "hbm_bytes": 17179869184, "headroom_frac": 1.0,
+         "executables": 1, "exec_hbm_bytes": 512, "vmem_checks": 1,
+         "vmem_refusals": 0, **_TSTAMP},
+    ]
+
+
+def _mem_check(rows, tmp_path):
+    p = tmp_path / "memory.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return check_jsonl.check_file(str(p), provenance=True)
+
+
+def test_memory_rows_valid_round_trip(tmp_path):
+    assert _mem_check(_mem_rows(), tmp_path) == []
+
+
+def test_memory_row_requires_provenance_and_vocabularies(tmp_path):
+    rows = _mem_rows()
+    del rows[0]["backend"]
+    assert any("provenance" in e for e in _mem_check(rows, tmp_path))
+    rows = _mem_rows()
+    rows[0]["ev"] = "malloc"
+    assert any("ev='malloc'" in e for e in _mem_check(rows, tmp_path))
+    rows = _mem_rows()
+    rows[0]["event"] = "leaked"
+    assert any("event='leaked'" in e for e in _mem_check(rows, tmp_path))
+    rows = _mem_rows()
+    rows[6]["source"] = "vibes"
+    assert any("'compile' or 'cache'" in e
+               for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_seq_must_strictly_increase(tmp_path):
+    rows = _mem_rows()
+    rows[1]["seq"] = 1  # replayed seq
+    assert any("did not increase" in e for e in _mem_check(rows, tmp_path))
+    rows = _mem_rows()
+    rows[0]["bytes"] = -5
+    assert any("non-negative" in e for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_watermark_must_rederive_exactly(tmp_path):
+    # a forged peak the events cannot reproduce
+    rows = _mem_rows()
+    rows[0]["peak_bytes"] = 2048
+    assert any("peak_bytes=2048 != derived 1024" in e
+               for e in _mem_check(rows, tmp_path))
+    # a forged live count on a buffer row
+    rows = _mem_rows()
+    rows[3]["live_bytes"] = 999
+    assert any("re-derive from the event stream EXACTLY" in e
+               for e in _mem_check(rows, tmp_path))
+    # a summary asserting a peak the stream never reached
+    rows = _mem_rows()
+    rows[-1]["peak_hbm_bytes"] = 4096
+    assert any("asserted, not measured" in e
+               for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_donated_buffer_must_leave_live_set(tmp_path):
+    # drop the donated buffer event: the dispatch row's claimed buffer
+    # is then still live — the runtime twin of HL303 fires
+    rows = [r for r in _mem_rows()
+            if not (r.get("ev") == "buffer"
+                    and r.get("event") == "donated")]
+    errs = _mem_check(rows, tmp_path)
+    assert any("still in the live set" in e and "HL303" in e
+               for e in errs)
+    # freeing a buffer that was never staged is equally forged
+    rows = _mem_rows()
+    rows[5]["buf"] = 77
+    assert any("is not in the live set" in e
+               for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_vmem_flags_must_follow_their_own_bytes(tmp_path):
+    rows = _mem_rows()
+    rows[7]["fits"] = False  # contradicts predicted <= budget
+    errs = _mem_check(rows, tmp_path)
+    assert any("contradicts predicted" in e for e in errs)
+    rows = _mem_rows()
+    rows[7]["refused"] = True  # refused must be the negation of fits
+    assert any("negation of fits" in e for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_executable_components_must_sum(tmp_path):
+    rows = _mem_rows()
+    rows[6]["exec_hbm_bytes"] = 9999
+    assert any("component sum" in e for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_export_must_terminate_in_one_summary(tmp_path):
+    # no summary at all
+    rows = _mem_rows()[:-1]
+    assert any("no terminating summary" in e
+               for e in _mem_check(rows, tmp_path))
+    # a second summary
+    rows = _mem_rows() + [dict(_mem_rows()[-1], seq=10)]
+    assert any("second memory summary" in e
+               for e in _mem_check(rows, tmp_path))
+    # a late buffer event after the summary
+    late = dict(_mem_rows()[0], seq=10)
+    rows = _mem_rows() + [late]
+    assert any("after the summary row" in e
+               for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_headroom_must_be_computed(tmp_path):
+    rows = _mem_rows()
+    rows[-1]["headroom_frac"] = 0.5
+    assert any("headroom must be computed" in e
+               for e in _mem_check(rows, tmp_path))
+    rows = _mem_rows()
+    rows[-1]["hbm_bytes"] = 0
+    assert any("positive integer" in e for e in _mem_check(rows, tmp_path))
+
+
+def test_memory_vocab_in_sync_with_memrec_module():
+    from harp_tpu.utils import memrec
+
+    assert memrec.EVS == check_jsonl.KNOWN_MEMORY_EVS
+    assert memrec.BUFFER_EVENTS == check_jsonl.KNOWN_MEMORY_EVENTS
+
+
+def test_golden_memory_fixture_is_clean_and_summarizes():
+    """The committed golden memory fixture (tests/data) passes the
+    checker AND the module's own replay — the fixture the memory CLI
+    smoke drives."""
+    p = os.path.join(os.path.dirname(__file__), "data",
+                     "golden_memory.jsonl")
+    assert check_jsonl.check_file(p) == []
+    from harp_tpu.utils import memrec, telemetry
+
+    s = memrec.summarize_rows(telemetry.load_rows(p)["memory"])
+    assert s["errors"] == []
+    assert s["vmem_refusals"] == 1        # the walkthrough's refusal
+    assert s["donated_bytes"] > 0         # the HL303 runtime twin
+    assert s["executables"] == 1
+
+
+# the derived evidence kinds that ship BOTH an offline validator
+# (python -m harp_tpu trace/timeline/health/memory, profile --json)
+# and a committed golden fixture; a new telemetry spine must join this
+# tuple with its checker + fixture or the pin fails tier-1
+GOLDEN_SPINE_KINDS = ("trace", "health", "profile", "steptrace",
+                      "memory")
+
+
+def test_meta_every_spine_kind_has_checker_and_golden_fixture():
+    """Satellite 3 (PR 19): every spine kind with an offline CLI has a
+    check_jsonl invariant (a ``_check_<kind>_row`` checker) AND a clean
+    committed golden fixture under tests/data/ containing rows of that
+    kind — a new spine cannot land half-pinned."""
+    data = os.path.join(os.path.dirname(__file__), "data")
+    goldens = sorted(f for f in os.listdir(data)
+                     if f.startswith("golden_") and f.endswith(".jsonl"))
+    assert goldens == sorted(f"golden_{k}.jsonl"
+                             for k in GOLDEN_SPINE_KINDS)
+    for kind in GOLDEN_SPINE_KINDS:
+        checker = getattr(check_jsonl, f"_check_{kind}_row", None)
+        assert callable(checker), f"no check_jsonl invariant for {kind}"
+        p = os.path.join(data, f"golden_{kind}.jsonl")
+        assert check_jsonl.check_file(p) == [], kind
+        kinds_in_file = {json.loads(ln).get("kind")
+                         for ln in open(p) if ln.strip()}
+        assert kind in kinds_in_file, f"{p} holds no {kind} rows"

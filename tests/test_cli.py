@@ -817,3 +817,36 @@ def test_elastic_cli_kmeans_stream_smoke(capsys, tmp_path):
     assert row["config"] == "kmeans_stream_elastic_cli"
     assert row["n_workers"] == 8 and row["worker_losses"] == 0
     assert np.isfinite(row["inertia"])
+
+
+def test_dispatch_memory_cli_smoke(capsys, tmp_path):
+    """python -m harp_tpu memory (PR 19): the committed golden ledger
+    fixture summarizes clean (exit 0) in human and JSON modes, an
+    unterminated export exits 1, an unreadable file exits 2."""
+    import json
+    import os
+
+    golden = os.path.join(os.path.dirname(__file__), "data",
+                          "golden_memory.jsonl")
+    assert cli.main(["memory", golden]) == 0
+    out = capsys.readouterr().out
+    assert "9 buffer event(s)" in out and "2 dispatch(es)" in out
+    assert "peak HBM" in out and "headroom" in out
+    assert "vmem checks 1 (1 refused)" in out    # the refusal evidence
+
+    assert cli.main(["memory", golden, "--json"]) == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["errors"] == []
+    assert row["peak_hbm_bytes"] == 1056772
+    assert row["vmem_refusals"] == 1 and row["donated_bytes"] == 16384
+
+    # an export whose summary row was lost (killed mid-write) exits 1
+    lines = [ln for ln in open(golden) if '"ev": "summary"' not in ln]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("".join(lines))
+    assert cli.main(["memory", str(bad)]) == 1
+    assert "unterminated" in capsys.readouterr().err
+
+    # unreadable input exits 2
+    assert cli.main(["memory", str(tmp_path / "nope.jsonl")]) == 2
+    assert "unreadable" in capsys.readouterr().err

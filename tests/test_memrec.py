@@ -1,0 +1,511 @@
+"""memrec (harp_tpu/utils/memrec, PR 19) — the device-memory ledger,
+eighth telemetry spine.
+
+Evidence layers, all on the 8-worker CPU sim:
+
+1. ledger mechanics: staged/output enter the live set, freed/donated
+   leave it, restored is a zero-delta, and every row's live/peak
+   re-derives EXACTLY from the event stream (check_jsonl invariant 17);
+2. the donation twin of HL303: a ``flightrec.track(...,
+   donate_argnums=…)`` dispatch claims the NEWEST live buffer whose
+   byte size matches the donated arg — metadata only, nothing is
+   materialized — and an unmatched size claims nothing;
+3. the VMEM gate: an over-budget Pallas tile is REFUSED before
+   dispatch with a MemoryError naming the predicted bytes (the
+   2026-08-01 silicon OOM as a pre-silicon check), regardless of
+   telemetry state; the registry declarations sit inside the same
+   PRESIZE_BAND harplint HL205 enforces;
+4. THE chaos drill (ISSUE 19 acceptance): staging + donation +
+   checkpoint restore + an injected over-VMEM config in ONE traced run
+   yield (a) the pre-dispatch refusal and (b) ONE export where the
+   watermark re-derives exactly, donated buffers have left the live
+   set, and the steptrace timeline carries memory marks — with a
+   healthy control alongside;
+5. the PR-3 contract: with telemetry off the ledger stays EMPTY and
+   traced programs/results are bit-identical; with memrec ARMED the
+   flagship flight budget (1 dispatch / 1 stacked readback / 0 steady
+   compiles / 0 H2D) passes UNCHANGED.
+"""
+
+import io
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from harp_tpu.health import sentinel
+from harp_tpu.utils import flightrec, memrec, steptrace, telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import check_jsonl  # noqa: E402
+
+
+def _export_rows():
+    """The ledger's stamped export (rows + closing summary) as dicts."""
+    buf = io.StringIO()
+    memrec.export_jsonl(buf)
+    return [json.loads(ln) for ln in buf.getvalue().splitlines()]
+
+
+# ---------------------------------------------------------------------------
+# vocabulary sync (the invariant-11/13/14/16 pin pattern)
+# ---------------------------------------------------------------------------
+
+def test_vocab_sync_with_check_jsonl():
+    """The frozen invariant-17 vocabularies must mirror the module's —
+    drift fails tier-1 before it can corrupt committed evidence."""
+    assert check_jsonl.KNOWN_MEMORY_EVS == memrec.EVS
+    assert check_jsonl.KNOWN_MEMORY_EVENTS == memrec.BUFFER_EVENTS
+    # the memory spine threads onto the superstep timeline (PR 18)...
+    assert "memory" in steptrace.SOURCES
+    assert "memory" in check_jsonl.KNOWN_STEPTRACE_SOURCES
+    # ...and into the health sentinel (PR 14)
+    assert "memory_pressure" in sentinel.DETECTORS
+    assert "memory_pressure" in check_jsonl.KNOWN_HEALTH_DETECTORS
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_replays_exactly(tmp_path):
+    """stage → dispatch(donate) → output → restore → free → vmem pass →
+    executable: the export re-derives clean through BOTH replays (the
+    module's summarize_rows and check_jsonl invariant 17)."""
+    def step(state, batch):
+        return (state * 0.5 + batch.sum()).sum()
+
+    tracked = flightrec.track(jax.jit(step), "memtest.step",
+                              donate_argnums=(0,))
+    state = jnp.zeros((8, 8), jnp.float32)    # 256 B
+    batch = jnp.ones((4,), jnp.float32)
+    with telemetry.scope(True):
+        memrec.on_staged(int(state.nbytes), "memtest.state")
+        tracked(state, batch)
+        memrec.on_restored(4096, "ckpt:step_1")
+        memrec.note_freed(nbytes=4)           # the scalar output
+        memrec.require_vmem_fit("memtest.kernel", 1 << 20,
+                                budget=14 << 20)
+        memrec.note_executable("memtest.step", {
+            "argument_bytes": 272, "output_bytes": 4,
+            "temp_bytes": 0, "generated_code_bytes": 0})
+        rows = _export_rows()
+        s = memrec.summarize_rows(rows)
+    assert s["errors"] == []
+    assert s["staged_bytes"] == 256 and s["donated_bytes"] == 256
+    assert s["freed_bytes"] == 4 and s["live_hbm_bytes"] == 0
+    # the staged buffer is donated at dispatch BEFORE the 4 B output
+    # lands, so the watermark is the staged buffer alone
+    assert s["peak_hbm_bytes"] == 256
+    assert s["vmem_checks"] == 1 and s["vmem_refusals"] == 0
+    assert s["executables"] == 1 and s["exec_hbm_bytes"] == 276
+    p = tmp_path / "mem.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert check_jsonl.check_file(str(p)) == []
+
+
+def test_restored_is_zero_delta():
+    with telemetry.scope(True):
+        memrec.on_staged(1000, "x")
+        before = (memrec.ledger.live_bytes, memrec.ledger.peak_bytes)
+        memrec.on_restored(1 << 30, "ckpt:step_9")
+        assert (memrec.ledger.live_bytes,
+                memrec.ledger.peak_bytes) == before
+        row = memrec.ledger._rows[-1]
+        assert row["event"] == "restored" and row["buf"] == 0
+
+
+def test_donation_claims_newest_exact_byte_match():
+    """The ledger claims the NEWEST live buffer with the donated arg's
+    exact byte size (LIFO matches the depth-2 pipeline's staging order);
+    an unmatched size claims nothing — never a wrong buffer."""
+    with telemetry.scope(True):
+        memrec.ledger.staged(100, "a")
+        memrec.ledger.staged(200, "b")
+        memrec.ledger.staged(100, "c")      # newest 100-byte buffer
+        memrec.ledger.dispatch("memtest.d1", [100])
+        drow = [r for r in memrec.ledger._rows
+                if r["ev"] == "dispatch"][-1]
+        assert drow["donated"] == [3] and drow["donated_bytes"] == 100
+        assert sorted(memrec.ledger._live) == [1, 2]
+        memrec.ledger.dispatch("memtest.d2", [999])   # no such buffer
+        drow = [r for r in memrec.ledger._rows
+                if r["ev"] == "dispatch"][-1]
+        assert drow["donated"] == [] and drow["donated_bytes"] == 0
+        assert memrec.summarize_rows(_export_rows())["errors"] == []
+
+
+def test_superstep_window_peak_marks():
+    """An armed superstep threads its window HBM peak onto the timeline
+    as a ``memory`` mark; a memory-inactive run keeps its pre-PR-19
+    mark counts bit-identical (note_superstep no-ops on an empty
+    ledger)."""
+    with telemetry.scope(True):
+        with steptrace.run("mem.quiet"):
+            with steptrace.superstep("mem.quiet", 0):
+                pass
+        quiet = [r for r in steptrace.tracer.rows()
+                 if r["ev"] == "mark" and r["source"] == "memory"]
+    assert quiet == []
+    with telemetry.scope(True):
+        with steptrace.run("mem.active"):
+            with steptrace.superstep("mem.active", 0):
+                memrec.on_staged(4096, "mem.active.x")
+        marks = [r for r in steptrace.tracer.rows()
+                 if r["ev"] == "mark" and r["source"] == "memory"]
+    assert len(marks) == 1
+    assert marks[0]["name"] == "superstep_peak"
+    assert marks[0]["peak_hbm_bytes"] >= 4096
+    assert marks[0]["live_hbm_bytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# the VMEM gate (the 2026-08-01 OOM as a pre-silicon check)
+# ---------------------------------------------------------------------------
+
+def test_require_vmem_fit_refuses_and_records():
+    predicted, budget = 20 << 20, 14 << 20
+    with telemetry.scope(True):
+        with pytest.raises(MemoryError) as ei:
+            memrec.require_vmem_fit("memtest.kernel", predicted,
+                                    budget=budget)
+        msg = str(ei.value)
+        assert str(predicted) in msg
+        assert "refused before dispatch" in msg
+        assert memrec.ledger.vmem_checks == 1
+        assert memrec.ledger.vmem_refusals == 1
+        row = memrec.ledger._rows[-1]
+        assert row["ev"] == "vmem_check" and row["refused"] is True
+        assert row["predicted_bytes"] == predicted
+
+
+def test_require_vmem_fit_is_a_safety_gate_not_a_collector():
+    """The refusal fires with telemetry OFF too (it guards silicon, not
+    evidence) — but records nothing."""
+    memrec.reset()
+    assert not telemetry.enabled()
+    with pytest.raises(MemoryError, match="refused before dispatch"):
+        memrec.require_vmem_fit("memtest.kernel", 20 << 20,
+                                budget=14 << 20)
+    assert memrec.ledger._rows == []
+
+
+def test_kmeans_int8_over_vmem_tile_refused_before_dispatch():
+    """An explicit 8000-row tile at d=1024 prices over the 14 MB budget
+    — the kernel entry point must raise the memrec MemoryError (naming
+    the predicted bytes) BEFORE building any Pallas launch."""
+    from harp_tpu.ops.kmeans_kernel import (_VMEM_BUDGET_INT8,
+                                            kmeans_partials_int8,
+                                            vmem_bytes_int8)
+
+    n, d, k = 8000, 1024, 100
+    kp = 128
+    predicted = vmem_bytes_int8(n, d, kp)
+    assert predicted > _VMEM_BUDGET_INT8       # the premise of the test
+    pts_q = np.zeros((n, d), np.int8)
+    c_q = np.zeros((k, d), np.int8)
+    c_scale = np.ones(k, np.float32)
+    c2 = np.zeros(k, np.float32)
+    col_scale = np.ones(d, np.float32)
+    with pytest.raises(MemoryError) as ei:
+        kmeans_partials_int8(pts_q, c_q, c_scale, c2, col_scale,
+                             tile_rows=n)
+    assert str(predicted) in str(ei.value)
+    assert "refused before dispatch" in str(ei.value)
+
+
+def test_presize_tiles_fit_their_own_budget():
+    """perfmodel.presize must only ever hand out tiles its own byte
+    model prices under the budget — the graded 1M×300 shape reproduces
+    the OOM-calibrated 8000-row tile."""
+    from harp_tpu.ops.kmeans_kernel import (_VMEM_BUDGET_INT8,
+                                            vmem_bytes_int8)
+    from harp_tpu.perfmodel import presize
+
+    r = presize("kmeans.partials_int8", n=1_000_000, d=300, k=100)
+    assert r["tile"] == 8000
+    assert vmem_bytes_int8(r["tile"], 300, 128) <= _VMEM_BUDGET_INT8
+
+
+def test_hl205_registry_declarations_inside_band():
+    """Satellite 2: every registry ``vmem_bytes`` declaration sits
+    inside PRESIZE_BAND of the kernel's own byte model (the lint
+    cross-check is clean on the real registry), and a stale declaration
+    fires HL205."""
+    from harp_tpu.analysis import mosaic_audit
+    from harp_tpu.ops.kernel_registry import KERNEL_WORK
+
+    assert mosaic_audit.check_work_declarations() == []
+    models = mosaic_audit._declared_vmem_models()
+    assert models  # the cross-check has teeth: >= 1 kernel participates
+    for name, model in models.items():
+        declared = KERNEL_WORK[name]["vmem_bytes"]
+        assert model <= declared <= model * memrec.PRESIZE_BAND
+        assert declared <= memrec.VMEM_CEILING
+
+
+def test_hl205_fires_on_stale_declaration(monkeypatch):
+    from harp_tpu.analysis import mosaic_audit
+    from harp_tpu.ops import kernel_registry
+
+    name = "kmeans.partials_int8"
+    work = dict(kernel_registry.KERNEL_WORK[name])
+    work["vmem_bytes"] = work["vmem_bytes"] * 4   # stale: way over band
+    monkeypatch.setitem(kernel_registry.KERNEL_WORK, name, work)
+    v = mosaic_audit.check_work_declarations()
+    assert any(x.rule == "HL205" and name in x.path
+               and "stale" in x.message for x in v)
+
+
+# ---------------------------------------------------------------------------
+# health: memory_pressure
+# ---------------------------------------------------------------------------
+
+def test_memory_pressure_fires_on_low_headroom():
+    """A run whose peak leaves <10% headroom warns exactly once (the
+    latch), carrying peak/capacity/headroom on the finding."""
+    with telemetry.scope(True):
+        memrec.set_hbm_capacity(1000)
+        memrec.on_staged(950, "big")
+        memrec.on_staged(10, "bigger")      # latch: no second finding
+        rows = [r for r in sentinel.monitor.findings()
+                if r["detector"] == "memory_pressure"]
+        assert len(rows) == 1
+        assert rows[0]["severity"] == "warn"
+        assert rows[0]["peak_hbm_bytes"] >= 950
+        assert rows[0]["hbm_bytes"] == 1000
+        assert rows[0]["headroom_frac"] < sentinel.HEADROOM_WARN_FRAC
+
+
+def test_memory_pressure_drift_against_baseline():
+    with telemetry.scope(True):
+        # plenty of headroom but 2x the committed baseline peak: drift
+        sentinel.monitor.observe_memory("kmeans", 2_000_000,
+                                        16 << 30,
+                                        baseline_peak=1_000_000)
+        rows = [r for r in sentinel.monitor.findings()
+                if r["detector"] == "memory_pressure"]
+        assert len(rows) == 1
+        assert rows[0]["peak_drift_frac"] == 1.0
+    # healthy: high headroom, no baseline — no finding
+    with telemetry.scope(True):
+        sentinel.monitor.observe_memory("kmeans", 1_000_000, 16 << 30)
+        assert [r for r in sentinel.monitor.findings()
+                if r["detector"] == "memory_pressure"] == []
+
+
+# ---------------------------------------------------------------------------
+# serve AOT cache sidecar (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_serve_cache_persists_memory_sidecar(tmp_path):
+    """compile_and_store writes the memory_analysis() footprint beside
+    the pickle; a warm load records the SAME footprint as a
+    source='cache' executable row without touching the backend."""
+    from harp_tpu.serve.cache import ExecutableCache
+
+    cache = ExecutableCache(str(tmp_path), fingerprint="memtest")
+    jitted = jax.jit(lambda x: x + 1.0)
+    args = (jnp.zeros((8, 8), jnp.float32),)
+    with telemetry.scope(True):
+        cache.get_or_compile("memtest.prog", jitted, args)
+        assert cache.misses == 1
+        compile_rows = [r for r in memrec.ledger._rows
+                        if r["ev"] == "executable"]
+        assert len(compile_rows) == 1
+        assert compile_rows[0]["source"] == "compile"
+        assert compile_rows[0]["exec_hbm_bytes"] > 0
+    sidecars = [f for f in os.listdir(tmp_path)
+                if f.endswith(".mem.json")]
+    assert len(sidecars) == 1
+    fp = cache.footprint("memtest.prog", args)
+    assert fp is not None
+    assert fp["argument_bytes"] == 256 and fp["output_bytes"] == 256
+    with telemetry.scope(True):
+        cache.load("memtest.prog", args)
+        assert cache.hits == 1
+        rows = [r for r in memrec.ledger._rows
+                if r["ev"] == "executable"]
+        assert len(rows) == 1 and rows[0]["source"] == "cache"
+        assert rows[0]["exec_hbm_bytes"] \
+            == compile_rows[0]["exec_hbm_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# the PR-3 contract: zero-cost off, budgets unchanged armed
+# ---------------------------------------------------------------------------
+
+def test_zero_cost_with_telemetry_off(mesh):
+    """With telemetry off the ledger stays EMPTY through a full driver
+    run — and the fit is bit-identical to the armed run (the ledger
+    observes, never participates)."""
+    from harp_tpu.models import kmeans
+
+    pts = np.random.default_rng(0).normal(size=(256, 8)) \
+        .astype(np.float32)
+    memrec.reset()
+    c_off, inertia_off = kmeans.fit(pts, k=4, iters=3, mesh=mesh, seed=0)
+    assert memrec.ledger._rows == []
+    assert memrec.snapshot() == {"peak_hbm_bytes": 0, "staged_bytes": 0,
+                                 "donated_bytes": 0, "events": 0}
+    with telemetry.scope(True):
+        c_on, inertia_on = kmeans.fit(pts, k=4, iters=3, mesh=mesh,
+                                      seed=0)
+        assert memrec.ledger._rows != []    # staged H2D entered the set
+    np.testing.assert_array_equal(np.asarray(c_off), np.asarray(c_on))
+    assert inertia_off == inertia_on
+
+
+def test_tracked_program_jaxpr_identical_on_off():
+    """The dispatch hooks read shape/dtype metadata only: tracing a
+    tracked-with-donation callable yields the IDENTICAL jaxpr with the
+    ledger armed or off."""
+    tracked = flightrec.track(jax.jit(lambda x: x * 2.0),
+                              "memtest.jaxpr", donate_argnums=(0,))
+    x = jnp.arange(8.0)
+    memrec.reset()
+    off = str(jax.make_jaxpr(lambda a: tracked(a))(x))
+    with telemetry.scope(True):
+        on = str(jax.make_jaxpr(lambda a: tracked(a))(x))
+    assert on == off
+
+
+def test_flagship_budget_pins_unchanged_with_memrec_armed(mesh):
+    """The PR-3/PR-17 flagship budget — 1 dispatch, 1 stacked readback,
+    0 steady compiles, 0 H2D — must hold bit-for-bit with the memory
+    ledger armed: memrec adds rows, never flight traffic."""
+    import harp_tpu.models.mfsgd as MF
+
+    cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                         entry_cap=32)
+    with telemetry.scope():
+        m = MF.MFSGD(64, 48, cfg, mesh, seed=3)
+        u, i, v = MF.synthetic_ratings(64, 48, 600, rank=4, seed=3)
+        m.set_ratings(u, i, v)
+        m.train_epoch()       # warmup
+        m.compile_epochs(3)
+        m.train_epochs(3)     # steady (stacked-readback ops compiled)
+        assert telemetry.enabled()          # memrec IS armed here
+        with flightrec.budget(compiles=0, dispatches=1, readbacks=1,
+                              h2d_bytes=0,
+                              tag="mfsgd.train_epochs.memrec") as b:
+            m.train_epochs(3)
+        assert b.spent()["dispatches"] == 1
+        assert b.spent()["readbacks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE chaos drill (ISSUE 19 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_memory_chaos_drill_one_reconciled_export(mesh, tmp_path):
+    """Staging + donation + checkpoint restore + an injected over-VMEM
+    Pallas config in ONE traced run: the refusal names the predicted
+    bytes pre-dispatch, and the single export is invariant-17 clean —
+    watermark re-derived exactly, donated buffers out of the live set,
+    memory marks on the superstep timeline."""
+    from harp_tpu.ops.kmeans_kernel import (kmeans_partials_int8,
+                                            vmem_bytes_int8)
+    from harp_tpu.utils.checkpoint import CheckpointManager
+
+    def step(state, batch):
+        return (state + batch.mean(0, keepdims=True)).sum()
+
+    tracked = flightrec.track(jax.jit(step), "memdrill.step",
+                              donate_argnums=(0,))
+    x = np.random.default_rng(1).normal(size=(64, 8)).astype(np.float32)
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    p = tmp_path / "drill.jsonl"
+    predicted = vmem_bytes_int8(8000, 1024, 128)
+    with telemetry.scope(True):
+        with steptrace.run("mem.drill"):
+            with steptrace.superstep("mem.drill", 0):
+                x_dev = mesh.shard_array(x)        # staged (H2D)
+                tracked(x_dev, jnp.asarray(x))     # donated + output
+                cm.save(1, {"w": x})
+                cm.restore(1)                      # restored, zero-delta
+            with steptrace.superstep("mem.drill", 1):
+                with pytest.raises(MemoryError) as ei:
+                    kmeans_partials_int8(
+                        np.zeros((8000, 1024), np.int8),
+                        np.zeros((100, 1024), np.int8),
+                        np.ones(100, np.float32),
+                        np.zeros(100, np.float32),
+                        np.ones(1024, np.float32), tile_rows=8000)
+        telemetry.export(str(p))
+    # (a) the refusal named the predicted footprint, before any launch
+    assert str(predicted) in str(ei.value)
+    assert "refused before dispatch" in str(ei.value)
+    # (b) ONE reconciled export: the whole-file sweep (invariants 16+17)
+    assert check_jsonl.check_file(str(p)) == []
+    rows = telemetry.load_rows(str(p))
+    s = memrec.summarize_rows(rows["memory"])
+    assert s["errors"] == []
+    assert s["staged_bytes"] >= x.nbytes
+    assert s["donated_bytes"] == x.nbytes          # left the live set
+    assert s["vmem_refusals"] == 1
+    events = {(r.get("event"), r.get("label"))
+              for r in rows["memory"] if r.get("ev") == "buffer"}
+    assert ("restored", "ckpt:step_1") in events
+    # the timeline carries the memory spine
+    mem_marks = [r for r in rows["steptrace"]
+                 if r.get("ev") == "mark" and r.get("source") == "memory"]
+    assert len(mem_marks) >= 1
+    assert all(m["peak_hbm_bytes"] > 0 for m in mem_marks)
+    # healthy control: the same staging/dispatch with a FITTING config
+    q = tmp_path / "control.jsonl"
+    with telemetry.scope(True):
+        with steptrace.run("mem.control"):
+            with steptrace.superstep("mem.control", 0):
+                x_dev = mesh.shard_array(x)
+                tracked(x_dev, jnp.asarray(x))
+                memrec.require_vmem_fit(
+                    "kmeans.partials_int8",
+                    vmem_bytes_int8(128, 256, 128), budget=14 << 20)
+        telemetry.export(str(q))
+    assert check_jsonl.check_file(str(q)) == []
+    s = memrec.summarize_rows(telemetry.load_rows(str(q))["memory"])
+    assert s["errors"] == [] and s["vmem_refusals"] == 0
+
+
+# ---------------------------------------------------------------------------
+# report + bench surfaces
+# ---------------------------------------------------------------------------
+
+def test_report_renders_memory_section():
+    from harp_tpu import report
+
+    with telemetry.scope(True):
+        memrec.on_staged(1 << 20, "x")
+        memrec.require_vmem_fit("memtest.kernel", 1 << 20,
+                                budget=14 << 20)
+        info = memrec.live_summary()
+        row = report.build_row({}, {}, memory_info=info)
+        assert row["memory"]["peak_hbm_bytes"] == 1 << 20
+        text = report.render(row)
+    assert "memory (device ledger): peak" in text
+    # live_summary never bumps the seq — a later export stays clean
+    with telemetry.scope(True):
+        memrec.on_staged(64, "x")
+        memrec.live_summary()
+        memrec.live_summary()
+        assert memrec.summarize_rows(_export_rows())["errors"] == []
+
+
+def test_bench_delta_counters():
+    with telemetry.scope(True):
+        memrec.on_staged(100, "a")
+        base = memrec.snapshot()
+        memrec.on_staged(50, "b")
+        memrec.note_freed(nbytes=100)
+        d = memrec.delta_since(base)
+        assert d["staged_bytes"] == 50
+        assert d["events"] == 2
+        assert d["peak_hbm_bytes"] == 150
+        assert 0.0 <= d["headroom_frac"] <= 1.0
