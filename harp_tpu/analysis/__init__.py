@@ -2,12 +2,15 @@
 
 Reference parity (SURVEY.md §6): Harp has no static analysis; its
 communication discipline is convention only.  This package machine-checks
-the conventions (CLAUDE.md traps) in four layers — source AST lints
+the conventions (CLAUDE.md traps) in five layers — source AST lints
 (:mod:`.astlints`), jaxpr analyzers (:mod:`.jaxpr_checks`), a
-no-hardware Mosaic kernel audit (:mod:`.mosaic_audit`), and the static
+no-hardware Mosaic kernel audit (:mod:`.mosaic_audit`), the static
 communication-graph auditor (:mod:`.commgraph`, the CommLedger
 cross-check + donation audit whose per-program byte sheets ride the
-lint JSON row) — behind one rule registry (:mod:`.rules`), one committed
+lint JSON row), and the thread-root concurrency auditor
+(:mod:`.threadgraph`, whose ownership map also arms the runtime twin
+:mod:`harp_tpu.utils.threadguard`) — behind one rule registry
+(:mod:`.rules`), one committed
 allowlist (``analysis/allowlist.toml``), and one CLI
 (``python -m harp_tpu lint``, :mod:`.cli`).
 

@@ -1,7 +1,8 @@
 """``python -m harp_tpu lint`` — the harplint front door.
 
-Runs the four analysis layers (AST lints / jaxpr detectors / Mosaic
-kernel audit / CommGraph communication audit), applies the committed
+Runs the five analysis layers (AST lints / jaxpr detectors / Mosaic
+kernel audit / CommGraph communication audit / thread-root concurrency
+audit), applies the committed
 allowlist, prints a human report plus ONE provenance-stamped machine
 line (``kind: "lint"``, printed through
 :func:`harp_tpu.utils.metrics.benchmark_json` so it carries the same
@@ -19,8 +20,10 @@ Fixture mode for tests / pre-commit checks of a single file:
   full, because they are program-keyed, not file-keyed;
 - ``--audit-module FILE`` imports a Python file and sweeps its
   ``HARPLINT_DRIVERS`` (jaxpr + commgraph layers) / ``HARPLINT_KERNELS``
-  (Mosaic layer) / ``HARPLINT_PROTOCOLS`` (donation audit) dicts — the
-  hook the seeded-fixture tests drive the traced layers through.
+  (Mosaic layer) / ``HARPLINT_PROTOCOLS`` (donation audit) /
+  ``HARPLINT_PLANES`` (thread-root layer: name -> (PlaneSpec, sources))
+  dicts — the hook the seeded-fixture tests drive the traced layers
+  through.
 
 ``paths`` / ``--audit-module`` skip the repo-wide default sweeps, so the
 exit code reflects only the requested targets (``--changed`` does NOT:
@@ -142,6 +145,29 @@ def run_protocol_layer(builders: dict) -> list[Violation]:
     return out
 
 
+def run_threads_layer(builders: dict | None, repo: str,
+                      only: list[str] | None = None) -> list[Violation]:
+    """Layer 5 (HL401-HL405) — pure ast, no jax import.  ``builders``
+    maps fixture names to ``(PlaneSpec, {relpath: source})`` pairs (the
+    ``HARPLINT_PLANES`` hook); ``None`` sweeps the repo's registered
+    planes, restricted to ``only`` on ``--changed`` runs."""
+    from harp_tpu.analysis import threadgraph
+
+    if builders is None:
+        return threadgraph.analyze_repo(repo, only=only)
+    out: list[Violation] = []
+    for name in sorted(builders):
+        try:
+            spec, sources = builders[name]
+        except Exception as e:  # noqa: BLE001
+            out.append(Violation("HL401", f"plane:{name}", 0,
+                                 f"plane fixture malformed: "
+                                 f"{type(e).__name__}: {e}"))
+            continue
+        out.extend(threadgraph.analyze_sources(spec, sources))
+    return out
+
+
 def run_mosaic_layer(builders: dict | None) -> list[Violation]:
     from harp_tpu.analysis.mosaic_audit import audit_kernel, audit_registry
 
@@ -238,12 +264,14 @@ def main(argv=None) -> int:
                         "(repo-relative or absolute); skips the default "
                         "repo-wide sweeps")
     p.add_argument("--changed", action="store_true",
-                   help="restrict the AST layer to files changed vs git "
-                        "HEAD (plus untracked) — the ~2 s dev loop as "
+                   help="restrict the AST layer to changed files and the "
+                        "thread-root layer to planes owning them (vs git "
+                        "HEAD, plus untracked) — the ~2 s dev loop as "
                         "the repo grows; the traced layers still run in "
                         "full (program-keyed, not file-keyed)")
     p.add_argument("--layer",
-                   choices=("ast", "jaxpr", "mosaic", "commgraph", "all"),
+                   choices=("ast", "jaxpr", "mosaic", "commgraph",
+                            "threads", "all"),
                    default="all")
     p.add_argument("--json", action="store_true",
                    help="print only the machine-readable line")
@@ -272,6 +300,8 @@ def main(argv=None) -> int:
 
     violations: list[Violation] = []
     scanned = 0
+    changed_rels = (_changed_paths(repo)
+                    if args.changed and not fixture_mode else None)
 
     if args.layer in ("ast", "all"):
         if args.paths:
@@ -280,7 +310,7 @@ def main(argv=None) -> int:
             violations += lint_paths(repo, rels)
             scanned += len(rels)
         elif not fixture_mode:
-            rels = (_changed_paths(repo) if args.changed
+            rels = (changed_rels if changed_rels is not None
                     else list(iter_python_files(repo)))
             violations += lint_paths(repo, rels)
             scanned += len(rels)
@@ -288,11 +318,26 @@ def main(argv=None) -> int:
     fixture_drivers: dict = {}
     fixture_kernels: dict = {}
     fixture_protocols: dict = {}
+    fixture_planes: dict = {}
     for mod_path in args.audit_module:
         mod = _load_audit_module(mod_path)
         fixture_drivers.update(getattr(mod, "HARPLINT_DRIVERS", {}))
         fixture_kernels.update(getattr(mod, "HARPLINT_KERNELS", {}))
         fixture_protocols.update(getattr(mod, "HARPLINT_PROTOCOLS", {}))
+        fixture_planes.update(getattr(mod, "HARPLINT_PLANES", {}))
+
+    if args.layer in ("threads", "all"):
+        # pure ast — no backend, no jax import; --changed scopes to the
+        # planes owning the changed files (graphs are cached per plane)
+        if fixture_mode:
+            if fixture_planes:
+                violations += run_threads_layer(fixture_planes, repo)
+        else:
+            from harp_tpu.analysis.threadgraph import planes_for_paths
+
+            only = (planes_for_paths(changed_rels)
+                    if changed_rels is not None else None)
+            violations += run_threads_layer(None, repo, only=only)
 
     if args.layer in ("jaxpr", "all"):
         if fixture_mode:
@@ -331,9 +376,15 @@ def main(argv=None) -> int:
     entries = [] if args.no_allowlist else allowlist_mod.load(args.allowlist)
     kept, suppressed, stale = allowlist_mod.apply(violations, entries)
     # staleness only means something when every layer swept everything:
-    # a fixture run or a --changed AST scope cannot prove an entry dead
+    # a fixture run or a --changed AST scope cannot prove an entry dead,
+    # and a --layer run can only judge entries of the layers that ran
+    # (an AST-only run matching no HL4xx entry proves nothing about it)
     if fixture_mode or args.changed:
         stale = []
+    elif args.layer != "all":
+        stale = [e for e in stale
+                 if RULES.get(e["rule"]) is not None
+                 and RULES[e["rule"]].layer == args.layer]
 
     row = build_row(kept, suppressed, stale, scanned, byte_sheets)
     from harp_tpu.utils.metrics import benchmark_json

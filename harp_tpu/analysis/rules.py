@@ -8,7 +8,7 @@ trap it prevents so a violation message teaches the fix instead of just
 rejecting the diff; MIGRATING.md "Running the linter" maps ids to the
 original trap prose.
 
-Four layers (see the sibling modules):
+Five layers (see the sibling modules):
 
 - ``HL0xx`` — source AST lints (:mod:`harp_tpu.analysis.astlints`; pure
   ``ast``, no jax import, fast enough for tier-1);
@@ -21,7 +21,13 @@ Four layers (see the sibling modules):
   (:mod:`harp_tpu.analysis.commgraph`; the static per-call-site
   collective schedule of every registered driver program, cross-checked
   against the CommLedger's trace-time records, plus the use-after-donate
-  protocol audit over the serve pipelines).
+  protocol audit over the serve pipelines);
+- ``HL4xx`` — thread-root concurrency audit
+  (:mod:`harp_tpu.analysis.threadgraph`; the static thread-root graph of
+  the serve/ingest/schedule/timing/fault/bench planes — jax ownership,
+  event-loop blocking, shared-state locking, lock-across-dispatch, and
+  thread lifecycle — whose ownership map also arms the runtime twin
+  :mod:`harp_tpu.utils.threadguard`).
 """
 
 from __future__ import annotations
@@ -32,7 +38,7 @@ import dataclasses
 @dataclasses.dataclass(frozen=True)
 class Rule:
     id: str
-    layer: str          # "ast" | "jaxpr" | "mosaic"
+    layer: str          # "ast" | "jaxpr" | "mosaic" | "commgraph" | "threads"
     title: str
     trap: str           # the CLAUDE.md trap this rule machine-checks
 
@@ -123,6 +129,34 @@ RULES: dict[str, Rule] = {r.id: r for r in [
          "depend on the loop carry or scanned inputs re-ships identical "
          "bytes every iteration — hoist it above the loop (trip count "
          "multiplies the wire for nothing)"),
+    Rule("HL401", "threads", "jax touched from a non-owner thread root",
+         "a jax-touching call (tracked dispatch, device_put/shard_array, "
+         "readback) reachable from a thread root other than the plane's "
+         "designated jax owner — the CPU sim tolerates concurrent "
+         "runtime access that corrupts state or deadlocks on silicon; "
+         "route the work through the owner (the transport dispatcher "
+         "thread is the pinned clean fixture)"),
+    Rule("HL402", "threads", "blocking call inside the event loop",
+         "a blocking call (device round trip, socket recv, unbounded "
+         "Queue.get/join/wait, time.sleep) reachable from an event-loop "
+         "coroutine and not awaited — a 20-150 ms relay round trip "
+         "freezes every socket the loop owns; await it, bound it, or "
+         "move it to the dispatcher thread"),
+    Rule("HL403", "threads", "multi-root write with no common lock",
+         "shared mutable state (a telemetry spine, scheduler "
+         "results/queues, pipeline stats) written from two or more "
+         "thread roots with no common lock on the write path — the "
+         "spines' single-writer contract becomes a checked invariant "
+         "instead of a comment"),
+    Rule("HL404", "threads", "lock held across a dispatch/readback",
+         "a lock held across a dispatch/readback boundary serializes a "
+         "20-150 ms relay round trip under the lock — serve-plane "
+         "head-of-line blocking; release the lock before touching the "
+         "device"),
+    Rule("HL405", "threads", "thread with neither daemon nor bounded join",
+         "a thread started with neither daemon=True nor a bounded "
+         "join(timeout) on a shutdown path hangs process exit when it "
+         "blocks — on this machine, typically inside a relay call"),
 ]}
 
 

@@ -187,11 +187,17 @@ class IngestPipeline:
                     st.consumer_s += t_in - last_out
                 t0 = time.perf_counter()
                 cur = self._read(j)
-                st.read_s += time.perf_counter() - t0
+                # lock ONLY the accumulation, never the read/prep work
+                # itself: the pool paths take self._lock for these same
+                # counters, and holding it across a stage (or a ship
+                # dispatch) would be harplint HL404
+                with self._lock:
+                    st.read_s += time.perf_counter() - t0
                 if self._prep is not None:
                     t0 = time.perf_counter()
                     cur = self._prep(cur)
-                    st.prep_s += time.perf_counter() - t0
+                    with self._lock:
+                        st.prep_s += time.perf_counter() - t0
                 cur = self._timed_ship(cur)
                 st.chunks += 1
                 last_out = time.perf_counter()

@@ -92,7 +92,10 @@ class StaticScheduler(Generic[I, O]):
             except BaseException as e:  # noqa: BLE001 - re-raised on main thread
                 errors.append(e)
 
-        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+        # named so threadguard's ownership map (generated from harplint
+        # Layer 5) can forbid jax work on scheduler workers by pattern
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True,
+                                    name=f"harp-sched-static-{t}")
                    for t in range(min(n, len(items)))]
         for th in threads:
             th.start()
@@ -143,8 +146,10 @@ class DynamicScheduler(Generic[I, O]):
                 except BaseException as e:  # noqa: BLE001 - surfaced in wait_output
                     self._out.put((idx, None, e))
 
-        self._threads = [threading.Thread(target=worker, args=(t,), daemon=True)
-                         for t in self.tasks]
+        self._threads = [threading.Thread(target=worker, args=(t,),
+                                          daemon=True,
+                                          name=f"harp-sched-dyn-{i}")
+                         for i, t in enumerate(self.tasks)]
         for th in self._threads:
             th.start()
 

@@ -65,6 +65,7 @@ from __future__ import annotations
 import bisect
 import json
 import math
+import threading
 from typing import Any
 
 from harp_tpu.utils import telemetry
@@ -230,9 +231,20 @@ class ReqTracer:
     so a seeded replay yields the same trace twice.  Collection is
     unbounded by design: tracing is for instrumented runs (the rolling
     histograms are the bounded-memory surface for always-on stats).
+
+    Thread safety (PR 20, HL403): on the TCP plane the event-loop
+    thread mints ids at arrival while the dispatcher thread stamps
+    deliver/outcome events — two writer roots on one spine, so every
+    mutator takes ``self._lock`` (an RLock: :meth:`end` records its
+    outcome event through :meth:`event`).  The lock sits AFTER the
+    telemetry-enabled early return, so the disabled path stays
+    zero-cost; harplint's thread-root layer verifies the lock is
+    present (a spine written from ≥2 roots without it is HL403) and
+    threadguard skips wrapping verified-locked spines at runtime.
     """
 
     def __init__(self):
+        self._lock = threading.RLock()
         self.reset()
 
     def reset(self) -> None:
@@ -251,14 +263,16 @@ class ReqTracer:
         Returns None (and records nothing) while telemetry is off."""
         if not telemetry.enabled():
             return None
-        self._next_id += 1
-        rid = self._next_id
-        ev = {"name": "arrival", "ts": float(ts)}
-        if attrs:
-            ev.update(attrs)
-        self._reqs[rid] = {"req": rid, "t0": float(ts), "t_last": float(ts),
-                           "events": [ev], "outcome": None}
-        return rid
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            ev = {"name": "arrival", "ts": float(ts)}
+            if attrs:
+                ev.update(attrs)
+            self._reqs[rid] = {"req": rid, "t0": float(ts),
+                               "t_last": float(ts),
+                               "events": [ev], "outcome": None}
+            return rid
 
     def event(self, rid: int | None, name: str, ts: float,
               **attrs: Any) -> None:
@@ -267,14 +281,15 @@ class ReqTracer:
         ignored so tracing may arm mid-run without raising."""
         if rid is None or not telemetry.enabled():
             return
-        r = self._reqs.get(rid)
-        if r is None:
-            return
-        ev = {"name": name, "ts": float(ts)}
-        if attrs:
-            ev.update(attrs)
-        r["events"].append(ev)
-        r["t_last"] = max(r["t_last"], float(ts))
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None:
+                return
+            ev = {"name": name, "ts": float(ts)}
+            if attrs:
+                ev.update(attrs)
+            r["events"].append(ev)
+            r["t_last"] = max(r["t_last"], float(ts))
 
     def end(self, rid: int | None, outcome: str, ts: float,
             **attrs: Any) -> None:
@@ -284,12 +299,13 @@ class ReqTracer:
             return
         if outcome not in OUTCOMES:
             raise ValueError(f"outcome {outcome!r} not in {OUTCOMES}")
-        r = self._reqs.get(rid)
-        if r is None or r["outcome"] is not None:
-            return
-        self.event(rid, outcome, ts, **attrs)
-        r["outcome"] = outcome
-        self.counts[outcome] += 1
+        with self._lock:
+            r = self._reqs.get(rid)
+            if r is None or r["outcome"] is not None:
+                return
+            self.event(rid, outcome, ts, **attrs)
+            r["outcome"] = outcome
+            self.counts[outcome] += 1
 
     # -- batch records -----------------------------------------------------
     def batch(self, seq: int, ts: float, *, rung: int, rows: int,
@@ -328,10 +344,11 @@ class ReqTracer:
     def mark(self, source: str, name: str, ts: float, **attrs: Any) -> None:
         if not telemetry.enabled():
             return
-        m = {"source": source, "name": name, "ts": float(ts)}
-        if attrs:
-            m.update(attrs)
-        self.marks.append(m)
+        with self._lock:
+            m = {"source": source, "name": name, "ts": float(ts)}
+            if attrs:
+                m.update(attrs)
+            self.marks.append(m)
 
     # -- reading / export --------------------------------------------------
     def summary(self) -> dict:

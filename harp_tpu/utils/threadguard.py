@@ -1,0 +1,156 @@
+"""Runtime twin of harplint Layer 5 — thread-ownership assertions.
+
+Reference parity (SURVEY.md §6; the static half is
+``harp_tpu/analysis/threadgraph.py``): HL401–HL405 prove at lint time
+that no forbidden thread root can *reach* a jax-touching call or an
+unlocked spine mutator.  This module proves the same contract at RUN
+time, the way the flight recorder's budgets are the runtime twin of the
+HL0xx traps: when armed, every flightrec observer site (dispatch / h2d
+/ readback / ckpt-write) and every mutator of a spine the static layer
+could NOT verify as internally locked asserts that the current thread's
+name does not match any forbidden pattern, and raises
+:class:`ThreadOwnershipError` if it does.
+
+The ownership map is **generated from the static layer**
+(:func:`harp_tpu.analysis.threadgraph.ownership_map`) — the forbidden
+patterns are the name patterns of the named non-owner thread roots the
+graph discovered, and the spine wrap list is exactly the spines whose
+mutators the graph could not verify as locked.  The two halves are
+sync-pinned by tests/test_threadguard.py (the HL303/``flightrec.track``
+pattern): hand-editing the runtime map is impossible by construction.
+
+Cost contract (the PR-3 pattern, pinned by the flagship budget tests):
+disarmed, this module installs NOTHING — no observer callbacks, no
+wrapped mutators, zero per-op work — so the serve sustained bench and
+the mfsgd/lda/kmeans budgets are bit-identical with the guard present.
+Armed (tests, chaos runs), each guarded site costs one thread-name
+fnmatch sweep.
+
+Usage::
+
+    with threadguard.armed():          # raising, for tests
+        run_serve_plane()
+    assert threadguard.stats()["checks"] > 0   # non-vacuous
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fnmatch
+import functools
+import importlib
+import threading
+from typing import Any
+
+
+class ThreadOwnershipError(AssertionError):
+    """A jax-touching op or unlocked-spine mutation ran on a thread the
+    static thread-root graph forbids (HL401/HL403 at runtime)."""
+
+
+class _Guard:
+    def __init__(self) -> None:
+        self.patterns: tuple[str, ...] = ()
+        self.checks = 0
+        self.violations: list[str] = []
+        self._installed: list[tuple[list, Any]] = []      # (registry, cb)
+        self._wrapped: list[tuple[Any, str, Any]] = []    # (obj, attr, orig)
+        self.active = False
+
+    def check(self, what: str) -> None:
+        self.checks += 1
+        name = threading.current_thread().name
+        for pat in self.patterns:
+            if fnmatch.fnmatch(name, pat):
+                msg = (f"{what} on forbidden thread {name!r} "
+                       f"(matches ownership pattern {pat!r}) — this "
+                       "thread root is not a jax owner on its plane; "
+                       "route the op through the designated owner "
+                       "(see harp_tpu/analysis/threadgraph.py)")
+                self.violations.append(msg)
+                raise ThreadOwnershipError(msg)
+
+
+_guard = _Guard()
+
+
+def arm(omap: dict | None = None) -> None:
+    """Install the ownership assertions.  ``omap`` defaults to the map
+    generated from the static layer — pass one explicitly only in tests
+    that sabotage it on purpose."""
+    if _guard.active:
+        return
+    if omap is None:
+        from harp_tpu.analysis import threadgraph
+
+        omap = threadgraph.ownership_map()
+    _guard.patterns = tuple(omap.get("forbidden_thread_patterns", ()))
+    _guard.checks = 0
+    _guard.violations = []
+    from harp_tpu.utils import flightrec
+
+    sites = (
+        (flightrec._DISPATCH_OBSERVERS,
+         lambda label: _guard.check(f"dispatch {label!r}")),
+        (flightrec._READBACK_OBSERVERS,
+         lambda x: _guard.check("readback")),
+        (flightrec._H2D_OBSERVERS,
+         lambda nbytes, site: _guard.check(f"h2d staging ({site})")),
+        (flightrec._CKPT_WRITE_OBSERVERS,
+         lambda path: _guard.check("ckpt write")),
+    )
+    for registry, cb in sites:
+        registry.append(cb)
+        _guard._installed.append((registry, cb))
+    # spines the static layer could NOT verify as internally locked get
+    # their mutators wrapped; verified-locked spines are skipped — the
+    # runtime honors the static verdict (that asymmetry is the sync pin)
+    for sp_name, sp in sorted(omap.get("spines", {}).items()):
+        if sp.get("locked"):
+            continue
+        mod = importlib.import_module(sp["module"])
+        target = getattr(mod, sp["obj"]) if sp.get("obj") else mod
+        for mut in sp["mutators"]:
+            orig = getattr(target, mut)
+
+            def wrapper(*a, __orig=orig, __what=f"{sp_name}.{mut}",
+                        **kw):
+                _guard.check(f"spine mutation {__what}")
+                return __orig(*a, **kw)
+
+            functools.update_wrapper(wrapper, orig)
+            setattr(target, mut, wrapper)
+            _guard._wrapped.append((target, mut, orig))
+    _guard.active = True
+
+
+def disarm() -> None:
+    """Remove everything :func:`arm` installed (restores the exact
+    original callables — the zero-cost pin checks identity)."""
+    for registry, cb in _guard._installed:
+        if cb in registry:
+            registry.remove(cb)
+    _guard._installed.clear()
+    for target, attr, orig in reversed(_guard._wrapped):
+        setattr(target, attr, orig)
+    _guard._wrapped.clear()
+    _guard.patterns = ()
+    _guard.active = False
+
+
+@contextlib.contextmanager
+def armed(omap: dict | None = None):
+    """``with threadguard.armed(): ...`` — arm for the block, always
+    disarm on exit."""
+    arm(omap)
+    try:
+        yield _guard
+    finally:
+        disarm()
+
+
+def stats() -> dict:
+    """Non-vacuity evidence: how many ownership checks actually ran."""
+    return {"active": _guard.active, "checks": _guard.checks,
+            "patterns": list(_guard.patterns),
+            "violations": list(_guard.violations)}

@@ -55,6 +55,9 @@ class HangWatchdog:
             self._gen += 1
             t = threading.Timer(self.timeout_s, self._fire, (what, self._gen))
             t.daemon = True
+            # stable name so threadguard's ownership map (generated from
+            # harplint Layer 5) can forbid jax work on the watchdog timer
+            t.name = "harp-watchdog"
             self._timer = t
         t.start()
 

@@ -389,7 +389,8 @@ def _check_skew_row(name: str, i: int, row: dict) -> list[str]:
 # with harp_tpu.analysis.rules.rule_ids() so drift fails tier-1
 KNOWN_LINT_RULES = ("HL000", "HL001", "HL002", "HL003", "HL004", "HL005",
                     "HL101", "HL102", "HL201", "HL202", "HL203", "HL204",
-                    "HL205", "HL301", "HL302", "HL303", "HL304")
+                    "HL205", "HL301", "HL302", "HL303", "HL304",
+                    "HL401", "HL402", "HL403", "HL404", "HL405")
 LINT_COUNT_FIELDS = ("files_scanned", "violations", "allowlisted",
                      "stale_allowlist")
 

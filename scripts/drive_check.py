@@ -2851,3 +2851,127 @@ print(f"memrec: lifecycle replay == watermark (peak {_mr_peak} B, "
       f"{len(_mr_marks)} superstep memory mark(s), cache sidecar "
       "compile==warm-load bytes, CLI exit 0/2, zero-cost off")
 print(f"DRIVE OK round-39 ({mode})")
+
+# ---------------------------------------------------------------------------
+# round 40 — host-concurrency auditor + thread-ownership twin (PR 20).
+# (a) the static layer's ownership map, generated from the thread-root
+# graph over the REAL planes, names the watchdog / scheduler workers /
+# TCP accept loop as forbidden and leaves the serve dispatcher (the
+# designated jax owner) alone; every Layer-5 finding at HEAD is a
+# reviewed HL403 allowlist entry and the scoped lint CLI exits 0;
+# (b) the runtime twin armed around a REAL socket serve under an
+# injected transient dispatch fault: the guard audits live traffic
+# (checks > 0), objects to none of it, and the responses still match
+# numpy; scheduler workers run under names the static patterns match;
+# (c) a thread wearing a forbidden name is caught at a flightrec
+# observer site; (d) disarmed, the observer registries and spine
+# mutators restore exactly (zero-install contract).
+# ---------------------------------------------------------------------------
+import fnmatch as _tg_fn
+import json as _tg_json
+import socket as _tg_sock
+import subprocess as _tg_sp
+import tempfile as _tg_tmp
+import threading as _tg_th
+
+from harp_tpu.analysis import allowlist as _tg_al
+from harp_tpu.analysis import threadgraph as _tg
+from harp_tpu.schedule import StaticScheduler as _TgSched
+from harp_tpu.serve.engines import ENGINES as _TG_ENGINES
+from harp_tpu.serve.server import Server as _TgServer
+from harp_tpu.serve.transport import TCPFrontEnd as _TgFE
+from harp_tpu.utils import flightrec as _tg_fr
+from harp_tpu.utils import telemetry as _tg_tm
+from harp_tpu.utils import threadguard as _tg_guard
+from harp_tpu.utils.fault import FaultInjector as _TgInj
+
+_tg_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (a) static half: generated map + HEAD findings all reviewed
+_tg_omap = _tg.ownership_map(_tg_repo)
+_tg_pats = _tg_omap["forbidden_thread_patterns"]
+assert "harp-watchdog" in _tg_pats and "harp-serve-tcp" in _tg_pats
+assert any(p.startswith("harp-sched-") for p in _tg_pats)
+assert not any(_tg_fn.fnmatch("harp-serve-dispatch", p)
+               for p in _tg_pats)
+assert _tg_omap["spines"]["reqtrace"]["locked"] is True
+_tg_vs = _tg.analyze_repo(_tg_repo)
+_tg_kept, _tg_sup, _ = _tg_al.apply(_tg_vs, _tg_al.load())
+assert _tg_kept == [] and {v.rule for v in _tg_sup} == {"HL403"}
+_tg_cli = _tg_sp.run(
+    [sys.executable, "-m", "harp_tpu", "lint", "--layer", "threads",
+     "--json"], capture_output=True, text=True, cwd=_tg_repo)
+assert _tg_cli.returncode == 0, _tg_cli.stdout + _tg_cli.stderr
+_tg_row = _tg_json.loads(_tg_cli.stdout.strip().splitlines()[-1])
+assert _tg_row["clean"] is True and _tg_row["stale_allowlist"] == 0
+
+# (b) runtime twin armed around a real-socket serve under chaos
+_tg_regs = (_tg_fr._READBACK_OBSERVERS, _tg_fr._DISPATCH_OBSERVERS,
+            _tg_fr._H2D_OBSERVERS, _tg_fr._CKPT_WRITE_OBSERVERS)
+_tg_before = [list(r) for r in _tg_regs]
+_tg_orig_h2d = _tg_fr.record_h2d
+_tg_rng = np.random.default_rng(40)
+with _tg_tm.scope(True):
+    _tg_state = _TG_ENGINES["kmeans"].synthetic_state(_tg_rng, k=8, d=16)
+    _tg_srv = _TgServer("kmeans", state=_tg_state, mesh=mesh,
+                        ladder=(1, 8), cache_dir=_tg_tmp.mkdtemp(),
+                        budget_action="warn")
+    _tg_srv.startup()
+    _tg_inj = _TgInj(seed=0, fail={"dispatch": (2,)})
+    with _tg_guard.armed() as _tg_g, _tg_inj.arm():
+        _tg_fe = _TgFE(_tg_srv, port=0, max_retries=2).start_in_thread()
+        try:
+            _tg_s = _tg_sock.create_connection(
+                ("127.0.0.1", _tg_fe.port), timeout=60)
+            _tg_f = _tg_s.makefile("rw")
+            _tg_xs = [_tg_rng.normal(size=(2, 16)).astype(np.float32)
+                      for _ in range(6)]
+            for _tg_i, _tg_x in enumerate(_tg_xs):
+                _tg_f.write(_tg_json.dumps(
+                    {"id": _tg_i, "x": _tg_x.tolist()}) + "\n")
+            _tg_f.flush()
+            _tg_got = [_tg_json.loads(_tg_f.readline()) for _ in range(6)]
+            _tg_s.close()
+        finally:
+            _tg_fe.shutdown()
+            _tg_fe.join(60)
+        # scheduler workers run under statically-forbidden names
+        _tg_names = []
+        _TgSched(lambda _x: _tg_names.append(
+            _tg_th.current_thread().name), n_threads=2).schedule([1, 2])
+        assert all(any(_tg_fn.fnmatch(n, p) for p in _tg_pats)
+                   for n in _tg_names)
+        # (c) a forbidden name is caught at an observer site
+        _tg_box = []
+
+        def _tg_evil():
+            try:
+                _tg_fr.readback(jnp.zeros(2))
+            except _tg_guard.ThreadOwnershipError as e:
+                _tg_box.append(e)
+
+        _tg_t = _tg_th.Thread(target=_tg_evil, name="harp-watchdog",
+                              daemon=True)
+        _tg_t.start()
+        _tg_t.join(30)
+        assert len(_tg_box) == 1 and "harp-watchdog" in str(_tg_box[0])
+    assert _tg_inj.injected["dispatch"] == 1
+    assert _tg_fe.runner.fault_retries >= 1
+    assert _tg_g.checks > 0
+    assert _tg_g.violations == [str(_tg_box[0])]  # ONLY the seeded one
+    _tg_cent = _tg_state["centroids"]
+    for _tg_r, _tg_x in zip(_tg_got, _tg_xs):
+        _tg_ref = np.argmin(((_tg_x[:, None, :] - _tg_cent[None]) ** 2
+                             ).sum(-1), 1)
+        assert _tg_r["result"] == _tg_ref.tolist()
+# (d) zero-install after disarm
+assert [list(r) for r in _tg_regs] == _tg_before
+assert _tg_fr.record_h2d is _tg_orig_h2d
+assert _tg_guard.stats()["active"] is False
+
+print(f"threadguard: map generated ({len(_tg_pats)} forbidden patterns, "
+      f"{len(_tg_sup)} reviewed HL403), scoped lint clean, chaos serve "
+      f"audited {_tg_g.checks} site crossings with 0 violations "
+      f"(retry absorbed {_tg_fe.runner.fault_retries} injected fault), "
+      f"forbidden-name readback caught, observers restored exactly")
+print(f"DRIVE OK round-40 ({mode})")

@@ -62,8 +62,10 @@ start_ok=${start_ok:-0}
 
 # harplint preflight: a sprint must never launch with a known
 # relay-burner in the tree (copy traps, per-seed recompiles, >2-word
-# prng_seed kernels — the silicon failures the linter encodes).  Runs on
-# the CPU backend in a couple of seconds; in rehearsal it HARD-FAILS
+# prng_seed kernels, cross-thread jax ownership breaks — the silicon
+# failures the linter encodes).  All FIVE layers run (AST, jaxpr,
+# Mosaic, CommGraph, threads — HL0xx..HL4xx) on the CPU backend in a
+# couple of seconds; in rehearsal it HARD-FAILS
 # (certifying a dirty tree defeats the rehearsal), in a live window it
 # warns and continues — the scarce relay must still be measured, and the
 # lint verdict is in the log for the post-sprint commit to act on.

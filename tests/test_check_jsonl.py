@@ -276,6 +276,23 @@ def test_lint_row_invariants(tmp_path):
     assert ":3:" in errors[2] and "negative" in errors[2]
 
 
+def test_lint_row_accepts_thread_rules_and_rejects_forgeries(tmp_path):
+    """Invariant 6, Layer-5 extension (PR 20): the HL4xx thread rules
+    are registered vocabulary — a row counting them passes, a forged
+    neighbor id fails."""
+    stamp = {"backend": "cpu", "date": "2026-08-06", "commit": "abc1234"}
+    good = {"kind": "lint", "violations": 5, **stamp,
+            "per_rule": {"HL401": 1, "HL402": 1, "HL403": 1,
+                         "HL404": 1, "HL405": 1}}
+    bad = {"kind": "lint", "violations": 1, **stamp,
+           "per_rule": {"HL499": 1}}
+    p = tmp_path / "BENCH_local.jsonl"
+    p.write_text(json.dumps(good) + "\n" + json.dumps(bad) + "\n")
+    errors = check_jsonl.check_file(str(p))
+    assert len(errors) == 1
+    assert ":2:" in errors[0] and "HL499" in errors[0]
+
+
 def _sheet(**over):
     """A valid kmeans.fit byte sheet (the hand-computed Layer-4 shape),
     with per-test forgeries spliced in."""

@@ -6,8 +6,10 @@ fixed tile-local form as a negative), a 3-seed-word ``prng_seed`` toy
 kernel the Mosaic audit must flag WITHOUT hardware, the Layer-4
 CommGraph fixtures (kmeans' hand-computed byte sheet as the HL302
 cross-check, an unledgered psum for HL301, a sabotaged donated-buffer
-re-read for HL303, a loop-invariant allgather for HL304), and the
-repo-wide tier-1 gate: zero unallowlisted violations at HEAD.
+re-read for HL303, a loop-invariant allgather for HL304), the Layer-5
+thread-root fixtures (one sabotaged synthetic plane per HL401–HL405
+plus its clean twin, driven through ``threadgraph.analyze_sources``),
+and the repo-wide tier-1 gate: zero unallowlisted violations at HEAD.
 """
 
 import contextlib
@@ -863,7 +865,7 @@ def test_changed_paths_subset_of_sweep():
 
 
 def test_cli_repo_run_is_clean(capsys):
-    """THE tier-1 gate: zero unallowlisted violations at HEAD, all four
+    """THE tier-1 gate: zero unallowlisted violations at HEAD, all five
     layers, and the machine line passes check_jsonl invariant 6 — with
     the Layer-4 byte sheets riding the row (>= 10 programs; kmeans.fit
     matching the hand-computed sheet exactly)."""
@@ -882,3 +884,308 @@ def test_cli_repo_run_is_clean(capsys):
     assert km["bytes_per_trace"] == 8 * 32 * 4 + 8 * 4 + 4
     assert km["amplified_bytes"] == 2 * km["bytes_per_trace"]
     assert km["collectives"][0]["verb"] == "allreduce"
+
+
+# ---------------------------------------------------------------------------
+# Layer 5 — thread-root graph (HL4xx): one sabotaged plane per rule
+# ---------------------------------------------------------------------------
+
+from harp_tpu.analysis import threadgraph  # noqa: E402
+
+
+def _plane(owners=("main",), name="fix"):
+    return threadgraph.PlaneSpec(name, ("fix.py",), tuple(owners))
+
+
+def _analyze(src, owners=("main",), spine_locked=None):
+    return threadgraph.analyze_sources(
+        _plane(owners), {"fix.py": textwrap.dedent(src)},
+        spine_locked=spine_locked)
+
+
+_HL401_SRC = """
+    import threading
+
+    import jax.numpy as jnp
+
+    class Worker:
+        def start(self):
+            t = threading.Thread(target=self._work, daemon=True,
+                                 name="fix-worker")
+            t.start()
+
+        def _work(self):
+            return jnp.zeros((4,))
+"""
+
+
+def test_hl401_jax_from_non_owner_thread_fires():
+    """The sabotaged twin: a named worker thread whose entry reaches a
+    jax call on a plane where only main owns jax."""
+    vs = _analyze(_HL401_SRC)
+    assert _rules(vs) == ["HL401"]
+    assert "thread:_work" in vs[0].message
+    assert "jnp.zeros" in vs[0].source
+
+
+def test_hl401_designated_owner_is_clean():
+    """The transport-dispatcher shape: the SAME source is clean once the
+    plane declares the thread root a jax owner (serve's
+    thread:_dispatch_loop is the pinned real case)."""
+    assert _analyze(_HL401_SRC, owners=("main", "thread:_work")) == []
+
+
+_HL402_SRC = """
+    import time
+
+    class FrontEnd:
+        async def _run(self):
+            while True:
+                self._drain()
+
+        def _drain(self):
+            time.sleep(0.1)
+            self._done.wait()
+"""
+
+
+def test_hl402_blocking_call_in_event_loop_fires():
+    """time.sleep and an unbounded Event.wait both reachable from the
+    coroutine root freeze every socket the loop owns."""
+    vs = _analyze(_HL402_SRC)
+    assert _rules(vs) == ["HL402"] and len(vs) == 2
+    assert any("time.sleep" in v.message for v in vs)
+    assert any("wait" in v.source for v in vs)
+
+
+def test_hl402_bounded_and_awaited_are_clean():
+    vs = _analyze("""
+        import asyncio
+
+        class FrontEnd:
+            async def _run(self):
+                await asyncio.sleep(0.1)
+                self._done.wait(0.5)
+    """)
+    assert vs == []
+
+
+_HL403_SPINE_SRC = """
+    import threading
+
+    from harp_tpu.utils import reqtrace
+
+    class Pump:
+        def start(self):
+            t = threading.Thread(target=self._pump, daemon=True,
+                                 name="fix-pump")
+            t.start()
+
+        def serve_one(self):
+            rid = reqtrace.tracer.begin(0.0)
+
+        def _pump(self):
+            reqtrace.tracer.event("r1", "deliver")
+"""
+
+
+def test_hl403_spine_written_from_two_roots_unlocked_fires():
+    """The single-writer contract: main and a pump thread both hit the
+    reqtrace spine, whose mutators are NOT verified locked."""
+    vs = _analyze(_HL403_SPINE_SRC, spine_locked={"reqtrace": False})
+    assert _rules(vs) == ["HL403"]
+    assert "reqtrace" in vs[0].message
+    assert "single-writer" in vs[0].message
+
+
+def test_hl403_verified_locked_spine_is_clean():
+    """Same two-root writes, but the spine's own mutators verified as
+    internally locked (the PR-20 reqtrace RLock) — no violation."""
+    assert _analyze(_HL403_SPINE_SRC,
+                    spine_locked={"reqtrace": True}) == []
+
+
+_HL403_ATTR_TMPL = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self.n = 0
+            self._lock = threading.Lock()
+
+        def start(self):
+            t = threading.Thread(target=self._bump, daemon=True,
+                                 name="fix-bump")
+            t.start()
+
+        def bump_from_main(self):
+            {main_write}
+
+        def _bump(self):
+            {thread_write}
+"""
+
+
+def test_hl403_shared_attr_two_roots_no_lock_fires():
+    vs = _analyze(_HL403_ATTR_TMPL.format(
+        main_write="self.n += 1", thread_write="self.n += 1"))
+    assert _rules(vs) == ["HL403"]
+    assert "'n'" in vs[0].message and "no common lock" in vs[0].message
+
+
+def test_hl403_shared_attr_common_lock_is_clean():
+    """Both write paths under self._lock: the lock sets intersect, and
+    __init__ writes are exempt (construction happens-before start)."""
+    vs = _analyze(_HL403_ATTR_TMPL.format(
+        main_write="with self._lock:\n                self.n += 1",
+        thread_write="with self._lock:\n                self.n += 1"))
+    assert vs == []
+
+
+def test_hl404_dispatch_under_lock_fires():
+    """A tracked-executable dispatch AND a jax call inside a with-lock
+    body: 20-150 ms relay round trips while holding the lock."""
+    vs = _analyze("""
+        class Runner:
+            def flush(self, batch):
+                with self._lock:
+                    out = self._exec[0](batch)
+                return out
+
+            def stage(self, a, b):
+                import jax.numpy as jnp
+                with self._lock:
+                    return jnp.dot(a, b)
+    """)
+    assert _rules(vs) == ["HL404"] and len(vs) == 2
+    assert all("holding" in v.message for v in vs)
+
+
+def test_hl404_dispatch_after_lock_release_is_clean():
+    vs = _analyze("""
+        class Runner:
+            def flush(self):
+                with self._lock:
+                    batch = self._q.popleft()
+                return self._exec[0](batch)
+    """)
+    assert vs == []
+
+
+def test_hl405_unjoinable_thread_fires():
+    vs = _analyze("""
+        import threading
+
+        def spawn(fn):
+            t = threading.Thread(target=fn, name="fix-zombie")
+            t.start()
+            return t
+    """)
+    assert _rules(vs) == ["HL405"]
+    assert "daemon" in vs[0].message
+
+
+def test_hl405_daemon_or_bounded_join_is_clean():
+    assert _analyze("""
+        import threading
+
+        def spawn_daemon(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+
+        def spawn_joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join(5.0)
+    """) == []
+
+
+def test_threads_layer_repo_at_head_only_allowlisted_findings():
+    """The Layer-5 HEAD gate at the API level: every finding over the
+    real planes is HL403 and matched by a committed allowlist entry
+    (with its reviewed reason) — nothing unallowlisted, nothing stale
+    among the HL4xx entries."""
+    vs = threadgraph.analyze_repo(ROOT)
+    assert vs, "the four reviewed HL403 findings should exist at HEAD"
+    assert _rules(vs) == ["HL403"]
+    entries = allowlist_mod.load()
+    kept, suppressed, stale = allowlist_mod.apply(vs, entries)
+    assert kept == []
+    assert len(suppressed) == len(vs)
+    assert not any(e["rule"].startswith("HL4") for e in stale)
+
+
+def test_cli_threads_layer_scoped_run_is_clean(capsys):
+    """`lint --layer threads` (the scoped run `--changed` uses): exit 0,
+    every finding allowlisted, and staleness judged ONLY against
+    threads-layer entries (an AST entry can't be proven dead here)."""
+    rc = cli.main(["--json", "--layer", "threads"])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, row
+    assert row["clean"] is True and row["violations"] == 0
+    assert row["allowlisted"] >= 4
+    assert row["stale_allowlist"] == 0
+
+
+def test_planes_for_paths_scopes_changed_runs():
+    """--changed scoping: a plane module maps to its plane; a spine
+    module re-runs every plane (lock verdicts feed all of them); an
+    unrelated file runs none."""
+    assert threadgraph.planes_for_paths(["harp_tpu/ingest.py"]) == \
+        ["ingest"]
+    allp = [p.name for p in threadgraph.PLANES]
+    assert threadgraph.planes_for_paths(
+        ["harp_tpu/utils/reqtrace.py"]) == allp
+    assert threadgraph.planes_for_paths(["harp_tpu/models/kmeans.py"]) \
+        == []
+
+
+def test_spine_lock_verification_reads_the_mutator_bodies():
+    """The verdict is derived from the spine SOURCE, not asserted: a
+    twin ReqTracer with one unlocked mutator flips to False."""
+    spec = next(s for s in threadgraph.SPINES if s.name == "reqtrace")
+    locked = textwrap.dedent("""
+        class ReqTracer:
+            def begin(self, t):
+                with self._lock:
+                    return 1
+            def event(self, rid, name):
+                with self._lock:
+                    pass
+            def end(self, rid, outcome, t):
+                with self._lock:
+                    pass
+            def mark(self, name):
+                with self._lock:
+                    pass
+    """)
+    assert threadgraph._spine_locked_from_source(spec, locked) is True
+    sabotaged = locked.replace(
+        "def mark(self, name):\n        with self._lock:\n            pass",
+        "def mark(self, name):\n        self.rows.append(name)")
+    assert threadgraph._spine_locked_from_source(spec, sabotaged) is False
+    # the REAL reqtrace at HEAD carries the PR-20 RLock
+    verdicts = threadgraph.spine_lock_verdicts(ROOT)
+    assert verdicts["reqtrace"] is True
+
+
+def test_ownership_map_is_generated_from_the_static_graph():
+    """The runtime twin's contract: forbidden patterns are exactly the
+    named non-owner roots the graph discovered (watchdog, scheduler
+    workers, the TCP accept loop) — and the serve dispatcher, a
+    designated owner, is NOT forbidden."""
+    import fnmatch
+
+    omap = threadgraph.ownership_map(ROOT)
+    pats = omap["forbidden_thread_patterns"]
+    assert "harp-watchdog" in pats
+    assert "harp-serve-tcp" in pats
+    assert any(p.startswith("harp-sched-static-") for p in pats)
+    assert any(p.startswith("harp-sched-dyn-") for p in pats)
+    assert not any(fnmatch.fnmatch("harp-serve-dispatch", p)
+                   for p in pats)
+    assert set(omap["spines"]) == {sp.name for sp in threadgraph.SPINES}
+    assert omap["spines"]["reqtrace"]["locked"] is True
+    for name, plane in omap["planes"].items():
+        assert set(plane["forbidden_thread_patterns"]) <= set(pats)
+        assert "main" in plane["jax_owners"]

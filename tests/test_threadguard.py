@@ -1,0 +1,284 @@
+"""threadguard (PR 20) — the runtime twin of harplint Layer 5.
+
+Four contracts pinned here: (1) the ownership map the guard arms is
+GENERATED from the static thread-root graph and matches the names real
+threads actually run under (the sync pin — hand-editing either side
+breaks a test); (2) armed, a forbidden thread is caught at every
+flightrec observer site and at every unlocked-spine mutator, while the
+whole serve plane under chaos (real socket, injected dispatch faults)
+runs clean; (3) disarmed, NOTHING is installed — observer lists and
+spine callables restore to the exact originals; (4) the flagship
+budgets are bit-identical with the guard armed (the PR-3 pattern).
+"""
+
+import fnmatch
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import check_jsonl  # noqa: E402
+from harp_tpu.analysis import threadgraph  # noqa: E402
+from harp_tpu.serve.engines import ENGINES  # noqa: E402
+from harp_tpu.serve.server import Server  # noqa: E402
+from harp_tpu.utils import flightrec, reqtrace, telemetry  # noqa: E402
+from harp_tpu.utils import threadguard  # noqa: E402
+from harp_tpu.utils.threadguard import ThreadOwnershipError  # noqa: E402
+
+
+def _run_named(name, fn):
+    """Run ``fn`` on a thread named ``name``; return the exception it
+    raised (or None)."""
+    box = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised by caller
+            box.append(e)
+
+    t = threading.Thread(target=run, name=name, daemon=True)
+    t.start()
+    t.join(30)
+    return box[0] if box else None
+
+
+# ---------------------------------------------------------------------------
+# The guard itself: observer sites + unlocked-spine mutators
+# ---------------------------------------------------------------------------
+
+def test_forbidden_thread_caught_at_observer_site():
+    """A thread matching a forbidden pattern trips the guard the moment
+    it crosses a flightrec observer site; the same op from main (an
+    owner everywhere) is clean."""
+    omap = {"forbidden_thread_patterns": ["evil-*"], "spines": {}}
+    with threadguard.armed(omap) as g:
+        flightrec.readback(jnp.zeros(2))          # main: allowed
+        before = g.checks
+        err = _run_named("evil-1",
+                         lambda: flightrec.readback(jnp.zeros(2)))
+        assert isinstance(err, ThreadOwnershipError)
+        assert "evil-1" in str(err) and "evil-*" in str(err)
+        assert g.checks >= before + 1
+        assert g.violations
+    assert threadguard.stats()["active"] is False
+
+
+def test_forbidden_thread_caught_at_unlocked_spine_mutator():
+    """A spine the static layer could NOT verify as locked gets its
+    mutators wrapped: a forbidden thread writing it raises BEFORE the
+    write lands."""
+    omap = {"forbidden_thread_patterns": ["evil-*"],
+            "spines": {"comm_ledger": {
+                "locked": False, "module": "harp_tpu.utils.telemetry",
+                "obj": "ledger", "mutators": ["record"]}}}
+    with telemetry.scope(True):
+        with threadguard.armed(omap):
+            telemetry.ledger.record("allreduce", jnp.zeros(4),
+                                    axis="workers")  # main: allowed
+            before = str(telemetry.ledger._tags)
+            err = _run_named(
+                "evil-2",
+                lambda: telemetry.ledger.record(
+                    "allreduce", jnp.zeros(4), axis="workers"))
+            assert isinstance(err, ThreadOwnershipError)
+            assert "comm_ledger.record" in str(err)
+            assert str(telemetry.ledger._tags) == before  # write rejected
+    # restored: the spine records unguarded again
+    assert telemetry.ledger.record.__name__ == "record"
+
+
+def test_verified_locked_spine_is_not_wrapped():
+    """THE asymmetry sync pin: the runtime honors the static lock
+    verdicts — reqtrace (verified RLocked at HEAD) keeps its original
+    mutators while unlocked spines are wrapped."""
+    omap = threadgraph.ownership_map(ROOT)
+    assert omap["spines"]["reqtrace"]["locked"] is True
+    orig_begin = reqtrace.tracer.begin
+    orig_record = telemetry.ledger.record
+    with threadguard.armed():
+        assert reqtrace.tracer.begin == orig_begin       # untouched
+        assert telemetry.ledger.record != orig_record    # wrapped
+        for sp_name, sp in omap["spines"].items():
+            if sp["locked"]:
+                continue
+            mod = __import__(sp["module"], fromlist=["_"])
+            target = getattr(mod, sp["obj"]) if sp["obj"] else mod
+            for mut in sp["mutators"]:
+                assert getattr(target, mut).__wrapped__ is not None
+    assert telemetry.ledger.record == orig_record        # restored
+
+
+def test_disarmed_installs_nothing():
+    """The zero-cost contract: before arm and after disarm the observer
+    registries hold exactly what they held, and every spine callable is
+    the exact original (identity, not equality-of-behavior)."""
+    registries = (flightrec._READBACK_OBSERVERS,
+                  flightrec._DISPATCH_OBSERVERS,
+                  flightrec._H2D_OBSERVERS,
+                  flightrec._CKPT_WRITE_OBSERVERS)
+    before = [list(r) for r in registries]
+    orig = (flightrec.record_h2d, flightrec.record_readback,
+            flightrec.record_bucket, telemetry.ledger.record)
+    with threadguard.armed():
+        assert all(len(r) == len(b) + 1
+                   for r, b in zip(registries, before))
+    assert [list(r) for r in registries] == before
+    assert (flightrec.record_h2d, flightrec.record_readback,
+            flightrec.record_bucket) == orig[:3]
+    assert flightrec.record_h2d is orig[0]
+    assert telemetry.ledger.record == orig[3]
+    assert threadguard.stats()["active"] is False
+    assert threadguard.stats()["patterns"] == []
+
+
+def test_arm_is_idempotent_and_disarm_total():
+    with threadguard.armed() as g:
+        threadguard.arm()                   # second arm: no double-wrap
+        assert len(flightrec._READBACK_OBSERVERS) == 1
+        flightrec.readback(jnp.zeros(1))
+        assert g.checks >= 1
+    assert flightrec._READBACK_OBSERVERS == []
+
+
+# ---------------------------------------------------------------------------
+# Sync pins: static map <-> the names real threads run under
+# ---------------------------------------------------------------------------
+
+def test_scheduler_worker_names_match_the_static_patterns():
+    """The f-string thread names in schedule.py and the patterns the
+    graph extracted from them must agree — renaming either side without
+    the other fails here."""
+    from harp_tpu.schedule import DynamicScheduler, StaticScheduler
+
+    pats = threadgraph.ownership_map(ROOT)["forbidden_thread_patterns"]
+    seen = []
+    StaticScheduler(lambda x: seen.append(
+        threading.current_thread().name), n_threads=2).schedule([1, 2])
+    DynamicScheduler(lambda x: seen.append(
+        threading.current_thread().name), n_threads=2).schedule([1, 2])
+    assert len(seen) == 4
+    for name in seen:
+        assert any(fnmatch.fnmatch(name, p) for p in pats), (name, pats)
+
+
+def test_watchdog_timer_name_matches_the_static_pattern():
+    from harp_tpu.utils.timing import HangWatchdog
+
+    pats = threadgraph.ownership_map(ROOT)["forbidden_thread_patterns"]
+    wd = HangWatchdog(timeout_s=600, _exit=lambda code: None)
+    wd.arm("sync-pin")
+    try:
+        assert wd._timer.name == "harp-watchdog"
+        assert any(fnmatch.fnmatch(wd._timer.name, p) for p in pats)
+    finally:
+        wd.cancel()
+
+
+# ---------------------------------------------------------------------------
+# THE chaos drill: real socket, injected faults, guard armed
+# ---------------------------------------------------------------------------
+
+def test_tcp_chaos_serve_runs_clean_with_guard_armed(mesh, tmp_path):
+    """The acceptance run: a real-socket TCP serve under injected
+    transient dispatch faults with the guard ARMED — zero ownership
+    violations (the dispatcher owns jax; the accept loop, forbidden,
+    never crosses a guarded site), the guard non-vacuously checked, the
+    invariant-9 ledger reconciles, and the exported request timeline is
+    invariant-11 clean.  Along the way the serve plane's live thread
+    names are pinned to the static map: the TCP loop IS forbidden, the
+    dispatcher is NOT."""
+    import socket
+
+    from harp_tpu.serve.transport import TCPFrontEnd
+    from harp_tpu.utils.fault import FaultInjector
+
+    pats = threadgraph.ownership_map(ROOT)["forbidden_thread_patterns"]
+    rng = np.random.default_rng(20)
+    with telemetry.scope(True):
+        state = ENGINES["kmeans"].synthetic_state(rng, k=8, d=16)
+        srv = Server("kmeans", state=state, mesh=mesh, ladder=(1, 8),
+                     cache_dir=str(tmp_path / "aot"),
+                     budget_action="warn")
+        srv.startup()
+        inj = FaultInjector(seed=0, fail={"dispatch": (2,)})
+        with threadguard.armed() as g, inj.arm():
+            fe = TCPFrontEnd(srv, port=0, max_retries=2).start_in_thread()
+            try:
+                live = {t.name for t in threading.enumerate()}
+                assert "harp-serve-tcp" in live
+                assert "harp-serve-dispatch" in live
+                assert any(fnmatch.fnmatch("harp-serve-tcp", p)
+                           for p in pats)
+                assert not any(fnmatch.fnmatch("harp-serve-dispatch", p)
+                               for p in pats)
+                s = socket.create_connection(("127.0.0.1", fe.port),
+                                             timeout=60)
+                f = s.makefile("rw")
+                xs = [rng.normal(size=(1 + i % 3, 16)).astype(np.float32)
+                      for i in range(12)]
+                for i, x in enumerate(xs):
+                    f.write(json.dumps({"id": i, "x": x.tolist()}) + "\n")
+                f.flush()
+                got = [json.loads(f.readline()) for _ in range(12)]
+                s.close()
+            finally:
+                fe.shutdown()
+                fe.join(60)
+        assert inj.injected["dispatch"] == 1      # chaos actually ran
+        assert fe.runner.fault_retries >= 1
+        cent = state["centroids"]
+        for r, x in zip(got, xs):
+            ref = np.argmin(((x[:, None, :] - cent[None]) ** 2).sum(-1), 1)
+            assert r["result"] == ref.tolist()
+        # the guard saw real traffic and objected to none of it
+        assert g.checks > 0
+        assert g.violations == []
+        # invariant 9: every offered request terminated exactly once
+        # (served rides the reqtrace ledger; shed/failed on the runner)
+        rs = fe.runner
+        tr = reqtrace.tracer
+        assert tr.counts["served"] + rs.shed + rs.failed == 12
+        assert tr.counts["served"] == 12 and tr.summary()["open"] == 0
+        p = tmp_path / "chaos.jsonl"
+        telemetry.export_timeline(str(p))
+    assert check_jsonl.check_file(str(p)) == []
+
+
+# ---------------------------------------------------------------------------
+# Flagship budget pins: armed guard costs no flight traffic
+# ---------------------------------------------------------------------------
+
+def test_flagship_budget_pin_unchanged_with_guard_armed(mesh):
+    """The PR-3 flagship budget — 1 dispatch, 1 stacked readback, 0
+    steady compiles, 0 H2D — must hold bit-for-bit with the ownership
+    guard armed: checks run, traffic does not change."""
+    import harp_tpu.models.mfsgd as MF
+
+    cfg = MF.MFSGDConfig(rank=4, algo="dense", u_tile=8, i_tile=8,
+                         entry_cap=32)
+    with telemetry.scope():
+        m = MF.MFSGD(64, 48, cfg, mesh, seed=3)
+        u, i, v = MF.synthetic_ratings(64, 48, 600, rank=4, seed=3)
+        m.set_ratings(u, i, v)
+        m.train_epoch()       # warmup
+        m.compile_epochs(3)
+        m.train_epochs(3)     # steady (stacked-readback ops compiled)
+        with threadguard.armed() as g:
+            with flightrec.budget(compiles=0, dispatches=1, readbacks=1,
+                                  h2d_bytes=0,
+                                  tag="mfsgd.train_epochs.guard") as b:
+                m.train_epochs(3)
+        assert b.spent()["dispatches"] == 1
+        assert b.spent()["readbacks"] == 1
+        assert g.checks > 0               # the guard actually audited
+        assert g.violations == []
